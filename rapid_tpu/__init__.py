@@ -1,0 +1,17 @@
+"""rapid_tpu: a TPU-native distributed membership framework.
+
+A ground-up rebuild of the capabilities of Rapid (lalithsuresh/rapid) —
+expander-based monitoring overlays, multi-node cut detection, and leaderless
+Fast Paxos — designed for TPU execution: the protocol hot paths (ring
+topology, watermark tallies, vote counting) are batched JAX kernels over N
+virtual nodes sharded across a device mesh, while the host-side asyncio
+runtime speaks the same two-interface messaging seam as the reference
+(IMessagingClient / IMessagingServer).
+"""
+
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import Endpoint, NodeId
+
+__version__ = "0.1.0"
+
+__all__ = ["Settings", "Endpoint", "NodeId", "__version__"]

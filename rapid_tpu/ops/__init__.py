@@ -1,0 +1,33 @@
+from rapid_tpu.ops.consensus import TallyResult, tally_candidates, tally_sorted
+from rapid_tpu.ops.cut_detection import (
+    CutResult,
+    CutState,
+    alerts_to_report_matrix,
+    process_alert_batch,
+)
+from rapid_tpu.ops.hashing import join64, lex_argsort, masked_set_hash, mix32, split64
+from rapid_tpu.ops.rings import (
+    RingTopology,
+    endpoint_ring_keys,
+    predecessor_of_keys,
+    ring_topology,
+)
+
+__all__ = [
+    "TallyResult",
+    "tally_candidates",
+    "tally_sorted",
+    "CutResult",
+    "CutState",
+    "alerts_to_report_matrix",
+    "process_alert_batch",
+    "join64",
+    "lex_argsort",
+    "masked_set_hash",
+    "mix32",
+    "split64",
+    "RingTopology",
+    "endpoint_ring_keys",
+    "predecessor_of_keys",
+    "ring_topology",
+]

@@ -1,0 +1,109 @@
+"""Batched Fast-Paxos vote tallies on device.
+
+The reference counts identical proposals in a hash map per receiving node
+(``FastPaxos.java:125-156``, with the comment that votesReceived "should be a
+bitset"). Here a whole configuration's fast round is tallied in one kernel
+over N vote slots: proposals are 64-bit set-hashes (uint32 hi/lo lanes), and
+the decision rule is the reference's: decided iff
+``total_votes >= N - F`` and ``max identical votes >= N - F`` with
+``F = floor((N-1)/4)``.
+
+Two kernels:
+- ``tally_candidates`` — counts votes against a small candidate list; every
+  reduction is a plain sum, so it shards over N with psum (the multi-chip
+  path).
+- ``tally_sorted`` — no candidate knowledge: sort the vote hashes and find
+  the longest run (single-chip / debugging path).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from rapid_tpu.ops.hashing import lex_argsort
+# Single source of truth for the decision threshold: plain arithmetic, works
+# on Python ints and JAX arrays alike.
+from rapid_tpu.protocol.fast_paxos import fast_paxos_quorum as fast_paxos_quorum_size
+
+
+class TallyResult(NamedTuple):
+    decided: jnp.ndarray  # scalar bool
+    winner_hi: jnp.ndarray  # uint32 (0 when undecided)
+    winner_lo: jnp.ndarray
+    max_count: jnp.ndarray  # int32 votes for the best proposal
+    total_votes: jnp.ndarray  # int32 valid votes seen
+
+
+@jax.jit
+def tally_candidates(
+    vote_hi: jnp.ndarray,
+    vote_lo: jnp.ndarray,
+    vote_valid: jnp.ndarray,
+    cand_hi: jnp.ndarray,
+    cand_lo: jnp.ndarray,
+    cand_valid: jnp.ndarray,
+    n_members: jnp.ndarray,
+) -> TallyResult:
+    """Count identical votes against C candidate proposals.
+
+    vote_*: [N] per-slot vote hash lanes + validity (has this member voted).
+    cand_*: [C] candidate proposal hashes (C small; from cohort proposals).
+    """
+    matches = (
+        vote_valid[None, :]
+        & cand_valid[:, None]
+        & (vote_hi[None, :] == cand_hi[:, None])
+        & (vote_lo[None, :] == cand_lo[:, None])
+    )
+    counts = jnp.sum(matches, axis=1, dtype=jnp.int32)  # [C]
+    total = jnp.sum(vote_valid, dtype=jnp.int32)
+    best = jnp.argmax(counts)
+    max_count = counts[best]
+    quorum = fast_paxos_quorum_size(n_members)
+    decided = (total >= quorum) & (max_count >= quorum)
+    return TallyResult(
+        decided=decided,
+        winner_hi=jnp.where(decided, cand_hi[best], jnp.uint32(0)),
+        winner_lo=jnp.where(decided, cand_lo[best], jnp.uint32(0)),
+        max_count=max_count,
+        total_votes=total,
+    )
+
+
+@jax.jit
+def tally_sorted(
+    vote_hi: jnp.ndarray,
+    vote_lo: jnp.ndarray,
+    vote_valid: jnp.ndarray,
+    n_members: jnp.ndarray,
+) -> TallyResult:
+    """Longest-identical-run tally without candidate knowledge: sort votes by
+    (invalid, hi, lo) and segment-count runs of equal hashes."""
+    n = vote_hi.shape[0]
+    invalid = (~vote_valid).astype(jnp.uint32)
+    order = lex_argsort((invalid, vote_hi, vote_lo))
+    hi_s = vote_hi[order]
+    lo_s = vote_lo[order]
+    valid_s = vote_valid[order]
+
+    idx = jnp.arange(n, dtype=jnp.int32)
+    new_run = (idx == 0) | (hi_s != jnp.roll(hi_s, 1)) | (lo_s != jnp.roll(lo_s, 1))
+    new_run = new_run | ~valid_s  # invalid tail never forms runs
+    run_id = jnp.cumsum(new_run.astype(jnp.int32)) - 1
+    counts = jax.ops.segment_sum(valid_s.astype(jnp.int32), run_id, num_segments=n)
+    best_run = jnp.argmax(counts)
+    max_count = counts[best_run]
+    first_of_best = jnp.argmax(run_id == best_run)  # first True index
+    total = jnp.sum(vote_valid, dtype=jnp.int32)
+    quorum = fast_paxos_quorum_size(n_members)
+    decided = (total >= quorum) & (max_count >= quorum)
+    return TallyResult(
+        decided=decided,
+        winner_hi=jnp.where(decided, hi_s[first_of_best], jnp.uint32(0)),
+        winner_lo=jnp.where(decided, lo_s[first_of_best], jnp.uint32(0)),
+        max_count=max_count,
+        total_votes=total,
+    )

@@ -1,0 +1,118 @@
+"""Batched multi-node cut detection on device.
+
+The reference tallies alerts one at a time through hash maps
+(``MultiNodeCutDetector.java:84-128``); here the whole detector state is a
+dense ``reports[N, K]`` bool matrix and one batch of alerts is processed by a
+single fused kernel: OR-in the new reports (per-(subject, ring) dedup is the
+OR), row-sum the tallies, apply the H/L watermark, run the implicit
+edge-invalidation pass (``MultiNodeCutDetector.java:137-164``), and re-check.
+
+Per-batch semantics match the union-of-proposals the membership service
+consumes per BatchedAlertMessage (``MembershipService.java:300-354``): a
+proposal is released iff at least one subject is past H and none sits in
+[L, H) after implicit invalidation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class CutState(NamedTuple):
+    """reports[n, k] — per-(subject, ring) report bits; seen_down — whether any
+    DOWN alert was applied since the last clear (gates invalidation, matching
+    MultiNodeCutDetector.java:139-142); released[n] — subjects already emitted
+    in an earlier batch's proposal (the reference clears its proposal set on
+    release, MultiNodeCutDetector.java:120-121, so they must not re-propose)."""
+
+    reports: jnp.ndarray
+    seen_down: jnp.ndarray
+    released: jnp.ndarray
+
+    @staticmethod
+    def create(n: int, k: int) -> "CutState":
+        return CutState(
+            reports=jnp.zeros((n, k), dtype=bool),
+            seen_down=jnp.zeros((), dtype=bool),
+            released=jnp.zeros((n,), dtype=bool),
+        )
+
+
+class CutResult(NamedTuple):
+    state: CutState
+    propose: jnp.ndarray  # scalar bool: a cut is ready
+    proposal_mask: jnp.ndarray  # [n] bool: members of the cut (when propose)
+    tally: jnp.ndarray  # [n] int32 report counts (diagnostics)
+
+
+@partial(jax.jit, static_argnames=("h", "l"))
+def process_alert_batch(
+    state: CutState,
+    new_reports: jnp.ndarray,
+    batch_has_down: jnp.ndarray,
+    inval_obs_idx: jnp.ndarray,
+    subject_mask: jnp.ndarray,
+    h: int,
+    l: int,
+) -> CutResult:
+    """Apply one batch of alerts.
+
+    new_reports:    [n, k] bool — report bits to OR in (dedup via OR).
+    batch_has_down: scalar bool — batch contained any DOWN alert.
+    inval_obs_idx:  [k, n] int32 — per (ring, subject): the slot whose own
+                    failure implies this edge (observer for present nodes,
+                    expected observer for joiners); -1 disables.
+    subject_mask:   [n] bool — slots that may legitimately be reported on
+                    (present members + pending joiners).
+    """
+    n, k = state.reports.shape
+    reports = (state.reports | new_reports) & subject_mask[:, None]
+    seen_down = state.seen_down | batch_has_down
+
+    tally = jnp.sum(reports, axis=1, dtype=jnp.int32)
+    stable = tally >= h
+    flux = (tally >= l) & (tally < h)
+    in_union = stable | flux
+
+    # Implicit edge invalidation: for every subject in flux, edges whose
+    # (expected) observer is itself failing/joining are auto-reported. The
+    # union (stable | flux) is invariant under the pass, so one masked OR is
+    # the fixpoint (see MultiNodeCutDetector.java:146-159).
+    obs = inval_obs_idx.T  # [n, k]
+    obs_in_union = jnp.where(obs >= 0, in_union[jnp.clip(obs, 0, n - 1)], False)
+    implicit = flux[:, None] & obs_in_union
+    reports = jnp.where(seen_down, reports | implicit, reports) & subject_mask[:, None]
+
+    tally2 = jnp.sum(reports, axis=1, dtype=jnp.int32)
+    stable2 = tally2 >= h
+    flux2 = (tally2 >= l) & (tally2 < h)
+    fresh_stable = stable2 & ~state.released
+    propose = jnp.any(fresh_stable) & ~jnp.any(flux2)
+    proposal_mask = fresh_stable & propose
+
+    return CutResult(
+        state=CutState(
+            reports=reports,
+            seen_down=seen_down,
+            released=state.released | proposal_mask,
+        ),
+        propose=propose,
+        proposal_mask=proposal_mask,
+        tally=tally2,
+    )
+
+
+def alerts_to_report_matrix(n: int, k: int, dst_idx, ring_numbers) -> jnp.ndarray:
+    """Scatter a list of (subject slot, ring) alerts into an [n, k] bool
+    matrix. Inputs are index arrays of equal length; negative dst entries are
+    ignored (padding)."""
+    dst_idx = jnp.asarray(dst_idx, dtype=jnp.int32)
+    ring_numbers = jnp.asarray(ring_numbers, dtype=jnp.int32)
+    valid = (dst_idx >= 0) & (ring_numbers >= 0) & (ring_numbers < k)
+    flat = jnp.where(valid, dst_idx * k + ring_numbers, n * k)
+    out = jnp.zeros((n * k + 1,), dtype=bool).at[flat].set(True)
+    return out[: n * k].reshape(n, k)

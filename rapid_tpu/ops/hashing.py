@@ -1,0 +1,70 @@
+"""Device-side hashing & sorting primitives.
+
+JAX runs with 32-bit integers by default (x64 disabled), so 64-bit ring keys
+and proposal identities are carried as (hi, lo) uint32 lane pairs. Sorting by
+a 64-bit key uses LSD radix composition of stable 32-bit argsorts, which XLA
+compiles to efficient on-device sorts.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def split64(values) -> tuple:
+    """Split an array/list of python 64-bit ints into (hi, lo) uint32 arrays."""
+    arr = np.asarray([int(v) & 0xFFFFFFFFFFFFFFFF for v in values], dtype=np.uint64)
+    hi = (arr >> np.uint64(32)).astype(np.uint32)
+    lo = (arr & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return jnp.asarray(hi), jnp.asarray(lo)
+
+
+def join64(hi, lo) -> np.ndarray:
+    """Rejoin device (hi, lo) uint32 lanes into numpy uint64 (host side)."""
+    hi = np.asarray(hi, dtype=np.uint64)
+    lo = np.asarray(lo, dtype=np.uint64)
+    return (hi << np.uint64(32)) | lo
+
+
+def lex_argsort(keys: tuple) -> jnp.ndarray:
+    """Stable argsort by a tuple of equal-length integer arrays, most
+    significant key first. LSD radix: stable-sort by the least significant
+    key, then re-sort by each more significant key in turn."""
+    order = None
+    for key in reversed(keys):
+        if order is None:
+            order = jnp.argsort(key, stable=True)
+        else:
+            order = order[jnp.argsort(key[order], stable=True)]
+    return order
+
+
+def mix32(x: jnp.ndarray) -> jnp.ndarray:
+    """A murmur3-style 32-bit finalizer: cheap per-lane avalanche on device."""
+    x = jnp.asarray(x, dtype=jnp.uint32)
+    x ^= x >> 16
+    x = x * jnp.uint32(0x85EBCA6B)
+    x ^= x >> 13
+    x = x * jnp.uint32(0xC2B2AE35)
+    x ^= x >> 16
+    return x
+
+
+def masked_set_hash(hi: jnp.ndarray, lo: jnp.ndarray, mask: jnp.ndarray) -> tuple:
+    """Order-independent 64-bit identity for a *set* of members, given
+    per-member (hi, lo) identity lanes and a membership mask.
+
+    Commutative (XOR + sum lanes) so it shards over the N axis with psum and
+    never depends on device-side ordering. Used for proposal identities and
+    engine configuration ids (host configuration ids use the sequential fold
+    in rapid_tpu.protocol.view for reference parity).
+    """
+    mask = mask.astype(jnp.uint32)
+    mixed_hi = mix32(hi ^ jnp.uint32(0x9E3779B9)) * mask
+    mixed_lo = mix32(lo ^ jnp.uint32(0x85EBCA77)) * mask
+    # Wrapping-sum folds (sum-of-hashes multiset hash): commutative, so the
+    # sharded path can reduce them with a plain psum over the N axis.
+    h1 = jnp.sum(mixed_hi, dtype=jnp.uint32) + jnp.sum(mask, dtype=jnp.uint32)
+    h2 = jnp.sum(mixed_lo, dtype=jnp.uint32)
+    return mix32(h1), mix32(h2 + h1)

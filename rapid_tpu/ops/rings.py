@@ -1,0 +1,114 @@
+"""Device kernels for the K-ring expander topology.
+
+The reference maintains K TreeSets and answers successor/predecessor queries
+one node at a time (``MembershipView.java:234-322``). On TPU the whole
+topology is one batched computation: N node slots carry K seeded 64-bit hash
+keys (as uint32 hi/lo lanes); for each ring we argsort the alive slots and
+read every node's observer (ring successor) and subject (ring predecessor) in
+one gather. Dynamic membership is a padded ``alive`` mask — adds/deletes flip
+mask bits and the next ``ring_topology`` call re-derives the permutations,
+keeping all shapes static for XLA.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rapid_tpu.ops.hashing import lex_argsort
+from rapid_tpu.protocol.view import ring_key
+
+
+class RingTopology(NamedTuple):
+    """Batched observer/subject tables for all K rings.
+
+    obs_idx[k, i]  = slot of the observer (ring-k successor) of slot i, or -1
+    subj_idx[k, i] = slot of the subject (ring-k predecessor) of slot i, or -1
+    order[k, p]    = slot at sorted ring position p (alive slots first)
+
+    Entries are -1 for dead slots and when fewer than 2 nodes are alive
+    (matching MembershipView.java:240-242's empty observer list).
+    """
+
+    obs_idx: jnp.ndarray
+    subj_idx: jnp.ndarray
+    order: jnp.ndarray
+
+
+def endpoint_ring_keys(endpoints, k: int):
+    """Host-side: K seeded 64-bit ring keys per endpoint, split into uint32
+    lanes of shape [K, N]. Uses the exact key function of the host view so
+    device and host topologies agree bit-for-bit."""
+    keys = np.asarray(
+        [[ring_key(ep, seed) for ep in endpoints] for seed in range(k)], dtype=np.uint64
+    )
+    hi = (keys >> np.uint64(32)).astype(np.uint32)
+    lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return jnp.asarray(hi), jnp.asarray(lo)
+
+
+def _ring_topology_single(key_hi, key_lo, alive):
+    """One ring: returns (obs_idx[N], subj_idx[N], order[N])."""
+    n = key_hi.shape[0]
+    dead = (~alive).astype(jnp.uint32)
+    order = lex_argsort((dead, key_hi, key_lo))  # alive slots first, by 64-bit key
+    n_alive = jnp.sum(alive.astype(jnp.int32))
+
+    positions = jnp.arange(n, dtype=jnp.int32)
+    in_ring = positions < n_alive
+    succ_pos = jnp.where(positions + 1 >= n_alive, 0, positions + 1)
+    pred_pos = jnp.where(positions - 1 < 0, n_alive - 1, positions - 1)
+    valid = in_ring & (n_alive >= 2)
+    succ_slot = jnp.where(valid, order[succ_pos], -1)
+    pred_slot = jnp.where(valid, order[pred_pos], -1)
+
+    obs_idx = jnp.full((n,), -1, dtype=jnp.int32).at[order].set(succ_slot)
+    subj_idx = jnp.full((n,), -1, dtype=jnp.int32).at[order].set(pred_slot)
+    return obs_idx, subj_idx, order.astype(jnp.int32)
+
+
+@jax.jit
+def ring_topology(key_hi: jnp.ndarray, key_lo: jnp.ndarray, alive: jnp.ndarray) -> RingTopology:
+    """All K rings at once: key_hi/key_lo are [K, N] uint32, alive is [N] bool."""
+    obs, subj, order = jax.vmap(_ring_topology_single, in_axes=(0, 0, None))(
+        key_hi, key_lo, alive
+    )
+    return RingTopology(obs_idx=obs, subj_idx=subj, order=order)
+
+
+@jax.jit
+def predecessor_of_keys(
+    key_hi: jnp.ndarray,
+    key_lo: jnp.ndarray,
+    alive: jnp.ndarray,
+    query_hi: jnp.ndarray,
+    query_lo: jnp.ndarray,
+) -> jnp.ndarray:
+    """Expected observers of joiners: for each query key (one per ring per
+    joiner), the alive slot that precedes it on that ring — the semantics of
+    ``getExpectedObserversOf`` (MembershipView.java:292-322).
+
+    key_hi/key_lo: [K, N]; query_hi/query_lo: [K, J]. Returns [K, J] slot
+    indices (-1 when no node is alive). Rank is computed by a masked
+    comparison sum — O(N·J) elementwise work that maps cleanly onto sharded N.
+    """
+
+    n_alive = jnp.sum(alive.astype(jnp.int32))
+    dead = (~alive).astype(jnp.uint32)
+
+    def one_ring(khi, klo, qhi, qlo):
+        order = lex_argsort((dead, khi, klo))
+
+        def one_query(h, low):
+            less = (khi < h) | ((khi == h) & (klo < low))
+            rank = jnp.sum((less & alive).astype(jnp.int32))
+            # Predecessor = alive node at sorted position (rank - 1) mod n_alive.
+            pred_pos = jnp.where(rank - 1 < 0, n_alive - 1, rank - 1)
+            return jnp.where(n_alive >= 1, order[pred_pos], -1).astype(jnp.int32)
+
+        return jax.vmap(one_query)(qhi, qlo)
+
+    return jax.vmap(one_ring)(key_hi, key_lo, query_hi, query_lo)

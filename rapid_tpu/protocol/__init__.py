@@ -1,0 +1,22 @@
+from rapid_tpu.protocol.cut_detector import MultiNodeCutDetector
+from rapid_tpu.protocol.events import ClusterEvents, ClusterStatusChange, NodeStatusChange
+from rapid_tpu.protocol.fast_paxos import FastPaxos, fast_paxos_quorum
+from rapid_tpu.protocol.metadata import MetadataManager
+from rapid_tpu.protocol.paxos import Paxos, select_proposal_using_coordinator_rule
+from rapid_tpu.protocol.view import Configuration, MembershipView, configuration_id_of, ring_key
+
+__all__ = [
+    "MultiNodeCutDetector",
+    "ClusterEvents",
+    "ClusterStatusChange",
+    "NodeStatusChange",
+    "FastPaxos",
+    "fast_paxos_quorum",
+    "MetadataManager",
+    "Paxos",
+    "select_proposal_using_coordinator_rule",
+    "Configuration",
+    "MembershipView",
+    "configuration_id_of",
+    "ring_key",
+]

@@ -1,0 +1,40 @@
+"""Subscription event model (reference: ClusterEvents.java, ClusterStatusChange.java,
+NodeStatusChange.java)."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Tuple
+
+from rapid_tpu.protocol.metadata import FrozenMetadata
+from rapid_tpu.types import EdgeStatus, Endpoint
+
+
+class ClusterEvents(enum.Enum):
+    """ClusterEvents.java:19-23 (VIEW_CHANGE_ONE_STEP_FAILED is declared but
+    never fired by the reference either; kept for API parity)."""
+
+    VIEW_CHANGE_PROPOSAL = "VIEW_CHANGE_PROPOSAL"
+    VIEW_CHANGE = "VIEW_CHANGE"
+    VIEW_CHANGE_ONE_STEP_FAILED = "VIEW_CHANGE_ONE_STEP_FAILED"
+    KICKED = "KICKED"
+
+
+@dataclass(frozen=True)
+class NodeStatusChange:
+    """NodeStatusChange.java:24-40."""
+
+    endpoint: Endpoint
+    status: EdgeStatus
+    metadata: FrozenMetadata = ()
+
+
+@dataclass(frozen=True)
+class ClusterStatusChange:
+    """ClusterStatusChange.java:20-34: (configuration id, full membership,
+    delta of status changes)."""
+
+    configuration_id: int
+    membership: Tuple[Endpoint, ...]
+    status_changes: Tuple[NodeStatusChange, ...]

@@ -1,0 +1,149 @@
+"""Leaderless Fast Paxos: count identical cut proposals; fall back to classic
+Paxos on a jittered timer.
+
+Semantics follow ``FastPaxos.java``: every node broadcasts its proposal as an
+implicit fast-round phase2b vote; a node decides once it has seen
+``N - F`` votes total *and* ``N - F`` votes for one identical proposal, where
+``F = floor((N-1)/4)`` (``FastPaxos.java:125-156``). Each ``propose`` also arms
+a classic-round fallback after an expovariate jitter with rate 1/N over a base
+delay (``FastPaxos.java:200-203``), cancelled on decision.
+
+The same tally runs batched on TPU in ``rapid_tpu.ops.consensus``; this class
+is the per-node host engine and oracle.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Callable, Dict, Optional, Sequence, Set, Tuple
+
+from rapid_tpu.protocol.paxos import BroadcastFn, OnDecideFn, Paxos, SendFn
+from rapid_tpu.types import (
+    ConsensusResponse,
+    Endpoint,
+    FastRoundPhase2bMessage,
+    Phase1aMessage,
+    Phase1bMessage,
+    Phase2aMessage,
+    Phase2bMessage,
+    RapidRequest,
+    RapidResponse,
+)
+from rapid_tpu.utils.clock import CancelHandle, Clock
+
+BASE_DELAY_MS = 1000
+
+
+def fast_paxos_quorum(n: int) -> int:
+    """N - F with F = floor((N-1)/4) (FastPaxos.java:145-146)."""
+    return n - (n - 1) // 4
+
+
+class FastPaxos:
+    def __init__(
+        self,
+        my_addr: Endpoint,
+        configuration_id: int,
+        membership_size: int,
+        broadcast_fn: BroadcastFn,
+        send_fn: SendFn,
+        on_decide: OnDecideFn,
+        clock: Clock,
+        consensus_fallback_base_delay_ms: int = BASE_DELAY_MS,
+        rng: Optional[random.Random] = None,
+    ) -> None:
+        self.my_addr = my_addr
+        self.configuration_id = configuration_id
+        self.n = membership_size
+        self._broadcast = broadcast_fn
+        self._clock = clock
+        self._base_delay_ms = consensus_fallback_base_delay_ms
+        self._rng = rng if rng is not None else random.Random()
+        self._votes_per_proposal: Dict[Tuple[Endpoint, ...], int] = {}
+        self._votes_received: Set[Endpoint] = set()
+        self.decided = False
+        self._fallback_task: Optional[CancelHandle] = None
+
+        def on_decide_wrapped(hosts: Tuple[Endpoint, ...]) -> None:
+            if self.decided:
+                return
+            self.decided = True
+            if self._fallback_task is not None:
+                self._fallback_task.cancel()
+            on_decide(hosts)
+
+        self._on_decide = on_decide_wrapped
+        self.paxos = Paxos(
+            my_addr, configuration_id, membership_size, broadcast_fn, send_fn, on_decide_wrapped
+        )
+
+    def propose(
+        self, proposal: Sequence[Endpoint], recovery_delay_ms: Optional[float] = None
+    ) -> None:
+        """Vote for ``proposal`` in the fast round and arm the classic-round
+        fallback (FastPaxos.java:94-108)."""
+        proposal = tuple(proposal)
+        self.paxos.register_fast_round_vote(proposal)
+        self._broadcast(
+            FastRoundPhase2bMessage(
+                sender=self.my_addr,
+                configuration_id=self.configuration_id,
+                endpoints=proposal,
+            )
+        )
+        if recovery_delay_ms is None:
+            recovery_delay_ms = self._random_delay_ms()
+        self._fallback_task = self._clock.call_later_ms(
+            recovery_delay_ms, self.start_classic_paxos_round
+        )
+
+    def handle_message(self, request: RapidRequest) -> RapidResponse:
+        """Route the five consensus message types (FastPaxos.java:163-184)."""
+        if isinstance(request, FastRoundPhase2bMessage):
+            self._handle_fast_round_vote(request)
+        elif isinstance(request, Phase1aMessage):
+            self.paxos.handle_phase1a(request)
+        elif isinstance(request, Phase1bMessage):
+            self.paxos.handle_phase1b(request)
+        elif isinstance(request, Phase2aMessage):
+            self.paxos.handle_phase2a(request)
+        elif isinstance(request, Phase2bMessage):
+            self.paxos.handle_phase2b(request)
+        else:
+            raise TypeError(f"unexpected consensus message: {type(request)!r}")
+        return ConsensusResponse()
+
+    def _handle_fast_round_vote(self, msg: FastRoundPhase2bMessage) -> None:
+        """FastPaxos.java:125-156."""
+        if msg.configuration_id != self.configuration_id:
+            return
+        if msg.sender in self._votes_received:
+            return
+        if self.decided:
+            return
+        self._votes_received.add(msg.sender)
+        proposal = tuple(msg.endpoints)
+        count = self._votes_per_proposal.get(proposal, 0) + 1
+        self._votes_per_proposal[proposal] = count
+        quorum = fast_paxos_quorum(self.n)
+        if len(self._votes_received) >= quorum and count >= quorum:
+            self._on_decide(proposal)
+
+    def start_classic_paxos_round(self) -> None:
+        """Fallback entry: classic rounds always start at round 2
+        (FastPaxos.java:189-195)."""
+        if not self.decided:
+            self.paxos.start_phase1a(2)
+
+    def cancel_fallback(self) -> None:
+        if self._fallback_task is not None:
+            self._fallback_task.cancel()
+
+    def _random_delay_ms(self) -> float:
+        """Expovariate jitter with rate 1/N over the base delay, keeping the
+        expected number of concurrent classic coordinators ~constant
+        (FastPaxos.java:200-203)."""
+        jitter_rate = 1.0 / max(self.n, 1)
+        jitter = -1000.0 * math.log(1.0 - self._rng.random()) / jitter_rate
+        return jitter + self._base_delay_ms

@@ -1,0 +1,41 @@
+"""Framework configuration.
+
+Mirrors the reference's ``Settings`` knob set (``Settings.java:21-112``) and
+fixes its one structural gap: the protocol constants K/H/L were hardcoded in
+``Cluster.java:72-74``; here they are first-class configuration.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Settings:
+    # Protocol constants (reference defaults: Cluster.java:72-74).
+    k: int = 10
+    h: int = 9
+    l: int = 4
+
+    # Messaging (GrpcClient.java:55-59).
+    rpc_timeout_ms: int = 1000
+    rpc_default_retries: int = 5
+    rpc_join_timeout_ms: int = 5000
+    rpc_probe_timeout_ms: int = 1000
+
+    # Protocol timing (MembershipService.java:75-78, FastPaxos.java:46).
+    failure_detector_interval_ms: int = 1000
+    batching_window_ms: int = 100
+    consensus_fallback_base_delay_ms: int = 1000
+
+    # Join client (Cluster.java:71).
+    join_attempts: int = 5
+
+    # Leave (MembershipService.java:78).
+    leave_message_timeout_ms: int = 1500
+
+    def validate(self) -> None:
+        if not (self.k >= 3 and self.k >= self.h >= self.l >= 1):
+            raise ValueError(
+                f"K/H/L must satisfy K>=3 and K>=H>=L>=1, got K={self.k} H={self.h} L={self.l}"
+            )

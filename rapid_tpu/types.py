@@ -1,0 +1,255 @@
+"""Wire-schema types for the membership protocol.
+
+These mirror the *semantics* of the reference's protobuf schema
+(``rapid/src/main/proto/rapid.proto``): one request envelope carrying exactly
+one protocol message, one response envelope. We use frozen dataclasses instead
+of protobuf — the in-process and TCP transports serialize them with
+``rapid_tpu.messaging.codec``; they are hashable so they can key vote tallies
+exactly the way the reference keys ``Map<List<Endpoint>, AtomicInteger>``
+(``FastPaxos.java:53``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import uuid as _uuid
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple, Union
+
+
+@dataclass(frozen=True, order=True)
+class Endpoint:
+    """A process address (``rapid.proto:13-17``)."""
+
+    hostname: str
+    port: int
+
+    def __str__(self) -> str:
+        return f"{self.hostname}:{self.port}"
+
+    @staticmethod
+    def parse(host_port: str) -> "Endpoint":
+        host, _, port = host_port.rpartition(":")
+        return Endpoint(host, int(port))
+
+
+@dataclass(frozen=True, order=True)
+class NodeId:
+    """A 128-bit logical node identifier (``rapid.proto:50-54``)."""
+
+    high: int
+    low: int
+
+    @staticmethod
+    def from_uuid(u: Optional[_uuid.UUID] = None) -> "NodeId":
+        u = u if u is not None else _uuid.uuid4()
+        as_int = u.int
+        high = (as_int >> 64) & ((1 << 64) - 1)
+        low = as_int & ((1 << 64) - 1)
+        return NodeId(high=high, low=low)
+
+
+class EdgeStatus(enum.IntEnum):
+    """``rapid.proto:112-115``."""
+
+    UP = 0
+    DOWN = 1
+
+
+class JoinStatusCode(enum.IntEnum):
+    """``rapid.proto:85-91``."""
+
+    HOSTNAME_ALREADY_IN_RING = 0
+    UUID_ALREADY_IN_RING = 1
+    SAFE_TO_JOIN = 2
+    CONFIG_CHANGED = 3
+    MEMBERSHIP_REJECTED = 4
+
+
+class NodeStatus(enum.IntEnum):
+    """Probe responses (``rapid.proto:203-206``)."""
+
+    OK = 0
+    BOOTSTRAPPING = 1
+
+
+Metadata = Dict[str, bytes]
+
+
+def freeze_metadata(metadata: Optional[Metadata]) -> Tuple[Tuple[str, bytes], ...]:
+    if not metadata:
+        return ()
+    return tuple(sorted(metadata.items()))
+
+
+# --------------------------------------------------------------------------
+# Request messages (the RapidRequest oneof, rapid.proto:21-35)
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PreJoinMessage:
+    """Phase-1 join: joiner → seed (``rapid.proto:57-63``)."""
+
+    sender: Endpoint
+    node_id: NodeId
+
+
+@dataclass(frozen=True)
+class JoinMessage:
+    """Phase-2 join: joiner → each observer (``rapid.proto:65-72``)."""
+
+    sender: Endpoint
+    node_id: NodeId
+    ring_numbers: Tuple[int, ...]
+    configuration_id: int
+    metadata: Tuple[Tuple[str, bytes], ...] = ()
+
+
+@dataclass(frozen=True)
+class AlertMessage:
+    """An edge status report (``rapid.proto:101-110``). ``node_id``/``metadata``
+    are only set on UP alerts emitted for joiners."""
+
+    edge_src: Endpoint
+    edge_dst: Endpoint
+    edge_status: EdgeStatus
+    configuration_id: int
+    ring_numbers: Tuple[int, ...]
+    node_id: Optional[NodeId] = None
+    metadata: Tuple[Tuple[str, bytes], ...] = ()
+
+
+@dataclass(frozen=True)
+class BatchedAlertMessage:
+    """``rapid.proto:95-99``."""
+
+    sender: Endpoint
+    messages: Tuple[AlertMessage, ...]
+
+
+@dataclass(frozen=True)
+class ProbeMessage:
+    """Failure-detector ping (``rapid.proto:192-196``)."""
+
+    sender: Endpoint
+
+
+@dataclass(frozen=True)
+class Rank:
+    """Paxos rank: ordered by (round, node_index) (``rapid.proto:133-137``)."""
+
+    round: int
+    node_index: int
+
+    def as_tuple(self) -> Tuple[int, int]:
+        return (self.round, self.node_index)
+
+
+@dataclass(frozen=True)
+class FastRoundPhase2bMessage:
+    """A fast-round vote: the sender's cut proposal (``rapid.proto:124-129``)."""
+
+    sender: Endpoint
+    configuration_id: int
+    endpoints: Tuple[Endpoint, ...]
+
+
+@dataclass(frozen=True)
+class Phase1aMessage:
+    sender: Endpoint
+    configuration_id: int
+    rank: Rank
+
+
+@dataclass(frozen=True)
+class Phase1bMessage:
+    sender: Endpoint
+    configuration_id: int
+    rnd: Rank
+    vrnd: Rank
+    vval: Tuple[Endpoint, ...]
+
+
+@dataclass(frozen=True)
+class Phase2aMessage:
+    sender: Endpoint
+    configuration_id: int
+    rnd: Rank
+    vval: Tuple[Endpoint, ...]
+
+
+@dataclass(frozen=True)
+class Phase2bMessage:
+    sender: Endpoint
+    configuration_id: int
+    rnd: Rank
+    endpoints: Tuple[Endpoint, ...]
+
+
+@dataclass(frozen=True)
+class LeaveMessage:
+    """Graceful-leave intent (``rapid.proto:185-188``)."""
+
+    sender: Endpoint
+
+
+RapidRequest = Union[
+    PreJoinMessage,
+    JoinMessage,
+    BatchedAlertMessage,
+    ProbeMessage,
+    FastRoundPhase2bMessage,
+    Phase1aMessage,
+    Phase1bMessage,
+    Phase2aMessage,
+    Phase2bMessage,
+    LeaveMessage,
+]
+
+CONSENSUS_MESSAGE_TYPES = (
+    FastRoundPhase2bMessage,
+    Phase1aMessage,
+    Phase1bMessage,
+    Phase2aMessage,
+    Phase2bMessage,
+)
+
+
+# --------------------------------------------------------------------------
+# Response messages (the RapidResponse oneof, rapid.proto:37-45)
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class JoinResponse:
+    """``rapid.proto:74-83``."""
+
+    sender: Endpoint
+    status_code: JoinStatusCode
+    configuration_id: int
+    endpoints: Tuple[Endpoint, ...] = ()
+    identifiers: Tuple[NodeId, ...] = ()
+    metadata_keys: Tuple[Endpoint, ...] = ()
+    metadata_values: Tuple[Tuple[Tuple[str, bytes], ...], ...] = ()
+
+
+@dataclass(frozen=True)
+class Response:
+    """Empty acknowledgement (``rapid.proto:117-119``)."""
+
+
+@dataclass(frozen=True)
+class ConsensusResponse:
+    """Empty consensus acknowledgement (``rapid.proto:172-174``)."""
+
+
+@dataclass(frozen=True)
+class ProbeResponse:
+    """``rapid.proto:198-201``."""
+
+    status: NodeStatus = NodeStatus.OK
+
+
+RapidResponse = Union[JoinResponse, Response, ConsensusResponse, ProbeResponse]

@@ -1,0 +1,3 @@
+from rapid_tpu.utils.xxhash import xxh64, xxh64_int, to_signed64
+
+__all__ = ["xxh64", "xxh64_int", "to_signed64"]

@@ -1,0 +1,93 @@
+"""Time & scheduling abstraction.
+
+The reference drives everything off wall-clock scheduled executors
+(``SharedResources.java:100-102``). To keep tests deterministic and to let the
+TPU virtual-cluster engine run simulated time at 100K nodes, every timing
+consumer in this framework (alert batcher, failure detectors, consensus
+fallback) goes through this interface instead of the event loop directly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import itertools
+from typing import Awaitable, Callable, List, Optional, Tuple
+
+
+class CancelHandle:
+    __slots__ = ("_cancel",)
+
+    def __init__(self, cancel: Callable[[], None]):
+        self._cancel = cancel
+
+    def cancel(self) -> None:
+        self._cancel()
+
+
+class Clock:
+    """Abstract clock + one-shot scheduler."""
+
+    def now_ms(self) -> float:
+        raise NotImplementedError
+
+    async def sleep_ms(self, delay_ms: float) -> None:
+        raise NotImplementedError
+
+    def call_later_ms(self, delay_ms: float, fn: Callable[[], None]) -> CancelHandle:
+        raise NotImplementedError
+
+
+class AsyncioClock(Clock):
+    """Wall-clock implementation over the running asyncio loop."""
+
+    def __init__(self, loop: Optional[asyncio.AbstractEventLoop] = None):
+        self._loop = loop
+
+    @property
+    def loop(self) -> asyncio.AbstractEventLoop:
+        if self._loop is None:
+            self._loop = asyncio.get_event_loop()
+        return self._loop
+
+    def now_ms(self) -> float:
+        return self.loop.time() * 1000.0
+
+    async def sleep_ms(self, delay_ms: float) -> None:
+        await asyncio.sleep(delay_ms / 1000.0)
+
+    def call_later_ms(self, delay_ms: float, fn: Callable[[], None]) -> CancelHandle:
+        handle = self.loop.call_later(delay_ms / 1000.0, fn)
+        return CancelHandle(handle.cancel)
+
+
+class ManualClock(Clock):
+    """Deterministic clock for unit tests: time only moves via ``advance_ms``."""
+
+    def __init__(self) -> None:
+        self._now = 0.0
+        self._counter = itertools.count()
+        self._pending: List[Tuple[float, int, Callable[[], None], List[bool]]] = []
+
+    def now_ms(self) -> float:
+        return self._now
+
+    async def sleep_ms(self, delay_ms: float) -> None:
+        event = asyncio.Event()
+        self.call_later_ms(delay_ms, event.set)
+        await event.wait()
+
+    def call_later_ms(self, delay_ms: float, fn: Callable[[], None]) -> CancelHandle:
+        cancelled = [False]
+        heapq.heappush(self._pending, (self._now + delay_ms, next(self._counter), fn, cancelled))
+        return CancelHandle(lambda: cancelled.__setitem__(0, True))
+
+    def advance_ms(self, delta_ms: float) -> None:
+        """Move time forward, firing due callbacks in order."""
+        target = self._now + delta_ms
+        while self._pending and self._pending[0][0] <= target:
+            when, _, fn, cancelled = heapq.heappop(self._pending)
+            self._now = when
+            if not cancelled[0]:
+                fn()
+        self._now = target

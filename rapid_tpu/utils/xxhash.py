@@ -1,0 +1,113 @@
+"""Seeded 64-bit hashing for ring permutations and configuration identifiers.
+
+The reference orders its K monitoring rings by seeded xxHash of each endpoint
+(``rapid/src/main/java/com/vrg/rapid/MembershipView.java:562-587``, via
+net.openhft zero-allocation-hashing) and folds endpoint/identifier hashes into
+a 64-bit configuration id (``MembershipView.java:544-556``). This module is a
+self-contained XXH64 implementation (the environment ships no xxhash package)
+plus the fold helpers the rest of the framework uses.
+
+Device kernels never hash strings: hosts hash endpoints once with this module
+and ship ``uint32`` hi/lo words to the TPU (see ``rapid_tpu.ops.rings``).
+"""
+
+from __future__ import annotations
+
+import struct
+
+_MASK64 = (1 << 64) - 1
+
+_P1 = 0x9E3779B185EBCA87
+_P2 = 0xC2B2AE3D27D4EB4F
+_P3 = 0x165667B19E3779F9
+_P4 = 0x85EBCA77C2B2AE63
+_P5 = 0x27D4EB2F165667C5
+
+
+def _rotl(x: int, r: int) -> int:
+    return ((x << r) | (x >> (64 - r))) & _MASK64
+
+
+def _round(acc: int, lane: int) -> int:
+    acc = (acc + lane * _P2) & _MASK64
+    acc = _rotl(acc, 31)
+    return (acc * _P1) & _MASK64
+
+
+def _merge_round(acc: int, val: int) -> int:
+    acc ^= _round(0, val)
+    return ((acc * _P1) + _P4) & _MASK64
+
+
+def _avalanche(h: int) -> int:
+    h ^= h >> 33
+    h = (h * _P2) & _MASK64
+    h ^= h >> 29
+    h = (h * _P3) & _MASK64
+    h ^= h >> 32
+    return h
+
+
+def xxh64(data: bytes, seed: int = 0) -> int:
+    """XXH64 of ``data`` with ``seed``; returns an unsigned 64-bit int."""
+    n = len(data)
+    seed &= _MASK64
+
+    if n >= 32:
+        v1 = (seed + _P1 + _P2) & _MASK64
+        v2 = (seed + _P2) & _MASK64
+        v3 = seed
+        v4 = (seed - _P1) & _MASK64
+        i = 0
+        limit = n - 32
+        while i <= limit:
+            l1, l2, l3, l4 = struct.unpack_from("<QQQQ", data, i)
+            v1 = _round(v1, l1)
+            v2 = _round(v2, l2)
+            v3 = _round(v3, l3)
+            v4 = _round(v4, l4)
+            i += 32
+        h = (_rotl(v1, 1) + _rotl(v2, 7) + _rotl(v3, 12) + _rotl(v4, 18)) & _MASK64
+        h = _merge_round(h, v1)
+        h = _merge_round(h, v2)
+        h = _merge_round(h, v3)
+        h = _merge_round(h, v4)
+    else:
+        h = (seed + _P5) & _MASK64
+        i = 0
+
+    h = (h + n) & _MASK64
+
+    while i + 8 <= n:
+        (lane,) = struct.unpack_from("<Q", data, i)
+        h ^= _round(0, lane)
+        h = (_rotl(h, 27) * _P1 + _P4) & _MASK64
+        i += 8
+
+    if i + 4 <= n:
+        (lane32,) = struct.unpack_from("<I", data, i)
+        h ^= (lane32 * _P1) & _MASK64
+        h = (_rotl(h, 23) * _P2 + _P3) & _MASK64
+        i += 4
+
+    while i < n:
+        h ^= (data[i] * _P5) & _MASK64
+        h = (_rotl(h, 11) * _P1) & _MASK64
+        i += 1
+
+    return _avalanche(h)
+
+
+def xxh64_int(value: int, seed: int = 0) -> int:
+    """Hash an integer by its little-endian 8-byte encoding (signed or unsigned)."""
+    return xxh64(struct.pack("<q", _to_signed64(value)), seed)
+
+
+def _to_signed64(value: int) -> int:
+    value &= _MASK64
+    return value - (1 << 64) if value >= (1 << 63) else value
+
+
+def to_signed64(value: int) -> int:
+    """Interpret an unsigned 64-bit value as Java-style signed (for display/parity)."""
+    return _to_signed64(value)

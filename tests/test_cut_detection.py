@@ -1,0 +1,177 @@
+"""MultiNodeCutDetector tests, mirroring the reference's CutDetectionTest
+scenarios (rapid/src/test/java/com/vrg/rapid/CutDetectionTest.java)."""
+
+import pytest
+
+from rapid_tpu.protocol.cut_detector import MultiNodeCutDetector
+from rapid_tpu.protocol.view import MembershipView
+from rapid_tpu.types import AlertMessage, EdgeStatus, Endpoint, NodeId
+
+K, H, L = 10, 8, 2
+CONFIG_ID = -1
+
+
+def alert(src: Endpoint, dst: Endpoint, status: EdgeStatus, ring_number: int) -> AlertMessage:
+    return AlertMessage(
+        edge_src=src,
+        edge_dst=dst,
+        edge_status=status,
+        configuration_id=CONFIG_ID,
+        ring_numbers=(ring_number,),
+    )
+
+
+def src(i: int) -> Endpoint:
+    return Endpoint("127.0.0.1", i)
+
+
+def test_invalid_watermarks_rejected():
+    for k, h, l in [(2, 2, 1), (10, 11, 2), (10, 8, 9), (10, 8, 0)]:
+        with pytest.raises(ValueError):
+            MultiNodeCutDetector(k, h, l)
+
+
+def test_cut_detection_basic():
+    wb = MultiNodeCutDetector(K, H, L)
+    dst = Endpoint("127.0.0.2", 2)
+    for i in range(H - 1):
+        assert wb.aggregate(alert(src(i + 1), dst, EdgeStatus.UP, i)) == []
+        assert wb.num_proposals == 0
+    ret = wb.aggregate(alert(src(H), dst, EdgeStatus.UP, H - 1))
+    assert ret == [dst]
+    assert wb.num_proposals == 1
+
+
+def test_cut_detection_one_blocker():
+    wb = MultiNodeCutDetector(K, H, L)
+    dst1 = Endpoint("127.0.0.2", 2)
+    dst2 = Endpoint("127.0.0.3", 2)
+    for i in range(H - 1):
+        assert wb.aggregate(alert(src(i + 1), dst1, EdgeStatus.UP, i)) == []
+    for i in range(H - 1):
+        assert wb.aggregate(alert(src(i + 1), dst2, EdgeStatus.UP, i)) == []
+    assert wb.aggregate(alert(src(H), dst1, EdgeStatus.UP, H - 1)) == []
+    assert wb.num_proposals == 0
+    ret = wb.aggregate(alert(src(H), dst2, EdgeStatus.UP, H - 1))
+    assert len(ret) == 2
+    assert set(ret) == {dst1, dst2}
+    assert wb.num_proposals == 1
+
+
+def test_cut_detection_three_blockers():
+    wb = MultiNodeCutDetector(K, H, L)
+    dsts = [Endpoint(f"127.0.0.{i}", 2) for i in (2, 3, 4)]
+    for dst in dsts:
+        for i in range(H - 1):
+            assert wb.aggregate(alert(src(i + 1), dst, EdgeStatus.UP, i)) == []
+    assert wb.aggregate(alert(src(H), dsts[0], EdgeStatus.UP, H - 1)) == []
+    assert wb.aggregate(alert(src(H), dsts[2], EdgeStatus.UP, H - 1)) == []
+    assert wb.num_proposals == 0
+    ret = wb.aggregate(alert(src(H), dsts[1], EdgeStatus.UP, H - 1))
+    assert set(ret) == set(dsts)
+    assert wb.num_proposals == 1
+
+
+def test_cut_detection_blockers_past_h():
+    wb = MultiNodeCutDetector(K, H, L)
+    dsts = [Endpoint(f"127.0.0.{i}", 2) for i in (2, 3, 4)]
+    for dst in dsts:
+        for i in range(H - 1):
+            assert wb.aggregate(alert(src(i + 1), dst, EdgeStatus.UP, i)) == []
+    # Duplicate ring announcements past H are ignored.
+    wb.aggregate(alert(src(H), dsts[0], EdgeStatus.UP, H - 1))
+    assert wb.aggregate(alert(src(H + 1), dsts[0], EdgeStatus.UP, H - 1)) == []
+    wb.aggregate(alert(src(H), dsts[2], EdgeStatus.UP, H - 1))
+    assert wb.aggregate(alert(src(H + 1), dsts[2], EdgeStatus.UP, H - 1)) == []
+    assert wb.num_proposals == 0
+    ret = wb.aggregate(alert(src(H), dsts[1], EdgeStatus.UP, H - 1))
+    assert set(ret) == set(dsts)
+    assert wb.num_proposals == 1
+
+
+def test_cut_detection_below_l_does_not_block():
+    wb = MultiNodeCutDetector(K, H, L)
+    dst1 = Endpoint("127.0.0.2", 2)
+    dst2 = Endpoint("127.0.0.3", 2)
+    dst3 = Endpoint("127.0.0.4", 2)
+    for i in range(H - 1):
+        assert wb.aggregate(alert(src(i + 1), dst1, EdgeStatus.UP, i)) == []
+    for i in range(L - 1):
+        assert wb.aggregate(alert(src(i + 1), dst2, EdgeStatus.UP, i)) == []
+    for i in range(H - 1):
+        assert wb.aggregate(alert(src(i + 1), dst3, EdgeStatus.UP, i)) == []
+    assert wb.aggregate(alert(src(H), dst1, EdgeStatus.UP, H - 1)) == []
+    assert wb.num_proposals == 0
+    ret = wb.aggregate(alert(src(H), dst3, EdgeStatus.UP, H - 1))
+    assert set(ret) == {dst1, dst3}
+    assert wb.num_proposals == 1
+
+
+def test_cut_detection_batch():
+    wb = MultiNodeCutDetector(K, H, L)
+    endpoints = [Endpoint("127.0.0.2", 2 + i) for i in range(3)]
+    proposal = []
+    for endpoint in endpoints:
+        for ring_number in range(K):
+            proposal.extend(wb.aggregate(alert(src(1), endpoint, EdgeStatus.UP, ring_number)))
+    assert len(proposal) == len(endpoints)
+
+
+def test_link_invalidation():
+    view = MembershipView(K)
+    wb = MultiNodeCutDetector(K, H, L)
+    num_nodes = 30
+    endpoints = []
+    for i in range(num_nodes):
+        node = Endpoint("127.0.0.2", 2 + i)
+        endpoints.append(node)
+        view.ring_add(node, NodeId(0, i))
+
+    dst = endpoints[0]
+    observers = view.observers_of(dst)
+    assert len(observers) == K
+
+    # Alerts from observers[0, H-1) about dst: dst stuck at H-1 reports.
+    for i in range(H - 1):
+        assert wb.aggregate(alert(observers[i], dst, EdgeStatus.DOWN, i)) == []
+
+    # Alerts about observers[H-1, K) of dst: those observers cross H.
+    failed_observers = set()
+    for i in range(H - 1, K):
+        observers_of_observer = view.observers_of(observers[i])
+        failed_observers.add(observers[i])
+        for j in range(K):
+            assert (
+                wb.aggregate(alert(observers_of_observer[j], observers[i], EdgeStatus.DOWN, j))
+                == []
+            )
+    assert wb.num_proposals == 0
+
+    # Implicit edge invalidation brings dst and the failed observers into one cut.
+    ret = wb.invalidate_failing_edges(view)
+    assert len(ret) == 4
+    assert wb.num_proposals == 1
+    for node in ret:
+        assert node in failed_observers or node == dst
+
+
+def test_invalidation_without_down_events_is_noop():
+    view = MembershipView(K)
+    wb = MultiNodeCutDetector(K, H, L)
+    for i in range(10):
+        view.ring_add(Endpoint("127.0.0.2", 2 + i), NodeId(0, i))
+    assert wb.invalidate_failing_edges(view) == []
+
+
+def test_clear_resets_all_state():
+    wb = MultiNodeCutDetector(K, H, L)
+    dst = Endpoint("127.0.0.2", 2)
+    for i in range(H):
+        wb.aggregate(alert(src(i + 1), dst, EdgeStatus.UP, i))
+    assert wb.num_proposals == 1
+    wb.clear()
+    assert wb.num_proposals == 0
+    # Same alerts go through again from scratch.
+    for i in range(H - 1):
+        assert wb.aggregate(alert(src(i + 1), dst, EdgeStatus.UP, i)) == []
+    assert wb.aggregate(alert(src(H), dst, EdgeStatus.UP, H - 1)) == [dst]

@@ -1,0 +1,123 @@
+"""Device vote-tally kernels vs the FastPaxos host oracle."""
+
+import numpy as np
+import pytest
+
+from rapid_tpu.ops.consensus import tally_candidates, tally_sorted
+from rapid_tpu.ops.hashing import masked_set_hash
+from rapid_tpu.protocol.fast_paxos import FastPaxos, fast_paxos_quorum
+from rapid_tpu.types import Endpoint, FastRoundPhase2bMessage
+from rapid_tpu.utils.clock import ManualClock
+
+import jax.numpy as jnp
+
+
+def ep(i: int) -> Endpoint:
+    return Endpoint("127.0.0.1", i)
+
+
+def oracle_decision(n, votes):
+    """Feed votes (list of proposal tuples or None) to a host FastPaxos."""
+    decided = []
+    instance = FastPaxos(
+        my_addr=ep(0),
+        configuration_id=1,
+        membership_size=n,
+        broadcast_fn=lambda r: None,
+        send_fn=lambda d, r: None,
+        on_decide=lambda hosts: decided.append(tuple(hosts)),
+        clock=ManualClock(),
+    )
+    for i, proposal in enumerate(votes):
+        if proposal is None:
+            continue
+        instance.handle_message(
+            FastRoundPhase2bMessage(sender=ep(100 + i), configuration_id=1, endpoints=proposal)
+        )
+    return decided[0] if decided else None
+
+
+def device_votes(n, votes, proposals):
+    """Encode per-slot votes as hash lanes. Returns (hi, lo, valid, cand)."""
+    prop_hash = {}
+    for p_idx, proposal in enumerate(proposals):
+        # Stand-in identity lanes: any injective 64-bit encoding works.
+        prop_hash[proposal] = (np.uint32(0xA0 + p_idx), np.uint32(0xB0 + p_idx))
+    hi = np.zeros(n, dtype=np.uint32)
+    lo = np.zeros(n, dtype=np.uint32)
+    valid = np.zeros(n, dtype=bool)
+    for i, proposal in enumerate(votes):
+        if proposal is None:
+            continue
+        hi[i], lo[i] = prop_hash[proposal]
+        valid[i] = True
+    cand_hi = np.array([prop_hash[p][0] for p in proposals], dtype=np.uint32)
+    cand_lo = np.array([prop_hash[p][1] for p in proposals], dtype=np.uint32)
+    cand_valid = np.ones(len(proposals), dtype=bool)
+    return hi, lo, valid, (cand_hi, cand_lo, cand_valid), prop_hash
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_randomized_tally_equivalence(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(4, 200))
+    proposals = [tuple(ep(9000 + i) for i in range(j + 1)) for j in range(rng.integers(1, 4))]
+    votes = []
+    for _ in range(n):
+        if rng.random() < 0.15:
+            votes.append(None)  # did not vote
+        else:
+            votes.append(proposals[rng.integers(0, len(proposals))])
+
+    expected = oracle_decision(n, votes)
+    hi, lo, valid, (chi, clo, cvalid), prop_hash = device_votes(n, votes, proposals)
+
+    for result in (
+        tally_candidates(hi, lo, valid, chi, clo, cvalid, jnp.int32(n)),
+        tally_sorted(hi, lo, valid, jnp.int32(n)),
+    ):
+        if expected is None:
+            assert not bool(result.decided)
+        else:
+            assert bool(result.decided)
+            assert (np.uint32(result.winner_hi), np.uint32(result.winner_lo)) == prop_hash[
+                expected
+            ]
+
+
+@pytest.mark.parametrize("n", [4, 5, 6, 10, 11, 20, 21, 102, 1000])
+def test_exact_quorum_boundary(n):
+    quorum = fast_paxos_quorum(n)
+    proposal = (ep(1),)
+    votes = [proposal] * (quorum - 1) + [None] * (n - quorum + 1)
+    hi, lo, valid, cand, _ = device_votes(n, votes, [proposal])
+    r = tally_candidates(hi, lo, valid, *cand, jnp.int32(n))
+    assert not bool(r.decided)
+    votes[quorum - 1] = proposal
+    hi, lo, valid, cand, _ = device_votes(n, votes, [proposal])
+    r = tally_candidates(hi, lo, valid, *cand, jnp.int32(n))
+    assert bool(r.decided)
+    r2 = tally_sorted(hi, lo, valid, jnp.int32(n))
+    assert bool(r2.decided)
+
+
+def test_masked_set_hash_properties():
+    rng = np.random.default_rng(0)
+    n = 64
+    hi = rng.integers(0, 2**32, size=n, dtype=np.uint32)
+    lo = rng.integers(0, 2**32, size=n, dtype=np.uint32)
+
+    m1 = np.zeros(n, dtype=bool)
+    m1[[3, 10, 40]] = True
+    h_a = masked_set_hash(jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(m1))
+
+    # Permuting slot order leaves the set hash unchanged.
+    perm = rng.permutation(n)
+    h_b = masked_set_hash(jnp.asarray(hi[perm]), jnp.asarray(lo[perm]), jnp.asarray(m1[perm]))
+    assert (int(h_a[0]), int(h_a[1])) == (int(h_b[0]), int(h_b[1]))
+
+    # Different sets hash differently (w.h.p.).
+    m2 = m1.copy()
+    m2[41] = True
+    h_c = masked_set_hash(jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(m2))
+    assert (int(h_a[0]), int(h_a[1])) != (int(h_c[0]), int(h_c[1]))

@@ -1,0 +1,236 @@
+"""Device cut-detection kernel vs the sequential MultiNodeCutDetector oracle.
+
+The device kernel uses end-of-batch semantics: a cut is released iff after the
+whole batch (plus implicit invalidation) at least one subject is past H and
+none is in [L, H). The sequential oracle is order-sensitive mid-batch, so the
+harness feeds it alerts with flux-enders first — the order under which its
+union-of-proposals coincides with end-of-batch semantics (see
+rapid_tpu/ops/cut_detection.py docstring).
+"""
+
+import numpy as np
+import pytest
+
+from rapid_tpu.ops.cut_detection import (
+    CutState,
+    alerts_to_report_matrix,
+    process_alert_batch,
+)
+from rapid_tpu.ops.rings import endpoint_ring_keys, predecessor_of_keys, ring_topology
+from rapid_tpu.protocol.cut_detector import MultiNodeCutDetector
+from rapid_tpu.protocol.view import MembershipView
+from rapid_tpu.types import AlertMessage, EdgeStatus, Endpoint, NodeId
+
+K, H, L = 10, 8, 3
+
+
+def make_world(n_members, n_joiners, seed):
+    rng = np.random.default_rng(seed)
+    total = n_members + n_joiners
+    ports = rng.choice(40000, size=total, replace=False) + 1
+    endpoints = [Endpoint(f"10.1.{i % 256}.{i // 256}", int(p)) for i, p in enumerate(ports)]
+    members, joiners = endpoints[:n_members], endpoints[n_members:]
+    view = MembershipView(K)
+    for i, ep in enumerate(members):
+        view.ring_add(ep, NodeId(0, i))
+    return view, members, joiners, rng
+
+
+def build_inval_obs(view, members, joiners):
+    """[K, n_slots] invalidation-observer table: ring successors for members,
+    alive-predecessors (expected observers) for joiner slots."""
+    n = len(members)
+    key_hi, key_lo = endpoint_ring_keys(members, K)
+    alive = np.ones(n, dtype=bool)
+    topo = ring_topology(key_hi, key_lo, alive)
+    obs = np.asarray(topo.obs_idx)  # [K, n]
+    if joiners:
+        qhi, qlo = endpoint_ring_keys(joiners, K)
+        pred = np.asarray(predecessor_of_keys(key_hi, key_lo, alive, qhi, qlo))  # [K, j]
+        obs = np.concatenate([obs, pred], axis=1)
+    return obs
+
+
+def run_device(view, members, joiners, alerts):
+    slots = members + joiners
+    slot_of = {ep: i for i, ep in enumerate(slots)}
+    n = len(slots)
+    dst_idx, rings = [], []
+    has_down = False
+    for a in alerts:
+        for r in a.ring_numbers:
+            dst_idx.append(slot_of[a.edge_dst])
+            rings.append(r)
+        has_down = has_down or a.edge_status == EdgeStatus.DOWN
+    new_reports = alerts_to_report_matrix(n, K, np.array(dst_idx), np.array(rings))
+    inval_obs = build_inval_obs(view, members, joiners)
+    subject_mask = np.ones(n, dtype=bool)
+    result = process_alert_batch(
+        CutState.create(n, K),
+        new_reports,
+        np.asarray(has_down),
+        inval_obs,
+        subject_mask,
+        H,
+        L,
+    )
+    mask = np.asarray(result.proposal_mask)
+    return bool(result.propose), {slots[i] for i in range(n) if mask[i]}
+
+
+def run_oracle(view, alerts):
+    """Union-of-proposals per batch + invalidation, as the membership service
+    consumes it (MembershipService.java:300-354)."""
+    detector = MultiNodeCutDetector(K, H, L)
+    proposal = set()
+    for a in alerts:
+        proposal.update(detector.aggregate(a))
+    proposal.update(detector.invalidate_failing_edges(view))
+    return bool(proposal), proposal
+
+
+def order_flux_enders_first(alerts):
+    """Sort so subjects whose final tally lands in [L, H) come first."""
+    by_dst = {}
+    for a in alerts:
+        by_dst.setdefault(a.edge_dst, []).append(a)
+    flux, other = [], []
+    for dst, msgs in by_dst.items():
+        rings = {r for m in msgs for r in m.ring_numbers}
+        (flux if L <= len(rings) < H else other).append((dst, msgs))
+    return [m for _, msgs in flux + other for m in msgs]
+
+
+def make_alerts(view, subjects_with_counts, status=EdgeStatus.DOWN):
+    alerts = []
+    for subject, count in subjects_with_counts:
+        observers = (
+            view.observers_of(subject)
+            if view.is_host_present(subject)
+            else view.expected_observers_of(subject)
+        )
+        for ring_number in range(count):
+            alerts.append(
+                AlertMessage(
+                    edge_src=observers[ring_number],
+                    edge_dst=subject,
+                    edge_status=status,
+                    configuration_id=0,
+                    ring_numbers=(ring_number,),
+                )
+            )
+    return alerts
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_randomized_equivalence_members_only(seed):
+    view, members, joiners, rng = make_world(40, 0, seed)
+    n_subjects = rng.integers(1, 8)
+    picks = rng.choice(len(members), size=n_subjects, replace=False)
+    subjects = [(members[i], int(rng.integers(1, K + 1))) for i in picks]
+    alerts = order_flux_enders_first(make_alerts(view, subjects))
+
+    dev_propose, dev_set = run_device(view, members, joiners, alerts)
+    ora_propose, ora_set = run_oracle(view, alerts)
+    assert dev_propose == ora_propose
+    if dev_propose:
+        assert dev_set == ora_set
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_randomized_equivalence_with_joiners(seed):
+    view, members, joiners, rng = make_world(30, 5, 100 + seed)
+    picks = rng.choice(len(members), size=3, replace=False)
+    subjects = [(members[i], int(rng.integers(1, K + 1))) for i in picks]
+    join_subjects = [(j, int(rng.integers(1, K + 1))) for j in joiners[:2]]
+    alerts = make_alerts(view, subjects, EdgeStatus.DOWN) + make_alerts(
+        view, join_subjects, EdgeStatus.UP
+    )
+    alerts = order_flux_enders_first(alerts)
+
+    dev_propose, dev_set = run_device(view, members, joiners, alerts)
+    ora_propose, ora_set = run_oracle(view, alerts)
+    assert dev_propose == ora_propose
+    if dev_propose:
+        assert dev_set == ora_set
+
+
+def test_link_invalidation_equivalence():
+    # The reference's cutDetectionTestLinkInvalidation scenario on device:
+    # dst stuck at H-1 with its remaining observers themselves past H.
+    view, members, joiners, _ = make_world(30, 0, 42)
+    dst = members[0]
+    observers = view.observers_of(dst)
+    alerts = []
+    for i in range(H - 1):
+        alerts.append(
+            AlertMessage(observers[i], dst, EdgeStatus.DOWN, 0, (i,))
+        )
+    failed = set()
+    for i in range(H - 1, K):
+        failed.add(observers[i])
+        oo = view.observers_of(observers[i])
+        for j in range(K):
+            alerts.append(AlertMessage(oo[j], observers[i], EdgeStatus.DOWN, 0, (j,)))
+
+    dev_propose, dev_set = run_device(view, members, joiners, alerts)
+    ora_propose, ora_set = run_oracle(view, alerts)
+    assert dev_propose and ora_propose
+    assert dev_set == ora_set == failed | {dst}
+
+
+def test_up_alerts_never_trigger_invalidation():
+    view, members, joiners, _ = make_world(25, 3, 5)
+    # Joiner stuck in flux; no DOWN alerts anywhere: invalidation must not run.
+    alerts = make_alerts(view, [(joiners[0], H - 1)], EdgeStatus.UP)
+    dev_propose, _ = run_device(view, members, joiners, alerts)
+    ora_propose, _ = run_oracle(view, alerts)
+    assert not dev_propose and not ora_propose
+
+
+def test_released_subjects_do_not_repropose():
+    # Reference clears its proposal set on release
+    # (MultiNodeCutDetector.java:120-121): a cut released in batch 1 must not
+    # reappear in batch 2's proposal.
+    view, members, joiners, _ = make_world(20, 0, 8)
+    n = len(members)
+    inval_obs = build_inval_obs(view, members, [])
+    subject_mask = np.ones(n, dtype=bool)
+    slot_of = {ep: i for i, ep in enumerate(members)}
+    a, b = members[2], members[9]
+
+    m1 = alerts_to_report_matrix(n, K, np.array([slot_of[a]] * H), np.arange(H))
+    r1 = process_alert_batch(
+        CutState.create(n, K), m1, np.asarray(True), inval_obs, subject_mask, H, L
+    )
+    assert bool(r1.propose)
+    assert {i for i in range(n) if np.asarray(r1.proposal_mask)[i]} == {slot_of[a]}
+
+    m2 = alerts_to_report_matrix(n, K, np.array([slot_of[b]] * H), np.arange(H))
+    r2 = process_alert_batch(r1.state, m2, np.asarray(True), inval_obs, subject_mask, H, L)
+    assert bool(r2.propose)
+    assert {i for i in range(n) if np.asarray(r2.proposal_mask)[i]} == {slot_of[b]}
+
+
+def test_state_accumulates_across_batches():
+    view, members, joiners, _ = make_world(20, 0, 6)
+    slots = members
+    n = len(slots)
+    subject = members[3]
+    observers = view.observers_of(subject)
+    inval_obs = build_inval_obs(view, members, [])
+    subject_mask = np.ones(n, dtype=bool)
+    state = CutState.create(n, K)
+    slot_of = {ep: i for i, ep in enumerate(slots)}
+
+    # H-1 reports in batch one: no proposal.
+    m1 = alerts_to_report_matrix(
+        n, K, np.array([slot_of[subject]] * (H - 1)), np.arange(H - 1)
+    )
+    r1 = process_alert_batch(state, m1, np.asarray(True), inval_obs, subject_mask, H, L)
+    assert not bool(r1.propose)
+    # The H-th report arrives in batch two: proposal fires from accumulated state.
+    m2 = alerts_to_report_matrix(n, K, np.array([slot_of[subject]]), np.array([H - 1]))
+    r2 = process_alert_batch(r1.state, m2, np.asarray(True), inval_obs, subject_mask, H, L)
+    assert bool(r2.propose)
+    assert np.asarray(r2.proposal_mask)[slot_of[subject]]

@@ -1,0 +1,97 @@
+"""Device ring-topology kernels vs the host MembershipView oracle."""
+
+import numpy as np
+import pytest
+
+from rapid_tpu.ops.rings import endpoint_ring_keys, predecessor_of_keys, ring_topology
+from rapid_tpu.protocol.view import MembershipView
+from rapid_tpu.types import Endpoint, NodeId
+
+
+def make_endpoints(n, seed=0):
+    rng = np.random.default_rng(seed)
+    ports = rng.choice(50000, size=n, replace=False) + 1
+    return [Endpoint(f"10.0.{i % 256}.{i // 256}", int(p)) for i, p in enumerate(ports)]
+
+
+@pytest.mark.parametrize("n,k", [(4, 3), (10, 10), (100, 10), (257, 7)])
+def test_topology_matches_view(n, k):
+    endpoints = make_endpoints(n, seed=n)
+    view = MembershipView(k)
+    for i, ep in enumerate(endpoints):
+        view.ring_add(ep, NodeId(0, i))
+
+    key_hi, key_lo = endpoint_ring_keys(endpoints, k)
+    alive = np.ones(n, dtype=bool)
+    topo = ring_topology(key_hi, key_lo, alive)
+    obs = np.asarray(topo.obs_idx)
+    subj = np.asarray(topo.subj_idx)
+
+    slot_of = {ep: i for i, ep in enumerate(endpoints)}
+    for i, ep in enumerate(endpoints):
+        expected_obs = [slot_of[o] for o in view.observers_of(ep)]
+        expected_subj = [slot_of[s] for s in view.subjects_of(ep)]
+        assert obs[:, i].tolist() == expected_obs
+        assert subj[:, i].tolist() == expected_subj
+
+
+def test_topology_with_dead_slots():
+    n, k = 60, 10
+    endpoints = make_endpoints(n, seed=3)
+    rng = np.random.default_rng(7)
+    alive = rng.random(n) > 0.3
+
+    view = MembershipView(k)
+    for i, ep in enumerate(endpoints):
+        if alive[i]:
+            view.ring_add(ep, NodeId(0, i))
+
+    key_hi, key_lo = endpoint_ring_keys(endpoints, k)
+    topo = ring_topology(key_hi, key_lo, alive)
+    obs = np.asarray(topo.obs_idx)
+    subj = np.asarray(topo.subj_idx)
+
+    slot_of = {ep: i for i, ep in enumerate(endpoints)}
+    for i, ep in enumerate(endpoints):
+        if not alive[i]:
+            assert (obs[:, i] == -1).all()
+            assert (subj[:, i] == -1).all()
+            continue
+        assert obs[:, i].tolist() == [slot_of[o] for o in view.observers_of(ep)]
+        assert subj[:, i].tolist() == [slot_of[s] for s in view.subjects_of(ep)]
+
+
+def test_topology_single_and_two_nodes():
+    endpoints = make_endpoints(5, seed=9)
+    k = 10
+    key_hi, key_lo = endpoint_ring_keys(endpoints, k)
+
+    alive = np.zeros(5, dtype=bool)
+    alive[2] = True
+    topo = ring_topology(key_hi, key_lo, alive)
+    # A lone node has no observers (MembershipView.java:240-242).
+    assert (np.asarray(topo.obs_idx)[:, 2] == -1).all()
+
+    alive[4] = True
+    topo = ring_topology(key_hi, key_lo, alive)
+    assert (np.asarray(topo.obs_idx)[:, 2] == 4).all()
+    assert (np.asarray(topo.obs_idx)[:, 4] == 2).all()
+
+
+def test_expected_observers_of_joiners():
+    n, k, j = 50, 10, 7
+    endpoints = make_endpoints(n + j, seed=11)
+    members, joiners = endpoints[:n], endpoints[n:]
+    view = MembershipView(k)
+    for i, ep in enumerate(members):
+        view.ring_add(ep, NodeId(0, i))
+
+    key_hi, key_lo = endpoint_ring_keys(members, k)
+    qhi, qlo = endpoint_ring_keys(joiners, k)
+    alive = np.ones(n, dtype=bool)
+    pred = np.asarray(predecessor_of_keys(key_hi, key_lo, alive, qhi, qlo))
+
+    slot_of = {ep: i for i, ep in enumerate(members)}
+    for jx, joiner in enumerate(joiners):
+        expected = [slot_of[o] for o in view.expected_observers_of(joiner)]
+        assert pred[:, jx].tolist() == expected

@@ -1,0 +1,305 @@
+"""Classic-Paxos tests: coordinator value-pick rule tables and full-protocol
+runs over direct wiring with selective message drops, mirroring the reference's
+PaxosTests (rapid/src/test/java/com/vrg/rapid/PaxosTests.java)."""
+
+import random
+from typing import Dict, List, Optional, Tuple, Type
+
+import pytest
+
+from rapid_tpu.protocol.fast_paxos import FastPaxos, fast_paxos_quorum
+from rapid_tpu.protocol.paxos import select_proposal_using_coordinator_rule
+from rapid_tpu.types import (
+    Endpoint,
+    FastRoundPhase2bMessage,
+    Phase1bMessage,
+    Rank,
+)
+from rapid_tpu.utils.clock import ManualClock
+
+
+def ep(i: int) -> Endpoint:
+    return Endpoint("127.0.0.1", i)
+
+
+def p1b(sender_port: int, rnd: Rank, vrnd: Rank, vval: Tuple[Endpoint, ...]) -> Phase1bMessage:
+    return Phase1bMessage(
+        sender=ep(sender_port), configuration_id=1, rnd=rnd, vrnd=vrnd, vval=vval
+    )
+
+
+CRND = Rank(2, 1)
+V1 = (ep(1001),)
+V2 = (ep(1002),)
+V3 = (ep(1003),)
+
+
+class TestCoordinatorRule:
+    def test_empty_messages_raise(self):
+        with pytest.raises(ValueError):
+            select_proposal_using_coordinatorrule_alias = select_proposal_using_coordinator_rule(
+                [], 5
+            )
+
+    def test_all_empty_vvals_choose_nothing(self):
+        msgs = [p1b(i, CRND, Rank(0, 0), ()) for i in range(3)]
+        assert select_proposal_using_coordinator_rule(msgs, 5) == ()
+
+    def test_single_voter_value_wins(self):
+        msgs = [p1b(0, CRND, Rank(1, 1), V1)] + [p1b(i, CRND, Rank(0, 0), ()) for i in range(1, 4)]
+        assert select_proposal_using_coordinator_rule(msgs, 5) == V1
+
+    def test_unique_value_at_max_vrnd_wins(self):
+        msgs = [
+            p1b(0, CRND, Rank(1, 1), V1),
+            p1b(1, CRND, Rank(1, 1), V1),
+            p1b(2, CRND, Rank(0, 0), ()),
+        ]
+        assert select_proposal_using_coordinator_rule(msgs, 5) == V1
+
+    def test_lower_vrnd_values_are_ignored(self):
+        msgs = [
+            p1b(0, CRND, Rank(1, 2), V2),
+            p1b(1, CRND, Rank(1, 1), V1),
+            p1b(2, CRND, Rank(1, 1), V1),
+        ]
+        assert select_proposal_using_coordinator_rule(msgs, 5) == V2
+
+    def test_majority_over_quarter_wins(self):
+        # N=10: need count > N/4 = 2.5, i.e. >= 3 among max-vrnd votes.
+        msgs = (
+            [p1b(i, CRND, Rank(1, 1), V1) for i in range(3)]
+            + [p1b(3 + i, CRND, Rank(1, 1), V2) for i in range(2)]
+            + [p1b(5 + i, CRND, Rank(1, 1), V3) for i in range(1)]
+        )
+        assert select_proposal_using_coordinator_rule(msgs, 10) == V1
+
+    def test_no_quarter_majority_picks_any_nonempty(self):
+        # N=20: threshold > 5; two values with 2 votes each — any proposed
+        # value is safe.
+        msgs = [
+            p1b(0, CRND, Rank(1, 1), V1),
+            p1b(1, CRND, Rank(1, 1), V1),
+            p1b(2, CRND, Rank(1, 1), V2),
+            p1b(3, CRND, Rank(1, 1), V2),
+        ]
+        chosen = select_proposal_using_coordinator_rule(msgs, 20)
+        assert chosen in (V1, V2)
+
+    def test_shuffled_quorums_always_pick_safe_value(self):
+        # Mirrors the reference's shuffled-iteration scheme: whenever one value
+        # has a fast-round quorum intersection (> N/4 identical at max vrnd),
+        # every shuffle must pick it.
+        rng = random.Random(42)
+        n = 10
+        msgs = [p1b(i, CRND, Rank(1, 1), V1) for i in range(4)] + [
+            p1b(4 + i, CRND, Rank(1, 1), V2) for i in range(2)
+        ]
+        for _ in range(100):
+            rng.shuffle(msgs)
+            assert select_proposal_using_coordinator_rule(msgs, n) == V1
+
+
+# ---------------------------------------------------------------------------
+# Full-protocol runs over direct wiring (reference: PaxosTests.java:72-191,
+# DirectMessagingClient/DirectBroadcaster :424-476).
+# ---------------------------------------------------------------------------
+
+
+class DirectNetwork:
+    """Synchronously delivers consensus messages between FastPaxos instances,
+    with optional per-message-type drops (PaxosTests.java:424-446)."""
+
+    def __init__(self) -> None:
+        self.instances: Dict[Endpoint, FastPaxos] = {}
+        self.drop_types: List[Type] = []
+        self._queue: List[Tuple[Optional[Endpoint], object]] = []
+        self._pumping = False
+
+    def broadcast(self, request) -> None:
+        self._enqueue(None, request)
+
+    def send(self, destination: Endpoint, request) -> None:
+        self._enqueue(destination, request)
+
+    def _enqueue(self, destination, request) -> None:
+        if any(isinstance(request, t) for t in self.drop_types):
+            return
+        self._queue.append((destination, request))
+        # Pump iteratively (not recursively) so delivery order is FIFO like a
+        # real network, and deep chains don't blow the stack.
+        if self._pumping:
+            return
+        self._pumping = True
+        try:
+            while self._queue:
+                dst, req = self._queue.pop(0)
+                targets = [self.instances[dst]] if dst is not None else list(
+                    self.instances.values()
+                )
+                for instance in targets:
+                    instance.handle_message(req)
+        finally:
+            self._pumping = False
+
+
+def build_cluster(n: int, network: DirectNetwork, decisions: Dict[Endpoint, Tuple[Endpoint, ...]]):
+    clock = ManualClock()
+    for i in range(n):
+        addr = ep(i)
+
+        def on_decide(hosts, addr=addr):
+            assert addr not in decisions, "node decided twice"
+            decisions[addr] = tuple(hosts)
+
+        network.instances[addr] = FastPaxos(
+            my_addr=addr,
+            configuration_id=1,
+            membership_size=n,
+            broadcast_fn=network.broadcast,
+            send_fn=network.send,
+            on_decide=on_decide,
+            clock=clock,
+            rng=random.Random(i),
+        )
+    return clock
+
+
+@pytest.mark.parametrize("n", [5, 6, 10, 11, 20])
+def test_all_agree_fast_round(n):
+    network = DirectNetwork()
+    decisions: Dict[Endpoint, Tuple[Endpoint, ...]] = {}
+    build_cluster(n, network, decisions)
+    proposal = (ep(9999),)
+    for instance in list(network.instances.values()):
+        instance.propose(proposal, recovery_delay_ms=1e9)
+    assert len(decisions) == n
+    assert all(d == proposal for d in decisions.values())
+
+
+@pytest.mark.parametrize("n", [6, 10, 20])
+def test_fast_round_silenced_classic_recovers(n):
+    network = DirectNetwork()
+    decisions: Dict[Endpoint, Tuple[Endpoint, ...]] = {}
+    build_cluster(n, network, decisions)
+    network.drop_types = [FastRoundPhase2bMessage]
+    proposal = (ep(9999),)
+    for instance in list(network.instances.values()):
+        instance.propose(proposal, recovery_delay_ms=1e9)
+    assert decisions == {}
+    # One node's fallback timer fires and drives a classic round.
+    network.drop_types = []
+    network.instances[ep(0)].start_classic_paxos_round()
+    assert len(decisions) == n
+    assert all(d == proposal for d in decisions.values())
+
+
+@pytest.mark.parametrize("n,votes_a", [(6, 4), (10, 7), (20, 14)])
+def test_mixed_fast_round_then_classic(n, votes_a):
+    """Fast round with conflicting proposals is silenced; a classic round must
+    still decide on one of the proposed values, everywhere."""
+    network = DirectNetwork()
+    decisions: Dict[Endpoint, Tuple[Endpoint, ...]] = {}
+    build_cluster(n, network, decisions)
+    network.drop_types = [FastRoundPhase2bMessage]
+    va, vb = (ep(9999),), (ep(8888),)
+    for i, instance in enumerate(network.instances.values()):
+        instance.propose(va if i < votes_a else vb, recovery_delay_ms=1e9)
+    network.drop_types = []
+    network.instances[ep(1)].start_classic_paxos_round()
+    assert len(decisions) == n
+    chosen = set(decisions.values())
+    assert len(chosen) == 1
+    assert chosen.pop() in (va, vb)
+
+
+def test_competing_coordinators_highest_rank_wins():
+    n = 10
+    network = DirectNetwork()
+    decisions: Dict[Endpoint, Tuple[Endpoint, ...]] = {}
+    build_cluster(n, network, decisions)
+    network.drop_types = [FastRoundPhase2bMessage]
+    proposal = (ep(9999),)
+    for instance in list(network.instances.values()):
+        instance.propose(proposal, recovery_delay_ms=1e9)
+    network.drop_types = []
+    # Two nodes race to coordinate round 2; ranks order them.
+    network.instances[ep(0)].start_classic_paxos_round()
+    network.instances[ep(1)].start_classic_paxos_round()
+    assert len(decisions) == n
+    assert all(d == proposal for d in decisions.values())
+
+
+# ---------------------------------------------------------------------------
+# Fast-round quorum tables (reference: FastPaxosWithoutFallbackTests.java).
+# ---------------------------------------------------------------------------
+
+
+def feed_votes(instance: FastPaxos, proposal, senders) -> None:
+    for s in senders:
+        instance.handle_message(
+            FastRoundPhase2bMessage(sender=s, configuration_id=1, endpoints=proposal)
+        )
+
+
+@pytest.mark.parametrize("n", [5, 6, 10, 11, 20, 21, 102])
+def test_fast_quorum_exact_threshold(n):
+    quorum = fast_paxos_quorum(n)
+    decided: List[Tuple[Endpoint, ...]] = []
+    instance = FastPaxos(
+        my_addr=ep(0),
+        configuration_id=1,
+        membership_size=n,
+        broadcast_fn=lambda req: None,
+        send_fn=lambda dst, req: None,
+        on_decide=lambda hosts: decided.append(tuple(hosts)),
+        clock=ManualClock(),
+    )
+    proposal = (ep(9999),)
+    feed_votes(instance, proposal, [ep(100 + i) for i in range(quorum - 1)])
+    assert decided == []
+    feed_votes(instance, proposal, [ep(100 + quorum - 1)])
+    assert decided == [proposal]
+
+
+@pytest.mark.parametrize("n", [6, 10, 20, 48, 102])
+def test_fast_quorum_conflicts_beyond_f_block_decision(n):
+    quorum = fast_paxos_quorum(n)
+    f = n - quorum
+    decided: List[Tuple[Endpoint, ...]] = []
+    instance = FastPaxos(
+        my_addr=ep(0),
+        configuration_id=1,
+        membership_size=n,
+        broadcast_fn=lambda req: None,
+        send_fn=lambda dst, req: None,
+        on_decide=lambda hosts: decided.append(tuple(hosts)),
+        clock=ManualClock(),
+    )
+    va, vb = (ep(9999),), (ep(8888),)
+    # f + 1 conflicting votes leave fewer than quorum identical votes possible.
+    feed_votes(instance, vb, [ep(100 + i) for i in range(f + 1)])
+    feed_votes(instance, va, [ep(200 + i) for i in range(n - f - 1)])
+    assert decided == []
+
+
+def test_duplicate_and_stale_votes_ignored():
+    n = 6
+    decided: List[Tuple[Endpoint, ...]] = []
+    instance = FastPaxos(
+        my_addr=ep(0),
+        configuration_id=1,
+        membership_size=n,
+        broadcast_fn=lambda req: None,
+        send_fn=lambda dst, req: None,
+        on_decide=lambda hosts: decided.append(tuple(hosts)),
+        clock=ManualClock(),
+    )
+    proposal = (ep(9999),)
+    # Duplicate senders only count once; wrong config ids are discarded.
+    for _ in range(10):
+        feed_votes(instance, proposal, [ep(101)])
+    instance.handle_message(
+        FastRoundPhase2bMessage(sender=ep(102), configuration_id=999, endpoints=proposal)
+    )
+    assert decided == []

@@ -1,0 +1,242 @@
+"""MembershipView tests, mirroring the reference's MembershipViewTest scenarios
+(rapid/src/test/java/com/vrg/rapid/MembershipViewTest.java)."""
+
+import pytest
+
+from rapid_tpu.errors import (
+    NodeAlreadyInRingError,
+    NodeNotInRingError,
+    UUIDAlreadySeenError,
+)
+from rapid_tpu.protocol.view import MembershipView
+from rapid_tpu.types import Endpoint, JoinStatusCode, NodeId
+
+K = 10
+
+
+def ep(i: int, host: str = "127.0.0.1") -> Endpoint:
+    return Endpoint(host, i)
+
+
+def nid(i: int) -> NodeId:
+    return NodeId(high=0, low=i)
+
+
+def test_one_ring_addition():
+    view = MembershipView(K)
+    view.ring_add(ep(123), nid(1))
+    for ring_idx in range(K):
+        ring = view.ring(ring_idx)
+        assert ring == [ep(123)]
+
+
+def test_multiple_ring_additions():
+    view = MembershipView(K)
+    num = 10
+    for i in range(num):
+        view.ring_add(ep(i), nid(i))
+    for ring_idx in range(K):
+        assert len(view.ring(ring_idx)) == num
+
+
+def test_ring_readditions_throw():
+    view = MembershipView(K)
+    num = 10
+    for i in range(num):
+        view.ring_add(ep(i), nid(i))
+    throws = 0
+    for i in range(num):
+        try:
+            view.ring_add(ep(i), nid(i + 100))
+        except NodeAlreadyInRingError:
+            throws += 1
+    assert throws == num
+
+
+def test_delete_absent_node_throws():
+    view = MembershipView(K)
+    throws = 0
+    for i in range(10):
+        try:
+            view.ring_delete(ep(i))
+        except NodeNotInRingError:
+            throws += 1
+    assert throws == 10
+
+
+def test_additions_and_deletions():
+    view = MembershipView(K)
+    num = 10
+    for i in range(num):
+        view.ring_add(ep(i), nid(i))
+    for i in range(num):
+        view.ring_delete(ep(i))
+    for ring_idx in range(K):
+        assert view.ring(ring_idx) == []
+
+
+def test_monitoring_single_node_is_empty():
+    view = MembershipView(K)
+    view.ring_add(ep(1), nid(1))
+    assert view.subjects_of(ep(1)) == []
+    assert view.observers_of(ep(1)) == []
+
+
+def test_monitoring_empty_view_throws():
+    view = MembershipView(K)
+    with pytest.raises(NodeNotInRingError):
+        view.observers_of(ep(1))
+    with pytest.raises(NodeNotInRingError):
+        view.subjects_of(ep(1))
+
+
+def test_monitoring_two_nodes():
+    view = MembershipView(K)
+    view.ring_add(ep(1), nid(1))
+    view.ring_add(ep(2), nid(2))
+    assert len(view.subjects_of(ep(1))) == K
+    assert len(view.observers_of(ep(1))) == K
+    # With two nodes, every ring's successor/predecessor is the other node.
+    assert set(view.subjects_of(ep(1))) == {ep(2)}
+    assert set(view.observers_of(ep(1))) == {ep(2)}
+
+
+def test_monitoring_three_nodes_with_delete():
+    view = MembershipView(K)
+    view.ring_add(ep(1), nid(1))
+    view.ring_add(ep(2), nid(2))
+    view.ring_add(ep(3), nid(3))
+    assert len(view.subjects_of(ep(1))) == K
+    assert len(view.observers_of(ep(1))) == K
+    assert set(view.subjects_of(ep(1))) == {ep(2), ep(3)}
+    assert set(view.observers_of(ep(1))) == {ep(2), ep(3)}
+    view.ring_delete(ep(2))
+    assert set(view.subjects_of(ep(1))) == {ep(3)}
+    assert set(view.observers_of(ep(1))) == {ep(3)}
+
+
+def test_monitoring_multiple_nodes():
+    view = MembershipView(K)
+    num = 1000
+    for i in range(num):
+        view.ring_add(ep(i), nid(i))
+    for i in range(num):
+        assert len(view.observers_of(ep(i))) == K
+        assert len(view.subjects_of(ep(i))) == K
+    # Observer/subject relationships are symmetric: o observes s on ring k
+    # iff s is the k-predecessor of o.
+    for i in range(0, num, 100):
+        node = ep(i)
+        for ring_number, subject in enumerate(view.subjects_of(node)):
+            assert view.observers_of(subject)[ring_number] == node
+
+
+def test_expected_observers_single_node_bootstrap():
+    view = MembershipView(K)
+    view.ring_add(ep(1), nid(1))
+    joiner = ep(2)
+    expected = view.expected_observers_of(joiner)
+    assert len(expected) == K
+    assert set(expected) == {ep(1)}
+
+
+def test_expected_observers_match_post_join_subject_relationship():
+    view = MembershipView(K)
+    num = 20
+    for i in range(num):
+        view.ring_add(ep(i), nid(i))
+    joiner = ep(5000)
+    expected = view.expected_observers_of(joiner)
+    assert len(expected) == K
+    # The gatekeepers are the joiner's ring predecessors; after the join they
+    # are exactly the joiner's subjects-relationship (reference semantics:
+    # getExpectedObserversOf and getSubjectsOf share getPredecessorsOf,
+    # MembershipView.java:292-322).
+    view.ring_add(joiner, nid(5000))
+    assert view.subjects_of(joiner) == expected
+
+
+def test_expected_observers_grow_towards_k():
+    # Mirrors monitoringRelationshipBootstrapMultiple
+    # (MembershipViewTest.java:319-344).
+    view = MembershipView(K)
+    joiner = ep(1233)
+    num_observers = 0
+    for i in range(20):
+        view.ring_add(ep(1234 + i), nid(i))
+        actual = len(view.expected_observers_of(joiner))
+        assert num_observers <= actual
+        num_observers = actual
+    assert K - 3 <= num_observers <= K
+
+
+def test_unique_id_rejections():
+    view = MembershipView(K)
+    view.ring_add(ep(1), nid(1))
+    with pytest.raises(UUIDAlreadySeenError):
+        view.ring_add(ep(2), nid(1))
+    # Identifiers stay poisoned even after the node leaves.
+    view.ring_add(ep(2), nid(2))
+    view.ring_delete(ep(2))
+    with pytest.raises(UUIDAlreadySeenError):
+        view.ring_add(ep(2), nid(2))
+    assert view.membership_size == 1
+
+
+def test_is_safe_to_join():
+    view = MembershipView(K)
+    view.ring_add(ep(1), nid(1))
+    assert view.is_safe_to_join(ep(1), nid(99)) == JoinStatusCode.HOSTNAME_ALREADY_IN_RING
+    assert view.is_safe_to_join(ep(2), nid(1)) == JoinStatusCode.UUID_ALREADY_IN_RING
+    assert view.is_safe_to_join(ep(2), nid(2)) == JoinStatusCode.SAFE_TO_JOIN
+
+
+def test_configuration_id_changes_every_membership_change():
+    view = MembershipView(K)
+    num = 1000
+    seen = set()
+    for i in range(num):
+        view.ring_add(ep(i), nid(i))
+        seen.add(view.configuration_id)
+    assert len(seen) == num
+    for i in range(num):
+        view.ring_delete(ep(i))
+        seen.add(view.configuration_id)
+    assert len(seen) == 2 * num
+
+
+def test_configurations_across_views_agree():
+    v1 = MembershipView(K)
+    v2 = MembershipView(K)
+    num = 100
+    # Insert in different orders; converged views must agree on rings and id.
+    for i in range(num):
+        v1.ring_add(ep(i), nid(i))
+    for i in reversed(range(num)):
+        v2.ring_add(ep(i), nid(i))
+    for ring_idx in range(K):
+        assert v1.ring(ring_idx) == v2.ring(ring_idx)
+    assert v1.configuration_id == v2.configuration_id
+
+
+def test_bootstrap_from_configuration():
+    v1 = MembershipView(K)
+    ids = [nid(i) for i in range(50)]
+    for i in range(50):
+        v1.ring_add(ep(i), ids[i])
+    config = v1.configuration
+    v2 = MembershipView(K, node_ids=config.node_ids, endpoints=config.endpoints)
+    assert v2.configuration_id == v1.configuration_id
+    for ring_idx in range(K):
+        assert v1.ring(ring_idx) == v2.ring(ring_idx)
+
+
+def test_ring_numbers():
+    view = MembershipView(K)
+    for i in range(10):
+        view.ring_add(ep(i), nid(i))
+    node = ep(0)
+    for ring_number, subject in enumerate(view.subjects_of(node)):
+        assert ring_number in view.ring_numbers(node, subject)
+    total = sum(len(view.ring_numbers(node, s)) for s in set(view.subjects_of(node)))
+    assert total == K
