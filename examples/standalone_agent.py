@@ -1,0 +1,109 @@
+"""Standalone cluster agent over the TCP transport.
+
+CLI parity with the reference's example agents
+(examples/src/main/java/com/vrg/standalone/StandaloneAgent.java:92-110 and
+AgentWithNettyMessaging.java): the seed starts a cluster, everyone else joins
+it; three subscriptions log view changes; membership size prints every second.
+
+Run a 3-node cluster on localhost:
+
+    python examples/standalone_agent.py --listen-address 127.0.0.1:9001 \
+        --seed-address 127.0.0.1:9001 &
+    python examples/standalone_agent.py --listen-address 127.0.0.1:9002 \
+        --seed-address 127.0.0.1:9001 &
+    python examples/standalone_agent.py --listen-address 127.0.0.1:9003 \
+        --seed-address 127.0.0.1:9001 &
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from rapid_tpu.messaging.tcp import TcpClient, TcpServer
+from rapid_tpu.protocol.cluster import Cluster
+from rapid_tpu.protocol.events import ClusterEvents
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import Endpoint
+
+LOG = logging.getLogger("standalone_agent")
+
+
+def subscription_logger(event: ClusterEvents):
+    def callback(change):
+        LOG.info(
+            "%s: config %d, %d members, delta: %s",
+            event.name,
+            change.configuration_id,
+            len(change.membership),
+            [(str(sc.endpoint), sc.status.name) for sc in change.status_changes],
+        )
+
+    return callback
+
+
+async def run(args) -> None:
+    listen = Endpoint.parse(args.listen_address)
+    seed = Endpoint.parse(args.seed_address)
+    settings = Settings()
+    metadata = (("role", args.role.encode()),) if args.role else ()
+    client, server = TcpClient(listen, settings), TcpServer(listen)
+
+    if listen == seed:
+        LOG.info("starting cluster as seed at %s", listen)
+        cluster = await Cluster.start(
+            listen, settings=settings, client=client, server=server, metadata=metadata
+        )
+    else:
+        LOG.info("joining cluster at %s from %s", seed, listen)
+        cluster = await Cluster.join(
+            seed, listen, settings=settings, client=client, server=server, metadata=metadata
+        )
+
+    for event in (
+        ClusterEvents.VIEW_CHANGE_PROPOSAL,
+        ClusterEvents.VIEW_CHANGE,
+        ClusterEvents.KICKED,
+    ):
+        cluster.register_subscription(event, subscription_logger(event))
+
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+
+    async def reporter():
+        while not stop.is_set():
+            LOG.info("membership size: %d (config %d)",
+                     cluster.membership_size, cluster.service.view.configuration_id)
+            await asyncio.sleep(args.report_interval)
+
+    reporter_task = asyncio.ensure_future(reporter())
+    await stop.wait()
+    reporter_task.cancel()
+    LOG.info("leaving gracefully")
+    await cluster.leave_gracefully()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="rapid_tpu standalone agent")
+    parser.add_argument("--listen-address", required=True, help="host:port to listen on")
+    parser.add_argument("--seed-address", required=True,
+                        help="host:port of the seed (same as listen-address to bootstrap)")
+    parser.add_argument("--role", default="", help="role metadata tag shared with the cluster")
+    parser.add_argument("--report-interval", type=float, default=1.0)
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s"
+    )
+    asyncio.run(run(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -14,4 +14,18 @@ from rapid_tpu.types import Endpoint, NodeId
 
 __version__ = "0.1.0"
 
-__all__ = ["Settings", "Endpoint", "NodeId", "__version__"]
+__all__ = ["Settings", "Endpoint", "NodeId", "Cluster", "ClusterEvents", "__version__"]
+
+
+def __getattr__(name):
+    # Lazy: the protocol runtime pulls in asyncio machinery that pure-kernel
+    # users (and the sharded engine) never need.
+    if name == "Cluster":
+        from rapid_tpu.protocol.cluster import Cluster
+
+        return Cluster
+    if name == "ClusterEvents":
+        from rapid_tpu.protocol.events import ClusterEvents
+
+        return ClusterEvents
+    raise AttributeError(f"module 'rapid_tpu' has no attribute {name!r}")

@@ -1,0 +1,27 @@
+from rapid_tpu.messaging.base import (
+    Broadcaster,
+    MessagingClient,
+    MessagingServer,
+    UnicastToAllBroadcaster,
+)
+from rapid_tpu.messaging.inprocess import (
+    ClientDelayer,
+    InProcessClient,
+    InProcessNetwork,
+    InProcessServer,
+    ServerDropFirstN,
+)
+from rapid_tpu.messaging.retries import call_with_retries
+
+__all__ = [
+    "Broadcaster",
+    "MessagingClient",
+    "MessagingServer",
+    "UnicastToAllBroadcaster",
+    "ClientDelayer",
+    "InProcessClient",
+    "InProcessNetwork",
+    "InProcessServer",
+    "ServerDropFirstN",
+    "call_with_retries",
+]

@@ -1,0 +1,99 @@
+"""Messaging plugin SPI.
+
+The critical design seam of the reference, reproduced exactly: the protocol
+core never touches sockets. All sends go through ``MessagingClient``
+(``messaging/IMessagingClient.java:25-49``), all receives enter through a
+``MessagingServer`` that forwards to ``MembershipService.handle_message``
+(``messaging/IMessagingServer.java:24-40``), and broadcast fan-out is a
+``Broadcaster`` (``messaging/IBroadcaster.java:26-29``). Transports are
+swapped via ``Cluster`` builder arguments.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import random
+from typing import List, Optional, TYPE_CHECKING
+
+from rapid_tpu.types import Endpoint, RapidRequest, RapidResponse
+
+if TYPE_CHECKING:
+    from rapid_tpu.protocol.service import MembershipService
+
+
+class MessagingClient(abc.ABC):
+    """Send messages to remote endpoints.
+
+    ``send`` retransmits per the transport's retry policy and raises on final
+    failure; ``send_best_effort`` makes one attempt and returns None on
+    failure (IMessagingClient.java:25-49).
+    """
+
+    @abc.abstractmethod
+    async def send(self, remote: Endpoint, request: RapidRequest) -> RapidResponse:
+        ...
+
+    @abc.abstractmethod
+    async def send_best_effort(
+        self, remote: Endpoint, request: RapidRequest
+    ) -> Optional[RapidResponse]:
+        ...
+
+    def send_nowait(self, remote: Endpoint, request: RapidRequest) -> None:
+        """Fire-and-forget best-effort send (broadcasts, consensus traffic)."""
+        asyncio.ensure_future(self.send_best_effort(remote, request))
+
+    @abc.abstractmethod
+    async def shutdown(self) -> None:
+        ...
+
+
+class MessagingServer(abc.ABC):
+    """Receive messages and hand them to the membership service. The server
+    starts before the service exists (join protocol); probes received in that
+    window answer BOOTSTRAPPING (GrpcServer.java:77-96)."""
+
+    @abc.abstractmethod
+    async def start(self) -> None:
+        ...
+
+    @abc.abstractmethod
+    async def shutdown(self) -> None:
+        ...
+
+    @abc.abstractmethod
+    def set_membership_service(self, service: "MembershipService") -> None:
+        ...
+
+
+class Broadcaster(abc.ABC):
+    """Fan a request out to all members (IBroadcaster.java:26-29)."""
+
+    @abc.abstractmethod
+    def broadcast(self, request: RapidRequest) -> None:
+        ...
+
+    @abc.abstractmethod
+    def set_membership(self, members: List[Endpoint]) -> None:
+        ...
+
+
+class UnicastToAllBroadcaster(Broadcaster):
+    """Default broadcaster: best-effort unicast to every member, in an order
+    shuffled once per configuration to spread load
+    (UnicastToAllBroadcaster.java:46-62)."""
+
+    def __init__(self, client: MessagingClient, rng: Optional[random.Random] = None) -> None:
+        self._client = client
+        self._members: List[Endpoint] = []
+        self._rng = rng if rng is not None else random.Random()
+
+    def broadcast(self, request: RapidRequest) -> None:
+        for member in self._members:
+            self._client.send_nowait(member, request)
+
+    def set_membership(self, members: List[Endpoint]) -> None:
+        members = list(members)
+        self._rng.shuffle(members)
+        self._members = members

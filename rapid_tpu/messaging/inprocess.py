@@ -1,0 +1,170 @@
+"""In-process transport: many virtual endpoints in one event loop.
+
+The reference's tests run whole clusters over in-process gRPC
+(``GrpcServer.java:132-148`` in-process mode, ``settings.setUseInProcessTransport``);
+this module is the equivalent first-class transport, plus the fault-injection
+interceptor seam its test fixtures provide (``MessageDropInterceptor.java:24-73``:
+drop-first-N-of-type at the server, latch-delay-by-type at the client).
+
+This transport is also how co-located virtual nodes talk on a TPU host in the
+hybrid host/device deployment: message passing is a Python method call, so the
+whole cluster's protocol traffic stays in one process.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Dict, List, Optional, Type
+
+from rapid_tpu.errors import ShuttingDownError
+from rapid_tpu.messaging.base import MessagingClient, MessagingServer
+from rapid_tpu.messaging.retries import call_with_retries
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import (
+    Endpoint,
+    JoinMessage,
+    PreJoinMessage,
+    ProbeMessage,
+    ProbeResponse,
+    NodeStatus,
+    RapidRequest,
+    RapidResponse,
+)
+
+
+class InProcessNetwork:
+    """A registry of in-process servers, shared by the clients of one test or
+    one co-located deployment."""
+
+    def __init__(self) -> None:
+        self.servers: Dict[Endpoint, "InProcessServer"] = {}
+        # Endpoints listed here are unreachable (simulated crash/partition).
+        self.blackholed: set = set()
+        # Directional blackholes: (src, dst) pairs that drop.
+        self.blackholed_links: set = set()
+
+    def server_for(self, endpoint: Endpoint) -> Optional["InProcessServer"]:
+        return self.servers.get(endpoint)
+
+
+class ServerDropFirstN:
+    """Drop the first N messages of a type at the server
+    (ServerDropInterceptors.FirstN, MessageDropInterceptor.java:24-49)."""
+
+    def __init__(self, message_type: Type, count: int) -> None:
+        self._type = message_type
+        self._remaining = count
+
+    def should_drop(self, request: RapidRequest) -> bool:
+        if isinstance(request, self._type) and self._remaining > 0:
+            self._remaining -= 1
+            return True
+        return False
+
+
+class ClientDelayer:
+    """Hold messages of a type until a latch opens
+    (ClientInterceptors.Delayer, MessageDropInterceptor.java:51-73)."""
+
+    def __init__(self, message_type: Type) -> None:
+        self._type = message_type
+        self._event = asyncio.Event()
+
+    def open(self) -> None:
+        self._event.set()
+
+    async def maybe_delay(self, request: RapidRequest) -> None:
+        if isinstance(request, self._type) and not self._event.is_set():
+            await self._event.wait()
+
+
+class InProcessServer(MessagingServer):
+    def __init__(self, network: InProcessNetwork, listen_address: Endpoint) -> None:
+        self._network = network
+        self.listen_address = listen_address
+        self._service = None
+        self._started = False
+        self.drop_interceptors: List[ServerDropFirstN] = []
+
+    def set_membership_service(self, service) -> None:
+        self._service = service
+
+    async def start(self) -> None:
+        self._network.servers[self.listen_address] = self
+        self._started = True
+
+    async def shutdown(self) -> None:
+        self._network.servers.pop(self.listen_address, None)
+        self._started = False
+
+    async def handle(self, request: RapidRequest) -> RapidResponse:
+        if not self._started:
+            raise ConnectionError(f"server {self.listen_address} not started")
+        for interceptor in self.drop_interceptors:
+            if interceptor.should_drop(request):
+                raise ConnectionError("dropped by interceptor")
+        if self._service is None:
+            # Answer probes while bootstrapping; joiners' FDs tolerate this
+            # status (GrpcServer.java:77-96).
+            if isinstance(request, ProbeMessage):
+                return ProbeResponse(status=NodeStatus.BOOTSTRAPPING)
+            raise ConnectionError(f"server {self.listen_address} has no service yet")
+        return await self._service.handle_message(request)
+
+
+class InProcessClient(MessagingClient):
+    def __init__(
+        self,
+        network: InProcessNetwork,
+        my_addr: Endpoint,
+        settings: Optional[Settings] = None,
+    ) -> None:
+        self._network = network
+        self.my_addr = my_addr
+        self._settings = settings if settings is not None else Settings()
+        self._shut_down = False
+        self.delayers: List[ClientDelayer] = []
+
+    def _timeout_ms_for(self, request: RapidRequest) -> float:
+        # Per-message-type deadlines (GrpcClient.java:194-203).
+        if isinstance(request, (JoinMessage, PreJoinMessage)):
+            return self._settings.rpc_join_timeout_ms
+        if isinstance(request, ProbeMessage):
+            return self._settings.rpc_probe_timeout_ms
+        return self._settings.rpc_timeout_ms
+
+    async def _attempt(self, remote: Endpoint, request: RapidRequest) -> RapidResponse:
+        if self._shut_down:
+            raise ShuttingDownError(f"client {self.my_addr} is shut down")
+        for delayer in self.delayers:
+            await delayer.maybe_delay(request)
+        if remote in self._network.blackholed or self.my_addr in self._network.blackholed:
+            raise ConnectionError(f"{remote} unreachable (blackholed)")
+        if (self.my_addr, remote) in self._network.blackholed_links:
+            raise ConnectionError(f"link {self.my_addr}->{remote} blackholed")
+        server = self._network.server_for(remote)
+        if server is None:
+            raise ConnectionError(f"no server at {remote}")
+        # Yield to the loop so in-process delivery preserves async semantics.
+        await asyncio.sleep(0)
+        return await asyncio.wait_for(
+            server.handle(request), timeout=self._timeout_ms_for(request) / 1000.0
+        )
+
+    async def send(self, remote: Endpoint, request: RapidRequest) -> RapidResponse:
+        return await call_with_retries(
+            lambda: self._attempt(remote, request), self._settings.rpc_default_retries
+        )
+
+    async def send_best_effort(
+        self, remote: Endpoint, request: RapidRequest
+    ) -> Optional[RapidResponse]:
+        try:
+            return await self._attempt(remote, request)
+        except ShuttingDownError:
+            raise
+        except Exception:
+            return None
+
+    async def shutdown(self) -> None:
+        self._shut_down = True

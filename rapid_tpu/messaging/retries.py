@@ -1,0 +1,23 @@
+"""Async retry combinator (reference: messaging/impl/Retries.java:43-90)."""
+
+from __future__ import annotations
+
+from typing import Awaitable, Callable, TypeVar
+
+T = TypeVar("T")
+
+
+async def call_with_retries(
+    call: Callable[[], Awaitable[T]],
+    retries: int,
+) -> T:
+    """Run ``call`` until it succeeds, for at most ``retries + 1`` attempts;
+    re-raises the last failure."""
+    last_exc: BaseException | None = None
+    for _ in range(retries + 1):
+        try:
+            return await call()
+        except BaseException as exc:  # noqa: BLE001 — transport failures vary by impl
+            last_exc = exc
+    assert last_exc is not None
+    raise last_exc

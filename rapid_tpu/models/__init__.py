@@ -1,0 +1,18 @@
+from rapid_tpu.models.state import (
+    EngineConfig,
+    EngineState,
+    FaultInputs,
+    StepEvents,
+    initial_state,
+)
+from rapid_tpu.models.virtual_cluster import VirtualCluster, engine_step
+
+__all__ = [
+    "EngineConfig",
+    "EngineState",
+    "FaultInputs",
+    "StepEvents",
+    "initial_state",
+    "VirtualCluster",
+    "engine_step",
+]

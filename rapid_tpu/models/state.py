@@ -1,0 +1,149 @@
+"""Engine state: N virtual membership endpoints as struct-of-arrays.
+
+This is the TPU-native replacement for the reference's object-per-node
+architecture: one ``EngineState`` pytree holds every virtual node's protocol
+state in padded device arrays (static shapes; membership changes flip bits in
+``alive``), so a whole cluster's protocol round is a single fused XLA program.
+
+Cohorts: receivers with identical connectivity share cut-detector state. In a
+reliably-delivered co-located deployment all healthy nodes see the same alert
+stream, so their detectors are bit-identical — cohort 0. Fault injection that
+partitions receivers (asymmetric/one-way links) assigns affected nodes to
+further cohorts; C stays tiny while N scales to 100K+.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from rapid_tpu.ops.hashing import masked_set_hash
+from rapid_tpu.ops.rings import ring_topology
+
+
+class EngineConfig(NamedTuple):
+    """Static (compile-time) engine parameters."""
+
+    n: int  # padded virtual-node slots
+    k: int  # rings
+    h: int  # high watermark
+    l: int  # low watermark
+    c: int = 2  # receiver cohorts
+    fd_threshold: int = 3  # consecutive failed probe windows before alerting
+    # Rounds an announced proposal may sit undecided before the classic-Paxos
+    # fallback fires (models FastPaxos.java:106-107's jittered recovery; the
+    # coordinator rule then forces the plurality value, Paxos.java:271-328).
+    fallback_rounds: int = 8
+
+
+class EngineState(NamedTuple):
+    """Device state for one virtual cluster (all arrays padded to n slots)."""
+
+    # Identity & topology (key lanes static per slot; topology re-derived on
+    # view change).
+    key_hi: jnp.ndarray  # [k, n] uint32
+    key_lo: jnp.ndarray  # [k, n] uint32
+    id_hi: jnp.ndarray  # [n] uint32 — node-identity lanes for set hashes
+    id_lo: jnp.ndarray  # [n] uint32
+    alive: jnp.ndarray  # [n] bool — current membership
+    obs_idx: jnp.ndarray  # [k, n] int32 — ring successor (observer) per slot
+    subj_idx: jnp.ndarray  # [k, n] int32 — ring predecessor (subject) per slot
+    inval_obs: jnp.ndarray  # [k, n] int32 — invalidation-observer table
+    config_epoch: jnp.ndarray  # int32 — counts view changes
+    config_hi: jnp.ndarray  # uint32 — commutative config-id lanes
+    config_lo: jnp.ndarray  # uint32
+    n_members: jnp.ndarray  # int32 — membership size of this configuration
+
+    # Failure-detector state per monitoring edge (subject, ring).
+    fd_count: jnp.ndarray  # [n, k] int32 consecutive failed windows
+    fd_fired: jnp.ndarray  # [n, k] bool alert already emitted
+
+    # Joiner bookkeeping.
+    join_pending: jnp.ndarray  # [n] bool — slots waiting to be admitted
+
+    # Cut-detector state per cohort.
+    cohort_of: jnp.ndarray  # [n] int32 — receiver cohort of each node
+    reports: jnp.ndarray  # [c, n, k] bool
+    seen_down: jnp.ndarray  # [c] bool
+    released: jnp.ndarray  # [c, n] bool
+    announced: jnp.ndarray  # [c] bool — cohort already proposed this config
+    prop_mask: jnp.ndarray  # [c, n] bool — cohort's announced proposal
+    prop_hi: jnp.ndarray  # [c] uint32
+    prop_lo: jnp.ndarray  # [c] uint32
+
+    # Fast-round votes.
+    vote_hi: jnp.ndarray  # [n] uint32
+    vote_lo: jnp.ndarray  # [n] uint32
+    vote_valid: jnp.ndarray  # [n] bool
+
+    # Rounds spent with an announced-but-undecided proposal (fallback timer).
+    rounds_undecided: jnp.ndarray  # int32
+
+
+def initial_state(cfg: EngineConfig, key_hi, key_lo, id_hi, id_lo, alive) -> EngineState:
+    """Build a configuration-consistent state from identity arrays."""
+    alive = jnp.asarray(alive, dtype=bool)
+    topo = ring_topology(jnp.asarray(key_hi), jnp.asarray(key_lo), alive)
+    config_hi, config_lo = masked_set_hash(jnp.asarray(id_hi), jnp.asarray(id_lo), alive)
+    n, k, c = cfg.n, cfg.k, cfg.c
+    return EngineState(
+        key_hi=jnp.asarray(key_hi, dtype=jnp.uint32),
+        key_lo=jnp.asarray(key_lo, dtype=jnp.uint32),
+        id_hi=jnp.asarray(id_hi, dtype=jnp.uint32),
+        id_lo=jnp.asarray(id_lo, dtype=jnp.uint32),
+        alive=alive,
+        obs_idx=topo.obs_idx,
+        subj_idx=topo.subj_idx,
+        # A copy, not an alias: engine_step donates its input state, and the
+        # runtime rejects the same buffer donated twice.
+        inval_obs=topo.obs_idx + 0,
+        config_epoch=jnp.int32(0),
+        config_hi=config_hi,
+        config_lo=config_lo,
+        n_members=jnp.sum(alive, dtype=jnp.int32),
+        fd_count=jnp.zeros((n, k), dtype=jnp.int32),
+        fd_fired=jnp.zeros((n, k), dtype=bool),
+        join_pending=jnp.zeros((n,), dtype=bool),
+        cohort_of=jnp.zeros((n,), dtype=jnp.int32),
+        reports=jnp.zeros((c, n, k), dtype=bool),
+        seen_down=jnp.zeros((c,), dtype=bool),
+        released=jnp.zeros((c, n), dtype=bool),
+        announced=jnp.zeros((c,), dtype=bool),
+        prop_mask=jnp.zeros((c, n), dtype=bool),
+        prop_hi=jnp.zeros((c,), dtype=jnp.uint32),
+        prop_lo=jnp.zeros((c,), dtype=jnp.uint32),
+        vote_hi=jnp.zeros((n,), dtype=jnp.uint32),
+        vote_lo=jnp.zeros((n,), dtype=jnp.uint32),
+        vote_valid=jnp.zeros((n,), dtype=bool),
+        rounds_undecided=jnp.int32(0),
+    )
+
+
+class FaultInputs(NamedTuple):
+    """Per-step fault-injection masks (the device analog of the reference's
+    StaticFailureDetector blacklist + MessageDropInterceptor fixtures)."""
+
+    crashed: jnp.ndarray  # [n] bool — unresponsive; never votes or alerts
+    probe_fail: jnp.ndarray  # [n, k] bool — extra per-edge probe failures
+    rx_block: jnp.ndarray  # [c, n] bool — cohort c cannot hear from slot i
+
+    @staticmethod
+    def none(cfg: EngineConfig) -> "FaultInputs":
+        return FaultInputs(
+            crashed=jnp.zeros((cfg.n,), dtype=bool),
+            probe_fail=jnp.zeros((cfg.n, cfg.k), dtype=bool),
+            rx_block=jnp.zeros((cfg.c, cfg.n), dtype=bool),
+        )
+
+
+class StepEvents(NamedTuple):
+    """Observable outcomes of one engine step (host-side driver reads these)."""
+
+    decided: jnp.ndarray  # scalar bool — consensus reached this step
+    winner_mask: jnp.ndarray  # [n] bool — the decided cut (flip set)
+    proposals_announced: jnp.ndarray  # [c] bool — cohorts that proposed this step
+    alerts_emitted: jnp.ndarray  # int32 — new edge alerts this step
+    total_votes: jnp.ndarray  # int32
+    max_votes: jnp.ndarray  # int32

@@ -1,0 +1,421 @@
+"""The flagship model: a whole Rapid-style cluster of N virtual endpoints
+executing the membership protocol as one fused device program.
+
+One ``engine_step`` = one protocol round for every virtual node at once
+(the device analog of ``MembershipService``'s per-message pipeline,
+MembershipService.java:300-354):
+
+  probe tick -> edge alerts -> cohort delivery -> watermark cut detection ->
+  fast-round votes -> quorum tally -> view-change application.
+
+Everything is static-shaped: membership is an ``alive`` mask, faults are
+masks, and the view change is a ``lax.cond`` that re-derives ring topology.
+The N axis shards over a device mesh (see rapid_tpu.parallel); every global
+reduction here is a sum/any over N, which XLA lowers to psum over ICI.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rapid_tpu.models.state import (
+    EngineConfig,
+    EngineState,
+    FaultInputs,
+    StepEvents,
+    initial_state,
+)
+from rapid_tpu.ops.consensus import tally_candidates
+from rapid_tpu.ops.hashing import masked_set_hash
+from rapid_tpu.ops.rings import endpoint_ring_keys, predecessor_of_keys, ring_topology
+
+
+def _fd_tick(cfg: EngineConfig, state: EngineState, faults: FaultInputs):
+    """Every observer probes its subjects; edges past the failure threshold
+    emit one DOWN alert (semantics of PingPongFailureDetector + the
+    edge-failure notification path, MembershipService.java:472-495)."""
+    n = cfg.n
+    obs = state.obs_idx.T  # [n, k] — observer of (subject s, ring k)
+    obs_clamped = jnp.clip(obs, 0, n - 1)
+    observer_active = (
+        (obs >= 0) & state.alive[obs_clamped] & ~faults.crashed[obs_clamped]
+    )
+    subject_down = faults.crashed[:, None] | faults.probe_fail
+    probe_failed = observer_active & subject_down & state.alive[:, None]
+
+    fd_count = jnp.where(probe_failed, state.fd_count + 1, state.fd_count)
+    fire = (fd_count >= cfg.fd_threshold) & ~state.fd_fired & state.alive[:, None]
+    fd_fired = state.fd_fired | fire
+    return fd_count, fd_fired, fire, obs_clamped
+
+
+def _cohort_cut_detection(cfg: EngineConfig, state: EngineState, new_reports, any_down):
+    """Per-cohort watermark pass (vmapped rapid_tpu.ops.cut_detection
+    semantics, gated by the per-configuration announced-proposal flag,
+    MembershipService.java:318-348)."""
+    subject_mask = state.alive | state.join_pending
+
+    def one_cohort(reports, released, announced, seen_down, fresh):
+        reports = (reports | fresh) & subject_mask[:, None]
+        seen_down = seen_down | any_down
+        tally = jnp.sum(reports, axis=1, dtype=jnp.int32)
+        stable = tally >= cfg.h
+        flux = (tally >= cfg.l) & (tally < cfg.h)
+        in_union = stable | flux
+        obs = state.inval_obs.T  # [n, k]
+        obs_ok = obs >= 0
+        obs_in_union = jnp.where(obs_ok, in_union[jnp.clip(obs, 0, cfg.n - 1)], False)
+        implicit = flux[:, None] & obs_in_union
+        reports = jnp.where(seen_down, reports | implicit, reports) & subject_mask[:, None]
+        tally2 = jnp.sum(reports, axis=1, dtype=jnp.int32)
+        stable2 = tally2 >= cfg.h
+        flux2 = (tally2 >= cfg.l) & (tally2 < cfg.h)
+        fresh_stable = stable2 & ~released
+        propose = ~announced & jnp.any(fresh_stable) & ~jnp.any(flux2)
+        proposal_mask = fresh_stable & propose
+        return (
+            reports,
+            released | proposal_mask,
+            announced | propose,
+            seen_down,
+            propose,
+            proposal_mask,
+        )
+
+    return jax.vmap(one_cohort)(
+        state.reports, state.released, state.announced, state.seen_down, new_reports
+    )
+
+
+def engine_step_impl(
+    cfg: EngineConfig, state: EngineState, faults: FaultInputs
+) -> Tuple[EngineState, StepEvents]:
+    n, k, c = cfg.n, cfg.k, cfg.c
+
+    # 1. Failure-detector tick -> fresh DOWN alerts per (subject, ring) edge.
+    fd_count, fd_fired, fire, obs_clamped = _fd_tick(cfg, state, faults)
+    alerts_emitted = jnp.sum(fire, dtype=jnp.int32)
+    any_down = jnp.any(fire)
+
+    # 2. Broadcast delivery: alert for edge (s, ring) originates at the edge's
+    #    observer; cohort c hears it unless that observer is rx-blocked
+    #    (the device analog of UnicastToAllBroadcaster + drop interceptors).
+    src_blocked = faults.rx_block[:, obs_clamped.reshape(-1)].reshape(c, n, k)
+    new_reports = fire[None, :, :] & ~src_blocked
+
+    # 3. Cut detection per cohort.
+    reports, released, announced, seen_down, proposed_now, prop_masks = _cohort_cut_detection(
+        cfg, state, new_reports, any_down
+    )
+    # Proposal identity = commutative set-hash of the cut's member identities
+    # (the canonical-sort-free equivalent of the ring-0-sorted endpoint list,
+    # MembershipService.java:346-348).
+    prop_hi_new, prop_lo_new = jax.vmap(
+        lambda mask: masked_set_hash(state.id_hi, state.id_lo, mask)
+    )(prop_masks)
+    prop_hi = jnp.where(proposed_now, prop_hi_new, state.prop_hi)
+    prop_lo = jnp.where(proposed_now, prop_lo_new, state.prop_lo)
+    prop_mask = jnp.where(proposed_now[:, None], prop_masks, state.prop_mask)
+
+    # 4. Fast-round votes: each live member votes its cohort's proposal, once
+    #    per configuration (FastPaxos.java:94-108).
+    cohort = state.cohort_of
+    cohort_announced = announced[cohort]
+    can_vote = state.alive & ~faults.crashed & ~state.vote_valid & cohort_announced
+    vote_hi = jnp.where(can_vote, prop_hi[cohort], state.vote_hi)
+    vote_lo = jnp.where(can_vote, prop_lo[cohort], state.vote_lo)
+    vote_valid = state.vote_valid | can_vote
+
+    # 5. Quorum tally over all N votes (FastPaxos.java:125-156).
+    tally = tally_candidates(
+        vote_hi, vote_lo, vote_valid, prop_hi, prop_lo, announced, state.n_members
+    )
+    fast_decided = tally.decided
+
+    # 5b. Classic-Paxos fallback: an announced proposal stuck past the
+    #     recovery delay falls back to a classic round whose coordinator rule
+    #     (> N/4 identical fast votes force the value, Paxos.java:287-308)
+    #     lands on the plurality proposal; it commits at a majority quorum.
+    cand_counts = jnp.sum(
+        vote_valid[None, :]
+        & announced[:, None]
+        & (vote_hi[None, :] == prop_hi[:, None])
+        & (vote_lo[None, :] == prop_lo[:, None]),
+        axis=1,
+        dtype=jnp.int32,
+    )
+    rounds_undecided = jnp.where(
+        jnp.any(announced) & ~fast_decided, state.rounds_undecided + 1, state.rounds_undecided
+    )
+    fallback_due = (rounds_undecided >= cfg.fallback_rounds) & jnp.any(announced) & ~fast_decided
+    fb_cohort = jnp.argmax(jnp.where(announced, cand_counts, -1))
+    classic_voters = jnp.sum(state.alive & ~faults.crashed, dtype=jnp.int32)
+    fb_decided = fallback_due & (classic_voters > state.n_members // 2)
+
+    decided = fast_decided | fb_decided
+    winner_cohort = jnp.where(
+        fast_decided,
+        jnp.argmax(announced & (prop_hi == tally.winner_hi) & (prop_lo == tally.winner_lo)),
+        fb_cohort,
+    )
+    winner_mask = jnp.where(decided, prop_mask[winner_cohort], jnp.zeros((n,), dtype=bool))
+
+    # 6. View change: flip the decided cut in/out of the membership, re-derive
+    #    topology, reset per-configuration state (MembershipService.java:385-444).
+    def apply_view_change(_):
+        alive2 = state.alive ^ winner_mask
+        topo = ring_topology(state.key_hi, state.key_lo, alive2)
+        config_hi, config_lo = masked_set_hash(state.id_hi, state.id_lo, alive2)
+        return EngineState(
+            key_hi=state.key_hi,
+            key_lo=state.key_lo,
+            id_hi=state.id_hi,
+            id_lo=state.id_lo,
+            alive=alive2,
+            obs_idx=topo.obs_idx,
+            subj_idx=topo.subj_idx,
+            inval_obs=topo.obs_idx + 0,
+            config_epoch=state.config_epoch + 1,
+            config_hi=config_hi,
+            config_lo=config_lo,
+            n_members=jnp.sum(alive2, dtype=jnp.int32),
+            fd_count=jnp.zeros((n, k), dtype=jnp.int32),
+            fd_fired=jnp.zeros((n, k), dtype=bool),
+            join_pending=state.join_pending & ~winner_mask,
+            cohort_of=state.cohort_of,
+            reports=jnp.zeros((c, n, k), dtype=bool),
+            seen_down=jnp.zeros((c,), dtype=bool),
+            released=jnp.zeros((c, n), dtype=bool),
+            announced=jnp.zeros((c,), dtype=bool),
+            prop_mask=jnp.zeros((c, n), dtype=bool),
+            prop_hi=jnp.zeros((c,), dtype=jnp.uint32),
+            prop_lo=jnp.zeros((c,), dtype=jnp.uint32),
+            vote_hi=jnp.zeros((n,), dtype=jnp.uint32),
+            vote_lo=jnp.zeros((n,), dtype=jnp.uint32),
+            vote_valid=jnp.zeros((n,), dtype=bool),
+            rounds_undecided=jnp.int32(0),
+        )
+
+    def keep_config(_):
+        return EngineState(
+            key_hi=state.key_hi,
+            key_lo=state.key_lo,
+            id_hi=state.id_hi,
+            id_lo=state.id_lo,
+            alive=state.alive,
+            obs_idx=state.obs_idx,
+            subj_idx=state.subj_idx,
+            inval_obs=state.inval_obs,
+            config_epoch=state.config_epoch,
+            config_hi=state.config_hi,
+            config_lo=state.config_lo,
+            n_members=state.n_members,
+            fd_count=fd_count,
+            fd_fired=fd_fired,
+            join_pending=state.join_pending,
+            cohort_of=state.cohort_of,
+            reports=reports,
+            seen_down=seen_down,
+            released=released,
+            announced=announced,
+            prop_mask=prop_mask,
+            prop_hi=prop_hi,
+            prop_lo=prop_lo,
+            vote_hi=vote_hi,
+            vote_lo=vote_lo,
+            vote_valid=vote_valid,
+            rounds_undecided=rounds_undecided,
+        )
+
+    new_state = jax.lax.cond(decided, apply_view_change, keep_config, operand=None)
+    events = StepEvents(
+        decided=decided,
+        winner_mask=winner_mask,
+        proposals_announced=proposed_now,
+        alerts_emitted=alerts_emitted,
+        total_votes=tally.total_votes,
+        max_votes=tally.max_count,
+    )
+    return new_state, events
+
+
+# Donating step for the long-running driver loop (state buffers reused in
+# place) and a non-donating variant for compile checks / sharded dry-runs.
+engine_step = jax.jit(engine_step_impl, static_argnums=(0,), donate_argnums=(1,))
+engine_step_nodonate = jax.jit(engine_step_impl, static_argnums=(0,))
+
+
+class VirtualCluster:
+    """Host driver around the device engine: owns the state, injects faults
+    and join waves, and runs rounds until convergence.
+
+    This is the deployment the BASELINE targets: N virtual Rapid endpoints
+    co-located on TPU hosts, alerts/votes as device-array writes.
+    """
+
+    def __init__(self, cfg: EngineConfig, state: EngineState):
+        self.cfg = cfg
+        self.state = state
+        self.faults = FaultInputs.none(cfg)
+        self._rng = np.random.default_rng(0)
+
+    # -- construction ---------------------------------------------------
+
+    @classmethod
+    def create(
+        cls,
+        n_members: int,
+        n_slots: Optional[int] = None,
+        k: int = 10,
+        h: int = 9,
+        l: int = 4,
+        cohorts: int = 2,
+        fd_threshold: int = 3,
+        seed: int = 0,
+    ) -> "VirtualCluster":
+        """Synthetic cluster: slot identities are random 64-bit lanes (the
+        host never materializes 100K endpoint strings; interop deployments
+        use from_endpoints)."""
+        n = n_slots if n_slots is not None else n_members
+        assert n >= n_members
+        cfg = EngineConfig(n=n, k=k, h=h, l=l, c=cohorts, fd_threshold=fd_threshold)
+        rng = np.random.default_rng(seed)
+        key_hi = rng.integers(0, 2**32, size=(k, n), dtype=np.uint32)
+        key_lo = rng.integers(0, 2**32, size=(k, n), dtype=np.uint32)
+        id_hi = rng.integers(0, 2**32, size=(n,), dtype=np.uint32)
+        id_lo = rng.integers(0, 2**32, size=(n,), dtype=np.uint32)
+        alive = np.zeros(n, dtype=bool)
+        alive[:n_members] = True
+        cluster = cls(cfg, initial_state(cfg, key_hi, key_lo, id_hi, id_lo, alive))
+        cluster._rng = rng
+        return cluster
+
+    @classmethod
+    def from_endpoints(
+        cls,
+        endpoints: Sequence,
+        n_slots: Optional[int] = None,
+        k: int = 10,
+        h: int = 9,
+        l: int = 4,
+        cohorts: int = 2,
+        fd_threshold: int = 3,
+    ) -> "VirtualCluster":
+        """Build from real endpoints with the host view's exact ring keys, so
+        the engine's topology matches a host MembershipView bit-for-bit."""
+        n_members = len(endpoints)
+        n = n_slots if n_slots is not None else n_members
+        cfg = EngineConfig(n=n, k=k, h=h, l=l, c=cohorts, fd_threshold=fd_threshold)
+        key_hi0, key_lo0 = endpoint_ring_keys(endpoints, k)
+        key_hi = np.zeros((k, n), dtype=np.uint32)
+        key_lo = np.zeros((k, n), dtype=np.uint32)
+        key_hi[:, :n_members] = np.asarray(key_hi0)
+        key_lo[:, :n_members] = np.asarray(key_lo0)
+        rng = np.random.default_rng(1234)
+        id_hi = rng.integers(0, 2**32, size=(n,), dtype=np.uint32)
+        id_lo = rng.integers(0, 2**32, size=(n,), dtype=np.uint32)
+        alive = np.zeros(n, dtype=bool)
+        alive[:n_members] = True
+        return cls(cfg, initial_state(cfg, key_hi, key_lo, id_hi, id_lo, alive))
+
+    # -- fault & membership injection ----------------------------------
+
+    def crash(self, slots: Sequence[int]) -> None:
+        """Crash-stop the given slots (unresponsive until revived)."""
+        crashed = np.asarray(self.faults.crashed).copy()
+        crashed[np.asarray(slots)] = True
+        self.faults = self.faults._replace(crashed=jnp.asarray(crashed))
+
+    def revive(self, slots: Sequence[int]) -> None:
+        crashed = np.asarray(self.faults.crashed).copy()
+        crashed[np.asarray(slots)] = False
+        self.faults = self.faults._replace(crashed=jnp.asarray(crashed))
+
+    def set_flaky_edges(self, probe_fail: np.ndarray) -> None:
+        """Arbitrary per-(subject, ring) probe failures — asymmetric/one-way
+        link patterns."""
+        self.faults = self.faults._replace(probe_fail=jnp.asarray(probe_fail, dtype=bool))
+
+    def inject_join_wave(self, slots: Sequence[int]) -> None:
+        """Admit a batch of joiners: their gatekeepers (ring predecessors)
+        emit UP alerts on all rings at once — the batched equivalent of the
+        two-phase join's phase 2 (Cluster.java:406-437)."""
+        slots = np.asarray(slots)
+        state = self.state
+        join_pending = np.asarray(state.join_pending).copy()
+        join_pending[slots] = True
+
+        # Expected observers of each joiner, for implicit invalidation parity.
+        qhi = np.asarray(state.key_hi)[:, slots]
+        qlo = np.asarray(state.key_lo)[:, slots]
+        pred = predecessor_of_keys(
+            state.key_hi, state.key_lo, state.alive, jnp.asarray(qhi), jnp.asarray(qlo)
+        )
+        inval_obs = np.asarray(state.inval_obs).copy()
+        inval_obs[:, slots] = np.asarray(pred)
+
+        # Gatekeepers report all K rings for each joiner; delivery to every
+        # cohort (joins ride the same broadcast path as DOWN alerts).
+        reports = np.asarray(state.reports).copy()
+        reports[:, slots, :] = True
+
+        self.state = state._replace(
+            join_pending=jnp.asarray(join_pending),
+            inval_obs=jnp.asarray(inval_obs),
+            reports=jnp.asarray(reports),
+        )
+
+    def assign_cohorts(self, cohort_of: np.ndarray) -> None:
+        self.state = self.state._replace(cohort_of=jnp.asarray(cohort_of, dtype=jnp.int32))
+
+    def set_rx_block(self, rx_block: np.ndarray) -> None:
+        self.faults = self.faults._replace(rx_block=jnp.asarray(rx_block, dtype=bool))
+
+    # -- execution ------------------------------------------------------
+
+    def step(self) -> StepEvents:
+        self.state, events = engine_step(self.cfg, self.state, self.faults)
+        return events
+
+    def run_until_converged(self, max_steps: int = 64) -> Tuple[int, Optional[StepEvents]]:
+        """Run rounds until a view change commits; returns (rounds, events)."""
+        for round_idx in range(max_steps):
+            events = self.step()
+            if bool(events.decided):
+                return round_idx + 1, events
+        return max_steps, None
+
+    def timed_convergence(self, max_steps: int = 64) -> Tuple[int, float]:
+        """(rounds, wall_ms) for a convergence run, excluding compilation
+        (callers should run one throwaway convergence first to warm the
+        cache)."""
+        start = time.perf_counter()
+        rounds, events = self.run_until_converged(max_steps)
+        jax.block_until_ready(self.state.alive)
+        elapsed_ms = (time.perf_counter() - start) * 1000.0
+        assert events is not None, "did not converge"
+        return rounds, elapsed_ms
+
+    # -- observers ------------------------------------------------------
+
+    @property
+    def membership_size(self) -> int:
+        return int(self.state.n_members)
+
+    @property
+    def alive_mask(self) -> np.ndarray:
+        return np.asarray(self.state.alive)
+
+    @property
+    def config_epoch(self) -> int:
+        return int(self.state.config_epoch)
+
+    @property
+    def config_id(self) -> int:
+        return (int(self.state.config_hi) << 32) | int(self.state.config_lo)

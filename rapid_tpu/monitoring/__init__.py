@@ -1,0 +1,20 @@
+from rapid_tpu.monitoring.base import (
+    EdgeFailureDetector,
+    EdgeFailureDetectorFactory,
+    EdgeFailureNotifier,
+)
+from rapid_tpu.monitoring.ping_pong import (
+    PingPongFailureDetector,
+    PingPongFailureDetectorFactory,
+)
+from rapid_tpu.monitoring.static_fd import StaticFailureDetector, StaticFailureDetectorFactory
+
+__all__ = [
+    "EdgeFailureDetector",
+    "EdgeFailureDetectorFactory",
+    "EdgeFailureNotifier",
+    "PingPongFailureDetector",
+    "PingPongFailureDetectorFactory",
+    "StaticFailureDetector",
+    "StaticFailureDetectorFactory",
+]

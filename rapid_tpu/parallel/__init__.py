@@ -1,0 +1,19 @@
+from rapid_tpu.parallel.mesh import (
+    NODE_AXIS,
+    fault_shardings,
+    make_mesh,
+    make_sharded_step,
+    shard_faults,
+    shard_state,
+    state_shardings,
+)
+
+__all__ = [
+    "NODE_AXIS",
+    "fault_shardings",
+    "make_mesh",
+    "make_sharded_step",
+    "shard_faults",
+    "shard_state",
+    "state_shardings",
+]

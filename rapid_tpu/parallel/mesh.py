@@ -1,0 +1,107 @@
+"""Device-mesh sharding for the virtual-cluster engine.
+
+Scale axis = N (virtual members), sharded over a 1-D mesh axis ``nodes``:
+every per-slot array partitions on its N dimension; ring/cohort axes and
+scalars replicate. All of the engine's global reductions (watermark tallies,
+vote counts, set hashes) are sums/anys over N, which XLA lowers to psum over
+ICI; the per-ring argsort in ``ring_topology`` runs only on view changes and
+is the one collective-heavy op (XLA inserts the gather it needs).
+
+This is the TPU equivalent of the reference's scale story (§ SURVEY 5.7):
+the reference keeps per-node load O(K) as N grows; here the whole cluster's
+protocol state is data-parallel over N.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from rapid_tpu.models.state import EngineConfig, EngineState, FaultInputs
+from rapid_tpu.models.virtual_cluster import engine_step_impl
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (NODE_AXIS,))
+
+
+def state_shardings(mesh: Mesh) -> EngineState:
+    """A NamedSharding pytree matching EngineState: shard every N axis."""
+
+    def sh(*spec) -> NamedSharding:
+        return NamedSharding(mesh, P(*spec))
+
+    return EngineState(
+        key_hi=sh(None, NODE_AXIS),
+        key_lo=sh(None, NODE_AXIS),
+        id_hi=sh(NODE_AXIS),
+        id_lo=sh(NODE_AXIS),
+        alive=sh(NODE_AXIS),
+        obs_idx=sh(None, NODE_AXIS),
+        subj_idx=sh(None, NODE_AXIS),
+        inval_obs=sh(None, NODE_AXIS),
+        config_epoch=sh(),
+        config_hi=sh(),
+        config_lo=sh(),
+        n_members=sh(),
+        fd_count=sh(NODE_AXIS, None),
+        fd_fired=sh(NODE_AXIS, None),
+        join_pending=sh(NODE_AXIS),
+        cohort_of=sh(NODE_AXIS),
+        reports=sh(None, NODE_AXIS, None),
+        seen_down=sh(),
+        released=sh(None, NODE_AXIS),
+        announced=sh(),
+        prop_mask=sh(None, NODE_AXIS),
+        prop_hi=sh(),
+        prop_lo=sh(),
+        vote_hi=sh(NODE_AXIS),
+        vote_lo=sh(NODE_AXIS),
+        vote_valid=sh(NODE_AXIS),
+        rounds_undecided=sh(),
+    )
+
+
+def fault_shardings(mesh: Mesh) -> FaultInputs:
+    def sh(*spec) -> NamedSharding:
+        return NamedSharding(mesh, P(*spec))
+
+    return FaultInputs(
+        crashed=sh(NODE_AXIS),
+        probe_fail=sh(NODE_AXIS, None),
+        rx_block=sh(None, NODE_AXIS),
+    )
+
+
+def make_sharded_step(cfg: EngineConfig, mesh: Mesh):
+    """jit the engine step with explicit in/out shardings over ``mesh``.
+
+    Output events replicate (they are scalars plus the [n] winner mask, which
+    stays sharded).
+    """
+    st_sh = state_shardings(mesh)
+    ft_sh = fault_shardings(mesh)
+
+    return jax.jit(
+        lambda state, faults: engine_step_impl(cfg, state, faults),
+        in_shardings=(st_sh, ft_sh),
+        out_shardings=None,  # let XLA propagate; state stays node-sharded
+        donate_argnums=(0,),
+    )
+
+
+def shard_state(state: EngineState, mesh: Mesh) -> EngineState:
+    """Place an existing (host/single-device) state onto the mesh."""
+    shardings = state_shardings(mesh)
+    return jax.tree.map(jax.device_put, state, shardings)
+
+
+def shard_faults(faults: FaultInputs, mesh: Mesh) -> FaultInputs:
+    shardings = fault_shardings(mesh)
+    return jax.tree.map(jax.device_put, faults, shardings)

@@ -1,0 +1,279 @@
+"""Public cluster API: bootstrap a seed or join through one.
+
+API surface mirrors the reference ``Cluster`` builder
+(``Cluster.java:53-160``): ``start()`` boots a single-node cluster,
+``join(seed)`` runs the two-phase bootstrap with retries
+(``Cluster.java:303-437``), plus ``membership``/``metadata`` accessors,
+subscriptions, ``leave_gracefully`` and ``shutdown``. Everything is
+async-first; transports plug in through the messaging SPI.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import Dict, List, Optional
+
+from rapid_tpu.errors import JoinError, JoinPhaseOneError, JoinPhaseTwoError
+from rapid_tpu.messaging.base import MessagingClient, MessagingServer
+from rapid_tpu.messaging.inprocess import InProcessClient, InProcessNetwork, InProcessServer
+from rapid_tpu.monitoring.base import EdgeFailureDetectorFactory
+from rapid_tpu.monitoring.ping_pong import PingPongFailureDetectorFactory
+from rapid_tpu.protocol.cut_detector import MultiNodeCutDetector
+from rapid_tpu.protocol.events import ClusterEvents
+from rapid_tpu.protocol.metadata import FrozenMetadata
+from rapid_tpu.protocol.service import MembershipService
+from rapid_tpu.protocol.view import MembershipView
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import (
+    Endpoint,
+    JoinMessage,
+    JoinResponse,
+    JoinStatusCode,
+    NodeId,
+    PreJoinMessage,
+)
+from rapid_tpu.utils.clock import Clock
+
+LOG = logging.getLogger(__name__)
+
+
+class Cluster:
+    def __init__(
+        self,
+        listen_address: Endpoint,
+        service: MembershipService,
+        server: MessagingServer,
+        client: MessagingClient,
+    ) -> None:
+        self.listen_address = listen_address
+        self.service = service
+        self._server = server
+        self._client = client
+
+    # -- accessors (Cluster.java:98-129) -------------------------------
+
+    @property
+    def membership(self) -> List[Endpoint]:
+        return self.service.membership
+
+    @property
+    def membership_size(self) -> int:
+        return self.service.membership_size
+
+    @property
+    def metadata(self) -> Dict[Endpoint, FrozenMetadata]:
+        return self.service.get_metadata()
+
+    def register_subscription(self, event: ClusterEvents, callback) -> None:
+        self.service.register_subscription(event, callback)
+
+    # -- lifecycle ------------------------------------------------------
+
+    async def leave_gracefully(self) -> None:
+        """Tell observers to proactively report us DOWN, then shut down
+        (Cluster.java:145-149)."""
+        await self.service.leave()
+        await self.shutdown()
+
+    async def shutdown(self) -> None:
+        await self._server.shutdown()
+        await self.service.shutdown()
+
+    def __str__(self) -> str:
+        return f"Cluster:{self.listen_address}"
+
+    # -- builders -------------------------------------------------------
+
+    @classmethod
+    async def start(
+        cls,
+        listen_address: Endpoint,
+        settings: Optional[Settings] = None,
+        network: Optional[InProcessNetwork] = None,
+        client: Optional[MessagingClient] = None,
+        server: Optional[MessagingServer] = None,
+        fd_factory: Optional[EdgeFailureDetectorFactory] = None,
+        metadata: FrozenMetadata = (),
+        subscriptions: Optional[Dict[ClusterEvents, List]] = None,
+        clock: Optional[Clock] = None,
+        rng: Optional[random.Random] = None,
+    ) -> "Cluster":
+        """Bootstrap a one-node cluster (Cluster.java:255-280)."""
+        settings = settings if settings is not None else Settings()
+        settings.validate()
+        client, server = cls._make_transport(listen_address, settings, network, client, server)
+        fd_factory = fd_factory or PingPongFailureDetectorFactory(listen_address, client)
+        node_id = NodeId.from_uuid()
+        view = MembershipView(settings.k, node_ids=[node_id], endpoints=[listen_address])
+        cut_detector = MultiNodeCutDetector(settings.k, settings.h, settings.l)
+        metadata_map = {listen_address: metadata} if metadata else {}
+        service = MembershipService(
+            my_addr=listen_address,
+            cut_detector=cut_detector,
+            view=view,
+            settings=settings,
+            client=client,
+            fd_factory=fd_factory,
+            metadata_map=metadata_map,
+            subscriptions=subscriptions,
+            clock=clock,
+            rng=rng,
+        )
+        server.set_membership_service(service)
+        await server.start()
+        await service.start()
+        return cls(listen_address, service, server, client)
+
+    @classmethod
+    async def join(
+        cls,
+        seed_address: Endpoint,
+        listen_address: Endpoint,
+        settings: Optional[Settings] = None,
+        network: Optional[InProcessNetwork] = None,
+        client: Optional[MessagingClient] = None,
+        server: Optional[MessagingServer] = None,
+        fd_factory: Optional[EdgeFailureDetectorFactory] = None,
+        metadata: FrozenMetadata = (),
+        subscriptions: Optional[Dict[ClusterEvents, List]] = None,
+        clock: Optional[Clock] = None,
+        rng: Optional[random.Random] = None,
+    ) -> "Cluster":
+        """Two-phase join through ``seed_address`` with retries
+        (Cluster.java:303-344)."""
+        settings = settings if settings is not None else Settings()
+        settings.validate()
+        client, server = cls._make_transport(listen_address, settings, network, client, server)
+        fd_factory = fd_factory or PingPongFailureDetectorFactory(listen_address, client)
+        node_id = NodeId.from_uuid()
+        # The server starts before the service exists; probes are answered
+        # with BOOTSTRAPPING in the meantime (Cluster.java:312).
+        await server.start()
+
+        for attempt in range(settings.join_attempts):
+            try:
+                return await cls._join_attempt(
+                    seed_address, listen_address, node_id, settings, client, server,
+                    fd_factory, metadata, subscriptions, clock, rng,
+                )
+            except JoinPhaseOneError as exc:
+                status = exc.join_response.status_code
+                LOG.warning("%s join phase 1 rejected: %s (attempt %d)",
+                            listen_address, status.name, attempt)
+                if status == JoinStatusCode.UUID_ALREADY_IN_RING:
+                    node_id = NodeId.from_uuid()
+                elif status not in (
+                    JoinStatusCode.CONFIG_CHANGED,
+                    JoinStatusCode.MEMBERSHIP_REJECTED,
+                ):
+                    break
+            except (JoinPhaseTwoError, ConnectionError, asyncio.TimeoutError) as exc:
+                LOG.warning("%s join attempt %d failed: %r", listen_address, attempt, exc)
+
+        await server.shutdown()
+        await client.shutdown()
+        raise JoinError(f"join attempt unsuccessful for {listen_address}")
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _make_transport(listen_address, settings, network, client, server):
+        if client is not None and server is not None:
+            return client, server
+        if network is None:
+            raise ValueError(
+                "provide either (client, server) or an InProcessNetwork to attach to"
+            )
+        return (
+            client or InProcessClient(network, listen_address, settings),
+            server or InProcessServer(network, listen_address),
+        )
+
+    @classmethod
+    async def _join_attempt(
+        cls, seed_address, listen_address, node_id, settings, client, server,
+        fd_factory, metadata, subscriptions, clock, rng,
+    ) -> "Cluster":
+        """One join attempt: phase 1 at the seed, phase 2 at the observers
+        (Cluster.java:352-401)."""
+        phase1 = await client.send(
+            seed_address, PreJoinMessage(sender=listen_address, node_id=node_id)
+        )
+        assert isinstance(phase1, JoinResponse)
+        if phase1.status_code not in (
+            JoinStatusCode.SAFE_TO_JOIN,
+            JoinStatusCode.HOSTNAME_ALREADY_IN_RING,
+        ):
+            raise JoinPhaseOneError(phase1)
+
+        # HOSTNAME_ALREADY_IN_RING: a previous attempt's consensus admitted us
+        # while our phase 2 timed out; join with config -1 so any observer
+        # streams the configuration back (Cluster.java:374-381).
+        config_to_join = (
+            -1
+            if phase1.status_code == JoinStatusCode.HOSTNAME_ALREADY_IN_RING
+            else phase1.configuration_id
+        )
+
+        # Group ring numbers per observer so each observer gets one message
+        # for all rings it gatekeeps (Cluster.java:406-419).
+        ring_numbers_per_observer: Dict[Endpoint, List[int]] = {}
+        for ring_number, observer in enumerate(phase1.endpoints):
+            ring_numbers_per_observer.setdefault(observer, []).append(ring_number)
+
+        sends = [
+            client.send(
+                observer,
+                JoinMessage(
+                    sender=listen_address,
+                    node_id=node_id,
+                    ring_numbers=tuple(ring_numbers),
+                    configuration_id=config_to_join,
+                    metadata=metadata,
+                ),
+            )
+            for observer, ring_numbers in ring_numbers_per_observer.items()
+        ]
+        responses = await asyncio.gather(*sends, return_exceptions=True)
+        for response in responses:
+            if (
+                isinstance(response, JoinResponse)
+                and response.status_code == JoinStatusCode.SAFE_TO_JOIN
+                and response.configuration_id != config_to_join
+            ):
+                return cls._from_join_response(
+                    response, listen_address, settings, client, server,
+                    fd_factory, subscriptions, clock, rng,
+                )
+        raise JoinPhaseTwoError()
+
+    @classmethod
+    def _from_join_response(
+        cls, response: JoinResponse, listen_address, settings, client, server,
+        fd_factory, subscriptions, clock, rng,
+    ) -> "Cluster":
+        """Build the node from a streamed configuration (Cluster.java:442-474)."""
+        assert response.endpoints and response.identifiers
+        view = MembershipView(
+            settings.k, node_ids=response.identifiers, endpoints=response.endpoints
+        )
+        metadata_map = dict(zip(response.metadata_keys, response.metadata_values))
+        cut_detector = MultiNodeCutDetector(settings.k, settings.h, settings.l)
+        service = MembershipService(
+            my_addr=listen_address,
+            cut_detector=cut_detector,
+            view=view,
+            settings=settings,
+            client=client,
+            fd_factory=fd_factory,
+            metadata_map=metadata_map,
+            subscriptions=subscriptions,
+            clock=clock,
+            rng=rng,
+        )
+        server.set_membership_service(service)
+        cluster = cls(listen_address, service, server, client)
+        asyncio.ensure_future(service.start())
+        return cluster

@@ -1,0 +1,506 @@
+"""The membership service: single protocol engine per endpoint.
+
+Orchestration semantics follow ``MembershipService.java``: one serialized
+protocol context handles every message (the reference serializes via a
+single-thread executor, ``SharedResources.java:53``; here an asyncio lock),
+owns alert batching (100 ms quiescence window), join bookkeeping, failure-
+detector scheduling, and view-change application.
+
+Message flow (MembershipService.java:174-196): every RapidRequest enters
+``handle_message``; alerts feed the cut detector; a released cut becomes a
+Fast Paxos proposal; the decision mutates the K-ring view, notifies
+subscribers, re-arms failure detectors, and unblocks joiners.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import Dict, List, Optional, Tuple
+
+from rapid_tpu.messaging.base import Broadcaster, MessagingClient, UnicastToAllBroadcaster
+from rapid_tpu.monitoring.base import EdgeFailureDetectorFactory
+from rapid_tpu.protocol.cut_detector import MultiNodeCutDetector
+from rapid_tpu.protocol.events import ClusterEvents, ClusterStatusChange, NodeStatusChange
+from rapid_tpu.protocol.fast_paxos import FastPaxos
+from rapid_tpu.protocol.metadata import FrozenMetadata, MetadataManager
+from rapid_tpu.protocol.view import MembershipView
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import (
+    AlertMessage,
+    BatchedAlertMessage,
+    ConsensusResponse,
+    EdgeStatus,
+    Endpoint,
+    FastRoundPhase2bMessage,
+    JoinMessage,
+    JoinResponse,
+    JoinStatusCode,
+    LeaveMessage,
+    NodeId,
+    Phase1aMessage,
+    Phase1bMessage,
+    Phase2aMessage,
+    Phase2bMessage,
+    PreJoinMessage,
+    ProbeMessage,
+    ProbeResponse,
+    RapidRequest,
+    RapidResponse,
+    Response,
+)
+from rapid_tpu.utils.clock import AsyncioClock, Clock
+
+LOG = logging.getLogger(__name__)
+
+CONSENSUS_TYPES = (
+    FastRoundPhase2bMessage,
+    Phase1aMessage,
+    Phase1bMessage,
+    Phase2aMessage,
+    Phase2bMessage,
+)
+
+
+class MembershipService:
+    def __init__(
+        self,
+        my_addr: Endpoint,
+        cut_detector: MultiNodeCutDetector,
+        view: MembershipView,
+        settings: Settings,
+        client: MessagingClient,
+        fd_factory: EdgeFailureDetectorFactory,
+        metadata_map: Optional[Dict[Endpoint, FrozenMetadata]] = None,
+        subscriptions: Optional[Dict[ClusterEvents, List]] = None,
+        clock: Optional[Clock] = None,
+        broadcaster: Optional[Broadcaster] = None,
+        rng: Optional[random.Random] = None,
+    ) -> None:
+        self.my_addr = my_addr
+        self.settings = settings
+        self.view = view
+        self.cut_detector = cut_detector
+        self.client = client
+        self.fd_factory = fd_factory
+        self.clock = clock if clock is not None else AsyncioClock()
+        self.rng = rng if rng is not None else random.Random()
+        self.metadata_manager = MetadataManager()
+        if metadata_map:
+            self.metadata_manager.add_metadata(metadata_map)
+        self.broadcaster = (
+            broadcaster if broadcaster is not None else UnicastToAllBroadcaster(client, self.rng)
+        )
+        self.subscriptions: Dict[ClusterEvents, List] = {event: [] for event in ClusterEvents}
+        if subscriptions:
+            for event, callbacks in subscriptions.items():
+                self.subscriptions[event].extend(callbacks)
+
+        self._lock = asyncio.Lock()  # the "protocol executor"
+        self._joiners_to_respond_to: Dict[Endpoint, List[asyncio.Future]] = {}
+        self._joiner_uuid: Dict[Endpoint, NodeId] = {}
+        self._joiner_metadata: Dict[Endpoint, FrozenMetadata] = {}
+        self._announced_proposal = False
+        self._send_queue: List[AlertMessage] = []
+        self._last_enqueue_ms: float = -1.0
+        self._background_tasks: List[asyncio.Task] = []
+        self._fd_tasks: List[asyncio.Task] = []
+        self._fd_generation = 0
+        self._stopped = False
+
+        self.broadcaster.set_membership(self.view.ring(0))
+        self._fast_paxos = self._new_fast_paxos()
+
+        # Inform the application that the start/join completed
+        # (MembershipService.java:162-168).
+        self._notify(ClusterEvents.VIEW_CHANGE, self._initial_view_change())
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self) -> None:
+        """Arm the alert batcher and failure detectors."""
+        self._background_tasks.append(asyncio.ensure_future(self._alert_batcher_loop()))
+        self._create_failure_detectors()
+
+    async def shutdown(self) -> None:
+        self._stopped = True
+        self._cancel_failure_detectors()
+        for task in self._background_tasks:
+            task.cancel()
+        await asyncio.gather(*self._background_tasks, return_exceptions=True)
+        self._background_tasks.clear()
+        await self.client.shutdown()
+
+    # ------------------------------------------------------------------
+    # accessors (Cluster API surface)
+    # ------------------------------------------------------------------
+
+    @property
+    def membership(self) -> List[Endpoint]:
+        return self.view.ring(0)
+
+    @property
+    def membership_size(self) -> int:
+        return self.view.membership_size
+
+    def get_metadata(self) -> Dict[Endpoint, FrozenMetadata]:
+        return self.metadata_manager.get_all_metadata()
+
+    def register_subscription(self, event: ClusterEvents, callback) -> None:
+        self.subscriptions[event].append(callback)
+
+    # ------------------------------------------------------------------
+    # message entry point (MembershipService.java:174-196)
+    # ------------------------------------------------------------------
+
+    async def handle_message(self, request: RapidRequest) -> RapidResponse:
+        if isinstance(request, ProbeMessage):
+            # Probes bypass the protocol context (MembershipService.java:449-452).
+            return ProbeResponse()
+        if isinstance(request, PreJoinMessage):
+            async with self._lock:
+                return self._handle_pre_join(request)
+        if isinstance(request, JoinMessage):
+            async with self._lock:
+                future = self._handle_join_phase2(request)
+            if isinstance(future, asyncio.Future):
+                return await future
+            return future
+        if isinstance(request, BatchedAlertMessage):
+            async with self._lock:
+                return self._handle_batched_alerts(request)
+        if isinstance(request, CONSENSUS_TYPES):
+            async with self._lock:
+                return self._fast_paxos.handle_message(request)
+        if isinstance(request, LeaveMessage):
+            async with self._lock:
+                self._edge_failure_notification(
+                    request.sender, self.view.configuration_id
+                )
+            return Response()
+        raise TypeError(f"unidentified request type {type(request)!r}")
+
+    # ------------------------------------------------------------------
+    # join protocol, server side
+    # ------------------------------------------------------------------
+
+    def _handle_pre_join(self, msg: PreJoinMessage) -> JoinResponse:
+        """Phase 1 at the seed (MembershipService.java:203-224)."""
+        status = self.view.is_safe_to_join(msg.sender, msg.node_id)
+        endpoints: Tuple[Endpoint, ...] = ()
+        if status in (JoinStatusCode.SAFE_TO_JOIN, JoinStatusCode.HOSTNAME_ALREADY_IN_RING):
+            endpoints = tuple(self.view.expected_observers_of(msg.sender))
+        LOG.info(
+            "join at seed %s for %s: %s (config %d, size %d)",
+            self.my_addr, msg.sender, status.name,
+            self.view.configuration_id, self.view.membership_size,
+        )
+        return JoinResponse(
+            sender=self.my_addr,
+            status_code=status,
+            configuration_id=self.view.configuration_id,
+            endpoints=endpoints,
+        )
+
+    def _handle_join_phase2(self, msg: JoinMessage):
+        """Phase 2 at an observer (MembershipService.java:232-289). Returns
+        either an immediate JoinResponse or a future resolved after consensus."""
+        current_config = self.view.configuration_id
+        if current_config == msg.configuration_id:
+            future: asyncio.Future = asyncio.get_event_loop().create_future()
+            self._joiners_to_respond_to.setdefault(msg.sender, []).append(future)
+            alert = AlertMessage(
+                edge_src=self.my_addr,
+                edge_dst=msg.sender,
+                edge_status=EdgeStatus.UP,
+                configuration_id=current_config,
+                ring_numbers=msg.ring_numbers,
+                node_id=msg.node_id,
+                metadata=msg.metadata,
+            )
+            self._enqueue_alert(alert)
+            return future
+
+        # Configuration changed between phase 1 and 2
+        # (MembershipService.java:255-286).
+        config = self.view.configuration
+        if self.view.is_host_present(msg.sender) and self.view.is_identifier_present(msg.node_id):
+            # The cluster already admitted this joiner; stream it the config.
+            metadata = self.metadata_manager.get_all_metadata()
+            return JoinResponse(
+                sender=self.my_addr,
+                status_code=JoinStatusCode.SAFE_TO_JOIN,
+                configuration_id=config.configuration_id,
+                endpoints=config.endpoints,
+                identifiers=config.node_ids,
+                metadata_keys=tuple(metadata.keys()),
+                metadata_values=tuple(metadata.values()),
+            )
+        return JoinResponse(
+            sender=self.my_addr,
+            status_code=JoinStatusCode.CONFIG_CHANGED,
+            configuration_id=config.configuration_id,
+        )
+
+    # ------------------------------------------------------------------
+    # alert pipeline (MembershipService.java:300-354)
+    # ------------------------------------------------------------------
+
+    def _handle_batched_alerts(self, batch: BatchedAlertMessage) -> Response:
+        config_id = self.view.configuration_id
+        valid = [
+            self._extract_joiner_details(msg)
+            for msg in batch.messages
+            if self._filter_alert(msg, config_id)
+        ]
+        if self._announced_proposal:
+            # We already initiated consensus and cannot revise our proposal.
+            return Response()
+
+        proposal = set()
+        for msg in valid:
+            proposal.update(self.cut_detector.aggregate(msg))
+        proposal.update(self.cut_detector.invalidate_failing_edges(self.view))
+
+        if proposal:
+            LOG.info("%s proposing membership change of size %d", self.my_addr, len(proposal))
+            self._announced_proposal = True
+            self._notify(
+                ClusterEvents.VIEW_CHANGE_PROPOSAL,
+                ClusterStatusChange(
+                    configuration_id=config_id,
+                    membership=tuple(self.view.ring(0)),
+                    status_changes=tuple(self._status_changes_for(proposal)),
+                ),
+            )
+            self._fast_paxos.propose(tuple(self.view.ring_zero_sorted(proposal)))
+        return Response()
+
+    def _filter_alert(self, msg: AlertMessage, config_id: int) -> bool:
+        """Config-id check + the once-in/once-out invariant
+        (MembershipService.java:644-675)."""
+        if msg.configuration_id != config_id:
+            return False
+        if msg.edge_status == EdgeStatus.UP and self.view.is_host_present(msg.edge_dst):
+            return False
+        if msg.edge_status == EdgeStatus.DOWN and not self.view.is_host_present(msg.edge_dst):
+            return False
+        return True
+
+    def _extract_joiner_details(self, msg: AlertMessage) -> AlertMessage:
+        """Save joiner UUID/metadata for the eventual ring add
+        (MembershipService.java:677-685)."""
+        if msg.edge_status == EdgeStatus.UP:
+            if msg.node_id is not None:
+                self._joiner_uuid[msg.edge_dst] = msg.node_id
+            self._joiner_metadata[msg.edge_dst] = msg.metadata
+        return msg
+
+    # ------------------------------------------------------------------
+    # consensus decision (MembershipService.java:385-444)
+    # ------------------------------------------------------------------
+
+    def _decide_view_change(self, proposal: Tuple[Endpoint, ...]) -> None:
+        LOG.info(
+            "%s decide view change in config %d (%d nodes): %s",
+            self.my_addr, self.view.configuration_id, self.view.membership_size,
+            [str(p) for p in proposal],
+        )
+        self._cancel_failure_detectors()
+
+        status_changes: List[NodeStatusChange] = []
+        for node in proposal:
+            if self.view.is_host_present(node):
+                self.view.ring_delete(node)
+                status_changes.append(
+                    NodeStatusChange(node, EdgeStatus.DOWN, self.metadata_manager.get(node))
+                )
+                self.metadata_manager.remove_node(node)
+            else:
+                node_id = self._joiner_uuid.pop(node)
+                self.view.ring_add(node, node_id)
+                metadata = self._joiner_metadata.pop(node, ())
+                if metadata:
+                    self.metadata_manager.add_metadata({node: metadata})
+                status_changes.append(NodeStatusChange(node, EdgeStatus.UP, metadata))
+
+        config_id = self.view.configuration_id
+        change = ClusterStatusChange(
+            configuration_id=config_id,
+            membership=tuple(self.view.ring(0)),
+            status_changes=tuple(status_changes),
+        )
+        self._notify(ClusterEvents.VIEW_CHANGE, change)
+
+        # Reset for the next configuration.
+        self.cut_detector.clear()
+        self._announced_proposal = False
+        self._fast_paxos = self._new_fast_paxos()
+        self.broadcaster.set_membership(self.view.ring(0))
+
+        if self.view.is_host_present(self.my_addr):
+            self._create_failure_detectors()
+        else:
+            LOG.info("%s was kicked out", self.my_addr)
+            self._notify(ClusterEvents.KICKED, change)
+
+        self._respond_to_joiners(proposal)
+
+    def _new_fast_paxos(self) -> FastPaxos:
+        return FastPaxos(
+            my_addr=self.my_addr,
+            configuration_id=self.view.configuration_id,
+            membership_size=self.view.membership_size,
+            broadcast_fn=self.broadcaster.broadcast,
+            send_fn=self.client.send_nowait,
+            on_decide=self._decide_view_change,
+            clock=self.clock,
+            consensus_fallback_base_delay_ms=self.settings.consensus_fallback_base_delay_ms,
+            rng=self.rng,
+        )
+
+    def _respond_to_joiners(self, proposal: Tuple[Endpoint, ...]) -> None:
+        """Stream the new configuration to nodes joining through us
+        (MembershipService.java:719-744)."""
+        config = self.view.configuration
+        metadata = self.metadata_manager.get_all_metadata()
+        response = JoinResponse(
+            sender=self.my_addr,
+            status_code=JoinStatusCode.SAFE_TO_JOIN,
+            configuration_id=config.configuration_id,
+            endpoints=config.endpoints,
+            identifiers=config.node_ids,
+            metadata_keys=tuple(metadata.keys()),
+            metadata_values=tuple(metadata.values()),
+        )
+        for node in proposal:
+            for future in self._joiners_to_respond_to.pop(node, []):
+                if not future.done():
+                    future.set_result(response)
+
+    # ------------------------------------------------------------------
+    # failure detection (MembershipService.java:472-495, 697-714)
+    # ------------------------------------------------------------------
+
+    def _edge_failure_notification(self, subject: Endpoint, config_id: int) -> None:
+        if config_id != self.view.configuration_id:
+            LOG.info(
+                "%s ignoring stale failure notification for %s (config %d != %d)",
+                self.my_addr, subject, config_id, self.view.configuration_id,
+            )
+            return
+        self._enqueue_alert(
+            AlertMessage(
+                edge_src=self.my_addr,
+                edge_dst=subject,
+                edge_status=EdgeStatus.DOWN,
+                configuration_id=config_id,
+                ring_numbers=tuple(self.view.ring_numbers(self.my_addr, subject)),
+            )
+        )
+
+    def _create_failure_detectors(self) -> None:
+        if self._stopped:
+            return
+        self._fd_generation += 1
+        generation = self._fd_generation
+        config_id = self.view.configuration_id
+        try:
+            subjects = self.view.subjects_of(self.my_addr)
+        except Exception:
+            return
+        for subject in set(subjects):
+            self._fd_tasks.append(
+                asyncio.ensure_future(self._fd_loop(subject, generation, config_id))
+            )
+
+    async def _fd_loop(self, subject: Endpoint, generation: int, config_id: int) -> None:
+        def notifier() -> None:
+            asyncio.ensure_future(self._notify_edge_failure(subject, config_id))
+
+        detector = self.fd_factory.create_instance(subject, notifier)
+        while not self._stopped and generation == self._fd_generation:
+            await detector.tick()
+            await self.clock.sleep_ms(self.settings.failure_detector_interval_ms)
+
+    async def _notify_edge_failure(self, subject: Endpoint, config_id: int) -> None:
+        async with self._lock:
+            self._edge_failure_notification(subject, config_id)
+
+    def _cancel_failure_detectors(self) -> None:
+        self._fd_generation += 1
+        for task in self._fd_tasks:
+            task.cancel()
+        self._fd_tasks.clear()
+
+    # ------------------------------------------------------------------
+    # alert batching (MembershipService.java:572-581, 613-637)
+    # ------------------------------------------------------------------
+
+    def _enqueue_alert(self, msg: AlertMessage) -> None:
+        self._last_enqueue_ms = self.clock.now_ms()
+        self._send_queue.append(msg)
+
+    async def _alert_batcher_loop(self) -> None:
+        window = self.settings.batching_window_ms
+        while not self._stopped:
+            await self.clock.sleep_ms(window)
+            if (
+                self._send_queue
+                and self._last_enqueue_ms > 0
+                and (self.clock.now_ms() - self._last_enqueue_ms) > window
+            ):
+                messages, self._send_queue = self._send_queue, []
+                self.broadcaster.broadcast(
+                    BatchedAlertMessage(sender=self.my_addr, messages=tuple(messages))
+                )
+
+    # ------------------------------------------------------------------
+    # leave (MembershipService.java:545-565)
+    # ------------------------------------------------------------------
+
+    async def leave(self) -> None:
+        try:
+            observers = self.view.observers_of(self.my_addr)
+        except Exception:
+            return  # already removed — nothing to announce
+        leave_msg = LeaveMessage(sender=self.my_addr)
+        sends = [self.client.send_best_effort(observer, leave_msg) for observer in observers]
+        try:
+            await asyncio.wait_for(
+                asyncio.gather(*sends, return_exceptions=True),
+                timeout=self.settings.leave_message_timeout_ms / 1000.0,
+            )
+        except asyncio.TimeoutError:
+            pass
+
+    # ------------------------------------------------------------------
+    # events
+    # ------------------------------------------------------------------
+
+    def _notify(self, event: ClusterEvents, change: ClusterStatusChange) -> None:
+        for callback in self.subscriptions[event]:
+            callback(change)
+
+    def _status_changes_for(self, proposal) -> List[NodeStatusChange]:
+        return [
+            NodeStatusChange(
+                node,
+                EdgeStatus.DOWN if self.view.is_host_present(node) else EdgeStatus.UP,
+                self.metadata_manager.get(node),
+            )
+            for node in proposal
+        ]
+
+    def _initial_view_change(self) -> ClusterStatusChange:
+        return ClusterStatusChange(
+            configuration_id=self.view.configuration_id,
+            membership=tuple(self.view.ring(0)),
+            status_changes=tuple(
+                NodeStatusChange(node, EdgeStatus.UP, self.metadata_manager.get(node))
+                for node in self.view.ring(0)
+            ),
+        )

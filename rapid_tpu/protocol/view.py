@@ -25,7 +25,7 @@ from rapid_tpu.errors import (
     UUIDAlreadySeenError,
 )
 from rapid_tpu.types import Endpoint, JoinStatusCode, NodeId
-from rapid_tpu.utils.xxhash import xxh64, xxh64_int
+from rapid_tpu.utils.xxhash import to_signed64, xxh64, xxh64_int
 
 _MASK64 = (1 << 64) - 1
 
@@ -41,7 +41,12 @@ def configuration_id_of(node_ids: Sequence[NodeId], endpoints: Sequence[Endpoint
     """Deterministic 64-bit fold over identifiers-seen and membership
     (semantics of ``MembershipView.Configuration.getConfigurationId``,
     MembershipView.java:544-556). ``node_ids`` must be in sorted order and
-    ``endpoints`` in ring-0 order for all members to agree."""
+    ``endpoints`` in ring-0 order for all members to agree.
+
+    Returned as *signed* 64-bit (Java-long convention, and the wire codec's
+    i64): every host-path config-id comparison uses this signed canonical
+    form. (The device engine's config identity is a separate unsigned
+    set-hash space, never compared against this fold.)"""
     h = 1
     for nid in node_ids:
         h = (h * 37 + xxh64_int(nid.high)) & _MASK64
@@ -49,7 +54,7 @@ def configuration_id_of(node_ids: Sequence[NodeId], endpoints: Sequence[Endpoint
     for ep in endpoints:
         h = (h * 37 + xxh64(ep.hostname.encode("utf-8"))) & _MASK64
         h = (h * 37 + xxh64_int(ep.port)) & _MASK64
-    return h
+    return to_signed64(h)
 
 
 class Configuration:

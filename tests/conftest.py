@@ -7,7 +7,16 @@ Environment must be set before jax is first imported.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force (override) CPU: the global environment pins JAX_PLATFORMS=axon (the
+# real TPU tunnel), which tests must not depend on.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# sitecustomize.py (from /root/.axon_site on PYTHONPATH) imports jax at
+# interpreter startup, so jax.config captured JAX_PLATFORMS=axon before this
+# file ran; override the live config too.
+import jax
+
+jax.config.update("jax_platforms", "cpu")

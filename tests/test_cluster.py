@@ -1,0 +1,334 @@
+"""Multi-node-in-one-process cluster tests over the in-process transport,
+mirroring the reference's ClusterTest scenarios
+(rapid/src/test/java/com/vrg/rapid/ClusterTest.java)."""
+
+import asyncio
+import functools
+import random
+
+import pytest
+
+from rapid_tpu.errors import JoinError
+from rapid_tpu.messaging.inprocess import InProcessNetwork, ServerDropFirstN
+from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
+from rapid_tpu.protocol.cluster import Cluster
+from rapid_tpu.protocol.events import ClusterEvents
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import Endpoint, JoinMessage, PreJoinMessage
+
+BASE_PORT = 1234
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        async def with_timeout():
+            await asyncio.wait_for(fn(*args, **kwargs), timeout=60)
+
+        asyncio.run(with_timeout())
+
+    return wrapper
+
+
+def fast_settings() -> Settings:
+    # Aggressive timeouts, like the reference's useShortJoinTimeouts /
+    # useFastFailureDetectionTimeouts helpers (ClusterTest.java:795-804).
+    s = Settings()
+    s.batching_window_ms = 20
+    s.failure_detector_interval_ms = 50
+    s.rpc_timeout_ms = 500
+    s.rpc_join_timeout_ms = 2000
+    s.rpc_probe_timeout_ms = 200
+    s.consensus_fallback_base_delay_ms = 2000
+    return s
+
+
+def ep(i: int) -> Endpoint:
+    return Endpoint("127.0.0.1", BASE_PORT + i)
+
+
+async def wait_until(predicate, timeout_s=20.0, interval_s=0.02):
+    deadline = asyncio.get_event_loop().time() + timeout_s
+    while asyncio.get_event_loop().time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval_s)
+    return predicate()
+
+
+async def start_cluster(n, network, fd_factory=None, settings=None, seed_subs=None):
+    settings = settings or fast_settings()
+    clusters = [
+        await Cluster.start(
+            ep(0), settings=settings, network=network,
+            fd_factory=fd_factory or StaticFailureDetectorFactory(),
+            subscriptions=seed_subs, rng=random.Random(0),
+        )
+    ]
+    for i in range(1, n):
+        clusters.append(
+            await Cluster.join(
+                ep(0), ep(i), settings=settings, network=network,
+                fd_factory=fd_factory or StaticFailureDetectorFactory(),
+                rng=random.Random(i),
+            )
+        )
+    return clusters
+
+
+async def shutdown_all(clusters):
+    await asyncio.gather(*(c.shutdown() for c in clusters), return_exceptions=True)
+
+
+def all_converged(clusters, expected_size):
+    return all(c.membership_size == expected_size for c in clusters) and (
+        len({tuple(c.membership) for c in clusters}) == 1
+    )
+
+
+@async_test
+async def test_single_node_starts():
+    network = InProcessNetwork()
+    cluster = await Cluster.start(ep(0), settings=fast_settings(), network=network,
+                                  fd_factory=StaticFailureDetectorFactory())
+    assert cluster.membership == [ep(0)]
+    assert cluster.membership_size == 1
+    await cluster.shutdown()
+
+
+@async_test
+async def test_ten_nodes_join_sequentially():
+    network = InProcessNetwork()
+    clusters = await start_cluster(10, network)
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 10))
+    finally:
+        await shutdown_all(clusters)
+
+
+@async_test
+async def test_twenty_nodes_join_in_parallel_through_one_seed():
+    network = InProcessNetwork()
+    settings = fast_settings()
+    seed = await Cluster.start(ep(0), settings=settings, network=network,
+                               fd_factory=StaticFailureDetectorFactory())
+    joiners = await asyncio.gather(
+        *(
+            Cluster.join(ep(0), ep(i), settings=settings, network=network,
+                         fd_factory=StaticFailureDetectorFactory(), rng=random.Random(i))
+            for i in range(1, 20)
+        )
+    )
+    clusters = [seed] + list(joiners)
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 20))
+    finally:
+        await shutdown_all(clusters)
+
+
+@async_test
+async def test_join_wave_onto_existing_cluster():
+    network = InProcessNetwork()
+    settings = fast_settings()
+    clusters = await start_cluster(10, network, settings=settings)
+    assert await wait_until(lambda: all_converged(clusters, 10))
+    wave = await asyncio.gather(
+        *(
+            Cluster.join(ep(0), ep(100 + i), settings=settings, network=network,
+                         fd_factory=StaticFailureDetectorFactory(), rng=random.Random(100 + i))
+            for i in range(10)
+        )
+    )
+    clusters += list(wave)
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 20))
+    finally:
+        await shutdown_all(clusters)
+
+
+@async_test
+async def test_one_failure_out_of_ten():
+    network = InProcessNetwork()
+    fd = StaticFailureDetectorFactory()
+    clusters = await start_cluster(10, network, fd_factory=fd)
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 10))
+        victim = clusters[4]
+        network.blackholed.add(victim.listen_address)
+        fd.add_failed_nodes([victim.listen_address])
+        survivors = [c for c in clusters if c is not victim]
+        assert await wait_until(lambda: all_converged(survivors, 9))
+        assert all(victim.listen_address not in c.membership for c in survivors)
+    finally:
+        await shutdown_all(clusters)
+
+
+@async_test
+async def test_three_failures_out_of_fifteen_single_cut():
+    network = InProcessNetwork()
+    fd = StaticFailureDetectorFactory()
+    clusters = await start_cluster(15, network, fd_factory=fd)
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 15))
+        victims = [clusters[3], clusters[8], clusters[12]]
+        view_changes = []
+        clusters[0].register_subscription(
+            ClusterEvents.VIEW_CHANGE, lambda change: view_changes.append(change)
+        )
+        for victim in victims:
+            network.blackholed.add(victim.listen_address)
+        fd.add_failed_nodes([v.listen_address for v in victims])
+        survivors = [c for c in clusters if c not in victims]
+        assert await wait_until(lambda: all_converged(survivors, 12))
+        victim_eps = {v.listen_address for v in victims}
+        assert all(not victim_eps & set(c.membership) for c in survivors)
+        # All three failures resolve in a single consensus decision (the
+        # multi-node cut; reference asserts likewise for concurrent crashes).
+        assert len(view_changes) == 1
+        assert {sc.endpoint for sc in view_changes[0].status_changes} == victim_eps
+    finally:
+        await shutdown_all(clusters)
+
+
+@async_test
+async def test_graceful_leave():
+    network = InProcessNetwork()
+    clusters = await start_cluster(8, network)
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 8))
+        leaver = clusters[5]
+        await leaver.leave_gracefully()
+        survivors = [c for c in clusters if c is not leaver]
+        assert await wait_until(lambda: all_converged(survivors, 7))
+        assert all(leaver.listen_address not in c.membership for c in survivors)
+    finally:
+        await shutdown_all(clusters)
+
+
+@async_test
+async def test_kicked_node_gets_event():
+    network = InProcessNetwork()
+    fd = StaticFailureDetectorFactory()
+    clusters = await start_cluster(10, network, fd_factory=fd)
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 10))
+        # The victim stays reachable (one-way suspicion): it hears the
+        # consensus that evicts it and must fire KICKED
+        # (MembershipService.java:433-440).
+        victim = clusters[6]
+        kicked = []
+        victim.register_subscription(ClusterEvents.KICKED, lambda change: kicked.append(change))
+        fd.add_failed_nodes([victim.listen_address])
+        survivors = [c for c in clusters if c is not victim]
+        assert await wait_until(lambda: all_converged(survivors, 9))
+        assert await wait_until(lambda: len(kicked) == 1)
+        assert victim.listen_address not in kicked[0].membership
+    finally:
+        await shutdown_all(clusters)
+
+
+@async_test
+async def test_join_with_metadata_propagates():
+    network = InProcessNetwork()
+    settings = fast_settings()
+    seed = await Cluster.start(ep(0), settings=settings, network=network,
+                               fd_factory=StaticFailureDetectorFactory())
+    joiner = await Cluster.join(
+        ep(0), ep(1), settings=settings, network=network,
+        fd_factory=StaticFailureDetectorFactory(),
+        metadata=(("role", b"worker"),),
+    )
+    clusters = [seed, joiner]
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 2))
+        assert await wait_until(lambda: seed.metadata.get(ep(1)) == (("role", b"worker"),))
+        late = await Cluster.join(ep(0), ep(2), settings=settings, network=network,
+                                  fd_factory=StaticFailureDetectorFactory())
+        clusters.append(late)
+        # Metadata reaches nodes that join later, via the streamed config.
+        assert await wait_until(lambda: late.metadata.get(ep(1)) == (("role", b"worker"),))
+    finally:
+        await shutdown_all(clusters)
+
+
+@async_test
+async def test_view_change_subscription_sees_joiner_delta():
+    network = InProcessNetwork()
+    settings = fast_settings()
+    changes = []
+    seed = await Cluster.start(
+        ep(0), settings=settings, network=network,
+        fd_factory=StaticFailureDetectorFactory(),
+    )
+    seed.register_subscription(ClusterEvents.VIEW_CHANGE, lambda c: changes.append(c))
+    joiner = await Cluster.join(ep(0), ep(1), settings=settings, network=network,
+                                fd_factory=StaticFailureDetectorFactory())
+    clusters = [seed, joiner]
+    try:
+        assert await wait_until(lambda: len(changes) >= 1)
+        delta = changes[-1].status_changes
+        assert len(delta) == 1
+        assert delta[0].endpoint == ep(1)
+        assert delta[0].status.name == "UP"
+    finally:
+        await shutdown_all(clusters)
+
+
+@async_test
+async def test_join_succeeds_despite_dropped_join_messages():
+    # Asymmetric-failure simulation via server-side drop interceptors
+    # (ClusterTest.injectAsymmetricDrops / MessageDropInterceptor.java).
+    network = InProcessNetwork()
+    settings = fast_settings()
+    seed = await Cluster.start(ep(0), settings=settings, network=network,
+                               fd_factory=StaticFailureDetectorFactory())
+    seed_server = network.servers[ep(0)]
+    seed_server.drop_interceptors.append(ServerDropFirstN(PreJoinMessage, 2))
+    joiner = await Cluster.join(ep(0), ep(1), settings=settings, network=network,
+                                fd_factory=StaticFailureDetectorFactory())
+    clusters = [seed, joiner]
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 2))
+    finally:
+        await shutdown_all(clusters)
+
+
+@async_test
+async def test_join_fails_when_no_seed():
+    network = InProcessNetwork()
+    settings = fast_settings()
+    settings.join_attempts = 2
+    settings.rpc_default_retries = 1
+    settings.rpc_timeout_ms = 100
+    settings.rpc_join_timeout_ms = 100
+    with pytest.raises(JoinError):
+        await Cluster.join(ep(0), ep(1), settings=settings, network=network,
+                           fd_factory=StaticFailureDetectorFactory())
+
+
+@async_test
+async def test_rejoin_after_crash_with_new_identity():
+    # A kicked/crashed node can rejoin with the same address
+    # (ClusterTest.java:416-463 rejoin loops).
+    network = InProcessNetwork()
+    fd = StaticFailureDetectorFactory()
+    clusters = await start_cluster(6, network, fd_factory=fd)
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 6))
+        victim = clusters[2]
+        network.blackholed.add(victim.listen_address)
+        fd.add_failed_nodes([victim.listen_address])
+        survivors = [c for c in clusters if c is not victim]
+        assert await wait_until(lambda: all_converged(survivors, 5))
+        await victim.shutdown()
+
+        network.blackholed.discard(victim.listen_address)
+        fd.blacklist.discard(victim.listen_address)
+        rejoined = await Cluster.join(
+            ep(0), victim.listen_address, settings=fast_settings(), network=network,
+            fd_factory=fd,
+        )
+        clusters = survivors + [rejoined]
+        assert await wait_until(lambda: all_converged(clusters, 6))
+    finally:
+        await shutdown_all(clusters)

@@ -1,0 +1,86 @@
+"""Full cluster over the real TCP transport (the reference's
+concurrentNodeJoinsNetty analog, ClusterTest.java:249-268)."""
+
+import asyncio
+import functools
+import random
+
+from rapid_tpu.messaging.tcp import TcpClient, TcpServer
+from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
+from rapid_tpu.protocol.cluster import Cluster
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import Endpoint
+
+BASE_PORT = 23100
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        async def with_timeout():
+            await asyncio.wait_for(fn(*args, **kwargs), timeout=60)
+
+        asyncio.run(with_timeout())
+
+    return wrapper
+
+
+def fast_settings() -> Settings:
+    s = Settings()
+    s.batching_window_ms = 20
+    s.failure_detector_interval_ms = 50
+    s.rpc_timeout_ms = 500
+    s.rpc_join_timeout_ms = 2000
+    s.rpc_probe_timeout_ms = 200
+    s.consensus_fallback_base_delay_ms = 2000
+    return s
+
+
+def ep(i: int) -> Endpoint:
+    return Endpoint("127.0.0.1", BASE_PORT + i)
+
+
+async def wait_until(predicate, timeout_s=20.0):
+    deadline = asyncio.get_event_loop().time() + timeout_s
+    while asyncio.get_event_loop().time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(0.02)
+    return predicate()
+
+
+def tcp_transport(addr: Endpoint, settings: Settings):
+    return TcpClient(addr, settings), TcpServer(addr)
+
+
+@async_test
+async def test_five_nodes_over_tcp_with_failure():
+    settings = fast_settings()
+    fd = StaticFailureDetectorFactory()
+    client, server = tcp_transport(ep(0), settings)
+    clusters = [
+        await Cluster.start(ep(0), settings=settings, client=client, server=server,
+                            fd_factory=fd, rng=random.Random(0))
+    ]
+    for i in range(1, 5):
+        client, server = tcp_transport(ep(i), settings)
+        clusters.append(
+            await Cluster.join(ep(0), ep(i), settings=settings, client=client, server=server,
+                               fd_factory=fd, rng=random.Random(i))
+        )
+    try:
+        assert await wait_until(
+            lambda: all(c.membership_size == 5 for c in clusters)
+            and len({tuple(c.membership) for c in clusters}) == 1
+        )
+        # Crash one node for real: kill its server, blacklist it in the FD.
+        victim = clusters[3]
+        await victim.shutdown()
+        fd.add_failed_nodes([victim.listen_address])
+        survivors = [c for c in clusters if c is not victim]
+        assert await wait_until(
+            lambda: all(c.membership_size == 4 for c in survivors)
+        )
+        assert all(victim.listen_address not in c.membership for c in survivors)
+    finally:
+        await asyncio.gather(*(c.shutdown() for c in clusters), return_exceptions=True)

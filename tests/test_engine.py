@@ -1,0 +1,133 @@
+"""Virtual-cluster engine tests: protocol outcomes at N in the hundreds,
+mirroring the cluster-level scenarios on the device path."""
+
+import numpy as np
+import pytest
+
+from rapid_tpu.models.virtual_cluster import VirtualCluster
+
+
+def test_single_crash_converges():
+    vc = VirtualCluster.create(100, k=10, h=9, l=4, fd_threshold=3, seed=0)
+    assert vc.membership_size == 100
+    config_before = vc.config_id
+    vc.crash([17])
+    rounds, events = vc.run_until_converged()
+    assert events is not None
+    assert vc.membership_size == 99
+    assert not vc.alive_mask[17]
+    assert vc.config_epoch == 1
+    assert vc.config_id != config_before
+    # FD threshold of 3 ticks plus one round to tally/decide.
+    assert rounds >= 3
+
+
+def test_concurrent_crashes_single_cut():
+    vc = VirtualCluster.create(200, fd_threshold=3, seed=1)
+    victims = [5, 50, 120, 199]
+    vc.crash(victims)
+    rounds, events = vc.run_until_converged()
+    assert events is not None
+    # All four removed in ONE consensus decision (the multi-node cut).
+    assert vc.config_epoch == 1
+    assert vc.membership_size == 196
+    winner = np.asarray(events.winner_mask)
+    assert set(np.nonzero(winner)[0].tolist()) == set(victims)
+
+
+def test_one_percent_crash_fault():
+    n = 1000
+    vc = VirtualCluster.create(n, fd_threshold=3, seed=2)
+    rng = np.random.default_rng(0)
+    victims = rng.choice(n, size=10, replace=False)
+    vc.crash(victims)
+    vc.run_until_converged()
+    assert vc.membership_size == n - 10
+    assert not vc.alive_mask[victims].any()
+
+
+def test_join_wave():
+    vc = VirtualCluster.create(100, n_slots=164, fd_threshold=3, seed=3)
+    joiners = list(range(100, 164))
+    vc.inject_join_wave(joiners)
+    rounds, events = vc.run_until_converged()
+    assert events is not None
+    assert vc.membership_size == 164
+    assert vc.alive_mask[joiners].all()
+    assert vc.config_epoch == 1
+
+
+def test_join_then_crash_two_cuts():
+    # Joiners arrive with full gatekeeper reports and propose immediately;
+    # crashes surface only after fd_threshold probe windows — two separate
+    # consensus rounds, like the reference's per-configuration proposals.
+    vc = VirtualCluster.create(50, n_slots=60, fd_threshold=3, seed=4)
+    vc.crash([7, 23])
+    vc.inject_join_wave(list(range(50, 60)))
+    rounds, events = vc.run_until_converged()
+    assert events is not None
+    assert vc.config_epoch == 1
+    assert vc.membership_size == 60  # joiners admitted first
+    assert vc.alive_mask[50:60].all()
+    rounds, events = vc.run_until_converged()
+    assert events is not None
+    assert vc.config_epoch == 2
+    assert vc.membership_size == 58
+    assert not vc.alive_mask[[7, 23]].any()
+
+
+def test_sequential_view_changes():
+    vc = VirtualCluster.create(80, fd_threshold=3, seed=5)
+    vc.crash([3])
+    vc.run_until_converged()
+    assert vc.membership_size == 79
+    first_epoch_config = vc.config_id
+    vc.crash([42])
+    vc.run_until_converged()
+    assert vc.membership_size == 78
+    assert vc.config_epoch == 2
+    assert vc.config_id != first_epoch_config
+
+
+def test_no_faults_no_decision():
+    vc = VirtualCluster.create(64, seed=6)
+    for _ in range(8):
+        events = vc.step()
+        assert not bool(events.decided)
+        assert int(events.alerts_emitted) == 0
+    assert vc.membership_size == 64
+    assert vc.config_epoch == 0
+
+
+def test_flaky_below_l_does_not_converge():
+    # A single flaky edge (below L distinct rings) must never produce a cut:
+    # stability against sub-L gossip, the almost-everywhere agreement
+    # precondition.
+    vc = VirtualCluster.create(60, k=10, h=9, l=4, fd_threshold=2, seed=7)
+    probe_fail = np.zeros((vc.cfg.n, vc.cfg.k), dtype=bool)
+    probe_fail[11, :2] = True  # 2 < L rings report subject 11
+    vc.set_flaky_edges(probe_fail)
+    for _ in range(12):
+        events = vc.step()
+        assert not bool(events.decided)
+    assert vc.membership_size == 60
+
+
+def test_asymmetric_cohorts_conflicting_proposals_blocked_then_resolved():
+    # Cohort 1 misses alerts from half the observers (one-way partition):
+    # receivers disagree transiently, but quorum still removes the victim.
+    n = 100
+    vc = VirtualCluster.create(n, fd_threshold=2, seed=8)
+    cohort_of = np.zeros(n, dtype=np.int32)
+    cohort_of[50:] = 1
+    vc.assign_cohorts(cohort_of)
+    victim = 30
+    vc.crash([victim])
+    rx_block = np.zeros((vc.cfg.c, vc.cfg.n), dtype=bool)
+    # Cohort 1 cannot hear from slots 0..9 (some of which observe the victim).
+    rx_block[1, :10] = True
+    vc.set_rx_block(rx_block)
+    rounds, events = vc.run_until_converged(max_steps=96)
+    assert events is not None
+    assert vc.membership_size == n - 1
+    assert not vc.alive_mask[victim]

@@ -1,0 +1,235 @@
+"""Messaging-layer tests: codec round-trips, TCP transport, broadcaster
+fan-out, client error paths (reference: MessagingTest.java,
+NettyClientServerTest.java)."""
+
+import asyncio
+import functools
+
+import pytest
+
+from rapid_tpu.errors import ShuttingDownError
+from rapid_tpu.messaging.base import UnicastToAllBroadcaster
+from rapid_tpu.messaging.codec import (
+    decode_request,
+    decode_response,
+    encode_request,
+    encode_response,
+)
+from rapid_tpu.messaging.inprocess import InProcessClient, InProcessNetwork, InProcessServer
+from rapid_tpu.messaging.tcp import TcpClient, TcpServer
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import (
+    AlertMessage,
+    BatchedAlertMessage,
+    ConsensusResponse,
+    EdgeStatus,
+    Endpoint,
+    FastRoundPhase2bMessage,
+    JoinMessage,
+    JoinResponse,
+    JoinStatusCode,
+    LeaveMessage,
+    NodeId,
+    NodeStatus,
+    Phase1aMessage,
+    Phase1bMessage,
+    Phase2aMessage,
+    Phase2bMessage,
+    PreJoinMessage,
+    ProbeMessage,
+    ProbeResponse,
+    Rank,
+    Response,
+)
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        async def with_timeout():
+            await asyncio.wait_for(fn(*args, **kwargs), timeout=30)
+
+        asyncio.run(with_timeout())
+
+    return wrapper
+
+
+EP1 = Endpoint("127.0.0.1", 5001)
+EP2 = Endpoint("127.0.0.1", 5002)
+NID = NodeId(0x1234567890ABCDEF, 0xFEDCBA0987654321)
+
+
+ALL_REQUESTS = [
+    PreJoinMessage(EP1, NID),
+    JoinMessage(EP1, NID, (0, 3, 9), -12345, (("role", b"w\x00rker"),)),
+    BatchedAlertMessage(
+        EP1,
+        (
+            AlertMessage(EP1, EP2, EdgeStatus.DOWN, 7, (1, 2)),
+            AlertMessage(EP2, EP1, EdgeStatus.UP, 7, (0,), NID, (("k", b"v"),)),
+        ),
+    ),
+    ProbeMessage(EP1),
+    FastRoundPhase2bMessage(EP1, 99, (EP1, EP2)),
+    Phase1aMessage(EP1, 1, Rank(2, 77)),
+    Phase1bMessage(EP1, 1, Rank(2, 77), Rank(1, 1), (EP2,)),
+    Phase2aMessage(EP1, 1, Rank(2, 77), (EP2, EP1)),
+    Phase2bMessage(EP1, 1, Rank(2, 77), (EP2,)),
+    LeaveMessage(EP1),
+]
+
+ALL_RESPONSES = [
+    JoinResponse(
+        EP1,
+        JoinStatusCode.SAFE_TO_JOIN,
+        -42,
+        endpoints=(EP1, EP2),
+        identifiers=(NID, NodeId(1, 2)),
+        metadata_keys=(EP2,),
+        metadata_values=((("role", b"seed"),),),
+    ),
+    Response(),
+    ConsensusResponse(),
+    ProbeResponse(NodeStatus.BOOTSTRAPPING),
+]
+
+
+@pytest.mark.parametrize("request_msg", ALL_REQUESTS, ids=lambda r: type(r).__name__)
+def test_request_codec_roundtrip(request_msg):
+    assert decode_request(encode_request(request_msg)) == request_msg
+
+
+@pytest.mark.parametrize("response_msg", ALL_RESPONSES, ids=lambda r: type(r).__name__)
+def test_response_codec_roundtrip(response_msg):
+    assert decode_response(encode_response(response_msg)) == response_msg
+
+
+class EchoService:
+    """Minimal stand-in for MembershipService at the transport boundary."""
+
+    def __init__(self):
+        self.received = []
+
+    async def handle_message(self, request):
+        self.received.append(request)
+        if isinstance(request, ProbeMessage):
+            return ProbeResponse()
+        return Response()
+
+
+@async_test
+async def test_tcp_round_trip():
+    addr = Endpoint("127.0.0.1", 19001)
+    server = TcpServer(addr)
+    service = EchoService()
+    server.set_membership_service(service)
+    await server.start()
+    client = TcpClient(Endpoint("127.0.0.1", 19002))
+    try:
+        response = await client.send(addr, ProbeMessage(sender=Endpoint("127.0.0.1", 19002)))
+        assert response == ProbeResponse()
+        response = await client.send(addr, ALL_REQUESTS[1])
+        assert response == Response()
+        assert service.received[1] == ALL_REQUESTS[1]
+    finally:
+        await client.shutdown()
+        await server.shutdown()
+
+
+@async_test
+async def test_tcp_probe_answers_bootstrapping_before_service():
+    addr = Endpoint("127.0.0.1", 19003)
+    server = TcpServer(addr)  # no service set
+    await server.start()
+    client = TcpClient(Endpoint("127.0.0.1", 19004))
+    try:
+        response = await client.send_best_effort(addr, ProbeMessage(sender=addr))
+        assert response == ProbeResponse(NodeStatus.BOOTSTRAPPING)
+    finally:
+        await client.shutdown()
+        await server.shutdown()
+
+
+@async_test
+async def test_tcp_ten_servers_fan_out():
+    # NettyClientServerTest's 10-server round-trip analog.
+    servers, services = [], []
+    base = 19010
+    for i in range(10):
+        addr = Endpoint("127.0.0.1", base + i)
+        server = TcpServer(addr)
+        service = EchoService()
+        server.set_membership_service(service)
+        await server.start()
+        servers.append(server)
+        services.append(service)
+    client = TcpClient(Endpoint("127.0.0.1", 18999))
+    broadcaster = UnicastToAllBroadcaster(client)
+    broadcaster.set_membership([Endpoint("127.0.0.1", base + i) for i in range(10)])
+    try:
+        broadcaster.broadcast(LeaveMessage(sender=Endpoint("127.0.0.1", 18999)))
+        for _ in range(100):
+            if all(len(s.received) == 1 for s in services):
+                break
+            await asyncio.sleep(0.02)
+        assert all(len(s.received) == 1 for s in services)
+    finally:
+        await client.shutdown()
+        for server in servers:
+            await server.shutdown()
+
+
+@async_test
+async def test_tcp_client_fails_fast_to_dead_server():
+    settings = Settings()
+    settings.rpc_default_retries = 1
+    settings.rpc_timeout_ms = 200
+    client = TcpClient(Endpoint("127.0.0.1", 19050), settings)
+    try:
+        with pytest.raises((ConnectionError, OSError, asyncio.TimeoutError)):
+            await client.send(Endpoint("127.0.0.1", 19999), LeaveMessage(sender=EP1))
+        assert (
+            await client.send_best_effort(Endpoint("127.0.0.1", 19999), LeaveMessage(sender=EP1))
+            is None
+        )
+    finally:
+        await client.shutdown()
+
+
+@async_test
+async def test_client_after_shutdown_raises():
+    # MessagingTest.java:428-466 analog: a shut-down client must raise, not hang.
+    network = InProcessNetwork()
+    client = InProcessClient(network, EP1)
+    await client.shutdown()
+    with pytest.raises(ShuttingDownError):
+        await client.send(EP2, ProbeMessage(sender=EP1))
+    tcp_client = TcpClient(EP1)
+    await tcp_client.shutdown()
+    with pytest.raises(ShuttingDownError):
+        await tcp_client.send(EP2, ProbeMessage(sender=EP1))
+
+
+@async_test
+async def test_inprocess_broadcast_fan_out():
+    # MessagingTest.java:397-421 analog: broadcaster reaches 100 servers.
+    network = InProcessNetwork()
+    services = []
+    members = []
+    for i in range(100):
+        addr = Endpoint("10.0.0.1", 20000 + i)
+        server = InProcessServer(network, addr)
+        service = EchoService()
+        server.set_membership_service(service)
+        await server.start()
+        services.append(service)
+        members.append(addr)
+    client = InProcessClient(network, EP1)
+    broadcaster = UnicastToAllBroadcaster(client)
+    broadcaster.set_membership(members)
+    broadcaster.broadcast(LeaveMessage(sender=EP1))
+    for _ in range(100):
+        if all(len(s.received) == 1 for s in services):
+            break
+        await asyncio.sleep(0.01)
+    assert all(len(s.received) == 1 for s in services)
