@@ -4,6 +4,8 @@ from __future__ import annotations
 
 from typing import Awaitable, Callable, TypeVar
 
+from rapid_tpu.errors import ShuttingDownError
+
 T = TypeVar("T")
 
 
@@ -12,12 +14,16 @@ async def call_with_retries(
     retries: int,
 ) -> T:
     """Run ``call`` until it succeeds, for at most ``retries + 1`` attempts;
-    re-raises the last failure."""
-    last_exc: BaseException | None = None
+    re-raises the last failure. Terminal conditions — task cancellation
+    (BaseException) and client shutdown — propagate immediately instead of
+    burning further attempts."""
+    last_exc: Exception | None = None
     for _ in range(retries + 1):
         try:
             return await call()
-        except BaseException as exc:  # noqa: BLE001 — transport failures vary by impl
+        except ShuttingDownError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — transport failures vary by impl
             last_exc = exc
     assert last_exc is not None
     raise last_exc

@@ -154,6 +154,7 @@ class TcpClient(MessagingClient):
         self.my_addr = my_addr
         self._settings = settings if settings is not None else Settings()
         self._connections: Dict[Endpoint, _Connection] = {}
+        self._connect_locks: Dict[Endpoint, asyncio.Lock] = {}
         self._correlation = itertools.count(1)
         self._shut_down = False
 
@@ -165,13 +166,22 @@ class TcpClient(MessagingClient):
         return self._settings.rpc_timeout_ms
 
     async def _connection_for(self, remote: Endpoint) -> _Connection:
-        conn = self._connections.get(remote)
-        if conn is not None and not conn.writer.is_closing():
+        # Per-remote connect lock: concurrent first sends must share one
+        # connection, not race to open several and leak the losers.
+        lock = self._connect_locks.setdefault(remote, asyncio.Lock())
+        async with lock:
+            conn = self._connections.get(remote)
+            if conn is not None and not conn.writer.is_closing():
+                return conn
+            reader, writer = await asyncio.open_connection(remote.hostname, remote.port)
+            conn = _Connection(reader, writer)
+            self._connections[remote] = conn
             return conn
-        reader, writer = await asyncio.open_connection(remote.hostname, remote.port)
-        conn = _Connection(reader, writer)
-        self._connections[remote] = conn
-        return conn
+
+    def _invalidate(self, remote: Endpoint, conn: _Connection) -> None:
+        if self._connections.get(remote) is conn:
+            self._connections.pop(remote, None)
+        conn.close()
 
     async def _attempt(self, remote: Endpoint, request: RapidRequest) -> RapidResponse:
         if self._shut_down:
@@ -186,13 +196,17 @@ class TcpClient(MessagingClient):
             await conn.writer.drain()
             payload = await asyncio.wait_for(future, timeout=timeout_s)
             return decode_response(payload)
+        except asyncio.TimeoutError:
+            # A slow RPC is not a transport failure: drop only this request's
+            # correlation slot and leave the shared connection (and everyone
+            # else's in-flight requests) alone.
+            conn.pending.pop(correlation_id, None)
+            raise
         except Exception:
             conn.pending.pop(correlation_id, None)
-            # Invalidate the cached connection on failure
+            # Invalidate the cached connection on transport-level failure
             # (GrpcClient.java:106-115's channel invalidation).
-            if conn.writer.is_closing() or self._connections.get(remote) is conn:
-                self._connections.pop(remote, None)
-                conn.close()
+            self._invalidate(remote, conn)
             raise
 
     async def send(self, remote: Endpoint, request: RapidRequest) -> RapidResponse:

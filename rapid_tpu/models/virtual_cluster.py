@@ -36,77 +36,122 @@ from rapid_tpu.ops.hashing import masked_set_hash
 from rapid_tpu.ops.rings import endpoint_ring_keys, predecessor_of_keys, ring_topology
 
 
-def _fd_tick(cfg: EngineConfig, state: EngineState, faults: FaultInputs):
+def _edge_masks(cfg: EngineConfig, state: EngineState, faults: FaultInputs):
+    """Per-edge observer masks: (observer_active[n,k], src_blocked[c,n,k]).
+
+    Per-observer flags (is the observer live? is it rx-blocked for cohort c?)
+    are packed into one uint32 per node so the tick plus broadcast delivery
+    costs a single [n, k] gather — gathers dominate the round on TPU. The
+    result depends only on (topology, faults), both fixed between view
+    changes, so convergence loops hoist this out of the round body entirely.
+    """
+    n, c = cfg.n, cfg.c
+    obs = state.obs_idx.T  # [n, k] — observer of (subject s, ring k)
+    obs_clamped = jnp.clip(obs, 0, n - 1)
+
+    # bit 0: observer is a live prober; bits 1..c: observer rx-blocked for
+    # cohort (c-1)'s receivers.
+    active = (state.alive & ~faults.crashed).astype(jnp.uint32)
+    cohort_shifts = jnp.arange(1, c + 1, dtype=jnp.uint32)
+    packed = active | jnp.sum(
+        faults.rx_block.astype(jnp.uint32) << cohort_shifts[:, None], axis=0
+    )
+    gathered = packed[obs_clamped]  # [n, k] — THE gather
+
+    observer_active = (obs >= 0) & ((gathered & 1) == 1)
+    src_blocked = (
+        (gathered[None, :, :] >> cohort_shifts[:, None, None]) & 1
+    ).astype(bool)  # [c, n, k]
+    return observer_active, src_blocked
+
+
+def _fd_tick(cfg: EngineConfig, state: EngineState, faults: FaultInputs, observer_active):
     """Every observer probes its subjects; edges past the failure threshold
     emit one DOWN alert (semantics of PingPongFailureDetector + the
     edge-failure notification path, MembershipService.java:472-495)."""
-    n = cfg.n
-    obs = state.obs_idx.T  # [n, k] — observer of (subject s, ring k)
-    obs_clamped = jnp.clip(obs, 0, n - 1)
-    observer_active = (
-        (obs >= 0) & state.alive[obs_clamped] & ~faults.crashed[obs_clamped]
-    )
     subject_down = faults.crashed[:, None] | faults.probe_fail
     probe_failed = observer_active & subject_down & state.alive[:, None]
 
     fd_count = jnp.where(probe_failed, state.fd_count + 1, state.fd_count)
     fire = (fd_count >= cfg.fd_threshold) & ~state.fd_fired & state.alive[:, None]
     fd_fired = state.fd_fired | fire
-    return fd_count, fd_fired, fire, obs_clamped
+    return fd_count, fd_fired, fire
 
 
 def _cohort_cut_detection(cfg: EngineConfig, state: EngineState, new_reports, any_down):
-    """Per-cohort watermark pass (vmapped rapid_tpu.ops.cut_detection
-    semantics, gated by the per-configuration announced-proposal flag,
-    MembershipService.java:318-348)."""
-    subject_mask = state.alive | state.join_pending
+    """Batched per-cohort watermark pass (rapid_tpu.ops.cut_detection
+    semantics over a leading cohort axis, gated by the per-configuration
+    announced-proposal flag, MembershipService.java:318-348).
 
-    def one_cohort(reports, released, announced, seen_down, fresh):
-        reports = (reports | fresh) & subject_mask[:, None]
-        seen_down = seen_down | any_down
-        tally = jnp.sum(reports, axis=1, dtype=jnp.int32)
-        stable = tally >= cfg.h
-        flux = (tally >= cfg.l) & (tally < cfg.h)
-        in_union = stable | flux
+    The implicit-invalidation gather only runs when some cohort actually has
+    subjects in flux after a DOWN event (lax.cond): in pure crash/join rounds
+    every subject jumps straight past H, so the expensive gather is skipped.
+    """
+    n = cfg.n
+    sm = (state.alive | state.join_pending)[None, :, None]  # [1, n, 1]
+    reports = (state.reports | new_reports) & sm
+    seen_down = state.seen_down | any_down  # [c]
+
+    tally = jnp.sum(reports, axis=2, dtype=jnp.int32)  # [c, n]
+    stable = tally >= cfg.h
+    flux = (tally >= cfg.l) & (tally < cfg.h)
+
+    def with_implicit(reports):
+        # Implicit edge invalidation (MultiNodeCutDetector.java:137-164): the
+        # union (stable | flux) is invariant under the pass, so one masked OR
+        # is the fixpoint.
+        in_union = stable | flux  # [c, n]
         obs = state.inval_obs.T  # [n, k]
-        obs_ok = obs >= 0
-        obs_in_union = jnp.where(obs_ok, in_union[jnp.clip(obs, 0, cfg.n - 1)], False)
-        implicit = flux[:, None] & obs_in_union
-        reports = jnp.where(seen_down, reports | implicit, reports) & subject_mask[:, None]
-        tally2 = jnp.sum(reports, axis=1, dtype=jnp.int32)
-        stable2 = tally2 >= cfg.h
-        flux2 = (tally2 >= cfg.l) & (tally2 < cfg.h)
-        fresh_stable = stable2 & ~released
-        propose = ~announced & jnp.any(fresh_stable) & ~jnp.any(flux2)
-        proposal_mask = fresh_stable & propose
-        return (
-            reports,
-            released | proposal_mask,
-            announced | propose,
-            seen_down,
-            propose,
-            proposal_mask,
+        gathered = in_union[:, jnp.clip(obs, 0, n - 1)]  # [c, n, k]
+        implicit = (
+            flux[:, :, None]
+            & gathered
+            & (obs >= 0)[None, :, :]
+            & seen_down[:, None, None]
         )
+        return (reports | implicit) & sm
 
-    return jax.vmap(one_cohort)(
-        state.reports, state.released, state.announced, state.seen_down, new_reports
+    need_invalidation = jnp.any(flux & seen_down[:, None])
+    reports = jax.lax.cond(need_invalidation, with_implicit, lambda r: r, reports)
+
+    tally2 = jnp.sum(reports, axis=2, dtype=jnp.int32)
+    stable2 = tally2 >= cfg.h
+    flux2 = (tally2 >= cfg.l) & (tally2 < cfg.h)
+    fresh_stable = stable2 & ~state.released
+    propose = ~state.announced & jnp.any(fresh_stable, axis=1) & ~jnp.any(flux2, axis=1)
+    proposal_mask = fresh_stable & propose[:, None]
+    return (
+        reports,
+        state.released | proposal_mask,
+        state.announced | propose,
+        seen_down,
+        propose,
+        proposal_mask,
     )
 
 
-def engine_step_impl(
-    cfg: EngineConfig, state: EngineState, faults: FaultInputs
-) -> Tuple[EngineState, StepEvents]:
+def _compute_round(
+    cfg: EngineConfig, state: EngineState, faults: FaultInputs, edge_masks=None
+):
+    """One protocol round WITHOUT view-change application: returns the
+    round-advanced state plus (decided, winner_mask, events). Keeping the
+    ring re-sort out of the round body lets the convergence loop run
+    sort-free and apply the view change exactly once on exit; loops also
+    hoist the per-edge gather by passing precomputed ``edge_masks``."""
     n, k, c = cfg.n, cfg.k, cfg.c
 
-    # 1. Failure-detector tick -> fresh DOWN alerts per (subject, ring) edge.
-    fd_count, fd_fired, fire, obs_clamped = _fd_tick(cfg, state, faults)
+    # 1. Failure-detector tick -> fresh DOWN alerts per (subject, ring) edge,
+    #    plus per-cohort source-blocked bits from the same packed gather.
+    if edge_masks is None:
+        edge_masks = _edge_masks(cfg, state, faults)
+    observer_active, src_blocked = edge_masks
+    fd_count, fd_fired, fire = _fd_tick(cfg, state, faults, observer_active)
     alerts_emitted = jnp.sum(fire, dtype=jnp.int32)
     any_down = jnp.any(fire)
 
     # 2. Broadcast delivery: alert for edge (s, ring) originates at the edge's
     #    observer; cohort c hears it unless that observer is rx-blocked
     #    (the device analog of UnicastToAllBroadcaster + drop interceptors).
-    src_blocked = faults.rx_block[:, obs_clamped.reshape(-1)].reshape(c, n, k)
     new_reports = fire[None, :, :] & ~src_blocked
 
     # 3. Cut detection per cohort.
@@ -166,74 +211,21 @@ def engine_step_impl(
     )
     winner_mask = jnp.where(decided, prop_mask[winner_cohort], jnp.zeros((n,), dtype=bool))
 
-    # 6. View change: flip the decided cut in/out of the membership, re-derive
-    #    topology, reset per-configuration state (MembershipService.java:385-444).
-    def apply_view_change(_):
-        alive2 = state.alive ^ winner_mask
-        topo = ring_topology(state.key_hi, state.key_lo, alive2)
-        config_hi, config_lo = masked_set_hash(state.id_hi, state.id_lo, alive2)
-        return EngineState(
-            key_hi=state.key_hi,
-            key_lo=state.key_lo,
-            id_hi=state.id_hi,
-            id_lo=state.id_lo,
-            alive=alive2,
-            obs_idx=topo.obs_idx,
-            subj_idx=topo.subj_idx,
-            inval_obs=topo.obs_idx + 0,
-            config_epoch=state.config_epoch + 1,
-            config_hi=config_hi,
-            config_lo=config_lo,
-            n_members=jnp.sum(alive2, dtype=jnp.int32),
-            fd_count=jnp.zeros((n, k), dtype=jnp.int32),
-            fd_fired=jnp.zeros((n, k), dtype=bool),
-            join_pending=state.join_pending & ~winner_mask,
-            cohort_of=state.cohort_of,
-            reports=jnp.zeros((c, n, k), dtype=bool),
-            seen_down=jnp.zeros((c,), dtype=bool),
-            released=jnp.zeros((c, n), dtype=bool),
-            announced=jnp.zeros((c,), dtype=bool),
-            prop_mask=jnp.zeros((c, n), dtype=bool),
-            prop_hi=jnp.zeros((c,), dtype=jnp.uint32),
-            prop_lo=jnp.zeros((c,), dtype=jnp.uint32),
-            vote_hi=jnp.zeros((n,), dtype=jnp.uint32),
-            vote_lo=jnp.zeros((n,), dtype=jnp.uint32),
-            vote_valid=jnp.zeros((n,), dtype=bool),
-            rounds_undecided=jnp.int32(0),
-        )
-
-    def keep_config(_):
-        return EngineState(
-            key_hi=state.key_hi,
-            key_lo=state.key_lo,
-            id_hi=state.id_hi,
-            id_lo=state.id_lo,
-            alive=state.alive,
-            obs_idx=state.obs_idx,
-            subj_idx=state.subj_idx,
-            inval_obs=state.inval_obs,
-            config_epoch=state.config_epoch,
-            config_hi=state.config_hi,
-            config_lo=state.config_lo,
-            n_members=state.n_members,
-            fd_count=fd_count,
-            fd_fired=fd_fired,
-            join_pending=state.join_pending,
-            cohort_of=state.cohort_of,
-            reports=reports,
-            seen_down=seen_down,
-            released=released,
-            announced=announced,
-            prop_mask=prop_mask,
-            prop_hi=prop_hi,
-            prop_lo=prop_lo,
-            vote_hi=vote_hi,
-            vote_lo=vote_lo,
-            vote_valid=vote_valid,
-            rounds_undecided=rounds_undecided,
-        )
-
-    new_state = jax.lax.cond(decided, apply_view_change, keep_config, operand=None)
+    round_state = state._replace(
+        fd_count=fd_count,
+        fd_fired=fd_fired,
+        reports=reports,
+        seen_down=seen_down,
+        released=released,
+        announced=announced,
+        prop_mask=prop_mask,
+        prop_hi=prop_hi,
+        prop_lo=prop_lo,
+        vote_hi=vote_hi,
+        vote_lo=vote_lo,
+        vote_valid=vote_valid,
+        rounds_undecided=rounds_undecided,
+    )
     events = StepEvents(
         decided=decided,
         winner_mask=winner_mask,
@@ -242,6 +234,56 @@ def engine_step_impl(
         total_votes=tally.total_votes,
         max_votes=tally.max_count,
     )
+    return round_state, decided, winner_mask, events
+
+
+def apply_view_change_impl(
+    cfg: EngineConfig, state: EngineState, winner_mask
+) -> EngineState:
+    """Commit a decided cut: flip membership, re-derive ring topology, reset
+    all per-configuration state (MembershipService.java:385-444)."""
+    n, k, c = cfg.n, cfg.k, cfg.c
+    alive2 = state.alive ^ winner_mask
+    topo = ring_topology(state.key_hi, state.key_lo, alive2)
+    config_hi, config_lo = masked_set_hash(state.id_hi, state.id_lo, alive2)
+    return state._replace(
+        alive=alive2,
+        obs_idx=topo.obs_idx,
+        subj_idx=topo.subj_idx,
+        inval_obs=topo.obs_idx + 0,
+        config_epoch=state.config_epoch + 1,
+        config_hi=config_hi,
+        config_lo=config_lo,
+        n_members=jnp.sum(alive2, dtype=jnp.int32),
+        fd_count=jnp.zeros((n, k), dtype=jnp.int32),
+        fd_fired=jnp.zeros((n, k), dtype=bool),
+        join_pending=state.join_pending & ~winner_mask,
+        reports=jnp.zeros((c, n, k), dtype=bool),
+        seen_down=jnp.zeros((c,), dtype=bool),
+        released=jnp.zeros((c, n), dtype=bool),
+        announced=jnp.zeros((c,), dtype=bool),
+        prop_mask=jnp.zeros((c, n), dtype=bool),
+        prop_hi=jnp.zeros((c,), dtype=jnp.uint32),
+        prop_lo=jnp.zeros((c,), dtype=jnp.uint32),
+        vote_hi=jnp.zeros((n,), dtype=jnp.uint32),
+        vote_lo=jnp.zeros((n,), dtype=jnp.uint32),
+        vote_valid=jnp.zeros((n,), dtype=bool),
+        rounds_undecided=jnp.int32(0),
+    )
+
+
+def engine_step_impl(
+    cfg: EngineConfig, state: EngineState, faults: FaultInputs
+) -> Tuple[EngineState, StepEvents]:
+    """One full protocol round including conditional view-change application
+    (the per-step driver path)."""
+    round_state, decided, winner_mask, events = _compute_round(cfg, state, faults)
+    new_state = jax.lax.cond(
+        decided,
+        lambda s: apply_view_change_impl(cfg, s, winner_mask),
+        lambda s: s,
+        round_state,
+    )
     return new_state, events
 
 
@@ -249,6 +291,49 @@ def engine_step_impl(
 # place) and a non-donating variant for compile checks / sharded dry-runs.
 engine_step = jax.jit(engine_step_impl, static_argnums=(0,), donate_argnums=(1,))
 engine_step_nodonate = jax.jit(engine_step_impl, static_argnums=(0,))
+
+
+def run_to_decision_impl(cfg: EngineConfig, state: EngineState, faults: FaultInputs, max_steps):
+    """Protocol rounds until a view change commits — entirely on device.
+
+    A ``lax.while_loop`` around ``engine_step_impl``: the host dispatches ONE
+    program per convergence instead of one per round, removing the per-round
+    device->host sync that dominates small-round convergences. Returns
+    (state, steps_taken, decided, winner_mask).
+    """
+    n = cfg.n
+
+    def cond(carry):
+        _, steps, decided, _ = carry
+        return (~decided) & (steps < max_steps)
+
+    # Topology and faults are fixed until the loop exits (it exits on the
+    # first decision), so the per-edge gather hoists out of the round body.
+    edge_masks = _edge_masks(cfg, state, faults)
+
+    def body(carry):
+        state, steps, _, _ = carry
+        round_state, decided, winner_mask, _ = _compute_round(
+            cfg, state, faults, edge_masks
+        )
+        return (round_state, steps + 1, decided, winner_mask)
+
+    init = (state, jnp.int32(0), jnp.bool_(False), jnp.zeros((n,), dtype=bool))
+    state, steps, decided, winner = jax.lax.while_loop(cond, body, init)
+    # Apply the (at most one) view change after the loop: the round body stays
+    # sort-free, and the ring rebuild runs exactly once per convergence.
+    state = jax.lax.cond(
+        decided,
+        lambda s: apply_view_change_impl(cfg, s, winner),
+        lambda s: s,
+        state,
+    )
+    return (state, steps, decided, winner)
+
+
+run_to_decision = jax.jit(
+    run_to_decision_impl, static_argnums=(0,), donate_argnums=(1,)
+)
 
 
 class VirtualCluster:
@@ -327,15 +412,14 @@ class VirtualCluster:
     # -- fault & membership injection ----------------------------------
 
     def crash(self, slots: Sequence[int]) -> None:
-        """Crash-stop the given slots (unresponsive until revived)."""
-        crashed = np.asarray(self.faults.crashed).copy()
-        crashed[np.asarray(slots)] = True
-        self.faults = self.faults._replace(crashed=jnp.asarray(crashed))
+        """Crash-stop the given slots (unresponsive until revived). Device-side
+        scatter: only the slot indices cross the host->device boundary."""
+        idx = jnp.asarray(np.asarray(slots, dtype=np.int32))
+        self.faults = self.faults._replace(crashed=self.faults.crashed.at[idx].set(True))
 
     def revive(self, slots: Sequence[int]) -> None:
-        crashed = np.asarray(self.faults.crashed).copy()
-        crashed[np.asarray(slots)] = False
-        self.faults = self.faults._replace(crashed=jnp.asarray(crashed))
+        idx = jnp.asarray(np.asarray(slots, dtype=np.int32))
+        self.faults = self.faults._replace(crashed=self.faults.crashed.at[idx].set(False))
 
     def set_flaky_edges(self, probe_fail: np.ndarray) -> None:
         """Arbitrary per-(subject, ring) probe failures — asymmetric/one-way
@@ -383,6 +467,26 @@ class VirtualCluster:
         self.state, events = engine_step(self.cfg, self.state, self.faults)
         return events
 
+    def sync(self) -> int:
+        """Force completion of all pending uploads/compute on the cluster
+        state and return a cheap checksum. ``jax.block_until_ready`` does not
+        round-trip on remote-tunnel backends; a scalar fetch that depends on
+        every state array does."""
+        state, faults = self.state, self.faults
+        total = (
+            jnp.sum(state.key_hi, dtype=jnp.uint32)
+            + jnp.sum(state.key_lo, dtype=jnp.uint32)
+            + jnp.sum(state.id_hi, dtype=jnp.uint32)
+            + jnp.sum(state.id_lo, dtype=jnp.uint32)
+            + jnp.sum(state.obs_idx).astype(jnp.uint32)
+            + jnp.sum(state.fd_count).astype(jnp.uint32)
+            + jnp.sum(state.reports).astype(jnp.uint32)
+            + jnp.sum(state.alive).astype(jnp.uint32)
+            + jnp.sum(faults.crashed).astype(jnp.uint32)
+            + jnp.sum(faults.probe_fail).astype(jnp.uint32)
+        )
+        return int(total)
+
     def run_until_converged(self, max_steps: int = 64) -> Tuple[int, Optional[StepEvents]]:
         """Run rounds until a view change commits; returns (rounds, events)."""
         for round_idx in range(max_steps):
@@ -390,6 +494,18 @@ class VirtualCluster:
             if bool(events.decided):
                 return round_idx + 1, events
         return max_steps, None
+
+    def run_to_decision(self, max_steps: int = 64) -> Tuple[int, bool, jnp.ndarray]:
+        """Single-dispatch convergence: the whole round loop runs on device
+        (lax.while_loop); returns (rounds, decided, winner_mask). The winner
+        mask stays on device — only two scalars cross the tunnel."""
+        self.state, steps, decided, winner = run_to_decision(
+            self.cfg, self.state, self.faults, jnp.int32(max_steps)
+        )
+        # One scalar readback total: every device->host fetch is a full
+        # tunnel round trip, so steps and the decided bit travel packed.
+        packed = int(steps | (decided.astype(jnp.int32) << 30))
+        return packed & ~(1 << 30), bool(packed >> 30), winner
 
     def timed_convergence(self, max_steps: int = 64) -> Tuple[int, float]:
         """(rounds, wall_ms) for a convergence run, excluding compilation

@@ -28,16 +28,20 @@ def join64(hi, lo) -> np.ndarray:
 
 
 def lex_argsort(keys: tuple) -> jnp.ndarray:
-    """Stable argsort by a tuple of equal-length integer arrays, most
-    significant key first. LSD radix: stable-sort by the least significant
-    key, then re-sort by each more significant key in turn."""
-    order = None
-    for key in reversed(keys):
-        if order is None:
-            order = jnp.argsort(key, stable=True)
-        else:
-            order = order[jnp.argsort(key[order], stable=True)]
-    return order
+    """Stable argsort by a tuple of equal-length integer arrays along the last
+    axis, most significant key first. One fused multi-key ``lax.sort`` — a
+    single on-device sort instead of one stable pass per key (3x fewer sorts
+    on the ring-rebuild hot path)."""
+    import jax
+
+    iota = jax.lax.broadcasted_iota(jnp.int32, keys[0].shape, keys[0].ndim - 1)
+    # The iota is the last *key*: ties on the real keys break by input index,
+    # which equals stable order while letting the backend use an unstable
+    # (cheaper) sort network.
+    out = jax.lax.sort(
+        tuple(keys) + (iota,), dimension=-1, num_keys=len(keys) + 1, is_stable=False
+    )
+    return out[-1]
 
 
 def mix32(x: jnp.ndarray) -> jnp.ndarray:

@@ -152,25 +152,37 @@ class Cluster:
         # with BOOTSTRAPPING in the meantime (Cluster.java:312).
         await server.start()
 
-        for attempt in range(settings.join_attempts):
-            try:
-                return await cls._join_attempt(
-                    seed_address, listen_address, node_id, settings, client, server,
-                    fd_factory, metadata, subscriptions, clock, rng,
-                )
-            except JoinPhaseOneError as exc:
-                status = exc.join_response.status_code
-                LOG.warning("%s join phase 1 rejected: %s (attempt %d)",
-                            listen_address, status.name, attempt)
-                if status == JoinStatusCode.UUID_ALREADY_IN_RING:
-                    node_id = NodeId.from_uuid()
-                elif status not in (
-                    JoinStatusCode.CONFIG_CHANGED,
-                    JoinStatusCode.MEMBERSHIP_REJECTED,
-                ):
-                    break
-            except (JoinPhaseTwoError, ConnectionError, asyncio.TimeoutError) as exc:
-                LOG.warning("%s join attempt %d failed: %r", listen_address, attempt, exc)
+        try:
+            for attempt in range(settings.join_attempts):
+                try:
+                    return await cls._join_attempt(
+                        seed_address, listen_address, node_id, settings, client, server,
+                        fd_factory, metadata, subscriptions, clock, rng,
+                    )
+                except JoinPhaseOneError as exc:
+                    status = exc.join_response.status_code
+                    LOG.warning("%s join phase 1 rejected: %s (attempt %d)",
+                                listen_address, status.name, attempt)
+                    if status == JoinStatusCode.UUID_ALREADY_IN_RING:
+                        node_id = NodeId.from_uuid()
+                    elif status not in (
+                        JoinStatusCode.CONFIG_CHANGED,
+                        JoinStatusCode.MEMBERSHIP_REJECTED,
+                    ):
+                        break
+                except (
+                    JoinPhaseTwoError,
+                    ConnectionError,
+                    OSError,
+                    asyncio.TimeoutError,
+                ) as exc:
+                    LOG.warning("%s join attempt %d failed: %r", listen_address, attempt, exc)
+        except BaseException:
+            # Unexpected failure (codec error, cancellation, ...): never leak
+            # the already-started server/client.
+            await server.shutdown()
+            await client.shutdown()
+            raise
 
         await server.shutdown()
         await client.shutdown()

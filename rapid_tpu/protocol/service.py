@@ -127,10 +127,12 @@ class MembershipService:
 
     async def shutdown(self) -> None:
         self._stopped = True
-        self._cancel_failure_detectors()
+        fd_tasks = self._cancel_failure_detectors()
         for task in self._background_tasks:
             task.cancel()
-        await asyncio.gather(*self._background_tasks, return_exceptions=True)
+        # Await detectors too: a mid-tick probe must finish (or unwind) before
+        # the client underneath it is shut down.
+        await asyncio.gather(*self._background_tasks, *fd_tasks, return_exceptions=True)
         self._background_tasks.clear()
         await self.client.shutdown()
 
@@ -430,11 +432,13 @@ class MembershipService:
         async with self._lock:
             self._edge_failure_notification(subject, config_id)
 
-    def _cancel_failure_detectors(self) -> None:
+    def _cancel_failure_detectors(self) -> List[asyncio.Task]:
         self._fd_generation += 1
-        for task in self._fd_tasks:
+        cancelled = list(self._fd_tasks)
+        for task in cancelled:
             task.cancel()
         self._fd_tasks.clear()
+        return cancelled
 
     # ------------------------------------------------------------------
     # alert batching (MembershipService.java:572-581, 613-637)

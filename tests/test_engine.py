@@ -89,6 +89,30 @@ def test_sequential_view_changes():
     assert vc.config_id != first_epoch_config
 
 
+def test_device_loop_matches_host_loop():
+    # run_to_decision (single-dispatch lax.while_loop) must land on the same
+    # outcome as the per-round host loop.
+    a = VirtualCluster.create(150, fd_threshold=3, seed=9)
+    b = VirtualCluster.create(150, fd_threshold=3, seed=9)
+    victims = [10, 99]
+    a.crash(victims)
+    b.crash(victims)
+    rounds_host, events = a.run_until_converged()
+    rounds_dev, decided, winner = b.run_to_decision()
+    assert decided
+    assert rounds_dev == rounds_host
+    np.testing.assert_array_equal(a.alive_mask, b.alive_mask)
+    assert set(np.nonzero(winner)[0].tolist()) == set(victims)
+    assert int(b.state.config_hi) == int(a.state.config_hi)
+
+
+def test_device_loop_no_decision_hits_max_steps():
+    vc = VirtualCluster.create(64, seed=10)
+    rounds, decided, winner = vc.run_to_decision(max_steps=5)
+    assert rounds == 5 and not decided
+    assert not winner.any()
+
+
 def test_no_faults_no_decision():
     vc = VirtualCluster.create(64, seed=6)
     for _ in range(8):
