@@ -22,6 +22,10 @@ def main() -> None:
     import jax
     import numpy as np
 
+    from rapid_tpu.utils._native import ensure_built
+
+    ensure_built()  # compile the native host library outside any event loop
+
     from rapid_tpu.models.virtual_cluster import VirtualCluster
 
     n = 100_000
@@ -33,8 +37,10 @@ def main() -> None:
 
     def build():
         # One receiver cohort: crash faults never diverge healthy receivers.
+        # The cut detector's merge+classify runs through the Pallas kernel.
         vc = VirtualCluster.create(
-            n, k=10, h=9, l=4, cohorts=1, fd_threshold=fd_threshold, seed=0
+            n, k=10, h=9, l=4, cohorts=1, fd_threshold=fd_threshold, seed=0,
+            use_pallas=(platform == "tpu"),
         )
         rng = np.random.default_rng(7)
         victims = rng.choice(n, size=int(n * crash_frac), replace=False)

@@ -44,6 +44,10 @@ class CodecError(ValueError):
     pass
 
 
+# Public field-codec surface for other modules that persist in this layout
+# (rapid_tpu.utils.checkpoint); the underscore classes remain as aliases.
+
+
 class _Writer:
     def __init__(self) -> None:
         self._parts: List[bytes] = []
@@ -66,6 +70,10 @@ class _Writer:
 
     def string(self, s: str) -> None:
         self.blob(s.encode("utf-8"))
+
+    def raw(self, b: bytes) -> None:
+        """Append bytes verbatim (headers/magic for codec-layout consumers)."""
+        self._parts.append(b)
 
     def getvalue(self) -> bytes:
         return b"".join(self._parts)
@@ -356,3 +364,7 @@ def decode_response(data: bytes) -> RapidResponse:
     if not r.done():
         raise CodecError("trailing bytes in response")
     return out
+
+
+Writer = _Writer
+Reader = _Reader

@@ -32,6 +32,9 @@ class EngineConfig(NamedTuple):
     l: int  # low watermark
     c: int = 2  # receiver cohorts
     fd_threshold: int = 3  # consecutive failed probe windows before alerting
+    # Run the cut detector's merge+classify through the Pallas TPU kernel
+    # (rapid_tpu.ops.pallas_kernels); off for sharded/CPU runs.
+    use_pallas: bool = False
     # Rounds an announced proposal may sit undecided before the classic-Paxos
     # fallback fires (models FastPaxos.java:106-107's jittered recovery; the
     # coordinator rule then forces the plurality value, Paxos.java:271-328).
@@ -63,9 +66,10 @@ class EngineState(NamedTuple):
     # Joiner bookkeeping.
     join_pending: jnp.ndarray  # [n] bool — slots waiting to be admitted
 
-    # Cut-detector state per cohort.
+    # Cut-detector state per cohort: reports are uint32 ring bitmasks per
+    # subject (bit k = ring k reported; OR is the dedup).
     cohort_of: jnp.ndarray  # [n] int32 — receiver cohort of each node
-    reports: jnp.ndarray  # [c, n, k] bool
+    report_bits: jnp.ndarray  # [c, n] uint32
     seen_down: jnp.ndarray  # [c] bool
     released: jnp.ndarray  # [c, n] bool
     announced: jnp.ndarray  # [c] bool — cohort already proposed this config
@@ -107,7 +111,7 @@ def initial_state(cfg: EngineConfig, key_hi, key_lo, id_hi, id_lo, alive) -> Eng
         fd_fired=jnp.zeros((n, k), dtype=bool),
         join_pending=jnp.zeros((n,), dtype=bool),
         cohort_of=jnp.zeros((n,), dtype=jnp.int32),
-        reports=jnp.zeros((c, n, k), dtype=bool),
+        report_bits=jnp.zeros((c, n), dtype=jnp.uint32),
         seen_down=jnp.zeros((c,), dtype=bool),
         released=jnp.zeros((c, n), dtype=bool),
         announced=jnp.zeros((c,), dtype=bool),

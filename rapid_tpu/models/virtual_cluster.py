@@ -33,6 +33,7 @@ from rapid_tpu.models.state import (
 )
 from rapid_tpu.ops.consensus import tally_candidates
 from rapid_tpu.ops.hashing import masked_set_hash
+from rapid_tpu.ops.pallas_kernels import _popcount32, watermark_merge_classify
 from rapid_tpu.ops.rings import endpoint_ring_keys, predecessor_of_keys, ring_topology
 
 
@@ -78,25 +79,37 @@ def _fd_tick(cfg: EngineConfig, state: EngineState, faults: FaultInputs, observe
     return fd_count, fd_fired, fire
 
 
-def _cohort_cut_detection(cfg: EngineConfig, state: EngineState, new_reports, any_down):
-    """Batched per-cohort watermark pass (rapid_tpu.ops.cut_detection
-    semantics over a leading cohort axis, gated by the per-configuration
-    announced-proposal flag, MembershipService.java:318-348).
+def _cohort_cut_detection(cfg: EngineConfig, state: EngineState, new_bits, any_down):
+    """Batched per-cohort watermark pass over uint32 ring-report bitmasks
+    (rapid_tpu.ops.pallas_kernels semantics over a leading cohort axis, gated
+    by the per-configuration announced-proposal flag,
+    MembershipService.java:318-348).
 
-    The implicit-invalidation gather only runs when some cohort actually has
-    subjects in flux after a DOWN event (lax.cond): in pure crash/join rounds
-    every subject jumps straight past H, so the expensive gather is skipped.
+    The merge + popcount + H/L classification runs through the Pallas TPU
+    kernel when cfg.use_pallas is set (single-device TPU runs); otherwise the
+    bit-identical jnp core. The implicit-invalidation gather only runs when
+    some cohort actually has subjects in flux after a DOWN event (lax.cond):
+    in pure crash/join rounds every subject jumps straight past H, so the
+    expensive gather is skipped.
     """
-    n = cfg.n
-    sm = (state.alive | state.join_pending)[None, :, None]  # [1, n, 1]
-    reports = (state.reports | new_reports) & sm
+    n, c = cfg.n, cfg.c
+    subject_mask = state.alive | state.join_pending  # [n]
+    sm_flat = jnp.broadcast_to(subject_mask[None, :], (c, n)).reshape(c * n)
+    bits_flat, cls_flat = watermark_merge_classify(
+        state.report_bits.reshape(c * n),
+        new_bits.reshape(c * n),
+        sm_flat,
+        cfg.h,
+        cfg.l,
+        use_pallas=cfg.use_pallas,
+    )
+    report_bits = bits_flat.reshape(c, n)
+    cls = cls_flat.reshape(c, n)
     seen_down = state.seen_down | any_down  # [c]
+    stable = cls == 2
+    flux = cls == 1
 
-    tally = jnp.sum(reports, axis=2, dtype=jnp.int32)  # [c, n]
-    stable = tally >= cfg.h
-    flux = (tally >= cfg.l) & (tally < cfg.h)
-
-    def with_implicit(reports):
+    def with_implicit(report_bits):
         # Implicit edge invalidation (MultiNodeCutDetector.java:137-164): the
         # union (stable | flux) is invariant under the pass, so one masked OR
         # is the fixpoint.
@@ -109,19 +122,24 @@ def _cohort_cut_detection(cfg: EngineConfig, state: EngineState, new_reports, an
             & (obs >= 0)[None, :, :]
             & seen_down[:, None, None]
         )
-        return (reports | implicit) & sm
+        shifts = jnp.arange(cfg.k, dtype=jnp.uint32)
+        implicit_bits = jnp.sum(
+            implicit.astype(jnp.uint32) << shifts[None, None, :], axis=2, dtype=jnp.uint32
+        )
+        merged = report_bits | implicit_bits
+        return jnp.where(subject_mask[None, :], merged, jnp.uint32(0))
 
     need_invalidation = jnp.any(flux & seen_down[:, None])
-    reports = jax.lax.cond(need_invalidation, with_implicit, lambda r: r, reports)
+    report_bits = jax.lax.cond(need_invalidation, with_implicit, lambda r: r, report_bits)
 
-    tally2 = jnp.sum(reports, axis=2, dtype=jnp.int32)
+    tally2 = _popcount32(report_bits)
     stable2 = tally2 >= cfg.h
     flux2 = (tally2 >= cfg.l) & (tally2 < cfg.h)
     fresh_stable = stable2 & ~state.released
     propose = ~state.announced & jnp.any(fresh_stable, axis=1) & ~jnp.any(flux2, axis=1)
     proposal_mask = fresh_stable & propose[:, None]
     return (
-        reports,
+        report_bits,
         state.released | proposal_mask,
         state.announced | propose,
         seen_down,
@@ -152,11 +170,17 @@ def _compute_round(
     # 2. Broadcast delivery: alert for edge (s, ring) originates at the edge's
     #    observer; cohort c hears it unless that observer is rx-blocked
     #    (the device analog of UnicastToAllBroadcaster + drop interceptors).
-    new_reports = fire[None, :, :] & ~src_blocked
+    #    Delivered alerts pack straight into per-subject ring bitmasks.
+    shifts = jnp.arange(k, dtype=jnp.uint32)
+    new_bits = jnp.sum(
+        (fire[None, :, :] & ~src_blocked).astype(jnp.uint32) << shifts[None, None, :],
+        axis=2,
+        dtype=jnp.uint32,
+    )
 
     # 3. Cut detection per cohort.
-    reports, released, announced, seen_down, proposed_now, prop_masks = _cohort_cut_detection(
-        cfg, state, new_reports, any_down
+    report_bits, released, announced, seen_down, proposed_now, prop_masks = _cohort_cut_detection(
+        cfg, state, new_bits, any_down
     )
     # Proposal identity = commutative set-hash of the cut's member identities
     # (the canonical-sort-free equivalent of the ring-0-sorted endpoint list,
@@ -214,7 +238,7 @@ def _compute_round(
     round_state = state._replace(
         fd_count=fd_count,
         fd_fired=fd_fired,
-        reports=reports,
+        report_bits=report_bits,
         seen_down=seen_down,
         released=released,
         announced=announced,
@@ -258,7 +282,7 @@ def apply_view_change_impl(
         fd_count=jnp.zeros((n, k), dtype=jnp.int32),
         fd_fired=jnp.zeros((n, k), dtype=bool),
         join_pending=state.join_pending & ~winner_mask,
-        reports=jnp.zeros((c, n, k), dtype=bool),
+        report_bits=jnp.zeros((c, n), dtype=jnp.uint32),
         seen_down=jnp.zeros((c,), dtype=bool),
         released=jnp.zeros((c, n), dtype=bool),
         announced=jnp.zeros((c,), dtype=bool),
@@ -363,13 +387,16 @@ class VirtualCluster:
         cohorts: int = 2,
         fd_threshold: int = 3,
         seed: int = 0,
+        use_pallas: bool = False,
     ) -> "VirtualCluster":
         """Synthetic cluster: slot identities are random 64-bit lanes (the
         host never materializes 100K endpoint strings; interop deployments
         use from_endpoints)."""
         n = n_slots if n_slots is not None else n_members
         assert n >= n_members
-        cfg = EngineConfig(n=n, k=k, h=h, l=l, c=cohorts, fd_threshold=fd_threshold)
+        cfg = EngineConfig(
+            n=n, k=k, h=h, l=l, c=cohorts, fd_threshold=fd_threshold, use_pallas=use_pallas
+        )
         rng = np.random.default_rng(seed)
         key_hi = rng.integers(0, 2**32, size=(k, n), dtype=np.uint32)
         key_lo = rng.integers(0, 2**32, size=(k, n), dtype=np.uint32)
@@ -446,13 +473,14 @@ class VirtualCluster:
 
         # Gatekeepers report all K rings for each joiner; delivery to every
         # cohort (joins ride the same broadcast path as DOWN alerts).
-        reports = np.asarray(state.reports).copy()
-        reports[:, slots, :] = True
+        full_mask = np.uint32((1 << self.cfg.k) - 1)
+        report_bits = np.asarray(state.report_bits).copy()
+        report_bits[:, slots] = full_mask
 
         self.state = state._replace(
             join_pending=jnp.asarray(join_pending),
             inval_obs=jnp.asarray(inval_obs),
-            reports=jnp.asarray(reports),
+            report_bits=jnp.asarray(report_bits),
         )
 
     def assign_cohorts(self, cohort_of: np.ndarray) -> None:
@@ -480,7 +508,7 @@ class VirtualCluster:
             + jnp.sum(state.id_lo, dtype=jnp.uint32)
             + jnp.sum(state.obs_idx).astype(jnp.uint32)
             + jnp.sum(state.fd_count).astype(jnp.uint32)
-            + jnp.sum(state.reports).astype(jnp.uint32)
+            + jnp.sum(state.report_bits).astype(jnp.uint32)
             + jnp.sum(state.alive).astype(jnp.uint32)
             + jnp.sum(faults.crashed).astype(jnp.uint32)
             + jnp.sum(faults.probe_fail).astype(jnp.uint32)

@@ -1,0 +1,126 @@
+"""Pallas TPU kernels for the protocol hot path.
+
+The densest per-round computation is the cut detector's watermark pass: merge
+this round's report bits into the accumulated per-(subject, ring) reports,
+count reports per subject, and classify each subject against the H/L
+watermarks (``MultiNodeCutDetector.java:84-128``). With reports held as one
+uint32 *bitmask per subject* (bit k = ring k reported; dedup is the OR), the
+whole pass is a single VMEM-resident sweep: OR + popcount + compares, one HBM
+read and one write per word instead of XLA's materialized [n, k] bool
+intermediates.
+
+Falls back to an identical jnp implementation off-TPU (and for testing).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas is TPU/Mosaic-gated; keep import soft for CPU-only installs
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+_LANES = 128
+_SUBLANES = 8
+_BLOCK = _SUBLANES * _LANES  # 1024 subjects per grid step
+
+
+def _popcount32(v):
+    """Branch-free 32-bit popcount (Hacker's Delight 5-1), VPU-friendly."""
+    v = v - ((v >> 1) & jnp.uint32(0x55555555))
+    v = (v & jnp.uint32(0x33333333)) + ((v >> 2) & jnp.uint32(0x33333333))
+    v = (v + (v >> 4)) & jnp.uint32(0x0F0F0F0F)
+    return ((v * jnp.uint32(0x01010101)) >> 24).astype(jnp.int32)
+
+
+def _watermark_kernel(h: int, l: int, old_ref, new_ref, mask_ref, bits_ref, cls_ref):
+    """One [8, 128] tile: merge report bits, classify against watermarks.
+
+    cls encoding per subject: 0 none, 1 flux (L <= tally < H), 2 stable (>= H).
+    """
+    merged = jnp.where(mask_ref[:], old_ref[:] | new_ref[:], jnp.uint32(0))
+    tally = _popcount32(merged)
+    stable = tally >= h
+    flux = (tally >= l) & (tally < h)
+    bits_ref[:] = merged
+    cls_ref[:] = jnp.where(stable, jnp.int32(2), jnp.where(flux, jnp.int32(1), jnp.int32(0)))
+
+
+def _watermark_jnp(old_bits, new_bits, subject_mask, h: int, l: int):
+    merged = jnp.where(subject_mask, old_bits | new_bits, jnp.uint32(0))
+    tally = _popcount32(merged)
+    stable = tally >= h
+    flux = (tally >= l) & (tally < h)
+    cls = jnp.where(stable, jnp.int32(2), jnp.where(flux, jnp.int32(1), jnp.int32(0)))
+    return merged, cls
+
+
+@functools.partial(jax.jit, static_argnames=("h", "l", "use_pallas"))
+def watermark_merge_classify(
+    old_bits: jnp.ndarray,
+    new_bits: jnp.ndarray,
+    subject_mask: jnp.ndarray,
+    h: int,
+    l: int,
+    use_pallas: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Merge per-subject report bitmasks and classify against H/L.
+
+    old_bits/new_bits: [n] uint32 ring-report bitmasks; subject_mask: [n] bool
+    (present members + pending joiners — reports for anything else clear to 0,
+    the filter invariant of MembershipService.java:644-675).
+    Returns (merged_bits [n] uint32, cls [n] int32: 0 none / 1 flux / 2 stable).
+    """
+    n = old_bits.shape[0]
+    on_tpu = _HAS_PALLAS and use_pallas and jax.default_backend() == "tpu"
+    if not on_tpu:
+        return _watermark_jnp(old_bits, new_bits, subject_mask, h, l)
+
+    # Pad to a whole number of [8, 128] tiles; padding has subject_mask=False,
+    # so it classifies to 0 and is sliced away.
+    n_pad = (-n) % _BLOCK
+    if n_pad:
+        old_bits = jnp.pad(old_bits, (0, n_pad))
+        new_bits = jnp.pad(new_bits, (0, n_pad))
+        subject_mask = jnp.pad(subject_mask, (0, n_pad))
+    total = n + n_pad
+
+    shape2d = (total // _LANES, _LANES)
+    grid = (total // _BLOCK,)
+    block = pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    bits, cls = pl.pallas_call(
+        functools.partial(_watermark_kernel, h, l),
+        out_shape=(
+            jax.ShapeDtypeStruct(shape2d, jnp.uint32),
+            jax.ShapeDtypeStruct(shape2d, jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[block, block, block],
+        out_specs=(block, block),
+    )(
+        old_bits.reshape(shape2d),
+        new_bits.reshape(shape2d),
+        subject_mask.reshape(shape2d),
+    )
+    return bits.reshape(total)[:n], cls.reshape(total)[:n]
+
+
+def reports_matrix_to_bits(reports: jnp.ndarray) -> jnp.ndarray:
+    """[..., n, k] bool report matrix -> [..., n] uint32 bitmasks."""
+    k = reports.shape[-1]
+    weights = (jnp.uint32(1) << jnp.arange(k, dtype=jnp.uint32))
+    return jnp.sum(reports.astype(jnp.uint32) * weights, axis=-1, dtype=jnp.uint32)
+
+
+def bits_to_reports_matrix(bits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """[..., n] uint32 bitmasks -> [..., n, k] bool report matrix."""
+    shifts = jnp.arange(k, dtype=jnp.uint32)
+    return ((bits[..., None] >> shifts) & 1).astype(bool)

@@ -41,10 +41,21 @@ class RingTopology(NamedTuple):
 def endpoint_ring_keys(endpoints, k: int):
     """Host-side: K seeded 64-bit ring keys per endpoint, split into uint32
     lanes of shape [K, N]. Uses the exact key function of the host view so
-    device and host topologies agree bit-for-bit."""
-    keys = np.asarray(
-        [[ring_key(ep, seed) for ep in endpoints] for seed in range(k)], dtype=np.uint64
+    device and host topologies agree bit-for-bit. The native C library (when
+    built) computes the whole batch at memory bandwidth; the Python fallback
+    is bit-identical."""
+    from rapid_tpu.utils._native import native_ring_keys_batch
+
+    keys = native_ring_keys_batch(
+        [ep.hostname.encode("utf-8") for ep in endpoints],
+        [ep.port for ep in endpoints],
+        k,
     )
+    if keys is None:
+        keys = np.asarray(
+            [[ring_key(ep, seed) for ep in endpoints] for seed in range(k)],
+            dtype=np.uint64,
+        )
     hi = (keys >> np.uint64(32)).astype(np.uint32)
     lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
     return jnp.asarray(hi), jnp.asarray(lo)

@@ -54,7 +54,7 @@ def state_shardings(mesh: Mesh) -> EngineState:
         fd_fired=sh(NODE_AXIS, None),
         join_pending=sh(NODE_AXIS),
         cohort_of=sh(NODE_AXIS),
-        reports=sh(None, NODE_AXIS, None),
+        report_bits=sh(None, NODE_AXIS),
         seen_down=sh(),
         released=sh(None, NODE_AXIS),
         announced=sh(),

@@ -69,6 +69,12 @@ class Cluster:
     def register_subscription(self, event: ClusterEvents, callback) -> None:
         self.service.register_subscription(event, callback)
 
+    @property
+    def metrics(self):
+        # Counters + timings for this node; view_change_convergence_ms is the
+        # north-star metric (SURVEY §5.1).
+        return self.service.metrics.summary()
+
     # -- lifecycle ------------------------------------------------------
 
     async def leave_gracefully(self) -> None:

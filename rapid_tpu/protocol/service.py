@@ -51,6 +51,7 @@ from rapid_tpu.types import (
     Response,
 )
 from rapid_tpu.utils.clock import AsyncioClock, Clock
+from rapid_tpu.utils.metrics import Metrics
 
 LOG = logging.getLogger(__name__)
 
@@ -97,6 +98,8 @@ class MembershipService:
             for event, callbacks in subscriptions.items():
                 self.subscriptions[event].extend(callbacks)
 
+        self.metrics = Metrics()
+        self._convergence_timing = False
         self._lock = asyncio.Lock()  # the "protocol executor"
         self._joiners_to_respond_to: Dict[Endpoint, List[asyncio.Future]] = {}
         self._joiner_uuid: Dict[Endpoint, NodeId] = {}
@@ -252,6 +255,7 @@ class MembershipService:
     # ------------------------------------------------------------------
 
     def _handle_batched_alerts(self, batch: BatchedAlertMessage) -> Response:
+        self.metrics.inc("alerts_received", len(batch.messages))
         config_id = self.view.configuration_id
         valid = [
             self._extract_joiner_details(msg)
@@ -269,7 +273,11 @@ class MembershipService:
 
         if proposal:
             LOG.info("%s proposing membership change of size %d", self.my_addr, len(proposal))
+            self.metrics.inc("proposals_announced")
             self._announced_proposal = True
+            if not self._convergence_timing:
+                self._convergence_timing = True
+                self.metrics.mark("view_change_convergence", self.clock.now_ms())
             self._notify(
                 ClusterEvents.VIEW_CHANGE_PROPOSAL,
                 ClusterStatusChange(
@@ -335,6 +343,13 @@ class MembershipService:
             membership=tuple(self.view.ring(0)),
             status_changes=tuple(status_changes),
         )
+        self.metrics.inc("view_changes")
+        if self._convergence_timing:
+            self.metrics.record_ms(
+                "view_change_convergence",
+                self.metrics.elapsed_since_ms("view_change_convergence", self.clock.now_ms()),
+            )
+            self._convergence_timing = False
         self._notify(ClusterEvents.VIEW_CHANGE, change)
 
         # Reset for the next configuration.
@@ -347,6 +362,7 @@ class MembershipService:
             self._create_failure_detectors()
         else:
             LOG.info("%s was kicked out", self.my_addr)
+            self.metrics.inc("kicked")
             self._notify(ClusterEvents.KICKED, change)
 
         self._respond_to_joiners(proposal)
@@ -447,6 +463,12 @@ class MembershipService:
     def _enqueue_alert(self, msg: AlertMessage) -> None:
         self._last_enqueue_ms = self.clock.now_ms()
         self._send_queue.append(msg)
+        self.metrics.inc("alerts_enqueued")
+        if not self._convergence_timing:
+            # North-star timer: first local evidence of a membership change
+            # until the view change commits.
+            self._convergence_timing = True
+            self.metrics.mark("view_change_convergence", self.clock.now_ms())
 
     async def _alert_batcher_loop(self) -> None:
         window = self.settings.batching_window_ms
@@ -458,6 +480,7 @@ class MembershipService:
                 and (self.clock.now_ms() - self._last_enqueue_ms) > window
             ):
                 messages, self._send_queue = self._send_queue, []
+                self.metrics.inc("alert_batches_sent")
                 self.broadcaster.broadcast(
                     BatchedAlertMessage(sender=self.my_addr, messages=tuple(messages))
                 )

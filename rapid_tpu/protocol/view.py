@@ -47,6 +47,16 @@ def configuration_id_of(node_ids: Sequence[NodeId], endpoints: Sequence[Endpoint
     i64): every host-path config-id comparison uses this signed canonical
     form. (The device engine's config identity is a separate unsigned
     set-hash space, never compared against this fold.)"""
+    from rapid_tpu.utils._native import native_configuration_id
+
+    native = native_configuration_id(
+        [nid.high for nid in node_ids],
+        [nid.low for nid in node_ids],
+        [ep.hostname.encode("utf-8") for ep in endpoints],
+        [ep.port for ep in endpoints],
+    )
+    if native is not None:
+        return to_signed64(native)
     h = 1
     for nid in node_ids:
         h = (h * 37 + xxh64_int(nid.high)) & _MASK64

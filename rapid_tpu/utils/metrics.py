@@ -1,0 +1,59 @@
+"""First-class metrics: counters and timers.
+
+The reference has no runtime metrics at all (SURVEY §5.1/5.5 — logging and
+subscriptions only); this registry gives every node and the virtual-cluster
+engine cheap counters plus the north-star timer, view-change convergence.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, List
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self.counters: Dict[str, int] = defaultdict(int)
+        self.timings_ms: Dict[str, List[float]] = defaultdict(list)
+        self._marks: Dict[str, float] = {}
+
+    def inc(self, name: str, value: int = 1) -> None:
+        self.counters[name] += value
+
+    def record_ms(self, name: str, value_ms: float) -> None:
+        self.timings_ms[name].append(value_ms)
+
+    @contextmanager
+    def timer(self, name: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record_ms(name, (time.perf_counter() - start) * 1000.0)
+
+    def mark(self, name: str, now_ms: float | None = None) -> None:
+        """Start (or restart) a named epoch for ``elapsed_since_ms``. Pass the
+        owning component's clock reading for simulated-time correctness."""
+        self._marks[name] = now_ms if now_ms is not None else time.perf_counter_ns() / 1e6
+
+    def elapsed_since_ms(self, name: str, now_ms: float | None = None) -> float:
+        start = self._marks.get(name)
+        if start is None:
+            return 0.0
+        now = now_ms if now_ms is not None else time.perf_counter_ns() / 1e6
+        return now - start
+
+    def summary(self) -> Dict[str, object]:
+        out: Dict[str, object] = dict(self.counters)
+        for name, values in self.timings_ms.items():
+            if values:
+                ordered = sorted(values)
+                out[f"{name}_ms"] = {
+                    "count": len(values),
+                    "last": round(values[-1], 3),
+                    "p50": round(ordered[len(ordered) // 2], 3),
+                    "max": round(ordered[-1], 3),
+                }
+        return out

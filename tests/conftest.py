@@ -20,3 +20,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# Build the native host library once per test session (load-only at runtime).
+from rapid_tpu.utils._native import ensure_built
+
+ensure_built()

@@ -1,0 +1,106 @@
+"""Checkpoint/resume: host configuration snapshots and engine state."""
+
+import numpy as np
+
+from rapid_tpu.protocol.view import MembershipView
+from rapid_tpu.types import Endpoint, NodeId
+from rapid_tpu.utils.checkpoint import (
+    configuration_from_bytes,
+    configuration_to_bytes,
+    load_engine_state,
+    save_engine_state,
+    view_from_configuration,
+)
+
+K = 10
+
+
+def test_configuration_roundtrip(tmp_path):
+    view = MembershipView(K)
+    for i in range(40):
+        view.ring_add(Endpoint(f"10.3.0.{i}", 4000 + i), NodeId(i, i * 7))
+    blob = configuration_to_bytes(view.configuration)
+    restored = configuration_from_bytes(blob)
+    assert restored.node_ids == view.configuration.node_ids
+    assert restored.endpoints == view.configuration.endpoints
+    assert restored.configuration_id == view.configuration_id
+
+    # Resume: identical rings and config id.
+    resumed = view_from_configuration(restored, K)
+    assert resumed.configuration_id == view.configuration_id
+    for ring_idx in range(K):
+        assert resumed.ring(ring_idx) == view.ring(ring_idx)
+
+
+def test_configuration_rejects_garbage():
+    import pytest
+
+    with pytest.raises(ValueError):
+        configuration_from_bytes(b"not a checkpoint")
+
+
+def test_engine_state_roundtrip(tmp_path):
+    from rapid_tpu.models.virtual_cluster import VirtualCluster
+
+    vc = VirtualCluster.create(120, fd_threshold=3, seed=0)
+    vc.crash([5, 9])
+    # Advance mid-protocol so non-trivial state is saved.
+    for _ in range(2):
+        vc.step()
+
+    path = tmp_path / "engine.npz"
+    save_engine_state(path, vc.cfg, vc.state)
+    cfg, state = load_engine_state(path)
+    assert cfg == vc.cfg
+    for field in state._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(state, field)), np.asarray(getattr(vc.state, field)), err_msg=field
+        )
+
+    # The resumed cluster continues to the same decision.
+    resumed = VirtualCluster(cfg, state)
+    resumed.crash([5, 9])
+    rounds_resumed, events = resumed.run_until_converged()
+    assert events is not None
+    rounds_orig, events_orig = vc.run_until_converged()
+    assert events_orig is not None
+    assert rounds_resumed == rounds_orig
+    np.testing.assert_array_equal(resumed.alive_mask, vc.alive_mask)
+
+
+def test_cluster_metrics_surface():
+    import asyncio
+    import random
+
+    from rapid_tpu.messaging.inprocess import InProcessNetwork
+    from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
+    from rapid_tpu.protocol.cluster import Cluster
+    from rapid_tpu.settings import Settings
+    from rapid_tpu.types import Endpoint
+
+    async def scenario():
+        settings = Settings()
+        settings.batching_window_ms = 20
+        settings.failure_detector_interval_ms = 50
+        network = InProcessNetwork()
+        fd = StaticFailureDetectorFactory()
+        seed = await Cluster.start(Endpoint("127.0.0.1", 31000), settings=settings,
+                                   network=network, fd_factory=fd, rng=random.Random(0))
+        node = await Cluster.join(Endpoint("127.0.0.1", 31000), Endpoint("127.0.0.1", 31001),
+                                  settings=settings, network=network, fd_factory=fd,
+                                  rng=random.Random(1))
+        for _ in range(200):
+            if seed.membership_size == 2 and node.membership_size == 2:
+                break
+            await asyncio.sleep(0.02)
+        metrics = seed.metrics
+        await seed.shutdown()
+        await node.shutdown()
+        return metrics
+
+    metrics = asyncio.run(asyncio.wait_for(scenario(), timeout=30))
+    assert metrics["view_changes"] >= 1
+    assert metrics["proposals_announced"] >= 1
+    assert metrics["alerts_enqueued"] >= 1
+    assert "view_change_convergence_ms" in metrics
+    assert metrics["view_change_convergence_ms"]["last"] > 0

@@ -275,6 +275,35 @@ async def test_view_change_subscription_sees_joiner_delta():
 
 
 @async_test
+async def test_down_notification_carries_metadata():
+    # SubscriptionsTest.java:170-243: DOWN deltas must carry the failed
+    # node's metadata so applications can act on its role.
+    network = InProcessNetwork()
+    settings = fast_settings()
+    fd = StaticFailureDetectorFactory()
+    seed = await Cluster.start(ep(0), settings=settings, network=network, fd_factory=fd)
+    worker = await Cluster.join(
+        ep(0), ep(1), settings=settings, network=network, fd_factory=fd,
+        metadata=(("role", b"worker"),),
+    )
+    filler = await Cluster.join(ep(0), ep(2), settings=settings, network=network, fd_factory=fd)
+    clusters = [seed, worker, filler]
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 3))
+        changes = []
+        seed.register_subscription(ClusterEvents.VIEW_CHANGE, lambda c: changes.append(c))
+        network.blackholed.add(worker.listen_address)
+        fd.add_failed_nodes([worker.listen_address])
+        assert await wait_until(lambda: seed.membership_size == 2)
+        down = [sc for c in changes for sc in c.status_changes if sc.status.name == "DOWN"]
+        assert len(down) == 1
+        assert down[0].endpoint == ep(1)
+        assert down[0].metadata == (("role", b"worker"),)
+    finally:
+        await shutdown_all(clusters)
+
+
+@async_test
 async def test_join_succeeds_despite_dropped_join_messages():
     # Asymmetric-failure simulation via server-side drop interceptors
     # (ClusterTest.injectAsymmetricDrops / MessageDropInterceptor.java).

@@ -1,0 +1,62 @@
+"""Watermark bitmask kernel: jnp path equivalence vs the dense-matrix
+semantics (the pallas path itself runs on TPU; CI runs the jnp fallback,
+which shares the popcount/classify core with the kernel body)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from rapid_tpu.ops.pallas_kernels import (
+    bits_to_reports_matrix,
+    reports_matrix_to_bits,
+    watermark_merge_classify,
+)
+
+H, L, K = 8, 3, 10
+
+
+def dense_reference(old_bits, new_bits, subject_mask):
+    old = bits_to_reports_matrix(jnp.asarray(old_bits), K)
+    new = bits_to_reports_matrix(jnp.asarray(new_bits), K)
+    merged = (np.asarray(old) | np.asarray(new)) & np.asarray(subject_mask)[:, None]
+    tally = merged.sum(axis=1)
+    cls = np.where(tally >= H, 2, np.where((tally >= L) & (tally < H), 1, 0))
+    return merged, cls
+
+
+def test_roundtrip_bits_matrix():
+    rng = np.random.default_rng(0)
+    reports = rng.random((4, 256, K)) < 0.3
+    bits = reports_matrix_to_bits(jnp.asarray(reports))
+    back = bits_to_reports_matrix(bits, K)
+    np.testing.assert_array_equal(np.asarray(back), reports)
+
+
+def test_watermark_classify_matches_dense():
+    rng = np.random.default_rng(1)
+    n = 2048
+    old = rng.integers(0, 1 << K, size=n, dtype=np.uint32)
+    new = rng.integers(0, 1 << K, size=n, dtype=np.uint32)
+    mask = rng.random(n) < 0.9
+    merged_bits, cls = watermark_merge_classify(
+        jnp.asarray(old), jnp.asarray(new), jnp.asarray(mask), H, L
+    )
+    dense_merged, dense_cls = dense_reference(old, new, mask)
+    np.testing.assert_array_equal(
+        np.asarray(bits_to_reports_matrix(merged_bits, K)), dense_merged
+    )
+    np.testing.assert_array_equal(np.asarray(cls), dense_cls)
+
+
+def test_watermark_boundaries():
+    # Exactly L-1, L, H-1, H reports.
+    cases = {0: 0, L - 1: 0, L: 1, H - 1: 1, H: 2, K: 2}
+    n = 1024
+    bits = np.zeros(n, dtype=np.uint32)
+    expected = np.zeros(n, dtype=np.int32)
+    for i, (count, cls) in enumerate(cases.items()):
+        bits[i] = (1 << count) - 1
+        expected[i] = cls
+    _, cls = watermark_merge_classify(
+        jnp.asarray(bits), jnp.zeros(n, dtype=jnp.uint32), jnp.ones(n, dtype=bool), H, L
+    )
+    np.testing.assert_array_equal(np.asarray(cls)[: len(cases)], expected[: len(cases)])

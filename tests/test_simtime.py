@@ -1,0 +1,108 @@
+"""Deterministic simulated-time cluster runs: every timing consumer (batcher,
+failure detectors, consensus fallback) goes through the Clock abstraction, so
+a ManualClock drives whole failure-detection -> consensus sequences in
+virtual milliseconds with zero wall-clock sleeps."""
+
+import asyncio
+import functools
+import random
+
+from rapid_tpu.messaging.inprocess import InProcessNetwork
+from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
+from rapid_tpu.protocol.cluster import Cluster
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import Endpoint
+from rapid_tpu.utils.clock import ManualClock
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        async def with_timeout():
+            await asyncio.wait_for(fn(*args, **kwargs), timeout=60)
+
+        asyncio.run(with_timeout())
+
+    return wrapper
+
+
+async def drain(loop_yields=50):
+    for _ in range(loop_yields):
+        await asyncio.sleep(0)
+
+
+async def advance(clock: ManualClock, total_ms: float, step_ms: float = 50):
+    """Advance simulated time, yielding to the loop between steps so woken
+    coroutines actually run."""
+    advanced = 0.0
+    while advanced < total_ms:
+        clock.advance_ms(step_ms)
+        advanced += step_ms
+        await drain()
+
+
+@async_test
+async def test_crash_detection_in_simulated_time():
+    settings = Settings()  # reference-default timings: 1 s FD, 100 ms batching
+    network = InProcessNetwork()
+    clock = ManualClock()
+    fd = StaticFailureDetectorFactory()
+    clusters = [
+        await Cluster.start(Endpoint("127.0.0.1", 32000), settings=settings, network=network,
+                            fd_factory=fd, clock=clock, rng=random.Random(0))
+    ]
+    # Joins block on consensus, which blocks on virtual batching windows:
+    # run them as tasks while time advances.
+    for i in range(1, 6):
+        join_task = asyncio.ensure_future(
+            Cluster.join(Endpoint("127.0.0.1", 32000), Endpoint("127.0.0.1", 32000 + i),
+                         settings=settings, network=network, fd_factory=fd,
+                         clock=clock, rng=random.Random(i))
+        )
+        while not join_task.done():
+            await advance(clock, 200)
+        clusters.append(join_task.result())
+    assert all(c.membership_size == 6 for c in clusters)
+
+    victim = clusters[3]
+    network.blackholed.add(victim.listen_address)
+    fd.add_failed_nodes([victim.listen_address])
+    survivors = [c for c in clusters if c is not victim]
+
+    # One FD interval surfaces the failure; one batching window broadcasts it;
+    # consensus follows instantly in-process. Give 3 simulated seconds.
+    sim_before = clock.now_ms()
+    await advance(clock, 3_000)
+    assert all(c.membership_size == 5 for c in survivors)
+    assert len({tuple(c.membership) for c in survivors}) == 1
+    # No wall-clock dependence: simulated now is exactly what we advanced.
+    assert clock.now_ms() == sim_before + 3_000
+
+    for c in clusters:
+        await c.shutdown()
+
+
+@async_test
+async def test_fallback_timer_is_virtual():
+    # The consensus fallback delay (>= 1 s simulated) must not consume wall
+    # time: schedule and cancel entirely in virtual milliseconds.
+    from rapid_tpu.protocol.fast_paxos import FastPaxos
+
+    clock = ManualClock()
+    fired = []
+    fp = FastPaxos(
+        my_addr=Endpoint("127.0.0.1", 1),
+        configuration_id=1,
+        membership_size=5,
+        broadcast_fn=lambda r: None,
+        send_fn=lambda d, r: None,
+        on_decide=lambda hosts: None,
+        clock=clock,
+        rng=random.Random(0),
+    )
+    fp.start_classic_paxos_round = lambda: fired.append(True)  # type: ignore[method-assign]
+    fp.propose((Endpoint("127.0.0.1", 9),), recovery_delay_ms=4_000)
+    clock.advance_ms(3_999)
+    assert not fired
+    clock.advance_ms(2)
+    assert fired == [True]
