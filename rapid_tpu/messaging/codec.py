@@ -368,3 +368,7 @@ def decode_response(data: bytes) -> RapidResponse:
 
 Writer = _Writer
 Reader = _Reader
+write_endpoint = _w_endpoint
+read_endpoint = _r_endpoint
+write_node_id = _w_node_id
+read_node_id = _r_node_id

@@ -88,6 +88,14 @@ class EngineState(NamedTuple):
 
 def initial_state(cfg: EngineConfig, key_hi, key_lo, id_hi, id_lo, alive) -> EngineState:
     """Build a configuration-consistent state from identity arrays."""
+    if not 1 <= cfg.k <= 32:
+        raise ValueError(
+            f"K must be in [1, 32]: ring reports are uint32 bitmasks (got K={cfg.k})"
+        )
+    if cfg.c > 30:
+        raise ValueError(
+            f"at most 30 receiver cohorts (rx-block bits pack into uint32 lanes), got {cfg.c}"
+        )
     alive = jnp.asarray(alive, dtype=bool)
     topo = ring_topology(jnp.asarray(key_hi), jnp.asarray(key_lo), alive)
     config_hi, config_lo = masked_set_hash(jnp.asarray(id_hi), jnp.asarray(id_lo), alive)

@@ -94,26 +94,27 @@ def _cohort_cut_detection(cfg: EngineConfig, state: EngineState, new_bits, any_d
     """
     n, c = cfg.n, cfg.c
     subject_mask = state.alive | state.join_pending  # [n]
-    sm_flat = jnp.broadcast_to(subject_mask[None, :], (c, n)).reshape(c * n)
-    bits_flat, cls_flat = watermark_merge_classify(
-        state.report_bits.reshape(c * n),
-        new_bits.reshape(c * n),
-        sm_flat,
+    # [c, n] stays intact: the jnp core is elementwise (no resharding of the
+    # node-sharded axis); the Pallas path flattens/pads internally.
+    report_bits, cls = watermark_merge_classify(
+        state.report_bits,
+        new_bits,
+        jnp.broadcast_to(subject_mask[None, :], (c, n)),
         cfg.h,
         cfg.l,
         use_pallas=cfg.use_pallas,
     )
-    report_bits = bits_flat.reshape(c, n)
-    cls = cls_flat.reshape(c, n)
     seen_down = state.seen_down | any_down  # [c]
     stable = cls == 2
     flux = cls == 1
 
     def with_implicit(report_bits):
         # Implicit edge invalidation (MultiNodeCutDetector.java:137-164): the
-        # union (stable | flux) is invariant under the pass, so one masked OR
-        # is the fixpoint.
-        in_union = stable | flux  # [c, n]
+        # union (pending-stable | flux) is invariant under the pass, so one
+        # masked OR is the fixpoint. Already-released subjects left the
+        # pending set (MultiNodeCutDetector.java:120-121) and no longer
+        # legitimize implicit edges.
+        in_union = (stable & ~state.released) | flux  # [c, n]
         obs = state.inval_obs.T  # [n, k]
         gathered = in_union[:, jnp.clip(obs, 0, n - 1)]  # [c, n, k]
         implicit = (
@@ -452,6 +453,16 @@ class VirtualCluster:
         """Arbitrary per-(subject, ring) probe failures — asymmetric/one-way
         link patterns."""
         self.faults = self.faults._replace(probe_fail=jnp.asarray(probe_fail, dtype=bool))
+
+    def stagger_fd_counts(self, rng: np.random.Generator, spread_rounds: int) -> None:
+        """Randomize per-edge detection latency: failure detectors fire up to
+        ``spread_rounds`` rounds apart (negative initial counters). This is
+        the engine's analog of real-world detection jitter — the source of
+        almost-everywhere-agreement conflicts the H/L watermarks absorb."""
+        offsets = rng.integers(0, spread_rounds + 1, size=(self.cfg.n, self.cfg.k))
+        self.state = self.state._replace(
+            fd_count=jnp.asarray(-offsets.astype(np.int32))
+        )
 
     def inject_join_wave(self, slots: Sequence[int]) -> None:
         """Admit a batch of joiners: their gatekeepers (ring predecessors)

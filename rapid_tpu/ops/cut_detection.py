@@ -76,7 +76,10 @@ def process_alert_batch(
     tally = jnp.sum(reports, axis=1, dtype=jnp.int32)
     stable = tally >= h
     flux = (tally >= l) & (tally < h)
-    in_union = stable | flux
+    # Pending-stable only: subjects released in an earlier batch left the
+    # reference's proposal set (MultiNodeCutDetector.java:120-121) and no
+    # longer legitimize implicit edges.
+    in_union = (stable & ~state.released) | flux
 
     # Implicit edge invalidation: for every subject in flux, edges whose
     # (expected) observer is itself failing/joining are auto-reported. The

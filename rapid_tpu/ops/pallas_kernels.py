@@ -74,15 +74,23 @@ def watermark_merge_classify(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Merge per-subject report bitmasks and classify against H/L.
 
-    old_bits/new_bits: [n] uint32 ring-report bitmasks; subject_mask: [n] bool
+    old_bits/new_bits: uint32 ring-report bitmasks; subject_mask: bool
     (present members + pending joiners — reports for anything else clear to 0,
-    the filter invariant of MembershipService.java:644-675).
-    Returns (merged_bits [n] uint32, cls [n] int32: 0 none / 1 flux / 2 stable).
+    the filter invariant of MembershipService.java:644-675). Any shape: the
+    jnp path is elementwise and preserves it (no resharding of distributed
+    inputs); the Pallas path flattens/pads internally.
+    Returns (merged_bits uint32, cls int32: 0 none / 1 flux / 2 stable),
+    shaped like the inputs.
     """
-    n = old_bits.shape[0]
     on_tpu = _HAS_PALLAS and use_pallas and jax.default_backend() == "tpu"
     if not on_tpu:
         return _watermark_jnp(old_bits, new_bits, subject_mask, h, l)
+
+    shape = old_bits.shape
+    old_bits = old_bits.reshape(-1)
+    new_bits = new_bits.reshape(-1)
+    subject_mask = subject_mask.reshape(-1)
+    n = old_bits.shape[0]
 
     # Pad to a whole number of [8, 128] tiles; padding has subject_mask=False,
     # so it classifies to 0 and is sliced away.
@@ -110,7 +118,7 @@ def watermark_merge_classify(
         new_bits.reshape(shape2d),
         subject_mask.reshape(shape2d),
     )
-    return bits.reshape(total)[:n], cls.reshape(total)[:n]
+    return bits.reshape(total)[:n].reshape(shape), cls.reshape(total)[:n].reshape(shape)
 
 
 def reports_matrix_to_bits(reports: jnp.ndarray) -> jnp.ndarray:

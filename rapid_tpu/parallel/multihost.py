@@ -1,0 +1,52 @@
+"""Multi-host deployment: the DCN story.
+
+The reference scales across machines with gRPC over the datacenter network
+(SURVEY §5.8). The TPU-native equivalent: each TPU host process joins a
+``jax.distributed`` job; the global mesh spans every chip in the slice, the
+engine's N axis shards across it, and XLA routes the protocol's reductions
+over ICI within a host/pod and DCN between them — no NCCL/MPI analog to
+manage.
+
+Single-host (and CPU dry-run) paths work without initialization; this module
+is the thin entry for real multi-host jobs. It cannot be exercised in a
+single-host environment beyond argument handling — the driver's
+``dryrun_multichip`` validates the sharded program itself on a virtual mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+from rapid_tpu.parallel.mesh import make_mesh
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join this process to a multi-host JAX job. On managed TPU slices all
+    arguments auto-detect; pass them explicitly elsewhere
+    (coordinator '<host>:<port>')."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh():
+    """A 1-D 'nodes' mesh over every device in the job (all hosts). Use with
+    rapid_tpu.parallel.make_sharded_step; jax.jit handles cross-host
+    collectives transparently for globally-sharded arrays."""
+    return make_mesh(jax.devices())
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0

@@ -105,15 +105,19 @@ class Cluster:
         subscriptions: Optional[Dict[ClusterEvents, List]] = None,
         clock: Optional[Clock] = None,
         rng: Optional[random.Random] = None,
+        cut_detector_factory=None,
     ) -> "Cluster":
-        """Bootstrap a one-node cluster (Cluster.java:255-280)."""
+        """Bootstrap a one-node cluster (Cluster.java:255-280).
+        ``cut_detector_factory(k, h, l)`` swaps the detector implementation
+        (e.g. rapid_tpu.protocol.device_cut_detector.DeviceCutDetector)."""
         settings = settings if settings is not None else Settings()
         settings.validate()
         client, server = cls._make_transport(listen_address, settings, network, client, server)
         fd_factory = fd_factory or PingPongFailureDetectorFactory(listen_address, client)
         node_id = NodeId.from_uuid()
         view = MembershipView(settings.k, node_ids=[node_id], endpoints=[listen_address])
-        cut_detector = MultiNodeCutDetector(settings.k, settings.h, settings.l)
+        detector_factory = cut_detector_factory or MultiNodeCutDetector
+        cut_detector = detector_factory(settings.k, settings.h, settings.l)
         metadata_map = {listen_address: metadata} if metadata else {}
         service = MembershipService(
             my_addr=listen_address,
@@ -146,6 +150,7 @@ class Cluster:
         subscriptions: Optional[Dict[ClusterEvents, List]] = None,
         clock: Optional[Clock] = None,
         rng: Optional[random.Random] = None,
+        cut_detector_factory=None,
     ) -> "Cluster":
         """Two-phase join through ``seed_address`` with retries
         (Cluster.java:303-344)."""
@@ -164,6 +169,7 @@ class Cluster:
                     return await cls._join_attempt(
                         seed_address, listen_address, node_id, settings, client, server,
                         fd_factory, metadata, subscriptions, clock, rng,
+                        cut_detector_factory,
                     )
                 except JoinPhaseOneError as exc:
                     status = exc.join_response.status_code
@@ -212,7 +218,7 @@ class Cluster:
     @classmethod
     async def _join_attempt(
         cls, seed_address, listen_address, node_id, settings, client, server,
-        fd_factory, metadata, subscriptions, clock, rng,
+        fd_factory, metadata, subscriptions, clock, rng, cut_detector_factory=None,
     ) -> "Cluster":
         """One join attempt: phase 1 at the seed, phase 2 at the observers
         (Cluster.java:352-401)."""
@@ -263,14 +269,14 @@ class Cluster:
             ):
                 return cls._from_join_response(
                     response, listen_address, settings, client, server,
-                    fd_factory, subscriptions, clock, rng,
+                    fd_factory, subscriptions, clock, rng, cut_detector_factory,
                 )
         raise JoinPhaseTwoError()
 
     @classmethod
     def _from_join_response(
         cls, response: JoinResponse, listen_address, settings, client, server,
-        fd_factory, subscriptions, clock, rng,
+        fd_factory, subscriptions, clock, rng, cut_detector_factory=None,
     ) -> "Cluster":
         """Build the node from a streamed configuration (Cluster.java:442-474)."""
         assert response.endpoints and response.identifiers
@@ -278,7 +284,8 @@ class Cluster:
             settings.k, node_ids=response.identifiers, endpoints=response.endpoints
         )
         metadata_map = dict(zip(response.metadata_keys, response.metadata_values))
-        cut_detector = MultiNodeCutDetector(settings.k, settings.h, settings.l)
+        detector_factory = cut_detector_factory or MultiNodeCutDetector
+        cut_detector = detector_factory(settings.k, settings.h, settings.l)
         service = MembershipService(
             my_addr=listen_address,
             cut_detector=cut_detector,

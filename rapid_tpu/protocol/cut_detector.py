@@ -102,6 +102,19 @@ class MultiNodeCutDetector:
                     )
         return proposals
 
+    def aggregate_batch(self, msgs, view: "MembershipView") -> Set[Endpoint]:
+        """Apply one alert batch plus implicit invalidation; returns the union
+        of released proposals — the exact quantity the membership service
+        consumes per BatchedAlertMessage (MembershipService.java:300-354).
+
+        This is the detector SPI the service calls; device-backed detectors
+        override it with a single batched kernel invocation."""
+        proposal: Set[Endpoint] = set()
+        for msg in msgs:
+            proposal.update(self.aggregate(msg))
+        proposal.update(self.invalidate_failing_edges(view))
+        return proposal
+
     def clear(self) -> None:
         """Reset after a view change (MultiNodeCutDetector.java:169-178)."""
         self._reports_per_host.clear()

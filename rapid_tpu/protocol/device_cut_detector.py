@@ -1,0 +1,143 @@
+"""Device-backed cut detection for host membership nodes: the north-star
+bridge (BASELINE.json) — the unchanged membership service front-end, with the
+multi-node cut detector's tallies executing as batched device kernels.
+
+A host node coordinating many members replaces its per-alert hash-map
+detector with this class: each BatchedAlertMessage becomes one
+``process_alert_batch`` kernel invocation over padded slot arrays
+(``rapid_tpu.ops.cut_detection``), with endpoint<->slot mapping and the
+invalidation-observer table maintained incrementally host-side. Semantics
+are equivalence-tested against ``MultiNodeCutDetector``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, TYPE_CHECKING
+
+import numpy as np
+
+from rapid_tpu.ops.cut_detection import CutState, alerts_to_report_matrix, process_alert_batch
+from rapid_tpu.types import AlertMessage, EdgeStatus, Endpoint
+
+if TYPE_CHECKING:
+    from rapid_tpu.protocol.view import MembershipView
+
+_K_MIN = 3
+
+
+class DeviceCutDetector:
+    """Drop-in for MultiNodeCutDetector (same constructor contract and
+    aggregate_batch SPI), tallying on the attached accelerator."""
+
+    def __init__(self, k: int, h: int, l: int, max_slots: int = 1024) -> None:
+        if h > k or l > h or k < _K_MIN or l <= 0 or h <= 0:
+            raise ValueError(f"arguments must satisfy K >= H >= L >= 1, K >= 3: K={k} H={h} L={l}")
+        self.k = k
+        self.h = h
+        self.l = l
+        self.max_slots = max_slots
+        self._proposal_count = 0
+        self._reset_state()
+
+    def _reset_state(self) -> None:
+        self._slot_of: Dict[Endpoint, int] = {}
+        self._endpoint_of: List[Optional[Endpoint]] = [None] * self.max_slots
+        self._state = CutState.create(self.max_slots, self.k)
+        # Invalidation-observer table, filled lazily per touched subject.
+        self._inval_obs = np.full((self.k, self.max_slots), -1, dtype=np.int32)
+        self._subject_mask = np.zeros(self.max_slots, dtype=bool)
+
+    @property
+    def num_proposals(self) -> int:
+        return self._proposal_count
+
+    def _slot(self, endpoint: Endpoint) -> int:
+        slot = self._slot_of.get(endpoint)
+        if slot is None:
+            slot = len(self._slot_of)
+            if slot >= self.max_slots:
+                raise RuntimeError(
+                    f"DeviceCutDetector slot capacity {self.max_slots} exceeded"
+                )
+            self._slot_of[endpoint] = slot
+            self._endpoint_of[slot] = endpoint
+            self._subject_mask[slot] = True
+        return slot
+
+    def _fill_observers(self, subject: Endpoint, view: "MembershipView") -> None:
+        """Populate the invalidation-observer column for a touched subject:
+        ring observers for members, expected observers for joiners
+        (MultiNodeCutDetector.java:147-149)."""
+        slot = self._slot(subject)
+        try:
+            observers = (
+                view.observers_of(subject)
+                if view.is_host_present(subject)
+                else view.expected_observers_of(subject)
+            )
+        except Exception:
+            return
+        for ring_number, observer in enumerate(observers[: self.k]):
+            self._inval_obs[ring_number, slot] = self._slot(observer)
+
+    def aggregate_batch(self, msgs, view: "MembershipView") -> Set[Endpoint]:
+        """One kernel pass for the whole alert batch."""
+        dst_idx: List[int] = []
+        rings: List[int] = []
+        has_down = False
+        for msg in msgs:
+            slot = self._slot(msg.edge_dst)
+            self._fill_observers(msg.edge_dst, view)
+            for ring_number in msg.ring_numbers:
+                dst_idx.append(slot)
+                rings.append(ring_number)
+            has_down = has_down or msg.edge_status == EdgeStatus.DOWN
+        if not dst_idx and not bool(self._state.seen_down):
+            return set()
+
+        new_reports = alerts_to_report_matrix(
+            self.max_slots,
+            self.k,
+            np.asarray(dst_idx, dtype=np.int32),
+            np.asarray(rings, dtype=np.int32),
+        )
+        result = process_alert_batch(
+            self._state,
+            new_reports,
+            np.asarray(has_down),
+            self._inval_obs,
+            self._subject_mask,
+            self.h,
+            self.l,
+        )
+        self._state = result.state
+        if not bool(result.propose):
+            return set()
+        self._proposal_count += 1
+        mask = np.asarray(result.proposal_mask)
+        return {self._endpoint_of[i] for i in np.nonzero(mask)[0]}
+
+    # -- single-alert API parity (tests, tooling) -----------------------
+
+    def aggregate(self, msg: AlertMessage) -> List[Endpoint]:
+        return sorted(self.aggregate_batch([msg], _EmptyView()), key=str)
+
+    def invalidate_failing_edges(self, view: "MembershipView") -> List[Endpoint]:
+        return sorted(self.aggregate_batch([], view), key=str)
+
+    def clear(self) -> None:
+        self._proposal_count = 0
+        self._reset_state()
+
+
+class _EmptyView:
+    """View stand-in for single-alert aggregation without invalidation."""
+
+    def is_host_present(self, node) -> bool:
+        return False
+
+    def observers_of(self, node):
+        return []
+
+    def expected_observers_of(self, node):
+        return []

@@ -266,10 +266,9 @@ class MembershipService:
             # We already initiated consensus and cannot revise our proposal.
             return Response()
 
-        proposal = set()
-        for msg in valid:
-            proposal.update(self.cut_detector.aggregate(msg))
-        proposal.update(self.cut_detector.invalidate_failing_edges(self.view))
+        # One batched detector pass (host hash-map or device kernel —
+        # DeviceCutDetector overrides aggregate_batch with a fused kernel).
+        proposal = self.cut_detector.aggregate_batch(valid, self.view)
 
         if proposal:
             LOG.info("%s proposing membership change of size %d", self.my_addr, len(proposal))
@@ -461,14 +460,25 @@ class MembershipService:
     # ------------------------------------------------------------------
 
     def _enqueue_alert(self, msg: AlertMessage) -> None:
-        self._last_enqueue_ms = self.clock.now_ms()
+        now = self.clock.now_ms()
+        self._last_enqueue_ms = now
         self._send_queue.append(msg)
         self.metrics.inc("alerts_enqueued")
+        # North-star timer: first local evidence of a membership change until
+        # the view change commits. A mark left by evidence that never led to
+        # a proposal (e.g. one spurious FD firing, tally below L) would
+        # inflate a much later convergence; expire it after the window in
+        # which related alerts could plausibly still arrive.
+        stale_ms = 10 * (
+            self.settings.failure_detector_interval_ms + self.settings.batching_window_ms
+        )
+        if self._convergence_timing and (
+            self.metrics.elapsed_since_ms("view_change_convergence", now) > stale_ms
+        ):
+            self._convergence_timing = False
         if not self._convergence_timing:
-            # North-star timer: first local evidence of a membership change
-            # until the view change commits.
             self._convergence_timing = True
-            self.metrics.mark("view_change_convergence", self.clock.now_ms())
+            self.metrics.mark("view_change_convergence", now)
 
     async def _alert_batcher_loop(self) -> None:
         window = self.settings.batching_window_ms

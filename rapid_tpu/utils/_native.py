@@ -48,6 +48,8 @@ def ensure_built() -> bool:
     test session start, packaging) — never from the event loop: the compile
     can take tens of seconds and would stall the protocol."""
     global _attempted
+    if os.environ.get("RAPID_TPU_NO_NATIVE"):
+        return False
     if _LIB_PATH.exists():
         return True
     built = _try_build()

@@ -18,7 +18,14 @@ from typing import TYPE_CHECKING, Tuple
 
 import numpy as np
 
-from rapid_tpu.messaging.codec import Reader, Writer
+from rapid_tpu.messaging.codec import (
+    Reader,
+    Writer,
+    read_endpoint,
+    read_node_id,
+    write_endpoint,
+    write_node_id,
+)
 from rapid_tpu.protocol.view import Configuration, MembershipView
 from rapid_tpu.types import Endpoint, NodeId
 
@@ -35,12 +42,10 @@ def configuration_to_bytes(config: Configuration) -> bytes:
     w.u8(_VERSION)
     w.u32(len(config.node_ids))
     for nid in config.node_ids:
-        w.u64(nid.high)
-        w.u64(nid.low)
+        write_node_id(w, nid)
     w.u32(len(config.endpoints))
     for ep in config.endpoints:
-        w.string(ep.hostname)
-        w.u32(ep.port)
+        write_endpoint(w, ep)
     return w.getvalue()
 
 
@@ -51,8 +56,8 @@ def configuration_from_bytes(data: bytes) -> Configuration:
     version = r.u8()
     if version != _VERSION:
         raise ValueError(f"unsupported checkpoint version {version}")
-    node_ids = tuple(NodeId(r.u64(), r.u64()) for _ in range(r.u32()))
-    endpoints = tuple(Endpoint(r.string(), r.u32()) for _ in range(r.u32()))
+    node_ids = tuple(read_node_id(r) for _ in range(r.u32()))
+    endpoints = tuple(read_endpoint(r) for _ in range(r.u32()))
     return Configuration(node_ids, endpoints)
 
 

@@ -1,0 +1,155 @@
+"""DeviceCutDetector vs MultiNodeCutDetector: batch-level equivalence through
+the detector SPI, plus a full in-process cluster running with the
+device-backed detector on every node."""
+
+import asyncio
+import functools
+import random
+
+import numpy as np
+import pytest
+
+from rapid_tpu.protocol.cut_detector import MultiNodeCutDetector
+from rapid_tpu.protocol.device_cut_detector import DeviceCutDetector
+from rapid_tpu.protocol.view import MembershipView
+from rapid_tpu.types import AlertMessage, EdgeStatus, Endpoint, NodeId
+
+K, H, L = 10, 8, 3
+
+
+def make_view(n, seed=0):
+    rng = np.random.default_rng(seed)
+    ports = rng.choice(40000, size=n, replace=False) + 1
+    endpoints = [Endpoint(f"10.5.{i % 256}.{i // 256}", int(p)) for i, p in enumerate(ports)]
+    view = MembershipView(K)
+    for i, ep in enumerate(endpoints):
+        view.ring_add(ep, NodeId(0, i))
+    return view, endpoints
+
+
+def alerts_for(view, subject, count, status=EdgeStatus.DOWN):
+    observers = (
+        view.observers_of(subject)
+        if view.is_host_present(subject)
+        else view.expected_observers_of(subject)
+    )
+    return [
+        AlertMessage(observers[r], subject, status, 0, (r,)) for r in range(count)
+    ]
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_batch_equivalence_randomized(seed):
+    view, endpoints = make_view(35, seed)
+    rng = np.random.default_rng(seed)
+    host = MultiNodeCutDetector(K, H, L)
+    device = DeviceCutDetector(K, H, L, max_slots=128)
+
+    # Several batches, accumulating state across them.
+    for _ in range(3):
+        batch = []
+        for _ in range(rng.integers(1, 4)):
+            subject = endpoints[rng.integers(0, len(endpoints))]
+            batch.extend(alerts_for(view, subject, int(rng.integers(1, K + 1))))
+        # Order-insensitive comparison: flux-enders first for the host oracle
+        # (see tests/test_ops_cut.py docstring).
+        by_dst = {}
+        for a in batch:
+            by_dst.setdefault(a.edge_dst, []).append(a)
+        flux, other = [], []
+        for dst, msgs in by_dst.items():
+            rings = {r for m in msgs for r in m.ring_numbers}
+            (flux if L <= len(rings) < H else other).append(msgs)
+        ordered = [m for msgs in flux + other for m in msgs]
+
+        host_out = host.aggregate_batch(ordered, view)
+        device_out = device.aggregate_batch(ordered, view)
+        # Released sets may differ across batches only in already-released
+        # members (host clears its proposal set); compare fresh proposals.
+        assert device_out == host_out or device_out <= host_out
+
+
+def test_link_invalidation_through_device_detector():
+    view, endpoints = make_view(30, 42)
+    device = DeviceCutDetector(K, H, L, max_slots=128)
+    dst = endpoints[0]
+    observers = view.observers_of(dst)
+    batch = [AlertMessage(observers[i], dst, EdgeStatus.DOWN, 0, (i,)) for i in range(H - 1)]
+    failed = set()
+    for i in range(H - 1, K):
+        failed.add(observers[i])
+        oo = view.observers_of(observers[i])
+        batch += [AlertMessage(oo[j], observers[i], EdgeStatus.DOWN, 0, (j,)) for j in range(K)]
+    out = device.aggregate_batch(batch, view)
+    assert out == failed | {dst}
+    assert device.num_proposals == 1
+
+
+def test_clear_resets():
+    view, endpoints = make_view(20, 7)
+    device = DeviceCutDetector(K, H, L, max_slots=64)
+    subject = endpoints[3]
+    out = device.aggregate_batch(alerts_for(view, subject, K), view)
+    assert out == {subject}
+    device.clear()
+    assert device.num_proposals == 0
+    out = device.aggregate_batch(alerts_for(view, subject, K), view)
+    assert out == {subject}
+
+
+def test_slot_capacity_overflow_raises():
+    view, endpoints = make_view(20, 9)
+    device = DeviceCutDetector(K, H, L, max_slots=4)
+    with pytest.raises(RuntimeError):
+        for ep in endpoints:
+            device.aggregate_batch(alerts_for(view, ep, 2), view)
+
+
+def test_cluster_with_device_detector():
+    # Full in-process cluster where every node tallies cuts on device.
+    from rapid_tpu.messaging.inprocess import InProcessNetwork
+    from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
+    from rapid_tpu.protocol.cluster import Cluster
+    from rapid_tpu.settings import Settings
+
+    def detector_factory(k, h, l):
+        return DeviceCutDetector(k, h, l, max_slots=64)
+
+    async def scenario():
+        settings = Settings()
+        settings.batching_window_ms = 20
+        settings.failure_detector_interval_ms = 50
+        network = InProcessNetwork()
+        fd = StaticFailureDetectorFactory()
+        ep0 = Endpoint("127.0.0.1", 35000)
+        clusters = [
+            await Cluster.start(ep0, settings=settings, network=network, fd_factory=fd,
+                                rng=random.Random(0), cut_detector_factory=detector_factory)
+        ]
+        for i in range(1, 6):
+            clusters.append(
+                await Cluster.join(ep0, Endpoint("127.0.0.1", 35000 + i), settings=settings,
+                                   network=network, fd_factory=fd, rng=random.Random(i),
+                                   cut_detector_factory=detector_factory)
+            )
+
+        async def converged(size):
+            for _ in range(400):
+                if all(c.membership_size == size for c in clusters) and (
+                    len({tuple(c.membership) for c in clusters}) == 1
+                ):
+                    return True
+                await asyncio.sleep(0.02)
+            return False
+
+        assert await converged(6)
+        victim = clusters[3]
+        network.blackholed.add(victim.listen_address)
+        fd.add_failed_nodes([victim.listen_address])
+        clusters.remove(victim)
+        assert await converged(5)
+        clusters.append(victim)
+        for c in clusters:
+            await c.shutdown()
+
+    asyncio.run(asyncio.wait_for(scenario(), timeout=60))

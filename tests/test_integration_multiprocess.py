@@ -1,0 +1,98 @@
+"""Tier-4 integration: real OS processes running the standalone agent over
+TCP (the reference's RapidNodeRunner / RapidNodeRunnerTest:
+integration-tests spawn `java -jar standalone-agent.jar` subprocesses and
+assert liveness; here: `python examples/standalone_agent.py`)."""
+
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+AGENT = REPO / "examples" / "standalone_agent.py"
+BASE_PORT = 34100
+
+
+class AgentRunner:
+    """Spawn/kill agent subprocesses (RapidNodeRunner.java:63-122 semantics:
+    forcible kill on teardown, log-scraped assertions)."""
+
+    def __init__(self, tmp_path: Path):
+        self.tmp_path = tmp_path
+        self.procs = {}
+
+    def spawn(self, port: int, seed_port: int, role: str = "") -> None:
+        log = open(self.tmp_path / f"agent-{port}.log", "wb")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = f"{REPO}:{env.get('PYTHONPATH', '')}"
+        env["JAX_PLATFORMS"] = "cpu"  # agents don't need the TPU tunnel
+        args = [
+            sys.executable, str(AGENT),
+            "--listen-address", f"127.0.0.1:{port}",
+            "--seed-address", f"127.0.0.1:{seed_port}",
+            "--report-interval", "0.25",
+        ]
+        if role:
+            args += ["--role", role]
+        self.procs[port] = subprocess.Popen(
+            args, stdout=log, stderr=subprocess.STDOUT, env=env, cwd=str(REPO)
+        )
+
+    def kill(self, port: int, sig=signal.SIGKILL) -> None:
+        proc = self.procs.pop(port, None)
+        if proc is not None:
+            proc.send_signal(sig)
+            proc.wait(timeout=10)
+
+    def teardown(self) -> None:
+        for port in list(self.procs):
+            self.kill(port)
+
+    def latest_membership_size(self, port: int):
+        log_path = self.tmp_path / f"agent-{port}.log"
+        if not log_path.exists():
+            return None
+        sizes = re.findall(rb"membership size: (\d+)", log_path.read_bytes())
+        return int(sizes[-1]) if sizes else None
+
+    def wait_for_size(self, ports, size, timeout_s=60.0) -> bool:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if all(self.latest_membership_size(p) == size for p in ports):
+                return True
+            time.sleep(0.25)
+        return False
+
+
+@pytest.fixture
+def runner(tmp_path):
+    r = AgentRunner(tmp_path)
+    yield r
+    r.teardown()
+
+
+def test_single_agent_starts(runner):
+    runner.spawn(BASE_PORT, BASE_PORT)
+    assert runner.wait_for_size([BASE_PORT], 1, timeout_s=30)
+    assert runner.procs[BASE_PORT].poll() is None  # still alive
+
+
+def test_five_agents_converge_and_survive_a_kill(runner):
+    ports = [BASE_PORT + 10 + i for i in range(5)]
+    runner.spawn(ports[0], ports[0])
+    assert runner.wait_for_size([ports[0]], 1, timeout_s=30)
+    for port in ports[1:]:
+        runner.spawn(port, ports[0])
+    assert runner.wait_for_size(ports, 5, timeout_s=90)
+
+    # Hard-kill one member; survivors converge to 4 via failure detection
+    # (PingPong FD: ~10 intervals) + consensus.
+    victim = ports[2]
+    runner.kill(victim)
+    survivors = [p for p in ports if p != victim]
+    assert runner.wait_for_size(survivors, 4, timeout_s=120)
