@@ -14,6 +14,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -80,6 +81,29 @@ def main() -> None:
     int(probe(jnp.int32(2)))
     rtt_ms = (time.perf_counter() - t0) * 1000.0
 
+    # Optional XL sample: 1M virtual nodes, 1% crash (10K concurrent faults in
+    # one cut). Adds ~2-3 min of XLA compile; enable with RAPID_TPU_BENCH_XL=1.
+    xl_ms = None
+    if os.environ.get("RAPID_TPU_BENCH_XL"):
+        n_xl = 1_000_000
+        vcx = VirtualCluster.create(
+            n_xl, k=10, h=9, l=4, cohorts=1, fd_threshold=fd_threshold, seed=0,
+            use_pallas=(platform == "tpu"),
+        )
+        vcx.crash(np.random.default_rng(7).choice(n_xl, size=n_xl // 100, replace=False))
+        vcx.sync()
+        vcx.run_to_decision(max_steps=fd_threshold + 8)  # warm-up/compile
+        vcx = VirtualCluster.create(
+            n_xl, k=10, h=9, l=4, cohorts=1, fd_threshold=fd_threshold, seed=1,
+            use_pallas=(platform == "tpu"),
+        )
+        vcx.crash(np.random.default_rng(8).choice(n_xl, size=n_xl // 100, replace=False))
+        vcx.sync()
+        t0 = time.perf_counter()
+        _, decided_xl, _ = vcx.run_to_decision(max_steps=fd_threshold + 8)
+        xl_ms = (time.perf_counter() - t0) * 1000.0
+        assert decided_xl and vcx.membership_size == n_xl - n_xl // 100
+
     value = min(samples)
     print(
         json.dumps(
@@ -94,6 +118,7 @@ def main() -> None:
                 "n_members": n,
                 "faults": int(n * crash_frac),
                 "device_rtt_ms": round(rtt_ms, 3),
+                **({"n1M_crash1pct_ms": round(xl_ms, 3)} if xl_ms is not None else {}),
             }
         )
     )

@@ -49,11 +49,9 @@ def run_once(n, k, h, l, f, cohorts, seed) -> tuple:
     for round_idx in range(64):
         events = vc.step()
         if bool(events.decided):
-            total = int(events.total_votes)
-            max_votes = int(events.max_votes)
-            conflict = total > max_votes
-            return True, conflict, round_idx + 1
-    return False, True, 64  # no decision within budget counts as conflicted
+            conflict = int(events.total_votes) > int(events.max_votes)
+            return conflict, round_idx + 1
+    return True, 64  # no decision within budget counts as conflicted
 
 
 def main() -> None:
@@ -70,10 +68,10 @@ def main() -> None:
         for l in (1, 2, 3, 4):
             if l >= h:
                 continue
-            for f in (2, 8):
+            for f in (2, 4, 8, 16):
                 conflicts, rounds_sum = 0, 0
                 for rep in range(args.reps):
-                    decided, conflict, rounds = run_once(
+                    conflict, rounds = run_once(
                         args.n, k, h, l, f, args.cohorts, seed=hash((h, l, f, rep)) % 2**31
                     )
                     conflicts += int(conflict)

@@ -12,6 +12,7 @@ are equivalence-tested against ``MultiNodeCutDetector``.
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, List, Optional, Set, TYPE_CHECKING
 
 import numpy as np
@@ -22,6 +23,8 @@ from rapid_tpu.types import AlertMessage, EdgeStatus, Endpoint
 if TYPE_CHECKING:
     from rapid_tpu.protocol.view import MembershipView
 
+LOG = logging.getLogger(__name__)
+
 _K_MIN = 3
 
 
@@ -29,7 +32,7 @@ class DeviceCutDetector:
     """Drop-in for MultiNodeCutDetector (same constructor contract and
     aggregate_batch SPI), tallying on the attached accelerator."""
 
-    def __init__(self, k: int, h: int, l: int, max_slots: int = 1024) -> None:
+    def __init__(self, k: int, h: int, l: int, max_slots: int = 4096) -> None:
         if h > k or l > h or k < _K_MIN or l <= 0 or h <= 0:
             raise ValueError(f"arguments must satisfy K >= H >= L >= 1, K >= 3: K={k} H={h} L={l}")
         self.k = k
@@ -46,19 +49,30 @@ class DeviceCutDetector:
         # Invalidation-observer table, filled lazily per touched subject.
         self._inval_obs = np.full((self.k, self.max_slots), -1, dtype=np.int32)
         self._subject_mask = np.zeros(self.max_slots, dtype=bool)
+        self._observers_filled: set = set()
+        self._overflow_warned = False
 
     @property
     def num_proposals(self) -> int:
         return self._proposal_count
 
-    def _slot(self, endpoint: Endpoint) -> int:
+    def _slot(self, endpoint: Endpoint) -> Optional[int]:
+        """Slot for an endpoint, or None when capacity is exhausted. Alerts
+        for unslottable endpoints are dropped — always protocol-safe (alert
+        delivery is best-effort) and strictly better than wedging the node's
+        alert handler for the rest of the configuration."""
         slot = self._slot_of.get(endpoint)
         if slot is None:
             slot = len(self._slot_of)
             if slot >= self.max_slots:
-                raise RuntimeError(
-                    f"DeviceCutDetector slot capacity {self.max_slots} exceeded"
-                )
+                if not self._overflow_warned:
+                    self._overflow_warned = True
+                    LOG.warning(
+                        "DeviceCutDetector slot capacity %d exhausted; dropping "
+                        "alerts for new endpoints until the next view change",
+                        self.max_slots,
+                    )
+                return None
             self._slot_of[endpoint] = slot
             self._endpoint_of[slot] = endpoint
             self._subject_mask[slot] = True
@@ -67,18 +81,23 @@ class DeviceCutDetector:
     def _fill_observers(self, subject: Endpoint, view: "MembershipView") -> None:
         """Populate the invalidation-observer column for a touched subject:
         ring observers for members, expected observers for joiners
-        (MultiNodeCutDetector.java:147-149)."""
-        slot = self._slot(subject)
-        try:
-            observers = (
-                view.observers_of(subject)
-                if view.is_host_present(subject)
-                else view.expected_observers_of(subject)
-            )
-        except Exception:
+        (MultiNodeCutDetector.java:147-149). Once per subject per
+        configuration."""
+        if subject in self._observers_filled:
             return
+        slot = self._slot(subject)
+        if slot is None:
+            return
+        self._observers_filled.add(subject)
+        observers = (
+            view.observers_of(subject)
+            if view.is_host_present(subject)
+            else view.expected_observers_of(subject)
+        )
         for ring_number, observer in enumerate(observers[: self.k]):
-            self._inval_obs[ring_number, slot] = self._slot(observer)
+            observer_slot = self._slot(observer)
+            if observer_slot is not None:
+                self._inval_obs[ring_number, slot] = observer_slot
 
     def aggregate_batch(self, msgs, view: "MembershipView") -> Set[Endpoint]:
         """One kernel pass for the whole alert batch."""
@@ -87,6 +106,8 @@ class DeviceCutDetector:
         has_down = False
         for msg in msgs:
             slot = self._slot(msg.edge_dst)
+            if slot is None:
+                continue  # capacity exhausted: drop (best-effort delivery)
             self._fill_observers(msg.edge_dst, view)
             for ring_number in msg.ring_numbers:
                 dst_idx.append(slot)

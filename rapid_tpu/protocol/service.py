@@ -472,8 +472,12 @@ class MembershipService:
         stale_ms = 10 * (
             self.settings.failure_detector_interval_ms + self.settings.batching_window_ms
         )
-        if self._convergence_timing and (
-            self.metrics.elapsed_since_ms("view_change_convergence", now) > stale_ms
+        if (
+            self._convergence_timing
+            and not self._announced_proposal
+            # Once a proposal is announced, convergence is genuinely in
+            # flight (possibly slow via the classic fallback) — never expire.
+            and self.metrics.elapsed_since_ms("view_change_convergence", now) > stale_ms
         ):
             self._convergence_timing = False
         if not self._convergence_timing:

@@ -1,0 +1,31 @@
+"""JAX profiler hooks (SURVEY §5.1: the rebuild adds first-class profiling).
+
+Wraps ``jax.profiler`` so engine convergences and kernel passes can be traced
+to TensorBoard-compatible traces without touching call sites:
+
+    from rapid_tpu.utils.profiling import trace
+    with trace("/tmp/rapid-trace"):
+        vc.run_to_decision()
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax
+
+
+@contextmanager
+def trace(log_dir: str):
+    """Capture a device+host profile of the enclosed block into ``log_dir``
+    (view with TensorBoard or Perfetto)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named trace span for host-side phases (shows up in the profile)."""
+    return jax.profiler.TraceAnnotation(name)

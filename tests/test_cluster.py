@@ -127,6 +127,34 @@ async def test_twenty_nodes_join_in_parallel_through_one_seed():
 
 
 @async_test
+async def test_fifty_node_cluster_with_multi_failure():
+    # The reference's workhorse scale (ClusterTest runs up to 50 nodes).
+    network = InProcessNetwork()
+    fd = StaticFailureDetectorFactory()
+    settings = fast_settings()
+    seed = await Cluster.start(ep(0), settings=settings, network=network,
+                               fd_factory=fd, rng=random.Random(0))
+    joiners = await asyncio.gather(
+        *(
+            Cluster.join(ep(0), ep(i), settings=settings, network=network,
+                         fd_factory=fd, rng=random.Random(i))
+            for i in range(1, 50)
+        )
+    )
+    clusters = [seed] + list(joiners)
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 50), timeout_s=40)
+        victims = [clusters[7], clusters[21], clusters[33], clusters[44]]
+        for victim in victims:
+            network.blackholed.add(victim.listen_address)
+        fd.add_failed_nodes([v.listen_address for v in victims])
+        survivors = [c for c in clusters if c not in victims]
+        assert await wait_until(lambda: all_converged(survivors, 46), timeout_s=40)
+    finally:
+        await shutdown_all(clusters)
+
+
+@async_test
 async def test_join_wave_onto_existing_cluster():
     network = InProcessNetwork()
     settings = fast_settings()

@@ -137,6 +137,34 @@ def test_flaky_below_l_does_not_converge():
     assert vc.membership_size == 60
 
 
+def test_contested_round_fallback_picks_plurality():
+    # Two cohorts announce genuinely different cuts: cohort 1 never hears
+    # about the second victim (its observers are rx-blocked), so it proposes
+    # a subset. The fast round can't reach N-F identical votes; the modeled
+    # classic fallback must commit the plurality proposal everywhere.
+    n = 120
+    vc = VirtualCluster.create(n, fd_threshold=2, seed=11)
+    cohort_of = np.zeros(n, dtype=np.int32)
+    cohort_of[80:] = 1  # minority cohort
+    vc.assign_cohorts(cohort_of)
+    v1, v2 = 10, 60
+    vc.crash([v1, v2])
+    # Cohort 1 cannot hear from ANY observer of v2 (block every slot except
+    # v2's own): it will only ever tally v1.
+    rx = np.zeros((vc.cfg.c, vc.cfg.n), dtype=bool)
+    obs_of_v2 = np.asarray(vc.state.obs_idx)[:, v2]
+    rx[1, obs_of_v2] = True
+    vc.set_rx_block(rx)
+    rounds, events = vc.run_until_converged(max_steps=64)
+    assert events is not None
+    winner = set(np.nonzero(np.asarray(events.winner_mask))[0].tolist())
+    # Majority cohort's cut (both victims) wins; minority's subset loses.
+    assert winner == {v1, v2}
+    assert vc.membership_size == n - 2
+    # The decision required the fallback (dissent makes N-F unreachable).
+    assert int(events.total_votes) > int(events.max_votes)
+
+
 def test_asymmetric_cohorts_conflicting_proposals_blocked_then_resolved():
     # Cohort 1 misses alerts from half the observers (one-way partition):
     # receivers disagree transiently, but quorum still removes the victim.

@@ -1,0 +1,138 @@
+"""Property-based fuzzing (hypothesis): codec round-trips for arbitrary
+message contents, watermark-kernel equivalence, and XXH64 native parity."""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+import jax.numpy as jnp
+
+from rapid_tpu.messaging.codec import (
+    decode_request,
+    decode_response,
+    encode_request,
+    encode_response,
+)
+from rapid_tpu.ops.pallas_kernels import (
+    bits_to_reports_matrix,
+    watermark_merge_classify,
+)
+from rapid_tpu.types import (
+    AlertMessage,
+    BatchedAlertMessage,
+    EdgeStatus,
+    Endpoint,
+    JoinMessage,
+    JoinResponse,
+    JoinStatusCode,
+    NodeId,
+    Phase1bMessage,
+    Rank,
+)
+from rapid_tpu.utils.xxhash import xxh64
+
+endpoints = st.builds(
+    Endpoint,
+    hostname=st.text(min_size=0, max_size=64),
+    port=st.integers(min_value=0, max_value=65535),
+)
+node_ids = st.builds(
+    NodeId,
+    high=st.integers(min_value=0, max_value=2**64 - 1),
+    low=st.integers(min_value=0, max_value=2**64 - 1),
+)
+metadata = st.lists(
+    st.tuples(st.text(max_size=16), st.binary(max_size=32)), max_size=4
+).map(tuple)
+config_ids = st.integers(min_value=-(2**63), max_value=2**63 - 1)
+ring_lists = st.lists(st.integers(min_value=0, max_value=31), max_size=10).map(tuple)
+
+alerts = st.builds(
+    AlertMessage,
+    edge_src=endpoints,
+    edge_dst=endpoints,
+    edge_status=st.sampled_from(list(EdgeStatus)),
+    configuration_id=config_ids,
+    ring_numbers=ring_lists,
+    node_id=st.none() | node_ids,
+    metadata=metadata,
+)
+
+
+@settings(max_examples=200, deadline=None)
+@given(
+    st.one_of(
+        st.builds(
+            JoinMessage,
+            sender=endpoints,
+            node_id=node_ids,
+            ring_numbers=ring_lists,
+            configuration_id=config_ids,
+            metadata=metadata,
+        ),
+        st.builds(
+            BatchedAlertMessage,
+            sender=endpoints,
+            messages=st.lists(alerts, max_size=5).map(tuple),
+        ),
+        st.builds(
+            Phase1bMessage,
+            sender=endpoints,
+            configuration_id=config_ids,
+            rnd=st.builds(Rank, round=st.integers(0, 2**31 - 1), node_index=st.integers(0, 2**31 - 1)),
+            vrnd=st.builds(Rank, round=st.integers(0, 2**31 - 1), node_index=st.integers(0, 2**31 - 1)),
+            vval=st.lists(endpoints, max_size=4).map(tuple),
+        ),
+    )
+)
+def test_request_codec_roundtrip_fuzz(request_msg):
+    assert decode_request(encode_request(request_msg)) == request_msg
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    st.builds(
+        JoinResponse,
+        sender=endpoints,
+        status_code=st.sampled_from(list(JoinStatusCode)),
+        configuration_id=config_ids,
+        endpoints=st.lists(endpoints, max_size=5).map(tuple),
+        identifiers=st.lists(node_ids, max_size=5).map(tuple),
+        metadata_keys=st.lists(endpoints, max_size=3).map(tuple),
+        metadata_values=st.lists(metadata, max_size=3).map(tuple),
+    )
+)
+def test_join_response_codec_roundtrip_fuzz(response_msg):
+    assert decode_response(encode_response(response_msg)) == response_msg
+
+
+@settings(max_examples=50, deadline=None)
+@given(
+    st.integers(min_value=0, max_value=2**32 - 1),
+    st.data(),
+)
+def test_watermark_classify_fuzz(seed, data):
+    k, h, l = 10, data.draw(st.integers(4, 10)), data.draw(st.integers(1, 3))
+    rng = np.random.default_rng(seed)
+    n = 256
+    old = rng.integers(0, 1 << k, size=n, dtype=np.uint32)
+    new = rng.integers(0, 1 << k, size=n, dtype=np.uint32)
+    mask = rng.random(n) < 0.8
+    merged, cls = watermark_merge_classify(
+        jnp.asarray(old), jnp.asarray(new), jnp.asarray(mask), h, l
+    )
+    dense = np.asarray(bits_to_reports_matrix(merged, k))
+    tally = dense.sum(axis=1)
+    expected = np.where(tally >= h, 2, np.where((tally >= l) & (tally < h), 1, 0))
+    np.testing.assert_array_equal(np.asarray(cls), expected)
+    # Merged bits are exactly (old | new) & mask.
+    np.testing.assert_array_equal(np.asarray(merged), np.where(mask, old | new, 0))
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.binary(max_size=128), st.integers(min_value=0, max_value=2**64 - 1))
+def test_native_xxh64_parity_fuzz(data, seed):
+    from rapid_tpu.utils._native import native_xxh64
+
+    native = native_xxh64(data, seed)
+    if native is not None:
+        assert native == xxh64(data, seed)
