@@ -32,6 +32,7 @@ def main() -> None:
     n = 100_000
     crash_frac = 0.01
     fd_threshold = 3
+    k_rings = 10
     baseline_target_ms = 500.0
 
     platform = jax.devices()[0].platform
@@ -40,7 +41,7 @@ def main() -> None:
         # One receiver cohort: crash faults never diverge healthy receivers.
         # The cut detector's merge+classify runs through the Pallas kernel.
         vc = VirtualCluster.create(
-            n, k=10, h=9, l=4, cohorts=1, fd_threshold=fd_threshold, seed=0,
+            n, k=k_rings, h=9, l=4, cohorts=1, fd_threshold=fd_threshold, seed=0,
             use_pallas=(platform == "tpu"),
         )
         rng = np.random.default_rng(7)
@@ -117,6 +118,12 @@ def main() -> None:
                 "samples_ms": [round(s, 3) for s in samples],
                 "n_members": n,
                 "faults": int(n * crash_frac),
+                # Logical alert deliveries during convergence: every fired
+                # edge alert (faults x K rings) reaches all N receivers —
+                # the BASELINE's alerts/sec axis.
+                "alert_deliveries_per_sec": round(
+                    int(n * crash_frac) * k_rings * n / (value / 1000.0), 0
+                ),
                 "device_rtt_ms": round(rtt_ms, 3),
                 **({"n1M_crash1pct_ms": round(xl_ms, 3)} if xl_ms is not None else {}),
             }

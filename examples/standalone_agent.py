@@ -27,6 +27,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from rapid_tpu.messaging.tcp import TcpClient, TcpServer
+from rapid_tpu.messaging.udp import UdpHybridClient, UdpHybridServer
 from rapid_tpu.protocol.cluster import Cluster
 from rapid_tpu.protocol.events import ClusterEvents
 from rapid_tpu.settings import Settings
@@ -53,7 +54,11 @@ async def run(args) -> None:
     seed = Endpoint.parse(args.seed_address)
     settings = Settings()
     metadata = (("role", args.role.encode()),) if args.role else ()
-    client, server = TcpClient(listen, settings), TcpServer(listen)
+    if args.transport == "udp":
+        # Hybrid: joins/probes over TCP, alerts/votes as datagrams.
+        client, server = UdpHybridClient(listen, settings), UdpHybridServer(listen)
+    else:
+        client, server = TcpClient(listen, settings), TcpServer(listen)
 
     if listen == seed:
         LOG.info("starting cluster as seed at %s", listen)
@@ -97,6 +102,8 @@ def main() -> None:
     parser.add_argument("--seed-address", required=True,
                         help="host:port of the seed (same as listen-address to bootstrap)")
     parser.add_argument("--role", default="", help="role metadata tag shared with the cluster")
+    parser.add_argument("--transport", choices=("tcp", "udp"), default="tcp",
+                        help="tcp: everything over TCP; udp: hybrid with datagram alerts/votes")
     parser.add_argument("--report-interval", type=float, default=1.0)
     args = parser.parse_args()
     logging.basicConfig(

@@ -12,6 +12,8 @@ from rapid_tpu.messaging.inprocess import (
     ServerDropFirstN,
 )
 from rapid_tpu.messaging.retries import call_with_retries
+from rapid_tpu.messaging.tcp import TcpClient, TcpServer
+from rapid_tpu.messaging.udp import UdpHybridClient, UdpHybridServer
 
 __all__ = [
     "Broadcaster",
@@ -24,4 +26,8 @@ __all__ = [
     "InProcessServer",
     "ServerDropFirstN",
     "call_with_retries",
+    "TcpClient",
+    "TcpServer",
+    "UdpHybridClient",
+    "UdpHybridServer",
 ]

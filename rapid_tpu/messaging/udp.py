@@ -1,0 +1,158 @@
+"""Hybrid TCP+UDP transport: datagrams for one-way best-effort traffic.
+
+The reference routes everything over unary gRPC, but the paper notes that
+alert gossip and consensus vote counting are one-way and loss-tolerant and
+"can run over UDP" (paper §7). This transport implements that: broadcast-fan
+messages (batched alerts, fast-round votes, phase2b echoes, leaves) travel
+as single datagrams — no connection setup, no response path — while
+request/response traffic (joins, probes, coordinator-bound phase1b) stays on
+the reliable TCP path. Both listeners share the endpoint's port.
+
+Protocol safety: everything sent over UDP is already best-effort in the
+protocol (alert redelivery via further FD ticks; consensus tolerates lost
+votes via the fallback), so datagram loss degrades latency, never
+correctness.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ipaddress
+import logging
+from typing import Dict, Optional, Tuple
+
+from rapid_tpu.messaging.codec import decode_request, encode_request
+from rapid_tpu.messaging.tcp import TcpClient, TcpServer
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import (
+    BatchedAlertMessage,
+    Endpoint,
+    FastRoundPhase2bMessage,
+    LeaveMessage,
+    Phase1aMessage,
+    Phase2aMessage,
+    Phase2bMessage,
+    RapidRequest,
+    RapidResponse,
+    Response,
+)
+
+LOG = logging.getLogger(__name__)
+
+# One-way message types: no caller consumes their response.
+ONEWAY_TYPES = (
+    BatchedAlertMessage,
+    FastRoundPhase2bMessage,
+    Phase1aMessage,
+    Phase2aMessage,
+    Phase2bMessage,
+    LeaveMessage,
+)
+
+_MAX_DATAGRAM = 60 * 1024
+
+
+class _ServerProtocol(asyncio.DatagramProtocol):
+    def __init__(self, server: "UdpHybridServer") -> None:
+        self._server = server
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        asyncio.ensure_future(self._server._handle_datagram(data))
+
+
+class UdpHybridServer(TcpServer):
+    """TCP server plus a UDP listener on the same port for one-way traffic."""
+
+    def __init__(self, listen_address: Endpoint) -> None:
+        super().__init__(listen_address)
+        self._udp_transport: Optional[asyncio.DatagramTransport] = None
+
+    async def start(self) -> None:
+        await super().start()
+        loop = asyncio.get_event_loop()
+        self._udp_transport, _ = await loop.create_datagram_endpoint(
+            lambda: _ServerProtocol(self),
+            local_addr=(self.listen_address.hostname, self.listen_address.port),
+        )
+
+    async def shutdown(self) -> None:
+        if self._udp_transport is not None:
+            self._udp_transport.close()
+            self._udp_transport = None
+        await super().shutdown()
+
+    async def _handle_datagram(self, data: bytes) -> None:
+        try:
+            request = decode_request(data)
+            if self._service is not None:
+                await self._service.handle_message(request)
+        except Exception as exc:  # noqa: BLE001 — datagram-level fault isolation
+            LOG.debug("server %s dropped bad datagram: %r", self.listen_address, exc)
+
+
+class _ClientProtocol(asyncio.DatagramProtocol):
+    def datagram_received(self, data: bytes, addr) -> None:
+        pass  # one-way: responses never arrive
+
+    def error_received(self, exc: Exception) -> None:
+        # sendto errors surface here asynchronously, not at the call site.
+        LOG.debug("udp send error: %r", exc)
+
+
+class UdpHybridClient(TcpClient):
+    """TCP client whose best-effort sends of one-way message types go as
+    single datagrams (everything else rides the TCP correlation path)."""
+
+    def __init__(self, my_addr: Endpoint, settings: Optional[Settings] = None) -> None:
+        super().__init__(my_addr, settings)
+        self._udp_transports: Dict[int, asyncio.DatagramTransport] = {}
+        self._udp_lock = asyncio.Lock()
+
+    async def _udp(self, ip_version: int) -> asyncio.DatagramTransport:
+        # Guarded like TcpClient._connection_for: concurrent first sends must
+        # share one socket, not race to create and leak several.
+        transport = self._udp_transports.get(ip_version)
+        if transport is not None and not transport.is_closing():
+            return transport
+        async with self._udp_lock:
+            transport = self._udp_transports.get(ip_version)
+            if transport is None or transport.is_closing():
+                loop = asyncio.get_event_loop()
+                local = ("0.0.0.0", 0) if ip_version == 4 else ("::", 0)
+                transport, _ = await loop.create_datagram_endpoint(
+                    _ClientProtocol, local_addr=local
+                )
+                self._udp_transports[ip_version] = transport
+            return transport
+
+    async def send_best_effort(
+        self, remote: Endpoint, request: RapidRequest
+    ) -> Optional[RapidResponse]:
+        # The datagram fast path applies only to literal-IP endpoints:
+        # transport.sendto never raises to the caller (errors land in
+        # error_received), so a hostname that resolves differently — or not
+        # at all — would be a silent drop with a fake success. Non-IP
+        # hostnames take the reliable TCP path.
+        if isinstance(request, ONEWAY_TYPES):
+            try:
+                ip = ipaddress.ip_address(remote.hostname)
+            except ValueError:
+                ip = None
+            if ip is not None:
+                payload = encode_request(request)
+                if len(payload) <= _MAX_DATAGRAM:
+                    try:
+                        transport = await self._udp(ip.version)
+                        transport.sendto(payload, (remote.hostname, remote.port))
+                        return Response()  # fire-and-forget: no ack exists
+                    except Exception as exc:  # noqa: BLE001 — fall back to TCP
+                        LOG.debug(
+                            "udp send to %s failed (%r); falling back to tcp", remote, exc
+                        )
+        return await super().send_best_effort(remote, request)
+
+    async def shutdown(self) -> None:
+        for transport in self._udp_transports.values():
+            transport.close()
+        self._udp_transports.clear()
+        await super().shutdown()

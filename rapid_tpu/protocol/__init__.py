@@ -1,4 +1,6 @@
+from rapid_tpu.protocol.cluster import Cluster
 from rapid_tpu.protocol.cut_detector import MultiNodeCutDetector
+from rapid_tpu.protocol.device_cut_detector import DeviceCutDetector
 from rapid_tpu.protocol.events import ClusterEvents, ClusterStatusChange, NodeStatusChange
 from rapid_tpu.protocol.fast_paxos import FastPaxos, fast_paxos_quorum
 from rapid_tpu.protocol.metadata import MetadataManager
@@ -6,7 +8,9 @@ from rapid_tpu.protocol.paxos import Paxos, select_proposal_using_coordinator_ru
 from rapid_tpu.protocol.view import Configuration, MembershipView, configuration_id_of, ring_key
 
 __all__ = [
+    "Cluster",
     "MultiNodeCutDetector",
+    "DeviceCutDetector",
     "ClusterEvents",
     "ClusterStatusChange",
     "NodeStatusChange",

@@ -127,6 +127,28 @@ async def test_twenty_nodes_join_in_parallel_through_one_seed():
 
 
 @async_test
+async def test_hundred_parallel_joins_through_one_seed():
+    # The reference's headline bootstrap test: 100 concurrent joins through a
+    # single seed (ClusterTest.java:183-191).
+    network = InProcessNetwork()
+    settings = fast_settings()
+    seed = await Cluster.start(ep(0), settings=settings, network=network,
+                               fd_factory=StaticFailureDetectorFactory())
+    joiners = await asyncio.gather(
+        *(
+            Cluster.join(ep(0), ep(1000 + i), settings=settings, network=network,
+                         fd_factory=StaticFailureDetectorFactory(), rng=random.Random(i))
+            for i in range(100)
+        )
+    )
+    clusters = [seed] + list(joiners)
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 101), timeout_s=45)
+    finally:
+        await shutdown_all(clusters)
+
+
+@async_test
 async def test_fifty_node_cluster_with_multi_failure():
     # The reference's workhorse scale (ClusterTest runs up to 50 nodes).
     network = InProcessNetwork()

@@ -1,0 +1,92 @@
+"""Full cluster over the hybrid TCP+UDP transport: alerts and votes ride
+datagrams, joins and probes ride TCP."""
+
+import asyncio
+import functools
+import random
+
+from rapid_tpu.messaging.udp import ONEWAY_TYPES, UdpHybridClient, UdpHybridServer
+from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
+from rapid_tpu.protocol.cluster import Cluster
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import Endpoint, FastRoundPhase2bMessage
+
+BASE_PORT = 37200
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        async def with_timeout():
+            await asyncio.wait_for(fn(*args, **kwargs), timeout=60)
+
+        asyncio.run(with_timeout())
+
+    return wrapper
+
+
+def fast_settings() -> Settings:
+    s = Settings()
+    s.batching_window_ms = 20
+    s.failure_detector_interval_ms = 50
+    s.rpc_timeout_ms = 500
+    s.rpc_join_timeout_ms = 2000
+    s.rpc_probe_timeout_ms = 200
+    s.consensus_fallback_base_delay_ms = 2000
+    return s
+
+
+def ep(i: int) -> Endpoint:
+    return Endpoint("127.0.0.1", BASE_PORT + i)
+
+
+async def wait_until(predicate, timeout_s=20.0):
+    deadline = asyncio.get_event_loop().time() + timeout_s
+    while asyncio.get_event_loop().time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(0.02)
+    return predicate()
+
+
+@async_test
+async def test_six_nodes_over_hybrid_udp_with_failure():
+    settings = fast_settings()
+    fd = StaticFailureDetectorFactory()
+
+    sent_udp = []
+
+    class CountingClient(UdpHybridClient):
+        async def send_best_effort(self, remote, request):
+            if isinstance(request, ONEWAY_TYPES):
+                sent_udp.append(type(request).__name__)
+            return await super().send_best_effort(remote, request)
+
+    clusters = [
+        await Cluster.start(ep(0), settings=settings, client=CountingClient(ep(0), settings),
+                            server=UdpHybridServer(ep(0)), fd_factory=fd, rng=random.Random(0))
+    ]
+    for i in range(1, 6):
+        clusters.append(
+            await Cluster.join(ep(0), ep(i), settings=settings,
+                               client=CountingClient(ep(i), settings),
+                               server=UdpHybridServer(ep(i)), fd_factory=fd,
+                               rng=random.Random(i))
+        )
+    try:
+        assert await wait_until(
+            lambda: all(c.membership_size == 6 for c in clusters)
+            and len({tuple(c.membership) for c in clusters}) == 1
+        )
+        # Alerts and fast-round votes actually traveled as datagrams.
+        assert "BatchedAlertMessage" in sent_udp
+        assert "FastRoundPhase2bMessage" in sent_udp
+
+        victim = clusters[4]
+        await victim.shutdown()
+        fd.add_failed_nodes([victim.listen_address])
+        survivors = [c for c in clusters if c is not victim]
+        assert await wait_until(lambda: all(c.membership_size == 5 for c in survivors))
+        assert all(victim.listen_address not in c.membership for c in survivors)
+    finally:
+        await asyncio.gather(*(c.shutdown() for c in clusters), return_exceptions=True)

@@ -137,6 +137,35 @@ def test_flaky_below_l_does_not_converge():
     assert vc.membership_size == 60
 
 
+def test_flip_flop_partition_removes_exactly_faulty_set():
+    # BASELINE config 4 / paper Fig. 9: one-way partitions that flip on and
+    # off. Rapid's watermarks + FD hysteresis must remove exactly the faulty
+    # set; healthy members must never be evicted (the reference's comparison
+    # systems oscillate forever here).
+    n = 400
+    vc = VirtualCluster.create(n, k=10, h=9, l=4, fd_threshold=4, seed=12)
+    faulty = list(range(40, 50))
+    on_mask = np.zeros((vc.cfg.n, vc.cfg.k), dtype=bool)
+    on_mask[faulty, :] = True
+    off_mask = np.zeros_like(on_mask)
+
+    removed_healthy = False
+    for cycle in range(6):
+        vc.set_flaky_edges(on_mask if cycle % 2 == 0 else off_mask)
+        for _ in range(3):
+            vc.step()
+        alive = vc.alive_mask
+        removed_healthy |= (~alive[: 40]).any() or (~alive[50:n]).any()
+    # Keep partitions on until convergence completes.
+    vc.set_flaky_edges(on_mask)
+    vc.run_until_converged(max_steps=32)
+    alive = vc.alive_mask
+    assert not removed_healthy
+    assert not alive[faulty].any(), "faulty set fully removed"
+    assert alive[:40].all() and alive[50:n].all(), "no healthy member evicted"
+    assert vc.membership_size == n - len(faulty)
+
+
 def test_contested_round_fallback_picks_plurality():
     # Two cohorts announce genuinely different cuts: cohort 1 never hears
     # about the second victim (its observers are rx-blocked), so it proposes
