@@ -55,9 +55,14 @@ _MAX_DATAGRAM = 60 * 1024
 class _ServerProtocol(asyncio.DatagramProtocol):
     def __init__(self, server: "UdpHybridServer") -> None:
         self._server = server
+        # Strong references: the loop only weakly references tasks, and a
+        # collected handler task silently drops the datagram.
+        self._tasks: set = set()
 
     def datagram_received(self, data: bytes, addr) -> None:
-        asyncio.ensure_future(self._server._handle_datagram(data))
+        task = asyncio.ensure_future(self._server._handle_datagram(data))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
 
 
 class UdpHybridServer(TcpServer):
@@ -70,10 +75,15 @@ class UdpHybridServer(TcpServer):
     async def start(self) -> None:
         await super().start()
         loop = asyncio.get_event_loop()
-        self._udp_transport, _ = await loop.create_datagram_endpoint(
-            lambda: _ServerProtocol(self),
-            local_addr=(self.listen_address.hostname, self.listen_address.port),
-        )
+        try:
+            self._udp_transport, _ = await loop.create_datagram_endpoint(
+                lambda: _ServerProtocol(self),
+                local_addr=(self.listen_address.hostname, self.listen_address.port),
+            )
+        except BaseException:
+            # Don't leak the already-accepting TCP listener.
+            await super().shutdown()
+            raise
 
     async def shutdown(self) -> None:
         if self._udp_transport is not None:

@@ -85,6 +85,17 @@ class EngineState(NamedTuple):
     # Rounds spent with an announced-but-undecided proposal (fallback timer).
     rounds_undecided: jnp.ndarray  # int32
 
+    # Classic-Paxos acceptor state, message-level (Paxos.java:64-74): the
+    # promised rank rnd and accepted (vrnd, vval) per node. Ranks are
+    # (round, node-index) pairs; values are cohort indices into prop_mask
+    # (every value in play is some cohort's announced cut); -1 = none.
+    cp_rnd_r: jnp.ndarray  # [n] int32
+    cp_rnd_i: jnp.ndarray  # [n] int32
+    cp_vrnd_r: jnp.ndarray  # [n] int32
+    cp_vrnd_i: jnp.ndarray  # [n] int32
+    cp_vval_src: jnp.ndarray  # [n] int32 — cohort index of accepted value
+    classic_epoch: jnp.ndarray  # int32 — classic attempts this configuration
+
 
 def initial_state(cfg: EngineConfig, key_hi, key_lo, id_hi, id_lo, alive) -> EngineState:
     """Build a configuration-consistent state from identity arrays."""
@@ -130,6 +141,12 @@ def initial_state(cfg: EngineConfig, key_hi, key_lo, id_hi, id_lo, alive) -> Eng
         vote_lo=jnp.zeros((n,), dtype=jnp.uint32),
         vote_valid=jnp.zeros((n,), dtype=bool),
         rounds_undecided=jnp.int32(0),
+        cp_rnd_r=jnp.zeros((n,), dtype=jnp.int32),
+        cp_rnd_i=jnp.zeros((n,), dtype=jnp.int32),
+        cp_vrnd_r=jnp.zeros((n,), dtype=jnp.int32),
+        cp_vrnd_i=jnp.zeros((n,), dtype=jnp.int32),
+        cp_vval_src=jnp.full((n,), -1, dtype=jnp.int32),
+        classic_epoch=jnp.int32(0),
     )
 
 

@@ -208,31 +208,103 @@ def _compute_round(
     )
     fast_decided = tally.decided
 
-    # 5b. Classic-Paxos fallback: an announced proposal stuck past the
-    #     recovery delay falls back to a classic round whose coordinator rule
-    #     (> N/4 identical fast votes force the value, Paxos.java:287-308)
-    #     lands on the plurality proposal; it commits at a majority quorum.
-    cand_counts = jnp.sum(
-        vote_valid[None, :]
-        & announced[:, None]
-        & (vote_hi[None, :] == prop_hi[:, None])
-        & (vote_lo[None, :] == prop_lo[:, None]),
-        axis=1,
-        dtype=jnp.int32,
-    )
+    # 5a'. Casting a fast-round vote also primes the classic acceptor state:
+    #      rnd = vrnd = (1, 1), vval = the vote (Paxos.java:246-260). The
+    #      fast round is always round 1; classic rounds start at 2.
+    prime = can_vote & (state.cp_rnd_r < 1)
+    cp_rnd_r = jnp.where(prime, 1, state.cp_rnd_r)
+    cp_rnd_i = jnp.where(prime, 1, state.cp_rnd_i)
+    cp_vrnd_r = jnp.where(prime, 1, state.cp_vrnd_r)
+    cp_vrnd_i = jnp.where(prime, 1, state.cp_vrnd_i)
+    cp_vval_src = jnp.where(prime, cohort, state.cp_vval_src)
+
     rounds_undecided = jnp.where(
         jnp.any(announced) & ~fast_decided, state.rounds_undecided + 1, state.rounds_undecided
     )
     fallback_due = (rounds_undecided >= cfg.fallback_rounds) & jnp.any(announced) & ~fast_decided
-    fb_cohort = jnp.argmax(jnp.where(announced, cand_counts, -1))
-    classic_voters = jnp.sum(state.alive & ~faults.crashed, dtype=jnp.int32)
-    fb_decided = fallback_due & (classic_voters > state.n_members // 2)
+
+    # 5b. Classic-Paxos fallback, message-level (Paxos.java:98-238): one
+    #     attempt per engine round once the recovery delay expires. A
+    #     rotating coordinator runs phase1a/1b (promises from reachable
+    #     acceptors), picks a value with the Fast Paxos coordinator rule
+    #     (Paxos.java:271-328), then phase2a/2b commits at majority.
+    #     Delivery respects the same per-cohort rx-block masks as alerts, so
+    #     partitioned coordinators genuinely fail and rotation recovers.
+    active = state.alive & ~faults.crashed
+    n_active = jnp.sum(active, dtype=jnp.int32)
+    majority = state.n_members // 2 + 1
+
+    # Rotating coordinator: the (epoch mod n_active)-th active slot.
+    target = jnp.where(n_active > 0, state.classic_epoch % jnp.maximum(n_active, 1) + 1, 1)
+    active_rank = jnp.cumsum(active.astype(jnp.int32))
+    coord = jnp.argmax(active & (active_rank == target)).astype(jnp.int32)
+    round_num = 2 + state.classic_epoch
+    slot_ids = jnp.arange(n, dtype=jnp.int32)
+
+    coord_cohort = state.cohort_of[coord]
+    # i hears the coordinator unless i's cohort rx-blocks the coordinator;
+    # the coordinator hears i unless its cohort rx-blocks i.
+    hears_coord = active & ~faults.rx_block[state.cohort_of, coord]
+    coord_hears = active & ~faults.rx_block[coord_cohort, slot_ids]
+
+    def rank_gt(ar, ai, br, bi):
+        return (ar > br) | ((ar == br) & (ai > bi))
+
+    # Phase 1a/1b: promise to the higher rank (Paxos.java:118-148).
+    promise = fallback_due & hears_coord & rank_gt(round_num, coord, cp_rnd_r, cp_rnd_i)
+    q1 = promise & coord_hears
+    q1_count = jnp.sum(q1, dtype=jnp.int32)
+    phase1_ok = q1_count >= majority
+
+    # Coordinator value-pick rule over the quorum's (vrnd, vval) pairs.
+    has_vval = cp_vval_src >= 0
+    voters = q1 & has_vval
+    mv_r = jnp.max(jnp.where(voters, cp_vrnd_r, -1))
+    mv_i = jnp.max(jnp.where(voters & (cp_vrnd_r == mv_r), cp_vrnd_i, -1))
+    at_max = voters & (cp_vrnd_r == mv_r) & (cp_vrnd_i == mv_i)
+    cohort_ids = jnp.arange(c, dtype=jnp.int32)
+    max_counts = jnp.sum(
+        at_max[None, :] & (cp_vval_src[None, :] == cohort_ids[:, None]), axis=1, dtype=jnp.int32
+    )
+    # Value pick: the plurality among max-vrnd accepted values (a safe
+    # instance of Paxos.java:287-308 — a fast-chosen value necessarily holds
+    # > N/4 of any majority quorum, and at most one value can be fast-chosen,
+    # so the plurality contains it whenever one exists). If NO quorum member
+    # has accepted anything, safety permits a free choice: the coordinator
+    # proposes an announced cut (Paxos.java:310-326's any-proposed-value
+    # clause) — without this, a cut whose only voters crashed would stall
+    # every rotation until failure detection caught up.
+    chosen = jnp.where(
+        jnp.any(max_counts > 0),
+        jnp.argmax(max_counts).astype(jnp.int32),
+        jnp.where(jnp.any(announced), jnp.argmax(announced).astype(jnp.int32), -1),
+    )
+
+    # Phase 2a/2b: reachable acceptors accept the coordinator's rank/value
+    # (Paxos.java:195-216); decision at a majority of accepts
+    # (Paxos.java:223-238 — phase2b is broadcast; tallied globally here).
+    can_accept = (
+        fallback_due
+        & phase1_ok
+        & (chosen >= 0)
+        & hears_coord
+        & ~rank_gt(cp_rnd_r, cp_rnd_i, round_num, coord)
+    )
+    accept_count = jnp.sum(can_accept, dtype=jnp.int32)
+    fb_decided = fallback_due & phase1_ok & (chosen >= 0) & (accept_count >= majority)
+
+    cp_rnd_r = jnp.where(promise | can_accept, round_num, cp_rnd_r)
+    cp_rnd_i = jnp.where(promise | can_accept, coord, cp_rnd_i)
+    cp_vrnd_r = jnp.where(can_accept, round_num, cp_vrnd_r)
+    cp_vrnd_i = jnp.where(can_accept, coord, cp_vrnd_i)
+    cp_vval_src = jnp.where(can_accept, chosen, cp_vval_src)
+    classic_epoch = jnp.where(fallback_due, state.classic_epoch + 1, state.classic_epoch)
 
     decided = fast_decided | fb_decided
     winner_cohort = jnp.where(
         fast_decided,
         jnp.argmax(announced & (prop_hi == tally.winner_hi) & (prop_lo == tally.winner_lo)),
-        fb_cohort,
+        jnp.maximum(chosen, 0),
     )
     winner_mask = jnp.where(decided, prop_mask[winner_cohort], jnp.zeros((n,), dtype=bool))
 
@@ -250,6 +322,12 @@ def _compute_round(
         vote_lo=vote_lo,
         vote_valid=vote_valid,
         rounds_undecided=rounds_undecided,
+        cp_rnd_r=cp_rnd_r,
+        cp_rnd_i=cp_rnd_i,
+        cp_vrnd_r=cp_vrnd_r,
+        cp_vrnd_i=cp_vrnd_i,
+        cp_vval_src=cp_vval_src,
+        classic_epoch=classic_epoch,
     )
     events = StepEvents(
         decided=decided,
@@ -294,6 +372,12 @@ def apply_view_change_impl(
         vote_lo=jnp.zeros((n,), dtype=jnp.uint32),
         vote_valid=jnp.zeros((n,), dtype=bool),
         rounds_undecided=jnp.int32(0),
+        cp_rnd_r=jnp.zeros((n,), dtype=jnp.int32),
+        cp_rnd_i=jnp.zeros((n,), dtype=jnp.int32),
+        cp_vrnd_r=jnp.zeros((n,), dtype=jnp.int32),
+        cp_vrnd_i=jnp.zeros((n,), dtype=jnp.int32),
+        cp_vval_src=jnp.full((n,), -1, dtype=jnp.int32),
+        classic_epoch=jnp.int32(0),
     )
 
 
@@ -389,6 +473,7 @@ class VirtualCluster:
         fd_threshold: int = 3,
         seed: int = 0,
         use_pallas: bool = False,
+        fallback_rounds: int = 8,
     ) -> "VirtualCluster":
         """Synthetic cluster: slot identities are random 64-bit lanes (the
         host never materializes 100K endpoint strings; interop deployments
@@ -396,7 +481,8 @@ class VirtualCluster:
         n = n_slots if n_slots is not None else n_members
         assert n >= n_members
         cfg = EngineConfig(
-            n=n, k=k, h=h, l=l, c=cohorts, fd_threshold=fd_threshold, use_pallas=use_pallas
+            n=n, k=k, h=h, l=l, c=cohorts, fd_threshold=fd_threshold,
+            use_pallas=use_pallas, fallback_rounds=fallback_rounds,
         )
         rng = np.random.default_rng(seed)
         key_hi = rng.integers(0, 2**32, size=(k, n), dtype=np.uint32)

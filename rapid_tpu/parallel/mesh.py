@@ -65,6 +65,12 @@ def state_shardings(mesh: Mesh) -> EngineState:
         vote_lo=sh(NODE_AXIS),
         vote_valid=sh(NODE_AXIS),
         rounds_undecided=sh(),
+        cp_rnd_r=sh(NODE_AXIS),
+        cp_rnd_i=sh(NODE_AXIS),
+        cp_vrnd_r=sh(NODE_AXIS),
+        cp_vrnd_i=sh(NODE_AXIS),
+        cp_vval_src=sh(NODE_AXIS),
+        classic_epoch=sh(),
     )
 
 

@@ -194,6 +194,39 @@ def test_contested_round_fallback_picks_plurality():
     assert int(events.total_votes) > int(events.max_votes)
 
 
+def test_classic_round_coordinator_rotation_survives_blocked_coordinators():
+    # Message-level classic fallback: early rotating coordinators are
+    # rx-blocked from the majority cohort, so their phase-1 quorums fail;
+    # rotation must eventually land on a reachable coordinator that commits.
+    n = 60
+    vc = VirtualCluster.create(n, fd_threshold=2, fallback_rounds=3, seed=13)
+    cohort_of = np.zeros(n, dtype=np.int32)
+    cohort_of[40:] = 1
+    vc.assign_cohorts(cohort_of)
+    victim = 25
+    vc.crash([victim])
+    rx = np.zeros((vc.cfg.c, vc.cfg.n), dtype=bool)
+    # Cohort 1 never hears any of victim's observers: it never proposes or
+    # fast-votes, so the fast round is stuck at 40 < quorum(45) votes.
+    obs_of_victim = np.asarray(vc.state.obs_idx)[:, victim]
+    rx[1, obs_of_victim] = True
+    # Cohort 0 (the majority, 40 members) cannot hear from the first few
+    # active non-observer slots — exactly the first rotating coordinators
+    # (excluding victim observers so cohort 0's cut detection still sees H
+    # reports).
+    blocked = [i for i in range(n) if i not in set(obs_of_victim.tolist()) and i != victim][:6]
+    rx[0, blocked] = True
+    vc.set_rx_block(rx)
+    rounds, events = vc.run_until_converged(max_steps=96)
+    assert events is not None
+    assert not vc.alive_mask[victim]
+    assert vc.membership_size == n - 1
+    # Rotation was actually needed: more than one classic attempt happened.
+    # (classic_epoch reset on view change, so check via the rounds taken:
+    # fd_threshold + fallback_rounds + >1 failed attempts.)
+    assert rounds > 2 + 3 + 1
+
+
 def test_asymmetric_cohorts_conflicting_proposals_blocked_then_resolved():
     # Cohort 1 misses alerts from half the observers (one-way partition):
     # receivers disagree transiently, but quorum still removes the victim.
