@@ -1,0 +1,3 @@
+from rapid_tpu.interop.grpc_transport import GrpcClient, GrpcServer
+
+__all__ = ["GrpcClient", "GrpcServer"]

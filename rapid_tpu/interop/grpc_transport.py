@@ -1,0 +1,165 @@
+"""gRPC transport speaking the reference's wire protocol.
+
+Implements the messaging SPI over the exact RPC the reference serves —
+``remoting.MembershipService/sendRequest`` (rapid.proto:9-11) with
+protobuf-encoded ``RapidRequest``/``RapidResponse`` envelopes — so a node
+running this framework can, in principle, sit in a cluster with the Java
+reference. Built on grpc.aio with a generic method handler (no generated
+stubs; the schema is materialized at runtime, rapid_tpu.interop.proto_schema).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional
+
+import grpc
+import grpc.aio
+
+from rapid_tpu.errors import ShuttingDownError
+from rapid_tpu.interop.convert import (
+    request_from_proto,
+    request_to_proto,
+    response_from_proto,
+    response_to_proto,
+)
+from rapid_tpu.interop.proto_schema import GRPC_METHOD, proto_class
+from rapid_tpu.messaging.base import MessagingClient, MessagingServer
+from rapid_tpu.messaging.retries import call_with_retries
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import (
+    Endpoint,
+    JoinMessage,
+    NodeStatus,
+    PreJoinMessage,
+    ProbeMessage,
+    ProbeResponse,
+    RapidRequest,
+    RapidResponse,
+)
+
+LOG = logging.getLogger(__name__)
+
+_SERVICE = "remoting.MembershipService"
+_METHOD = "sendRequest"
+
+
+def _serialize_response(response_proto) -> bytes:
+    return response_proto.SerializeToString()
+
+
+def _deserialize_request(data: bytes):
+    msg = proto_class("RapidRequest")()
+    msg.ParseFromString(data)
+    return msg
+
+
+class GrpcServer(MessagingServer):
+    """grpc.aio server exposing the reference's single unary RPC."""
+
+    def __init__(self, listen_address: Endpoint) -> None:
+        self.listen_address = listen_address
+        self._service = None
+        self._server: Optional[grpc.aio.Server] = None
+
+    def set_membership_service(self, service) -> None:
+        self._service = service
+
+    async def start(self) -> None:
+        server = grpc.aio.server()
+
+        async def send_request(request_proto, context):
+            if self._service is None:
+                request = request_from_proto(request_proto)
+                if isinstance(request, ProbeMessage):
+                    # BOOTSTRAPPING probes before the service exists
+                    # (GrpcServer.java:77-96).
+                    return response_to_proto(ProbeResponse(status=NodeStatus.BOOTSTRAPPING))
+                await context.abort(grpc.StatusCode.UNAVAILABLE, "bootstrapping")
+            request = request_from_proto(request_proto)
+            response = await self._service.handle_message(request)
+            return response_to_proto(response)
+
+        handler = grpc.unary_unary_rpc_method_handler(
+            send_request,
+            request_deserializer=_deserialize_request,
+            response_serializer=_serialize_response,
+        )
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(_SERVICE, {_METHOD: handler}),)
+        )
+        server.add_insecure_port(f"{self.listen_address.hostname}:{self.listen_address.port}")
+        await server.start()
+        self._server = server
+
+    async def shutdown(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=0.5)
+            self._server = None
+
+
+class GrpcClient(MessagingClient):
+    """grpc.aio client with a channel cache and per-message-type deadlines
+    (GrpcClient.java:85-95, 194-203)."""
+
+    def __init__(self, my_addr: Endpoint, settings: Optional[Settings] = None) -> None:
+        self.my_addr = my_addr
+        self._settings = settings if settings is not None else Settings()
+        self._channels: Dict[Endpoint, grpc.aio.Channel] = {}
+        self._shut_down = False
+
+    def _timeout_s_for(self, request: RapidRequest) -> float:
+        if isinstance(request, (JoinMessage, PreJoinMessage)):
+            return self._settings.rpc_join_timeout_ms / 1000.0
+        if isinstance(request, ProbeMessage):
+            return self._settings.rpc_probe_timeout_ms / 1000.0
+        return self._settings.rpc_timeout_ms / 1000.0
+
+    def _channel(self, remote: Endpoint) -> grpc.aio.Channel:
+        channel = self._channels.get(remote)
+        if channel is None:
+            channel = grpc.aio.insecure_channel(f"{remote.hostname}:{remote.port}")
+            self._channels[remote] = channel
+        return channel
+
+    async def _attempt(self, remote: Endpoint, request: RapidRequest) -> RapidResponse:
+        if self._shut_down:
+            raise ShuttingDownError(f"client {self.my_addr} is shut down")
+        channel = self._channel(remote)
+        call = channel.unary_unary(
+            GRPC_METHOD,
+            request_serializer=lambda r: r.SerializeToString(),
+            response_deserializer=lambda data: _parse_response(data),
+        )
+        response_proto = await call(
+            request_to_proto(request), timeout=self._timeout_s_for(request)
+        )
+        return response_from_proto(response_proto)
+
+    async def send(self, remote: Endpoint, request: RapidRequest) -> RapidResponse:
+        return await call_with_retries(
+            lambda: self._attempt(remote, request), self._settings.rpc_default_retries
+        )
+
+    async def send_best_effort(
+        self, remote: Endpoint, request: RapidRequest
+    ) -> Optional[RapidResponse]:
+        try:
+            return await self._attempt(remote, request)
+        except ShuttingDownError:
+            raise
+        except Exception:
+            return None
+
+    async def shutdown(self) -> None:
+        self._shut_down = True
+        for channel in self._channels.values():
+            await channel.close()
+        self._channels.clear()
+
+
+def _parse_response(data: bytes):
+    msg = proto_class("RapidResponse")()
+    msg.ParseFromString(data)
+    return msg

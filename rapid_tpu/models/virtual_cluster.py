@@ -230,74 +230,98 @@ def _compute_round(
     #     (Paxos.java:271-328), then phase2a/2b commits at majority.
     #     Delivery respects the same per-cohort rx-block masks as alerts, so
     #     partitioned coordinators genuinely fail and rotation recovers.
-    active = state.alive & ~faults.crashed
-    n_active = jnp.sum(active, dtype=jnp.int32)
-    majority = state.n_members // 2 + 1
+    #     Cond-gated: the common fast path skips the cumsum/gathers entirely.
+    def classic_attempt(cp):
+        cp_rnd_r, cp_rnd_i, cp_vrnd_r, cp_vrnd_i, cp_vval_src = cp
+        active = state.alive & ~faults.crashed
+        n_active = jnp.sum(active, dtype=jnp.int32)
+        majority = state.n_members // 2 + 1
 
-    # Rotating coordinator: the (epoch mod n_active)-th active slot.
-    target = jnp.where(n_active > 0, state.classic_epoch % jnp.maximum(n_active, 1) + 1, 1)
-    active_rank = jnp.cumsum(active.astype(jnp.int32))
-    coord = jnp.argmax(active & (active_rank == target)).astype(jnp.int32)
-    round_num = 2 + state.classic_epoch
-    slot_ids = jnp.arange(n, dtype=jnp.int32)
+        # Rotating coordinator: the (epoch mod n_active)-th active slot.
+        target = jnp.where(
+            n_active > 0, state.classic_epoch % jnp.maximum(n_active, 1) + 1, 1
+        )
+        active_rank = jnp.cumsum(active.astype(jnp.int32))
+        coord = jnp.argmax(active & (active_rank == target)).astype(jnp.int32)
+        round_num = 2 + state.classic_epoch
+        slot_ids = jnp.arange(n, dtype=jnp.int32)
 
-    coord_cohort = state.cohort_of[coord]
-    # i hears the coordinator unless i's cohort rx-blocks the coordinator;
-    # the coordinator hears i unless its cohort rx-blocks i.
-    hears_coord = active & ~faults.rx_block[state.cohort_of, coord]
-    coord_hears = active & ~faults.rx_block[coord_cohort, slot_ids]
+        coord_cohort = state.cohort_of[coord]
+        # i hears the coordinator unless i's cohort rx-blocks the coordinator;
+        # the coordinator hears i unless its cohort rx-blocks i.
+        hears_coord = active & ~faults.rx_block[state.cohort_of, coord]
+        coord_hears = active & ~faults.rx_block[coord_cohort, slot_ids]
 
-    def rank_gt(ar, ai, br, bi):
-        return (ar > br) | ((ar == br) & (ai > bi))
+        def rank_gt(ar, ai, br, bi):
+            return (ar > br) | ((ar == br) & (ai > bi))
 
-    # Phase 1a/1b: promise to the higher rank (Paxos.java:118-148).
-    promise = fallback_due & hears_coord & rank_gt(round_num, coord, cp_rnd_r, cp_rnd_i)
-    q1 = promise & coord_hears
-    q1_count = jnp.sum(q1, dtype=jnp.int32)
-    phase1_ok = q1_count >= majority
+        # Phase 1a/1b: promise to the higher rank (Paxos.java:118-148).
+        promise = hears_coord & rank_gt(round_num, coord, cp_rnd_r, cp_rnd_i)
+        q1 = promise & coord_hears
+        q1_count = jnp.sum(q1, dtype=jnp.int32)
+        phase1_ok = q1_count >= majority
 
-    # Coordinator value-pick rule over the quorum's (vrnd, vval) pairs.
-    has_vval = cp_vval_src >= 0
-    voters = q1 & has_vval
-    mv_r = jnp.max(jnp.where(voters, cp_vrnd_r, -1))
-    mv_i = jnp.max(jnp.where(voters & (cp_vrnd_r == mv_r), cp_vrnd_i, -1))
-    at_max = voters & (cp_vrnd_r == mv_r) & (cp_vrnd_i == mv_i)
-    cohort_ids = jnp.arange(c, dtype=jnp.int32)
-    max_counts = jnp.sum(
-        at_max[None, :] & (cp_vval_src[None, :] == cohort_ids[:, None]), axis=1, dtype=jnp.int32
+        # Coordinator value-pick rule over the quorum's (vrnd, vval) pairs.
+        voters = q1 & (cp_vval_src >= 0)
+        mv_r = jnp.max(jnp.where(voters, cp_vrnd_r, -1))
+        mv_i = jnp.max(jnp.where(voters & (cp_vrnd_r == mv_r), cp_vrnd_i, -1))
+        at_max = voters & (cp_vrnd_r == mv_r) & (cp_vrnd_i == mv_i)
+        cohort_ids = jnp.arange(c, dtype=jnp.int32)
+        max_counts = jnp.sum(
+            at_max[None, :] & (cp_vval_src[None, :] == cohort_ids[:, None]),
+            axis=1,
+            dtype=jnp.int32,
+        )
+        # Value pick: the plurality among max-vrnd accepted values (a safe
+        # instance of Paxos.java:287-308 — a fast-chosen value necessarily
+        # holds > N/4 of any majority quorum, and at most one value can be
+        # fast-chosen, so the plurality contains it whenever one exists). If
+        # NO quorum member has accepted anything, safety permits a free
+        # choice: the coordinator proposes an announced cut
+        # (Paxos.java:310-326's any-proposed-value clause) — without this, a
+        # cut whose only voters crashed would stall every rotation until
+        # failure detection caught up.
+        chosen = jnp.where(
+            jnp.any(max_counts > 0),
+            jnp.argmax(max_counts).astype(jnp.int32),
+            jnp.where(jnp.any(announced), jnp.argmax(announced).astype(jnp.int32), -1),
+        )
+
+        # Phase 2a/2b: reachable acceptors accept the coordinator's
+        # rank/value (Paxos.java:195-216); decision at a majority of accepts
+        # (Paxos.java:223-238 — phase2b is broadcast; tallied globally here).
+        can_accept = (
+            phase1_ok
+            & (chosen >= 0)
+            & hears_coord
+            & ~rank_gt(cp_rnd_r, cp_rnd_i, round_num, coord)
+        )
+        accept_count = jnp.sum(can_accept, dtype=jnp.int32)
+        fb_decided = phase1_ok & (chosen >= 0) & (accept_count >= majority)
+
+        return (
+            jnp.where(promise | can_accept, round_num, cp_rnd_r),
+            jnp.where(promise | can_accept, coord, cp_rnd_i),
+            jnp.where(can_accept, round_num, cp_vrnd_r),
+            jnp.where(can_accept, coord, cp_vrnd_i),
+            jnp.where(can_accept, chosen, cp_vval_src),
+            fb_decided,
+            chosen,
+        )
+
+    def no_attempt(cp):
+        cp_rnd_r, cp_rnd_i, cp_vrnd_r, cp_vrnd_i, cp_vval_src = cp
+        return (
+            cp_rnd_r, cp_rnd_i, cp_vrnd_r, cp_vrnd_i, cp_vval_src,
+            jnp.bool_(False), jnp.int32(-1),
+        )
+
+    cp_rnd_r, cp_rnd_i, cp_vrnd_r, cp_vrnd_i, cp_vval_src, fb_decided, chosen = jax.lax.cond(
+        fallback_due,
+        classic_attempt,
+        no_attempt,
+        (cp_rnd_r, cp_rnd_i, cp_vrnd_r, cp_vrnd_i, cp_vval_src),
     )
-    # Value pick: the plurality among max-vrnd accepted values (a safe
-    # instance of Paxos.java:287-308 — a fast-chosen value necessarily holds
-    # > N/4 of any majority quorum, and at most one value can be fast-chosen,
-    # so the plurality contains it whenever one exists). If NO quorum member
-    # has accepted anything, safety permits a free choice: the coordinator
-    # proposes an announced cut (Paxos.java:310-326's any-proposed-value
-    # clause) — without this, a cut whose only voters crashed would stall
-    # every rotation until failure detection caught up.
-    chosen = jnp.where(
-        jnp.any(max_counts > 0),
-        jnp.argmax(max_counts).astype(jnp.int32),
-        jnp.where(jnp.any(announced), jnp.argmax(announced).astype(jnp.int32), -1),
-    )
-
-    # Phase 2a/2b: reachable acceptors accept the coordinator's rank/value
-    # (Paxos.java:195-216); decision at a majority of accepts
-    # (Paxos.java:223-238 — phase2b is broadcast; tallied globally here).
-    can_accept = (
-        fallback_due
-        & phase1_ok
-        & (chosen >= 0)
-        & hears_coord
-        & ~rank_gt(cp_rnd_r, cp_rnd_i, round_num, coord)
-    )
-    accept_count = jnp.sum(can_accept, dtype=jnp.int32)
-    fb_decided = fallback_due & phase1_ok & (chosen >= 0) & (accept_count >= majority)
-
-    cp_rnd_r = jnp.where(promise | can_accept, round_num, cp_rnd_r)
-    cp_rnd_i = jnp.where(promise | can_accept, coord, cp_rnd_i)
-    cp_vrnd_r = jnp.where(can_accept, round_num, cp_vrnd_r)
-    cp_vrnd_i = jnp.where(can_accept, coord, cp_vrnd_i)
-    cp_vval_src = jnp.where(can_accept, chosen, cp_vval_src)
     classic_epoch = jnp.where(fallback_due, state.classic_epoch + 1, state.classic_epoch)
 
     decided = fast_decided | fb_decided
@@ -505,12 +529,17 @@ class VirtualCluster:
         l: int = 4,
         cohorts: int = 2,
         fd_threshold: int = 3,
+        use_pallas: bool = False,
+        fallback_rounds: int = 8,
     ) -> "VirtualCluster":
         """Build from real endpoints with the host view's exact ring keys, so
         the engine's topology matches a host MembershipView bit-for-bit."""
         n_members = len(endpoints)
         n = n_slots if n_slots is not None else n_members
-        cfg = EngineConfig(n=n, k=k, h=h, l=l, c=cohorts, fd_threshold=fd_threshold)
+        cfg = EngineConfig(
+            n=n, k=k, h=h, l=l, c=cohorts, fd_threshold=fd_threshold,
+            use_pallas=use_pallas, fallback_rounds=fallback_rounds,
+        )
         key_hi0, key_lo0 = endpoint_ring_keys(endpoints, k)
         key_hi = np.zeros((k, n), dtype=np.uint32)
         key_lo = np.zeros((k, n), dtype=np.uint32)

@@ -82,7 +82,26 @@ def load_engine_state(path) -> Tuple["EngineConfig", "EngineState"]:
         cfg = EngineConfig(*(int(v) for v in data["__cfg__"]))
         import jax.numpy as jnp
 
-        state = EngineState(
-            **{field: jnp.asarray(data[field]) for field in EngineState._fields}
-        )
+        # Fields added after a checkpoint was written fill with their
+        # initial-state defaults (per-configuration state is safe to reset:
+        # at worst a fallback restarts from round 2).
+        defaults = {
+            "cp_rnd_r": lambda: jnp.zeros((cfg.n,), dtype=jnp.int32),
+            "cp_rnd_i": lambda: jnp.zeros((cfg.n,), dtype=jnp.int32),
+            "cp_vrnd_r": lambda: jnp.zeros((cfg.n,), dtype=jnp.int32),
+            "cp_vrnd_i": lambda: jnp.zeros((cfg.n,), dtype=jnp.int32),
+            "cp_vval_src": lambda: jnp.full((cfg.n,), -1, dtype=jnp.int32),
+            "classic_epoch": lambda: jnp.int32(0),
+        }
+        arrays = {}
+        for field in EngineState._fields:
+            if field in data:
+                arrays[field] = jnp.asarray(data[field])
+            elif field in defaults:
+                arrays[field] = defaults[field]()
+            else:
+                raise KeyError(
+                    f"checkpoint missing field {field!r} with no known default"
+                )
+        state = EngineState(**arrays)
     return cfg, state
