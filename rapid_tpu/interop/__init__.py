@@ -1,3 +1,14 @@
-from rapid_tpu.interop.grpc_transport import GrpcClient, GrpcServer
+"""Reference-wire interop: protobuf schema/conversions (protobuf-only) and
+the gRPC transport (needs grpcio — imported lazily so the conversion paths
+work without it)."""
+
+
+def __getattr__(name):
+    if name in ("GrpcClient", "GrpcServer"):
+        from rapid_tpu.interop import grpc_transport
+
+        return getattr(grpc_transport, name)
+    raise AttributeError(f"module 'rapid_tpu.interop' has no attribute {name!r}")
+
 
 __all__ = ["GrpcClient", "GrpcServer"]

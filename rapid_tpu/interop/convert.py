@@ -166,6 +166,8 @@ def request_to_proto(request: t.RapidRequest):
 
 def request_from_proto(envelope) -> t.RapidRequest:
     which = envelope.WhichOneof("content")
+    if which is None:
+        raise ValueError("empty RapidRequest envelope (no content set)")
     sub = getattr(envelope, which)
     if which == "preJoinMessage":
         return t.PreJoinMessage(_ep_back(sub.sender), _nid_back(sub.nodeId))
@@ -235,6 +237,8 @@ def response_to_proto(response: t.RapidResponse):
 
 def response_from_proto(envelope) -> t.RapidResponse:
     which = envelope.WhichOneof("content")
+    if which is None:
+        raise ValueError("empty RapidResponse envelope (no content set)")
     sub = getattr(envelope, which)
     if which == "joinResponse":
         return t.JoinResponse(

@@ -10,7 +10,6 @@ stubs; the schema is materialized at runtime, rapid_tpu.interop.proto_schema).
 
 from __future__ import annotations
 
-import asyncio
 import logging
 from typing import Dict, Optional
 
@@ -70,14 +69,13 @@ class GrpcServer(MessagingServer):
         server = grpc.aio.server()
 
         async def send_request(request_proto, context):
+            request = request_from_proto(request_proto)
             if self._service is None:
-                request = request_from_proto(request_proto)
                 if isinstance(request, ProbeMessage):
                     # BOOTSTRAPPING probes before the service exists
                     # (GrpcServer.java:77-96).
                     return response_to_proto(ProbeResponse(status=NodeStatus.BOOTSTRAPPING))
                 await context.abort(grpc.StatusCode.UNAVAILABLE, "bootstrapping")
-            request = request_from_proto(request_proto)
             response = await self._service.handle_message(request)
             return response_to_proto(response)
 
@@ -89,7 +87,12 @@ class GrpcServer(MessagingServer):
         server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(_SERVICE, {_METHOD: handler}),)
         )
-        server.add_insecure_port(f"{self.listen_address.hostname}:{self.listen_address.port}")
+        bound = server.add_insecure_port(
+            f"{self.listen_address.hostname}:{self.listen_address.port}"
+        )
+        if bound == 0:
+            # Match the TCP transport's contract: bind failures raise.
+            raise OSError(f"could not bind gRPC server to {self.listen_address}")
         await server.start()
         self._server = server
 

@@ -14,7 +14,7 @@ whole cluster's protocol traffic stays in one process.
 from __future__ import annotations
 
 import asyncio
-from typing import Callable, Dict, List, Optional, Type
+from typing import Dict, List, Optional, Type
 
 from rapid_tpu.errors import ShuttingDownError
 from rapid_tpu.messaging.base import MessagingClient, MessagingServer

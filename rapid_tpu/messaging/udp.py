@@ -19,7 +19,7 @@ from __future__ import annotations
 import asyncio
 import ipaddress
 import logging
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 from rapid_tpu.messaging.codec import decode_request, encode_request
 from rapid_tpu.messaging.tcp import TcpClient, TcpServer

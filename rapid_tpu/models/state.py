@@ -17,7 +17,6 @@ from __future__ import annotations
 from typing import NamedTuple
 
 import jax.numpy as jnp
-import numpy as np
 
 from rapid_tpu.ops.hashing import masked_set_hash
 from rapid_tpu.ops.rings import ring_topology

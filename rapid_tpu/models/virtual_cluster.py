@@ -17,8 +17,7 @@ reduction here is a sum/any over N, which XLA lowers to psum over ICI.
 from __future__ import annotations
 
 import time
-from functools import partial
-from typing import List, NamedTuple, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -9,7 +9,7 @@ invoking its notifier exactly once.
 from __future__ import annotations
 
 import abc
-from typing import Awaitable, Callable
+from typing import Callable
 
 from rapid_tpu.types import Endpoint
 

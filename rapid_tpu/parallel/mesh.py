@@ -14,7 +14,7 @@ protocol state is data-parallel over N.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import numpy as np

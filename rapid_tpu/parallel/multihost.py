@@ -15,7 +15,7 @@ single-host environment beyond argument handling — the driver's
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional
 
 import jax
 

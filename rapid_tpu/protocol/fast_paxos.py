@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import math
 import random
-from typing import Callable, Dict, Optional, Sequence, Set, Tuple
+from typing import Dict, Optional, Sequence, Set, Tuple
 
 from rapid_tpu.protocol.paxos import BroadcastFn, OnDecideFn, Paxos, SendFn
 from rapid_tpu.types import (

@@ -13,7 +13,7 @@ reference's IBroadcaster / IMessagingClient seam.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Tuple
 
 from rapid_tpu.types import (
     Endpoint,

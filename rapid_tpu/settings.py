@@ -7,7 +7,7 @@ fixes its one structural gap: the protocol constants K/H/L were hardcoded in
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 
 @dataclass

@@ -11,10 +11,9 @@ exactly the way the reference keys ``Map<List<Endpoint>, AtomicInteger>``
 
 from __future__ import annotations
 
-import dataclasses
 import enum
 import uuid as _uuid
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, Optional, Tuple, Union
 
 

@@ -13,7 +13,6 @@ and streamed to every joiner. This module makes that durable:
 
 from __future__ import annotations
 
-import io
 from typing import TYPE_CHECKING, Tuple
 
 import numpy as np
@@ -27,7 +26,6 @@ from rapid_tpu.messaging.codec import (
     write_node_id,
 )
 from rapid_tpu.protocol.view import Configuration, MembershipView
-from rapid_tpu.types import Endpoint, NodeId
 
 if TYPE_CHECKING:
     from rapid_tpu.models.state import EngineConfig, EngineState

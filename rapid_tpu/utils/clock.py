@@ -12,7 +12,7 @@ from __future__ import annotations
 import asyncio
 import heapq
 import itertools
-from typing import Awaitable, Callable, List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 
 class CancelHandle:

@@ -14,7 +14,7 @@ from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
 from rapid_tpu.protocol.cluster import Cluster
 from rapid_tpu.protocol.events import ClusterEvents
 from rapid_tpu.settings import Settings
-from rapid_tpu.types import Endpoint, JoinMessage, PreJoinMessage
+from rapid_tpu.types import Endpoint, PreJoinMessage
 
 BASE_PORT = 1234
 

@@ -3,7 +3,6 @@ the detector SPI, plus a full in-process cluster running with the
 device-backed detector on every node."""
 
 import asyncio
-import functools
 import random
 
 import numpy as np

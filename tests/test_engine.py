@@ -2,7 +2,6 @@
 mirroring the cluster-level scenarios on the device path."""
 
 import numpy as np
-import pytest
 
 from rapid_tpu.models.virtual_cluster import VirtualCluster
 

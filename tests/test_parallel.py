@@ -5,13 +5,12 @@ import numpy as np
 
 import jax
 
-from rapid_tpu.models.virtual_cluster import VirtualCluster, engine_step_nodonate
+from rapid_tpu.models.virtual_cluster import VirtualCluster
 from rapid_tpu.parallel.mesh import (
     make_mesh,
     make_sharded_step,
     shard_faults,
     shard_state,
-    state_shardings,
 )
 
 

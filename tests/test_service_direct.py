@@ -1,0 +1,143 @@
+"""Driving MembershipService directly (reference: MessagingTest.java):
+join phase-1 semantics against large views, the ClientDelayer latch fixture,
+and service-level fast-round quorum behavior."""
+
+import asyncio
+import functools
+import random
+
+from rapid_tpu.messaging.inprocess import (
+    ClientDelayer,
+    InProcessClient,
+    InProcessNetwork,
+    InProcessServer,
+)
+from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
+from rapid_tpu.protocol.cut_detector import MultiNodeCutDetector
+from rapid_tpu.protocol.service import MembershipService
+from rapid_tpu.protocol.view import MembershipView
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import (
+    Endpoint,
+    FastRoundPhase2bMessage,
+    JoinResponse,
+    JoinStatusCode,
+    NodeId,
+    PreJoinMessage,
+    ProbeMessage,
+)
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        async def with_timeout():
+            await asyncio.wait_for(fn(*args, **kwargs), timeout=30)
+
+        asyncio.run(with_timeout())
+
+    return wrapper
+
+
+def make_service(n_members, k=10, h=9, l=4, base_port=40000):
+    """A single MembershipService with a synthetic n-member view
+    (MessagingTest.java:151+'s 1000-node configuration scenario)."""
+    settings = Settings()
+    settings.k, settings.h, settings.l = k, h, l
+    network = InProcessNetwork()
+    my_addr = Endpoint("127.0.0.1", base_port)
+    endpoints = [Endpoint("127.0.0.1", base_port + i) for i in range(n_members)]
+    node_ids = [NodeId(0, i) for i in range(n_members)]
+    view = MembershipView(k, node_ids=node_ids, endpoints=endpoints)
+    service = MembershipService(
+        my_addr=my_addr,
+        cut_detector=MultiNodeCutDetector(k, h, l),
+        view=view,
+        settings=settings,
+        client=InProcessClient(network, my_addr, settings),
+        fd_factory=StaticFailureDetectorFactory(),
+        rng=random.Random(0),
+    )
+    return service, endpoints
+
+
+@async_test
+async def test_prejoin_against_thousand_node_view():
+    service, endpoints = make_service(1000)
+    joiner = Endpoint("127.0.0.1", 50000)
+    response = await service.handle_message(PreJoinMessage(sender=joiner, node_id=NodeId(7, 7)))
+    assert isinstance(response, JoinResponse)
+    assert response.status_code == JoinStatusCode.SAFE_TO_JOIN
+    assert len(response.endpoints) == 10  # K expected observers
+    assert all(ep in endpoints for ep in response.endpoints)
+    # Rejections: hostname present / uuid seen.
+    response = await service.handle_message(
+        PreJoinMessage(sender=endpoints[5], node_id=NodeId(7, 8))
+    )
+    assert response.status_code == JoinStatusCode.HOSTNAME_ALREADY_IN_RING
+    response = await service.handle_message(
+        PreJoinMessage(sender=joiner, node_id=NodeId(0, 123))
+    )
+    assert response.status_code == JoinStatusCode.UUID_ALREADY_IN_RING
+    await service.shutdown()
+
+
+@async_test
+async def test_service_level_fast_round_quorum():
+    # FastPaxosWithoutFallbackTests at the service boundary: hand-built
+    # votes through handle_message decide exactly at N - floor((N-1)/4).
+    n = 102
+    service, endpoints = make_service(n)
+    config_id = service.view.configuration_id
+    victim = endpoints[50]
+    proposal = (victim,)
+    quorum = n - (n - 1) // 4
+    for i in range(quorum - 1):
+        await service.handle_message(
+            FastRoundPhase2bMessage(sender=endpoints[i], configuration_id=config_id,
+                                    endpoints=proposal)
+        )
+        assert service.membership_size == n  # not yet
+    # Note: the decision path calls ring_delete for the victim.
+    await service.handle_message(
+        FastRoundPhase2bMessage(sender=endpoints[quorum - 1], configuration_id=config_id,
+                                endpoints=proposal)
+    )
+    assert service.membership_size == n - 1
+    assert victim not in service.membership
+    await service.shutdown()
+
+
+@async_test
+async def test_client_delayer_latch():
+    # The ClientDelayer fixture (MessageDropInterceptor.java:51-73): messages
+    # of a type are held until the latch opens.
+    network = InProcessNetwork()
+    target_addr = Endpoint("127.0.0.1", 41000)
+    server = InProcessServer(network, target_addr)
+    received = []
+
+    class Recorder:
+        async def handle_message(self, request):
+            received.append(request)
+            from rapid_tpu.types import Response
+
+            return Response()
+
+    server.set_membership_service(Recorder())
+    await server.start()
+
+    client = InProcessClient(network, Endpoint("127.0.0.1", 41001))
+    delayer = ClientDelayer(ProbeMessage)
+    client.delayers.append(delayer)
+
+    probe_task = asyncio.ensure_future(
+        client.send_best_effort(target_addr, ProbeMessage(sender=target_addr))
+    )
+    await asyncio.sleep(0.05)
+    assert received == []  # held by the latch
+    delayer.open()
+    await probe_task
+    assert len(received) == 1
+    await client.shutdown()
+    await server.shutdown()
