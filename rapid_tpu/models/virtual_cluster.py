@@ -31,7 +31,7 @@ from rapid_tpu.models.state import (
     initial_state,
 )
 from rapid_tpu.ops.consensus import tally_candidates
-from rapid_tpu.ops.hashing import masked_set_hash
+from rapid_tpu.ops.hashing import masked_set_hash, mix32
 from rapid_tpu.ops.pallas_kernels import _popcount32, watermark_merge_classify
 from rapid_tpu.ops.rings import endpoint_ring_keys, predecessor_of_keys, ring_topology
 
@@ -236,9 +236,15 @@ def _compute_round(
         n_active = jnp.sum(active, dtype=jnp.int32)
         majority = state.n_members // 2 + 1
 
-        # Rotating coordinator: the (epoch mod n_active)-th active slot.
+        # Pseudo-random coordinator rotation: the real protocol's expovariate
+        # jitter makes successive coordinators effectively random, so a
+        # contiguous run of partitioned slots is escaped in O(1) expected
+        # attempts — sequential rotation would crawl through it.
+        pick = mix32(state.classic_epoch.astype(jnp.uint32) + jnp.uint32(0x5BD1E995))
         target = jnp.where(
-            n_active > 0, state.classic_epoch % jnp.maximum(n_active, 1) + 1, 1
+            n_active > 0,
+            (pick % jnp.maximum(n_active, 1).astype(jnp.uint32)).astype(jnp.int32) + 1,
+            1,
         )
         active_rank = jnp.cumsum(active.astype(jnp.int32))
         coord = jnp.argmax(active & (active_rank == target)).astype(jnp.int32)
@@ -596,11 +602,19 @@ class VirtualCluster:
         inval_obs = np.asarray(state.inval_obs).copy()
         inval_obs[:, slots] = np.asarray(pred)
 
-        # Gatekeepers report all K rings for each joiner; delivery to every
-        # cohort (joins ride the same broadcast path as DOWN alerts).
-        full_mask = np.uint32((1 << self.cfg.k) - 1)
+        # Gatekeepers report all K rings for each joiner, riding the same
+        # broadcast path as DOWN alerts: cohort c only receives ring k's
+        # report if it can hear that ring's gatekeeper (rx-block parity with
+        # the failure-detector alert delivery).
+        pred_np = np.asarray(pred)  # [k, j] gatekeeper slots
+        rx_block = np.asarray(self.faults.rx_block)  # [c, n]
         report_bits = np.asarray(state.report_bits).copy()
-        report_bits[:, slots] = full_mask
+        for c in range(self.cfg.c):
+            heard = ~rx_block[c][pred_np]  # [k, j]
+            bits = np.zeros(len(slots), dtype=np.uint32)
+            for k in range(self.cfg.k):
+                bits |= heard[k].astype(np.uint32) << np.uint32(k)
+            report_bits[c, slots] |= bits
 
         self.state = state._replace(
             join_pending=jnp.asarray(join_pending),

@@ -1,0 +1,110 @@
+"""Soak tests: many consecutive view changes exercise per-configuration state
+resets (cut detector, votes, FD counters, classic acceptor state) across
+epochs — the class of bug that single-view tests can't see."""
+
+import asyncio
+import functools
+import random
+
+import numpy as np
+
+from rapid_tpu.models.virtual_cluster import VirtualCluster
+
+
+def test_engine_churn_soak_ten_epochs():
+    # Alternating crash waves and join waves over 10 configurations; every
+    # epoch must converge and membership accounting must stay exact.
+    n_slots = 640
+    vc = VirtualCluster.create(500, n_slots=n_slots, fd_threshold=2, seed=20)
+    rng = np.random.default_rng(20)
+    expected = 500
+    dead: set = set()
+    next_join = 500
+
+    for epoch in range(10):
+        if epoch % 2 == 0:
+            # Crash 1-2% of current members.
+            alive_slots = np.nonzero(vc.alive_mask)[0]
+            victims = rng.choice(alive_slots, size=max(2, expected // 60), replace=False)
+            vc.crash(victims)
+            dead.update(int(v) for v in victims)
+            expected -= len(victims)
+        else:
+            # Join a small wave into fresh slots.
+            wave = list(range(next_join, min(next_join + 12, n_slots)))
+            if not wave:
+                continue
+            vc.inject_join_wave(wave)
+            next_join += len(wave)
+            expected += len(wave)
+
+        rounds, events = vc.run_until_converged(max_steps=32)
+        assert events is not None, f"epoch {epoch} did not converge"
+        assert vc.config_epoch == epoch + 1
+        assert vc.membership_size == expected, f"epoch {epoch}"
+        alive = vc.alive_mask
+        assert not any(alive[d] for d in dead), "a crashed slot came back"
+
+    # State sanity after 10 epochs: nothing left armed.
+    assert int(vc.state.rounds_undecided) == 0
+    assert not bool(np.asarray(vc.state.announced).any())
+    assert not bool(np.asarray(vc.state.vote_valid).any())
+
+
+def test_host_rejoin_cycles():
+    # A node crashes, is evicted, and rejoins — three times over, with the
+    # same address each time (ClusterTest.java rejoin loops).
+    from rapid_tpu.messaging.inprocess import InProcessNetwork
+    from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
+    from rapid_tpu.protocol.cluster import Cluster
+    from rapid_tpu.settings import Settings
+    from rapid_tpu.types import Endpoint
+
+    async def scenario():
+        settings = Settings()
+        settings.batching_window_ms = 20
+        settings.failure_detector_interval_ms = 50
+        network = InProcessNetwork()
+        fd = StaticFailureDetectorFactory()
+
+        def ep(i):
+            return Endpoint("127.0.0.1", 42100 + i)
+
+        clusters = [await Cluster.start(ep(0), settings=settings, network=network,
+                                        fd_factory=fd, rng=random.Random(0))]
+        for i in range(1, 5):
+            clusters.append(await Cluster.join(ep(0), ep(i), settings=settings,
+                                               network=network, fd_factory=fd,
+                                               rng=random.Random(i)))
+
+        async def converged(cs, size):
+            for _ in range(600):
+                if all(c.membership_size == size for c in cs) and (
+                    len({tuple(c.membership) for c in cs}) == 1
+                ):
+                    return True
+                await asyncio.sleep(0.02)
+            return False
+
+        assert await converged(clusters, 5)
+        bouncer_addr = ep(4)
+        for cycle in range(3):
+            bouncer = next(c for c in clusters if c.listen_address == bouncer_addr)
+            network.blackholed.add(bouncer_addr)
+            fd.add_failed_nodes([bouncer_addr])
+            clusters.remove(bouncer)
+            assert await converged(clusters, 4), f"evict cycle {cycle}"
+            await bouncer.shutdown()
+
+            network.blackholed.discard(bouncer_addr)
+            fd.blacklist.discard(bouncer_addr)
+            rejoined = await Cluster.join(ep(0), bouncer_addr, settings=settings,
+                                          network=network, fd_factory=fd,
+                                          rng=random.Random(100 + cycle))
+            clusters.append(rejoined)
+            assert await converged(clusters, 5), f"rejoin cycle {cycle}"
+
+        for c in clusters:
+            await c.shutdown()
+
+    asyncio.run(asyncio.wait_for(scenario(), timeout=90))
