@@ -106,10 +106,13 @@ class Cluster:
         clock: Optional[Clock] = None,
         rng: Optional[random.Random] = None,
         cut_detector_factory=None,
+        vote_tally_factory=None,
     ) -> "Cluster":
         """Bootstrap a one-node cluster (Cluster.java:255-280).
         ``cut_detector_factory(k, h, l)`` swaps the detector implementation
-        (e.g. rapid_tpu.protocol.device_cut_detector.DeviceCutDetector)."""
+        (e.g. DeviceCutDetector); ``vote_tally_factory(membership_size)``
+        swaps the consensus vote tally (e.g. DeviceVoteTally) — together they
+        put both halves of the protocol hot path on the accelerator."""
         settings = settings if settings is not None else Settings()
         settings.validate()
         client, server = cls._make_transport(listen_address, settings, network, client, server)
@@ -130,6 +133,7 @@ class Cluster:
             subscriptions=subscriptions,
             clock=clock,
             rng=rng,
+            vote_tally_factory=vote_tally_factory,
         )
         server.set_membership_service(service)
         await server.start()
@@ -151,6 +155,7 @@ class Cluster:
         clock: Optional[Clock] = None,
         rng: Optional[random.Random] = None,
         cut_detector_factory=None,
+        vote_tally_factory=None,
     ) -> "Cluster":
         """Two-phase join through ``seed_address`` with retries
         (Cluster.java:303-344)."""
@@ -169,7 +174,7 @@ class Cluster:
                     return await cls._join_attempt(
                         seed_address, listen_address, node_id, settings, client, server,
                         fd_factory, metadata, subscriptions, clock, rng,
-                        cut_detector_factory,
+                        cut_detector_factory, vote_tally_factory,
                     )
                 except JoinPhaseOneError as exc:
                     status = exc.join_response.status_code
@@ -219,6 +224,7 @@ class Cluster:
     async def _join_attempt(
         cls, seed_address, listen_address, node_id, settings, client, server,
         fd_factory, metadata, subscriptions, clock, rng, cut_detector_factory=None,
+        vote_tally_factory=None,
     ) -> "Cluster":
         """One join attempt: phase 1 at the seed, phase 2 at the observers
         (Cluster.java:352-401)."""
@@ -270,6 +276,7 @@ class Cluster:
                 return cls._from_join_response(
                     response, listen_address, settings, client, server,
                     fd_factory, subscriptions, clock, rng, cut_detector_factory,
+                    vote_tally_factory,
                 )
         raise JoinPhaseTwoError()
 
@@ -277,6 +284,7 @@ class Cluster:
     def _from_join_response(
         cls, response: JoinResponse, listen_address, settings, client, server,
         fd_factory, subscriptions, clock, rng, cut_detector_factory=None,
+        vote_tally_factory=None,
     ) -> "Cluster":
         """Build the node from a streamed configuration (Cluster.java:442-474)."""
         assert response.endpoints and response.identifiers
@@ -297,6 +305,7 @@ class Cluster:
             subscriptions=subscriptions,
             clock=clock,
             rng=rng,
+            vote_tally_factory=vote_tally_factory,
         )
         server.set_membership_service(service)
         cluster = cls(listen_address, service, server, client)

@@ -1,0 +1,123 @@
+"""Device-backed Fast Paxos vote tallying for host membership nodes: the vote
+half of the north-star bridge (BASELINE.json — "alerts/votes become
+device-array writes").
+
+A host node coordinating a large configuration replaces ``FastPaxos``'s
+per-vote hash-map counting (FastPaxos.java:53-54, whose own comment says the
+sender set "should be a bitset") with device arrays: each vote is one slot
+write (sender slot -> proposal hash lanes), and the quorum check is the
+``rapid_tpu.ops.consensus.tally_candidates`` kernel over all N slots —
+exactly the tally the virtual-cluster engine runs, now serving the real
+distributed protocol.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from rapid_tpu.ops.consensus import tally_candidates
+from rapid_tpu.types import Endpoint
+from rapid_tpu.utils.xxhash import xxh64
+
+LOG = logging.getLogger(__name__)
+
+Proposal = Tuple[Endpoint, ...]
+
+
+def _proposal_hash_lanes(proposal: Proposal) -> Tuple[int, int]:
+    """64-bit identity of a canonical (ring-0-sorted) endpoint list, split
+    into uint32 lanes (the host analog of the engine's set hashes). The full
+    64-bit running hash seeds every chaining step — truncating it would
+    bottleneck distinct-proposal collisions at 2^-32."""
+    h = 0x243F6A8885A308D3
+    for ep in proposal:
+        h = xxh64(ep.hostname.encode("utf-8"), h)
+        h = xxh64(ep.port.to_bytes(4, "little"), h)
+    return (h >> 32) & 0xFFFFFFFF, h & 0xFFFFFFFF
+
+
+class DeviceVoteTally:
+    """Drop-in vote tally for FastPaxos (see its ``vote_tally`` parameter).
+
+    ``add_vote(sender, proposal)`` records one fast-round vote and returns the
+    decided proposal once the reference's rule holds: total votes >= N - F and
+    votes for one identical proposal >= N - F, F = floor((N-1)/4)
+    (FastPaxos.java:145-150).
+    """
+
+    def __init__(self, membership_size: int, max_slots: int = 4096, max_proposals: int = 32):
+        self.n = membership_size
+        self.max_slots = max(max_slots, membership_size)
+        self.max_proposals = max_proposals
+        self._sender_slot: Dict[Endpoint, int] = {}
+        self._voted: set = set()
+        self._proposal_index: Dict[Tuple[int, int], int] = {}
+        self._proposals: List[Proposal] = []
+        # Persistent device arrays: each vote is one scatter write, never a
+        # full re-upload.
+        self._vote_hi = jnp.zeros(self.max_slots, dtype=jnp.uint32)
+        self._vote_lo = jnp.zeros(self.max_slots, dtype=jnp.uint32)
+        self._vote_valid = jnp.zeros(self.max_slots, dtype=bool)
+        self._cand_hi = jnp.zeros(max_proposals, dtype=jnp.uint32)
+        self._cand_lo = jnp.zeros(max_proposals, dtype=jnp.uint32)
+        self._cand_valid = jnp.zeros(max_proposals, dtype=bool)
+
+    def add_vote(self, sender: Endpoint, proposal: Proposal) -> Optional[Proposal]:
+        from rapid_tpu.protocol.fast_paxos import fast_paxos_quorum
+
+        if sender in self._voted:
+            return None  # duplicate sender (FastPaxos.java:134-136)
+        slot = self._sender_slot.get(sender)
+        if slot is None:
+            slot = len(self._sender_slot)
+            if slot >= self.max_slots:
+                LOG.warning(
+                    "DeviceVoteTally slot capacity %d exhausted; dropping vote", self.max_slots
+                )
+                return None
+            self._sender_slot[sender] = slot
+
+        lanes = _proposal_hash_lanes(proposal)
+        cand = self._proposal_index.get(lanes)
+        if cand is None:
+            cand = len(self._proposals)
+            if cand >= self.max_proposals:
+                LOG.warning(
+                    "DeviceVoteTally proposal capacity %d exhausted; dropping vote",
+                    self.max_proposals,
+                )
+                return None
+            self._proposal_index[lanes] = cand
+            self._proposals.append(tuple(proposal))
+            self._cand_hi = self._cand_hi.at[cand].set(lanes[0])
+            self._cand_lo = self._cand_lo.at[cand].set(lanes[1])
+            self._cand_valid = self._cand_valid.at[cand].set(True)
+
+        # The device-array write: one slot per sender.
+        self._voted.add(sender)
+        self._vote_hi = self._vote_hi.at[slot].set(lanes[0])
+        self._vote_lo = self._vote_lo.at[slot].set(lanes[1])
+        self._vote_valid = self._vote_valid.at[slot].set(True)
+
+        # No decision is possible before quorum-many votes exist; skip the
+        # tally kernel (and its device->host readback) until then.
+        if len(self._voted) < fast_paxos_quorum(self.n):
+            return None
+
+        result = tally_candidates(
+            self._vote_hi,
+            self._vote_lo,
+            self._vote_valid,
+            self._cand_hi,
+            self._cand_lo,
+            self._cand_valid,
+            jnp.int32(self.n),
+        )
+        if not bool(result.decided):
+            return None
+        winner = (int(result.winner_hi), int(result.winner_lo))
+        return self._proposals[self._proposal_index[winner]]

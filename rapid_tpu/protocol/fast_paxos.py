@@ -52,6 +52,7 @@ class FastPaxos:
         clock: Clock,
         consensus_fallback_base_delay_ms: int = BASE_DELAY_MS,
         rng: Optional[random.Random] = None,
+        vote_tally=None,
     ) -> None:
         self.my_addr = my_addr
         self.configuration_id = configuration_id
@@ -60,6 +61,10 @@ class FastPaxos:
         self._clock = clock
         self._base_delay_ms = consensus_fallback_base_delay_ms
         self._rng = rng if rng is not None else random.Random()
+        # Pluggable tally: None = host hash-map counting; a DeviceVoteTally
+        # turns each vote into a device-array write with the quorum check on
+        # the accelerator (rapid_tpu.protocol.device_vote_tally).
+        self._vote_tally = vote_tally
         self._votes_per_proposal: Dict[Tuple[Endpoint, ...], int] = {}
         self._votes_received: Set[Endpoint] = set()
         self.decided = False
@@ -118,12 +123,17 @@ class FastPaxos:
         """FastPaxos.java:125-156."""
         if msg.configuration_id != self.configuration_id:
             return
-        if msg.sender in self._votes_received:
-            return
         if self.decided:
             return
-        self._votes_received.add(msg.sender)
         proposal = tuple(msg.endpoints)
+        if self._vote_tally is not None:
+            winner = self._vote_tally.add_vote(msg.sender, proposal)
+            if winner is not None:
+                self._on_decide(winner)
+            return
+        if msg.sender in self._votes_received:
+            return
+        self._votes_received.add(msg.sender)
         count = self._votes_per_proposal.get(proposal, 0) + 1
         self._votes_per_proposal[proposal] = count
         quorum = fast_paxos_quorum(self.n)

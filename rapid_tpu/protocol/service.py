@@ -78,6 +78,7 @@ class MembershipService:
         clock: Optional[Clock] = None,
         broadcaster: Optional[Broadcaster] = None,
         rng: Optional[random.Random] = None,
+        vote_tally_factory=None,
     ) -> None:
         self.my_addr = my_addr
         self.settings = settings
@@ -93,6 +94,9 @@ class MembershipService:
         self.broadcaster = (
             broadcaster if broadcaster is not None else UnicastToAllBroadcaster(client, self.rng)
         )
+        # vote_tally_factory(membership_size) -> tally object, re-created per
+        # configuration (e.g. rapid_tpu.protocol.device_vote_tally.DeviceVoteTally).
+        self._vote_tally_factory = vote_tally_factory
         self.subscriptions: Dict[ClusterEvents, List] = {event: [] for event in ClusterEvents}
         if subscriptions:
             for event, callbacks in subscriptions.items():
@@ -367,6 +371,11 @@ class MembershipService:
         self._respond_to_joiners(proposal)
 
     def _new_fast_paxos(self) -> FastPaxos:
+        vote_tally = (
+            self._vote_tally_factory(self.view.membership_size)
+            if self._vote_tally_factory is not None
+            else None
+        )
         return FastPaxos(
             my_addr=self.my_addr,
             configuration_id=self.view.configuration_id,
@@ -377,6 +386,7 @@ class MembershipService:
             clock=self.clock,
             consensus_fallback_base_delay_ms=self.settings.consensus_fallback_base_delay_ms,
             rng=self.rng,
+            vote_tally=vote_tally,
         )
 
     def _respond_to_joiners(self, proposal: Tuple[Endpoint, ...]) -> None:

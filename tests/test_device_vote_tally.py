@@ -1,0 +1,133 @@
+"""DeviceVoteTally: quorum behavior equivalent to the host hash-map tally,
+plus FastPaxos running with the device tally plugged in."""
+
+import random
+
+import pytest
+
+from rapid_tpu.protocol.device_vote_tally import DeviceVoteTally
+from rapid_tpu.protocol.fast_paxos import FastPaxos, fast_paxos_quorum
+from rapid_tpu.types import Endpoint, FastRoundPhase2bMessage
+from rapid_tpu.utils.clock import ManualClock
+
+
+def ep(i: int) -> Endpoint:
+    return Endpoint("127.0.0.1", i)
+
+
+@pytest.mark.parametrize("n", [5, 6, 10, 20, 102])
+def test_decides_exactly_at_quorum(n):
+    tally = DeviceVoteTally(n)
+    quorum = fast_paxos_quorum(n)
+    proposal = (ep(9999), ep(8888))
+    for i in range(quorum - 1):
+        assert tally.add_vote(ep(100 + i), proposal) is None
+    assert tally.add_vote(ep(100 + quorum - 1), proposal) == proposal
+
+
+def test_conflicting_votes_block_and_dedup():
+    n = 10
+    tally = DeviceVoteTally(n)
+    quorum = fast_paxos_quorum(n)  # 8
+    va, vb = (ep(1),), (ep(2),)
+    # 3 conflicting votes: only 7 identical votes remain possible.
+    for i in range(3):
+        assert tally.add_vote(ep(200 + i), vb) is None
+    for i in range(n - 3):
+        assert tally.add_vote(ep(300 + i), va) is None
+    # Duplicate senders never double-count.
+    assert tally.add_vote(ep(300), va) is None
+
+
+def test_fast_paxos_with_device_tally():
+    n = 8
+    decided = []
+    fp = FastPaxos(
+        my_addr=ep(0),
+        configuration_id=1,
+        membership_size=n,
+        broadcast_fn=lambda r: None,
+        send_fn=lambda d, r: None,
+        on_decide=lambda hosts: decided.append(tuple(hosts)),
+        clock=ManualClock(),
+        rng=random.Random(0),
+        vote_tally=DeviceVoteTally(n),
+    )
+    proposal = (ep(7777),)
+    quorum = fast_paxos_quorum(n)
+    for i in range(quorum - 1):
+        fp.handle_message(
+            FastRoundPhase2bMessage(sender=ep(100 + i), configuration_id=1, endpoints=proposal)
+        )
+    assert decided == []
+    fp.handle_message(
+        FastRoundPhase2bMessage(sender=ep(100 + quorum - 1), configuration_id=1,
+                                endpoints=proposal)
+    )
+    assert decided == [proposal]
+    # Further votes after the decision are ignored (decided latch).
+    fp.handle_message(
+        FastRoundPhase2bMessage(sender=ep(999), configuration_id=1, endpoints=proposal)
+    )
+    assert decided == [proposal]
+
+
+def test_cluster_with_device_tally_and_detector():
+    # The full north-star bridge: host nodes whose cut detection AND vote
+    # tallies both run as device-kernel calls.
+    import asyncio
+
+    from rapid_tpu.messaging.inprocess import InProcessNetwork
+    from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
+    from rapid_tpu.protocol.cluster import Cluster
+    from rapid_tpu.protocol.device_cut_detector import DeviceCutDetector
+    from rapid_tpu.settings import Settings
+
+    async def scenario():
+        settings = Settings()
+        settings.batching_window_ms = 20
+        settings.failure_detector_interval_ms = 50
+        network = InProcessNetwork()
+        fd = StaticFailureDetectorFactory()
+
+        def ep_(i):
+            return Endpoint("127.0.0.1", 43200 + i)
+
+        def detector_factory(k, h, l):
+            return DeviceCutDetector(k, h, l, max_slots=64)
+
+        def tally_factory(membership_size):
+            return DeviceVoteTally(membership_size)
+
+        clusters = [
+            await Cluster.start(ep_(0), settings=settings, network=network, fd_factory=fd,
+                                rng=random.Random(0), cut_detector_factory=detector_factory,
+                                vote_tally_factory=tally_factory)
+        ]
+        for i in range(1, 5):
+            clusters.append(
+                await Cluster.join(ep_(0), ep_(i), settings=settings, network=network,
+                                   fd_factory=fd, rng=random.Random(i),
+                                   cut_detector_factory=detector_factory,
+                                   vote_tally_factory=tally_factory)
+            )
+
+        async def converged(cs, size):
+            for _ in range(600):
+                if all(c.membership_size == size for c in cs) and (
+                    len({tuple(c.membership) for c in cs}) == 1
+                ):
+                    return True
+                await asyncio.sleep(0.02)
+            return False
+
+        assert await converged(clusters, 5)
+        victim = clusters[3]
+        network.blackholed.add(victim.listen_address)
+        fd.add_failed_nodes([victim.listen_address])
+        survivors = [c for c in clusters if c is not victim]
+        assert await converged(survivors, 4)
+        for c in clusters:
+            await c.shutdown()
+
+    asyncio.run(asyncio.wait_for(scenario(), timeout=60))
