@@ -17,7 +17,6 @@ import logging
 from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
-import numpy as np
 
 from rapid_tpu.ops.consensus import tally_candidates
 from rapid_tpu.types import Endpoint

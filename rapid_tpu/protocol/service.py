@@ -30,7 +30,6 @@ from rapid_tpu.settings import Settings
 from rapid_tpu.types import (
     AlertMessage,
     BatchedAlertMessage,
-    ConsensusResponse,
     EdgeStatus,
     Endpoint,
     FastRoundPhase2bMessage,

@@ -3,7 +3,6 @@ resets (cut detector, votes, FD counters, classic acceptor state) across
 epochs — the class of bug that single-view tests can't see."""
 
 import asyncio
-import functools
 import random
 
 import numpy as np
