@@ -15,12 +15,53 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 
+def _ensure_responsive_backend() -> None:
+    """The axon tunnel backend can wedge such that ``jax.devices()`` blocks
+    forever (observed after killed mid-compile sessions). Probe device init in
+    a subprocess with a timeout; if it hangs or fails, re-exec on CPU so the
+    bench always emits its JSON line instead of hanging the driver.
+
+    Cost on a healthy backend: one extra device init (a few seconds), paid
+    once per bench invocation — cheap insurance against an unbounded hang.
+    Skip with RAPID_TPU_BENCH_NO_PROBE=1."""
+    if os.environ.get("RAPID_TPU_BENCH_NO_PROBE") or os.environ.get("JAX_PLATFORMS") == "cpu":
+        return
+    detail = "probe timed out"
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=180,
+            capture_output=True,
+        )
+        if probe.returncode == 0:
+            return
+        # Surface the real diagnostic: a nonzero exit is a misconfigured
+        # backend (missing/broken driver), not a wedge.
+        detail = probe.stderr.decode(errors="replace")[-800:]
+    except subprocess.TimeoutExpired:
+        pass
+    print(
+        f"bench: accelerator backend unresponsive; falling back to CPU ({detail})",
+        file=sys.stderr,
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAPID_TPU_BENCH_NO_PROBE"] = "1"
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
 def main() -> None:
+    _ensure_responsive_backend()
     import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # sitecustomize imported jax before us; env alone is too late.
+        jax.config.update("jax_platforms", "cpu")
     import numpy as np
 
     from rapid_tpu.utils._native import ensure_built
