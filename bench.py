@@ -32,19 +32,35 @@ def _ensure_responsive_backend() -> None:
     if os.environ.get("RAPID_TPU_BENCH_NO_PROBE") or os.environ.get("JAX_PLATFORMS") == "cpu":
         return
     detail = "probe timed out"
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=180,
-            capture_output=True,
-        )
-        if probe.returncode == 0:
-            return
-        # Surface the real diagnostic: a nonzero exit is a misconfigured
-        # backend (missing/broken driver), not a wedge.
-        detail = probe.stderr.decode(errors="replace")[-800:]
-    except subprocess.TimeoutExpired:
-        pass
+    # Manual poll loop instead of subprocess.run: run()'s TimeoutExpired path
+    # does kill()+wait() with no bound, and a child wedged in an
+    # uninterruptible driver call (the exact failure this guards against)
+    # survives SIGKILL — the reap must be abandonable.
+    probe = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+    )
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        code = probe.poll()
+        if code is not None:
+            if code == 0:
+                return
+            # Surface the real diagnostic: a nonzero exit is a misconfigured
+            # backend (missing/broken driver), not a wedge.
+            try:
+                detail = (probe.stderr.read() or b"").decode(errors="replace")[-800:]
+            except Exception:  # noqa: BLE001 — diagnostics are best-effort
+                pass
+            break
+        time.sleep(1)
+    else:
+        probe.kill()
+        try:
+            probe.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass  # unreapable (D-state) child: abandon it, fall back anyway
     print(
         f"bench: accelerator backend unresponsive; falling back to CPU ({detail})",
         file=sys.stderr,

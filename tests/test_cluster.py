@@ -325,6 +325,42 @@ async def test_view_change_subscription_sees_joiner_delta():
 
 
 @async_test
+async def test_proposal_event_precedes_view_change():
+    # SubscriptionsTest parity: VIEW_CHANGE_PROPOSAL fires when the cut is
+    # announced (pre-consensus, MembershipService.java:337-345), before the
+    # VIEW_CHANGE for the same delta, and carries the same endpoints.
+    network = InProcessNetwork()
+    settings = fast_settings()
+    fd = StaticFailureDetectorFactory()
+    seed = await Cluster.start(ep(0), settings=settings, network=network, fd_factory=fd)
+    events = []
+    seed.register_subscription(
+        ClusterEvents.VIEW_CHANGE_PROPOSAL, lambda c: events.append(("proposal", c))
+    )
+    seed.register_subscription(
+        ClusterEvents.VIEW_CHANGE, lambda c: events.append(("view_change", c))
+    )
+    joiner = await Cluster.join(ep(0), ep(1), settings=settings, network=network,
+                                fd_factory=fd)
+    clusters = [seed, joiner]
+    try:
+        assert await wait_until(lambda: len(events) >= 2)
+        kinds = [kind for kind, _ in events]
+        assert kinds.index("proposal") < kinds.index("view_change")
+        proposal_change = next(c for kind, c in events if kind == "proposal")
+        view_change = next(c for kind, c in events if kind == "view_change")
+        assert {sc.endpoint for sc in proposal_change.status_changes} == {ep(1)}
+        assert {sc.endpoint for sc in view_change.status_changes} == {ep(1)}
+        # The proposal event reports the OLD configuration (pre-change), the
+        # view change the NEW one.
+        assert proposal_change.configuration_id != view_change.configuration_id
+        assert ep(1) not in proposal_change.membership
+        assert ep(1) in view_change.membership
+    finally:
+        await shutdown_all(clusters)
+
+
+@async_test
 async def test_down_notification_carries_metadata():
     # SubscriptionsTest.java:170-243: DOWN deltas must carry the failed
     # node's metadata so applications can act on its role.
