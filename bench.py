@@ -77,7 +77,9 @@ def main() -> None:
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         # sitecustomize imported jax before us; env alone is too late.
-        jax.config.update("jax_platforms", "cpu")
+        from rapid_tpu.utils.platform import force_platform
+
+        force_platform("cpu")
     import numpy as np
 
     from rapid_tpu.utils._native import ensure_built

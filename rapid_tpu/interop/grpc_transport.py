@@ -2,10 +2,17 @@
 
 Implements the messaging SPI over the exact RPC the reference serves —
 ``remoting.MembershipService/sendRequest`` (rapid.proto:9-11) with
-protobuf-encoded ``RapidRequest``/``RapidResponse`` envelopes — so a node
-running this framework can, in principle, sit in a cluster with the Java
-reference. Built on grpc.aio with a generic method handler (no generated
-stubs; the schema is materialized at runtime, rapid_tpu.interop.proto_schema).
+protobuf-encoded ``RapidRequest``/``RapidResponse`` envelopes. Compatibility
+is at the RPC/wire layer only: mixed Java/Python clusters are a NON-GOAL,
+because the two implementations order rings differently (our ``ring_key``
+hashes the port as 8 bytes and sorts identifiers unsigned; the reference
+hashes 4-byte ints and uses a signed NodeId comparator,
+``MembershipView.java:579-587``), so configuration ids and observer sets
+would diverge immediately and each side would filter the other's alerts.
+What this transport buys is the reference's operational surface — gRPC
+tooling, interceptors, proxies — for homogeneous rapid_tpu clusters. Built
+on grpc.aio with a generic method handler (no generated stubs; the schema is
+materialized at runtime, rapid_tpu.interop.proto_schema).
 """
 
 from __future__ import annotations

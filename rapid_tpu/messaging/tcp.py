@@ -66,6 +66,10 @@ class TcpServer(MessagingServer):
         self._service = None
         self._server: Optional[asyncio.AbstractServer] = None
         self._connections: set = set()
+        # Strong references to in-flight handlers: the event loop only holds
+        # tasks weakly, so without this a handler can be garbage-collected
+        # mid-flight and the request silently dropped.
+        self._handler_tasks: set = set()
 
     def set_membership_service(self, service) -> None:
         self._service = service
@@ -79,9 +83,16 @@ class TcpServer(MessagingServer):
         if self._server is not None:
             self._server.close()
             # Close live connections first: wait_closed() blocks until every
-            # per-connection handler returns.
+            # per-connection reader loop returns.
             for writer in list(self._connections):
                 writer.close()
+            # Reader loops spawn handlers as separate tasks; those must not
+            # outlive shutdown (they would write to closed writers and leak
+            # "Task was destroyed but it is pending" at loop close).
+            for task in list(self._handler_tasks):
+                task.cancel()
+            if self._handler_tasks:
+                await asyncio.gather(*self._handler_tasks, return_exceptions=True)
             await self._server.wait_closed()
             self._server = None
 
@@ -94,9 +105,11 @@ class TcpServer(MessagingServer):
                 correlation_id, kind, payload = await _read_frame(reader)
                 if kind != 0:
                     raise ConnectionError("client sent non-request frame")
-                asyncio.ensure_future(
+                task = asyncio.ensure_future(
                     self._handle_one(correlation_id, payload, writer)
                 )
+                self._handler_tasks.add(task)
+                task.add_done_callback(self._handler_tasks.discard)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:

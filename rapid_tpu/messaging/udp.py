@@ -10,8 +10,14 @@ the reliable TCP path. Both listeners share the endpoint's port.
 
 Protocol safety: everything sent over UDP is already best-effort in the
 protocol (alert redelivery via further FD ticks; consensus tolerates lost
-votes via the fallback), so datagram loss degrades latency, never
-correctness.
+votes via the fallback), so datagram loss normally costs latency, not
+correctness. The known exception is a lost UP alert whose decision still
+arrives via consensus: the receiver then lacks the joiner's UUID and cannot
+apply the view. The membership service detects that case before mutating
+anything and recovers by rejoining (``service._recover_from_unknown_joiners``)
+rather than corrupting its view — so the failure mode is a forced rejoin,
+not an inconsistency, but it is a real availability cost this transport
+widens relative to TCP-only alert delivery.
 """
 
 from __future__ import annotations

@@ -321,6 +321,22 @@ class MembershipService:
             self.my_addr, self.view.configuration_id, self.view.membership_size,
             [str(p) for p in proposal],
         )
+        # Validate BEFORE mutating anything: alert broadcasts are best-effort
+        # (single-attempt, and the UDP hybrid transport ships them as
+        # droppable datagrams), so a decision can name a joiner whose UP alert
+        # we never saw — leaving us without its UUID. Applying a partial view
+        # would fork this node from the cluster; applying half a view and
+        # raising mid-loop (the reference NPEs here,
+        # MembershipService.java:401-404) would strand it with no failure
+        # detectors. Apply nothing and recover instead.
+        missing = [
+            node
+            for node in proposal
+            if not self.view.is_host_present(node) and node not in self._joiner_uuid
+        ]
+        if missing:
+            self._recover_from_unknown_joiners(missing)
+            return
         self._cancel_failure_detectors()
 
         status_changes: List[NodeStatusChange] = []
@@ -368,6 +384,30 @@ class MembershipService:
             self._notify(ClusterEvents.KICKED, change)
 
         self._respond_to_joiners(proposal)
+
+    def _recover_from_unknown_joiners(self, missing: List[Endpoint]) -> None:
+        """The cluster decided a view containing joiners we know nothing
+        about; the rest of the cluster will apply it, so our configuration is
+        now permanently stale. Stop participating and signal ``KICKED`` so the
+        application layer performs the standard stale-node recovery: rejoin
+        with a fresh identity (same path as an eviction)."""
+        LOG.error(
+            "%s cannot apply view change in config %d: no UUID recorded for "
+            "joiner(s) %s; signalling KICKED for rejoin",
+            self.my_addr,
+            self.view.configuration_id,
+            [str(n) for n in missing],
+        )
+        self.metrics.inc("decision_missing_joiner_uuid")
+        self._cancel_failure_detectors()
+        self._notify(
+            ClusterEvents.KICKED,
+            ClusterStatusChange(
+                configuration_id=self.view.configuration_id,
+                membership=tuple(self.view.ring(0)),
+                status_changes=(),
+            ),
+        )
 
     def _new_fast_paxos(self) -> FastPaxos:
         vote_tally = (

@@ -1,0 +1,53 @@
+"""Force the live JAX platform despite eager sitecustomize imports.
+
+This build environment reaches its TPU through the experimental ``axon``
+plugin: a ``sitecustomize`` module imports jax at interpreter startup, so by
+the time user code runs, ``jax.config`` has already captured whatever
+``JAX_PLATFORMS`` said at process start. Setting the environment variable
+afterwards does nothing; the live config must be updated explicitly, and it
+must happen before the first backend initialization.
+
+One helper, one behavior — used by ``tests/conftest.py``, ``bench.py``, and
+``__graft_entry__.py`` so a platform-selection fix lands everywhere at once.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+_COUNT_FLAG = "xla_force_host_platform_device_count"
+
+
+def force_platform(platform: str, n_host_devices: int | None = None) -> bool:
+    """Point the live jax config at ``platform`` before any backend exists.
+
+    ``n_host_devices`` (CPU only) requests that many virtual host devices via
+    ``XLA_FLAGS``; the flag is read lazily at first backend initialization, so
+    setting it post-import still works. Returns True when the config update
+    succeeded; on failure (a backend is already live) a warning is printed and
+    the caller should verify ``jax.devices()[0].platform`` before trusting the
+    process.
+    """
+    os.environ["JAX_PLATFORMS"] = platform
+    if n_host_devices is not None and platform == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if _COUNT_FLAG in flags:
+            # Replace a conflicting count rather than silently keeping it
+            # (e.g. inherited --...count=8 when the caller asked for 16).
+            flags = re.sub(rf"--{_COUNT_FLAG}=\d+", f"--{_COUNT_FLAG}={n_host_devices}", flags)
+            os.environ["XLA_FLAGS"] = flags
+        else:
+            os.environ["XLA_FLAGS"] = f"{flags} --{_COUNT_FLAG}={n_host_devices}".strip()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", platform)
+        return True
+    except Exception as exc:  # pragma: no cover - only with a live backend
+        print(
+            f"rapid_tpu: could not force jax platform {platform!r}: {exc}",
+            file=sys.stderr,
+        )
+        return False

@@ -5,21 +5,14 @@ without TPU hardware (the driver separately dry-runs the multichip path).
 Environment must be set before jax is first imported.
 """
 
-import os
-
 # Force (override) CPU: the global environment pins JAX_PLATFORMS=axon (the
 # real TPU tunnel), which tests must not depend on.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+from rapid_tpu.utils.platform import force_platform
 
-# sitecustomize.py (from /root/.axon_site on PYTHONPATH) imports jax at
-# interpreter startup, so jax.config captured JAX_PLATFORMS=axon before this
-# file ran; override the live config too.
-import jax
-
-jax.config.update("jax_platforms", "cpu")
+assert force_platform("cpu", n_host_devices=8), (
+    "could not force the CPU platform: a jax backend was initialized before "
+    "tests/conftest.py ran; tests must not touch the axon tunnel"
+)
 
 
 # Build the native host library once per test session (load-only at runtime).

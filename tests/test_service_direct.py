@@ -109,6 +109,39 @@ async def test_service_level_fast_round_quorum():
 
 
 @async_test
+async def test_decision_with_unknown_joiner_triggers_rejoin_not_corruption():
+    # A consensus decision can name a joiner whose UP alert this node never
+    # received (alert broadcasts are best-effort; the UDP transport ships
+    # them as droppable datagrams). The service must apply NOTHING and signal
+    # KICKED for rejoin — not KeyError mid-mutation (the reference NPEs,
+    # MembershipService.java:401-404).
+    from rapid_tpu.protocol.events import ClusterEvents
+
+    n = 20
+    service, endpoints = make_service(n)
+    config_id = service.view.configuration_id
+    unknown_joiner = Endpoint("127.0.0.1", 59999)  # no UP alert ever seen
+    proposal = (unknown_joiner,)
+    kicked = []
+    service.register_subscription(ClusterEvents.KICKED, kicked.append)
+    quorum = n - (n - 1) // 4
+    for i in range(quorum):
+        await service.handle_message(
+            FastRoundPhase2bMessage(sender=endpoints[i], configuration_id=config_id,
+                                    endpoints=proposal)
+        )
+    # View untouched: same config, same size, joiner absent.
+    assert service.membership_size == n
+    assert unknown_joiner not in service.membership
+    assert service.view.configuration_id == config_id
+    # Recovery signalled with the stale configuration's details.
+    assert len(kicked) == 1
+    assert kicked[0].configuration_id == config_id
+    assert service.metrics.counters["decision_missing_joiner_uuid"] == 1
+    await service.shutdown()
+
+
+@async_test
 async def test_client_delayer_latch():
     # The ClientDelayer fixture (MessageDropInterceptor.java:51-73): messages
     # of a type are held until the latch opens.
