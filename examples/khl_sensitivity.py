@@ -7,12 +7,15 @@ H in {6..9}, L in {1..4}, F concurrent failures in {2,4,8,16}: ~2% conflicts
 at H-L=5 with F=2, improving ~4x per extra watermark gap.
 
 This reproduces the experiment on the TPU engine: F crashed members,
-per-edge detection jitter (staggered failure detectors), and receiver cohorts
-with randomized one-way delivery loss. A run conflicts when the fast round's
-decision shows dissenting votes (total voters > max identical votes) or the
-classic fallback had to fire.
+per-edge detection jitter (staggered failure detectors), and 64 (default)
+independently-diverging receiver cohorts — each with its own per-edge
+delivery-delay draw (``delivery_spread``; optional one-way loss via
+``loss``) — the sampled analog of the reference's N independent per-node
+cut detectors (MultiNodeCutDetector.java:31-37). A run conflicts when more
+than one distinct cut proposal was announced (the paper's metric) or no
+decision landed within the round budget.
 
-Usage: python examples/khl_sensitivity.py [--n 1000] [--reps 10]
+Usage: python examples/khl_sensitivity.py [--n 1000] [--reps 10] [--cohorts 64]
 """
 
 from __future__ import annotations
@@ -26,31 +29,46 @@ import numpy as np
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
-def run_once(n, k, h, l, f, cohorts, seed) -> tuple:
+def run_once(n, k, h, l, f, cohorts, seed, delivery_spread=1, stagger=1, loss=0.0) -> tuple:
     from rapid_tpu.models.virtual_cluster import VirtualCluster
 
     rng = np.random.default_rng(seed)
     vc = VirtualCluster.create(
-        n, k=k, h=h, l=l, cohorts=cohorts, fd_threshold=2, seed=seed
+        n, k=k, h=h, l=l, cohorts=cohorts, fd_threshold=2, seed=seed,
+        delivery_spread=delivery_spread,
     )
-    # Receivers split into cohorts; each non-primary cohort misses alerts from
-    # a random 2% of sources (one-way loss).
+    # Receivers split into cohorts; every cohort gets an independent
+    # per-edge delivery-delay draw (delivery_spread). The paper's Fig. 11
+    # simulation models pure timing divergence, so one-way loss defaults to
+    # 0; pass loss > 0 to additionally blind each non-primary cohort to a
+    # random fraction of sources.
     cohort_of = rng.integers(0, cohorts, size=n).astype(np.int32)
     vc.assign_cohorts(cohort_of)
-    rx_block = np.zeros((cohorts, vc.cfg.n), dtype=bool)
-    for c in range(1, cohorts):
-        rx_block[c] = rng.random(vc.cfg.n) < 0.02
-    vc.set_rx_block(rx_block)
+    if loss > 0:
+        rx_block = np.zeros((cohorts, vc.cfg.n), dtype=bool)
+        for c in range(1, cohorts):
+            rx_block[c] = rng.random(vc.cfg.n) < loss
+        vc.set_rx_block(rx_block)
 
     victims = rng.choice(n, size=f, replace=False)
     vc.crash(victims)
-    vc.stagger_fd_counts(rng, spread_rounds=6)
+    vc.stagger_fd_counts(rng, spread_rounds=stagger)
 
+    proposals = set()
     for round_idx in range(64):
         events = vc.step()
+        announced = np.asarray(events.proposals_announced)
+        if announced.any():
+            # Read the hashes from the EVENTS (pre-view-change capture): on a
+            # deciding round, vc.state.prop_* is already reset to zeros.
+            hi = np.asarray(events.prop_hi)
+            lo = np.asarray(events.prop_lo)
+            for ci in np.nonzero(announced)[0]:
+                proposals.add((int(hi[ci]), int(lo[ci])))
         if bool(events.decided):
-            conflict = int(events.total_votes) > int(events.max_votes)
-            return conflict, round_idx + 1
+            # The paper's metric: did receivers PROPOSE different cuts?
+            # (Fig. 11 counts conflicting proposals, not vote dissent.)
+            return len(proposals) > 1, round_idx + 1
     return True, 64  # no decision within budget counts as conflicted
 
 
@@ -58,8 +76,25 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--n", type=int, default=1000)
     parser.add_argument("--reps", type=int, default=10)
-    parser.add_argument("--cohorts", type=int, default=4)
+    parser.add_argument("--cohorts", type=int, default=64)
+    parser.add_argument("--delivery-spread", type=int, default=1,
+                        help="max extra rounds of per-(cohort, edge) delivery delay")
+    parser.add_argument("--stagger", type=int, default=1,
+                        help="max rounds of per-edge detection jitter")
+    parser.add_argument("--loss", type=float, default=0.0,
+                        help="one-way loss fraction per non-primary cohort (paper sim: 0)")
+    parser.add_argument(
+        "--platform",
+        default="cpu",
+        help="jax platform (default cpu: the sweep is small, and the forced "
+        "override avoids wedging on a dead accelerator tunnel; pass the "
+        "accelerator platform explicitly to run there)",
+    )
     args = parser.parse_args()
+
+    from rapid_tpu.utils.platform import force_platform
+
+    force_platform(args.platform)
 
     k = 10
     print(f"N={args.n}, K={k}, cohorts={args.cohorts}, reps={args.reps}")
@@ -72,7 +107,11 @@ def main() -> None:
                 conflicts, rounds_sum = 0, 0
                 for rep in range(args.reps):
                     conflict, rounds = run_once(
-                        args.n, k, h, l, f, args.cohorts, seed=hash((h, l, f, rep)) % 2**31
+                        args.n, k, h, l, f, args.cohorts,
+                        seed=hash((h, l, f, rep)) % 2**31,
+                        delivery_spread=args.delivery_spread,
+                        stagger=args.stagger,
+                        loss=args.loss,
                     )
                     conflicts += int(conflict)
                     rounds_sum += rounds

@@ -86,14 +86,17 @@ class TcpServer(MessagingServer):
             # per-connection reader loop returns.
             for writer in list(self._connections):
                 writer.close()
-            # Reader loops spawn handlers as separate tasks; those must not
+            await self._server.wait_closed()
+            # Reader loops are done now, so no NEW handler tasks can appear
+            # (cancelling before wait_closed would race buffered frames
+            # spawning fresh handlers). Reap the stragglers: they must not
             # outlive shutdown (they would write to closed writers and leak
             # "Task was destroyed but it is pending" at loop close).
-            for task in list(self._handler_tasks):
-                task.cancel()
             if self._handler_tasks:
-                await asyncio.gather(*self._handler_tasks, return_exceptions=True)
-            await self._server.wait_closed()
+                tasks = list(self._handler_tasks)
+                for task in tasks:
+                    task.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
             self._server = None
 
     async def _on_connection(

@@ -5,11 +5,16 @@ architecture: one ``EngineState`` pytree holds every virtual node's protocol
 state in padded device arrays (static shapes; membership changes flip bits in
 ``alive``), so a whole cluster's protocol round is a single fused XLA program.
 
-Cohorts: receivers with identical connectivity share cut-detector state. In a
-reliably-delivered co-located deployment all healthy nodes see the same alert
-stream, so their detectors are bit-identical — cohort 0. Fault injection that
-partitions receivers (asymmetric/one-way links) assigns affected nodes to
-further cohorts; C stays tiny while N scales to 100K+.
+Cohorts: receivers with identical delivery experience share cut-detector
+state. In a reliably-delivered co-located deployment all healthy nodes see
+the same alert stream, so their detectors are bit-identical — cohort 0.
+Divergence comes from two injectable sources: per-cohort rx-block masks
+(asymmetric/one-way links) and per-(cohort, edge) delivery delay jitter
+(``EngineConfig.delivery_spread`` — broadcast arrival skew, the paper's
+Fig. 11 divergence regime). Delivery masks pack bitwise over cohorts
+(uint32 words), so C scales to hundreds of independently-diverging receiver
+states at N=100K+ (the reference's N independent ``MultiNodeCutDetector``
+instances, ``MultiNodeCutDetector.java:31-37``, sampled at C of them).
 """
 
 from __future__ import annotations
@@ -20,6 +25,10 @@ import jax.numpy as jnp
 
 from rapid_tpu.ops.hashing import masked_set_hash
 from rapid_tpu.ops.rings import ring_topology
+
+# Sentinel for "this edge's alert has not fired": far enough in the future
+# that (round_idx - FIRE_NEVER) stays hugely negative in int32.
+FIRE_NEVER = 1 << 30
 
 
 class EngineConfig(NamedTuple):
@@ -38,6 +47,19 @@ class EngineConfig(NamedTuple):
     # fallback fires (models FastPaxos.java:106-107's jittered recovery; the
     # coordinator rule then forces the plurality value, Paxos.java:271-328).
     fallback_rounds: int = 8
+    # Max extra rounds of per-(cohort, edge) alert delivery delay, drawn
+    # deterministically from a hash of (cohort, edge, configuration). 0 =
+    # same-round delivery for every cohort (no timing divergence). This is
+    # the engine's model of broadcast arrival skew — the reason real
+    # receivers' cut detectors diverge (paper Fig. 11).
+    delivery_spread: int = 0
+    # Coordinators racing per classic-fallback attempt. The reference lets
+    # any number of nodes start recovery concurrently, ordered by rank
+    # (Paxos.java:93-97, 333-339); modeling R > 1 exercises that contention:
+    # acceptors promise to every heard rank in order, so a lower-ranked
+    # coordinator can win phase 1 yet have its phase 2a rejected wherever a
+    # higher rank's phase 1a also arrived.
+    concurrent_coordinators: int = 1
 
 
 class EngineState(NamedTuple):
@@ -61,6 +83,7 @@ class EngineState(NamedTuple):
     # Failure-detector state per monitoring edge (subject, ring).
     fd_count: jnp.ndarray  # [n, k] int32 consecutive failed windows
     fd_fired: jnp.ndarray  # [n, k] bool alert already emitted
+    fire_round: jnp.ndarray  # [n, k] int32 round the alert fired (FIRE_NEVER if not)
 
     # Joiner bookkeeping.
     join_pending: jnp.ndarray  # [n] bool — slots waiting to be admitted
@@ -95,6 +118,9 @@ class EngineState(NamedTuple):
     cp_vval_src: jnp.ndarray  # [n] int32 — cohort index of accepted value
     classic_epoch: jnp.ndarray  # int32 — classic attempts this configuration
 
+    # Rounds elapsed in this configuration (drives delivery-delay maturity).
+    round_idx: jnp.ndarray  # int32
+
 
 def initial_state(cfg: EngineConfig, key_hi, key_lo, id_hi, id_lo, alive) -> EngineState:
     """Build a configuration-consistent state from identity arrays."""
@@ -102,10 +128,13 @@ def initial_state(cfg: EngineConfig, key_hi, key_lo, id_hi, id_lo, alive) -> Eng
         raise ValueError(
             f"K must be in [1, 32]: ring reports are uint32 bitmasks (got K={cfg.k})"
         )
-    if cfg.c > 30:
+    if cfg.c > 1024:
         raise ValueError(
-            f"at most 30 receiver cohorts (rx-block bits pack into uint32 lanes), got {cfg.c}"
+            f"at most 1024 receiver cohorts (per-cohort state is [c, n]; "
+            f"sample divergence, don't materialize every receiver), got {cfg.c}"
         )
+    if cfg.delivery_spread < 0:
+        raise ValueError(f"delivery_spread must be >= 0, got {cfg.delivery_spread}")
     alive = jnp.asarray(alive, dtype=bool)
     topo = ring_topology(jnp.asarray(key_hi), jnp.asarray(key_lo), alive)
     config_hi, config_lo = masked_set_hash(jnp.asarray(id_hi), jnp.asarray(id_lo), alive)
@@ -127,6 +156,7 @@ def initial_state(cfg: EngineConfig, key_hi, key_lo, id_hi, id_lo, alive) -> Eng
         n_members=jnp.sum(alive, dtype=jnp.int32),
         fd_count=jnp.zeros((n, k), dtype=jnp.int32),
         fd_fired=jnp.zeros((n, k), dtype=bool),
+        fire_round=jnp.full((n, k), FIRE_NEVER, dtype=jnp.int32),
         join_pending=jnp.zeros((n,), dtype=bool),
         cohort_of=jnp.zeros((n,), dtype=jnp.int32),
         report_bits=jnp.zeros((c, n), dtype=jnp.uint32),
@@ -146,6 +176,7 @@ def initial_state(cfg: EngineConfig, key_hi, key_lo, id_hi, id_lo, alive) -> Eng
         cp_vrnd_i=jnp.zeros((n,), dtype=jnp.int32),
         cp_vval_src=jnp.full((n,), -1, dtype=jnp.int32),
         classic_epoch=jnp.int32(0),
+        round_idx=jnp.int32(0),
     )
 
 
@@ -175,3 +206,8 @@ class StepEvents(NamedTuple):
     alerts_emitted: jnp.ndarray  # int32 — new edge alerts this step
     total_votes: jnp.ndarray  # int32
     max_votes: jnp.ndarray  # int32
+    # Per-cohort announced-proposal hash lanes as of THIS round, captured
+    # before any view-change reset (reading state.prop_* after a deciding
+    # step sees post-reset zeros — observers must use these instead).
+    prop_hi: jnp.ndarray  # [c] uint32
+    prop_lo: jnp.ndarray  # [c] uint32

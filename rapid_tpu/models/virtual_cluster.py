@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from rapid_tpu.models.state import (
+    FIRE_NEVER,
     EngineConfig,
     EngineState,
     FaultInputs,
@@ -36,33 +37,38 @@ from rapid_tpu.ops.pallas_kernels import _popcount32, watermark_merge_classify
 from rapid_tpu.ops.rings import endpoint_ring_keys, predecessor_of_keys, ring_topology
 
 
-def _edge_masks(cfg: EngineConfig, state: EngineState, faults: FaultInputs):
-    """Per-edge observer masks: (observer_active[n,k], src_blocked[c,n,k]).
+def cohort_words(c: int) -> int:
+    """uint32 words needed to carry one bit per receiver cohort."""
+    return (c + 31) // 32
 
-    Per-observer flags (is the observer live? is it rx-blocked for cohort c?)
-    are packed into one uint32 per node so the tick plus broadcast delivery
-    costs a single [n, k] gather — gathers dominate the round on TPU. The
-    result depends only on (topology, faults), both fixed between view
-    changes, so convergence loops hoist this out of the round body entirely.
+
+def _edge_masks(cfg: EngineConfig, state: EngineState, faults: FaultInputs):
+    """Per-edge observer masks: (observer_active[n,k], blocked_words[w,n,k]).
+
+    ``blocked_words`` packs "cohort c cannot hear the observer of edge
+    (subject, ring)" bitwise over cohorts — bit j of word w is cohort
+    ``32w + j`` — so the hoisted delivery mask costs O(K·N·C/32) uint32
+    instead of O(K·N·C) bools, which is what lets C scale to hundreds of
+    independently-diverging receiver cohorts. Both outputs depend only on
+    (topology, faults), fixed between view changes, so convergence loops
+    hoist this out of the round body entirely.
     """
     n, c = cfg.n, cfg.c
+    w = cohort_words(c)
     obs = state.obs_idx.T  # [n, k] — observer of (subject s, ring k)
     obs_clamped = jnp.clip(obs, 0, n - 1)
 
-    # bit 0: observer is a live prober; bits 1..c: observer rx-blocked for
-    # cohort (c-1)'s receivers.
-    active = (state.alive & ~faults.crashed).astype(jnp.uint32)
-    cohort_shifts = jnp.arange(1, c + 1, dtype=jnp.uint32)
-    packed = active | jnp.sum(
-        faults.rx_block.astype(jnp.uint32) << cohort_shifts[:, None], axis=0
-    )
-    gathered = packed[obs_clamped]  # [n, k] — THE gather
+    active = state.alive & ~faults.crashed
+    observer_active = (obs >= 0) & active[obs_clamped]
 
-    observer_active = (obs >= 0) & ((gathered & 1) == 1)
-    src_blocked = (
-        (gathered[None, :, :] >> cohort_shifts[:, None, None]) & 1
-    ).astype(bool)  # [c, n, k]
-    return observer_active, src_blocked
+    # Pack rx_block over the cohort axis, then gather per observer.
+    pad = w * 32 - c
+    rxb = jnp.pad(faults.rx_block, ((0, pad), (0, 0))).astype(jnp.uint32)  # [32w, n]
+    rxb = rxb.reshape(w, 32, n)
+    bit_weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    words = jnp.sum(rxb * bit_weights[None, :, None], axis=1, dtype=jnp.uint32)  # [w, n]
+    blocked_words = words[:, obs_clamped]  # [w, n, k] — THE gather
+    return observer_active, blocked_words
 
 
 def _fd_tick(cfg: EngineConfig, state: EngineState, faults: FaultInputs, observer_active):
@@ -78,7 +84,47 @@ def _fd_tick(cfg: EngineConfig, state: EngineState, faults: FaultInputs, observe
     return fd_count, fd_fired, fire
 
 
-def _cohort_cut_detection(cfg: EngineConfig, state: EngineState, new_bits, any_down):
+def _deliver_alerts(cfg: EngineConfig, state: EngineState, fire_round, blocked_words):
+    """Per-cohort delivered alert bitmasks, ``new_bits[c, n]`` (bit k = ring
+    k's alert for subject n has reached cohort c).
+
+    The device analog of UnicastToAllBroadcaster + per-receiver arrival
+    timing: an alert fired at round f reaches cohort c at round
+    ``f + delay(c, edge)`` where the delay is drawn deterministically from a
+    hash of (cohort, edge, configuration) in ``[0, delivery_spread]`` —
+    different cohorts genuinely hear different alert subsets at any instant,
+    which is where almost-everywhere-agreement conflicts come from (paper
+    Fig. 11). Delivery is recomputed cumulatively each round (cheap bitwise
+    work); the OR-merge into ``report_bits`` makes redelivery idempotent.
+    Materializes [c, n] per ring — never [c, n, k].
+    """
+    n, k, c = cfg.n, cfg.k, cfg.c
+    c_ids = jnp.arange(c, dtype=jnp.uint32)
+    word_idx = (c_ids // 32).astype(jnp.int32)  # [c]
+    bit_idx = c_ids % 32  # [c]
+    age = state.round_idx - fire_round  # [n, k]; hugely negative if unfired
+    slot_salt = jnp.arange(n, dtype=jnp.uint32) * jnp.uint32(0x85EBCA77)
+    epoch_salt = state.config_epoch.astype(jnp.uint32) * jnp.uint32(0x27D4EB2F)
+
+    new_bits = jnp.zeros((c, n), dtype=jnp.uint32)
+    for ring in range(k):
+        blocked = (blocked_words[word_idx, :, ring] >> bit_idx[:, None]) & 1  # [c, n]
+        if cfg.delivery_spread > 0:
+            rnd = mix32(
+                (c_ids[:, None] * jnp.uint32(0x9E3779B1))
+                ^ slot_salt[None, :]
+                ^ jnp.uint32((ring * 0xC2B2AE3D) & 0xFFFFFFFF)
+                ^ epoch_salt
+            )
+            delay = (rnd % jnp.uint32(cfg.delivery_spread + 1)).astype(jnp.int32)
+        else:
+            delay = 0
+        delivered = (age[:, ring][None, :] >= delay) & (blocked == 0)  # [c, n]
+        new_bits = new_bits | (delivered.astype(jnp.uint32) << jnp.uint32(ring))
+    return new_bits
+
+
+def _cohort_cut_detection(cfg: EngineConfig, state: EngineState, new_bits, heard_down):
     """Batched per-cohort watermark pass over uint32 ring-report bitmasks
     (rapid_tpu.ops.pallas_kernels semantics over a leading cohort axis, gated
     by the per-configuration announced-proposal flag,
@@ -103,7 +149,7 @@ def _cohort_cut_detection(cfg: EngineConfig, state: EngineState, new_bits, any_d
         cfg.l,
         use_pallas=cfg.use_pallas,
     )
-    seen_down = state.seen_down | any_down  # [c]
+    seen_down = state.seen_down | heard_down  # [c]
     stable = cls == 2
     flux = cls == 1
 
@@ -112,20 +158,18 @@ def _cohort_cut_detection(cfg: EngineConfig, state: EngineState, new_bits, any_d
         # union (pending-stable | flux) is invariant under the pass, so one
         # masked OR is the fixpoint. Already-released subjects left the
         # pending set (MultiNodeCutDetector.java:120-121) and no longer
-        # legitimize implicit edges.
+        # legitimize implicit edges. Per-ring loop: [c, n] gathers, never a
+        # [c, n, k] materialization (C can be in the hundreds).
         in_union = (stable & ~state.released) | flux  # [c, n]
-        obs = state.inval_obs.T  # [n, k]
-        gathered = in_union[:, jnp.clip(obs, 0, n - 1)]  # [c, n, k]
-        implicit = (
-            flux[:, :, None]
-            & gathered
-            & (obs >= 0)[None, :, :]
-            & seen_down[:, None, None]
-        )
-        shifts = jnp.arange(cfg.k, dtype=jnp.uint32)
-        implicit_bits = jnp.sum(
-            implicit.astype(jnp.uint32) << shifts[None, None, :], axis=2, dtype=jnp.uint32
-        )
+        obs = state.inval_obs  # [k, n]
+        implicit_bits = jnp.zeros((cfg.c, n), dtype=jnp.uint32)
+        for ring in range(cfg.k):
+            obs_r = obs[ring]  # [n]
+            gathered = in_union[:, jnp.clip(obs_r, 0, n - 1)]  # [c, n]
+            implicit_r = flux & gathered & (obs_r >= 0)[None, :] & seen_down[:, None]
+            implicit_bits = implicit_bits | (
+                implicit_r.astype(jnp.uint32) << jnp.uint32(ring)
+            )
         merged = report_bits | implicit_bits
         return jnp.where(subject_mask[None, :], merged, jnp.uint32(0))
 
@@ -158,29 +202,26 @@ def _compute_round(
     hoist the per-edge gather by passing precomputed ``edge_masks``."""
     n, k, c = cfg.n, cfg.k, cfg.c
 
-    # 1. Failure-detector tick -> fresh DOWN alerts per (subject, ring) edge,
-    #    plus per-cohort source-blocked bits from the same packed gather.
+    # 1. Failure-detector tick -> fresh DOWN alerts per (subject, ring) edge.
     if edge_masks is None:
         edge_masks = _edge_masks(cfg, state, faults)
-    observer_active, src_blocked = edge_masks
+    observer_active, blocked_words = edge_masks
     fd_count, fd_fired, fire = _fd_tick(cfg, state, faults, observer_active)
+    fire_round = jnp.where(fire, state.round_idx, state.fire_round)
     alerts_emitted = jnp.sum(fire, dtype=jnp.int32)
-    any_down = jnp.any(fire)
 
     # 2. Broadcast delivery: alert for edge (s, ring) originates at the edge's
-    #    observer; cohort c hears it unless that observer is rx-blocked
-    #    (the device analog of UnicastToAllBroadcaster + drop interceptors).
-    #    Delivered alerts pack straight into per-subject ring bitmasks.
-    shifts = jnp.arange(k, dtype=jnp.uint32)
-    new_bits = jnp.sum(
-        (fire[None, :, :] & ~src_blocked).astype(jnp.uint32) << shifts[None, None, :],
-        axis=2,
-        dtype=jnp.uint32,
-    )
+    #    observer; cohort c hears it unless that observer is rx-blocked, and
+    #    only once the per-(cohort, edge) delivery delay has matured
+    #    (the device analog of UnicastToAllBroadcaster + drop interceptors +
+    #    arrival-timing skew). Delivered alerts pack straight into
+    #    per-subject ring bitmasks.
+    new_bits = _deliver_alerts(cfg, state, fire_round, blocked_words)
+    heard_down = jnp.any(new_bits != 0, axis=1)  # [c] — cohort heard >=1 alert
 
     # 3. Cut detection per cohort.
     report_bits, released, announced, seen_down, proposed_now, prop_masks = _cohort_cut_detection(
-        cfg, state, new_bits, any_down
+        cfg, state, new_bits, heard_down
     )
     # Proposal identity = commutative set-hash of the cut's member identities
     # (the canonical-sort-free equivalent of the ring-0-sorted endpoint list,
@@ -223,95 +264,146 @@ def _compute_round(
     fallback_due = (rounds_undecided >= cfg.fallback_rounds) & jnp.any(announced) & ~fast_decided
 
     # 5b. Classic-Paxos fallback, message-level (Paxos.java:98-238): one
-    #     attempt per engine round once the recovery delay expires. A
-    #     rotating coordinator runs phase1a/1b (promises from reachable
-    #     acceptors), picks a value with the Fast Paxos coordinator rule
-    #     (Paxos.java:271-328), then phase2a/2b commits at majority.
-    #     Delivery respects the same per-cohort rx-block masks as alerts, so
-    #     partitioned coordinators genuinely fail and rotation recovers.
-    #     Cond-gated: the common fast path skips the cumsum/gathers entirely.
+    #     attempt per engine round once the recovery delay expires. R =
+    #     cfg.concurrent_coordinators rotating coordinators race within the
+    #     attempt, rank-ordered as in the reference (Paxos.java:93-97,
+    #     333-339): every acceptor promises to each heard phase1a in rank
+    #     order, so several coordinators can win phase 1, but an acceptor's
+    #     final rnd is the max heard rank and phase2a messages below it are
+    #     rejected — a lower-ranked coordinator's phase 2 loses wherever a
+    #     higher rank reached. Each coordinator picks a value with the Fast
+    #     Paxos coordinator rule (Paxos.java:271-328); decision at a
+    #     majority of accepts for one rank (majorities intersect, so at most
+    #     one rank can decide per attempt). Delivery respects the same
+    #     per-cohort rx-block masks as alerts, so partitioned coordinators
+    #     genuinely fail and rotation recovers. Cond-gated: the common fast
+    #     path skips the cumsum/gathers entirely.
     def classic_attempt(cp):
         cp_rnd_r, cp_rnd_i, cp_vrnd_r, cp_vrnd_i, cp_vval_src = cp
         active = state.alive & ~faults.crashed
         n_active = jnp.sum(active, dtype=jnp.int32)
         majority = state.n_members // 2 + 1
-
-        # Pseudo-random coordinator rotation: the real protocol's expovariate
-        # jitter makes successive coordinators effectively random, so a
-        # contiguous run of partitioned slots is escaped in O(1) expected
-        # attempts — sequential rotation would crawl through it.
-        pick = mix32(state.classic_epoch.astype(jnp.uint32) + jnp.uint32(0x5BD1E995))
-        target = jnp.where(
-            n_active > 0,
-            (pick % jnp.maximum(n_active, 1).astype(jnp.uint32)).astype(jnp.int32) + 1,
-            1,
-        )
-        active_rank = jnp.cumsum(active.astype(jnp.int32))
-        coord = jnp.argmax(active & (active_rank == target)).astype(jnp.int32)
         round_num = 2 + state.classic_epoch
         slot_ids = jnp.arange(n, dtype=jnp.int32)
-
-        coord_cohort = state.cohort_of[coord]
-        # i hears the coordinator unless i's cohort rx-blocks the coordinator;
-        # the coordinator hears i unless its cohort rx-blocks i.
-        hears_coord = active & ~faults.rx_block[state.cohort_of, coord]
-        coord_hears = active & ~faults.rx_block[coord_cohort, slot_ids]
+        cohort_ids = jnp.arange(c, dtype=jnp.int32)
+        active_rank = jnp.cumsum(active.astype(jnp.int32))
 
         def rank_gt(ar, ai, br, bi):
             return (ar > br) | ((ar == br) & (ai > bi))
 
-        # Phase 1a/1b: promise to the higher rank (Paxos.java:118-148).
-        promise = hears_coord & rank_gt(round_num, coord, cp_rnd_r, cp_rnd_i)
-        q1 = promise & coord_hears
-        q1_count = jnp.sum(q1, dtype=jnp.int32)
-        phase1_ok = q1_count >= majority
+        # Pseudo-random coordinator picks, one hash stream per racer: the
+        # real protocol's expovariate jitter makes concurrent recoverers
+        # effectively random slots, so a contiguous run of partitioned slots
+        # is escaped in O(1) expected attempts.
+        coords = []
+        for j in range(cfg.concurrent_coordinators):
+            pick = mix32(
+                state.classic_epoch.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+                + jnp.uint32((0x5BD1E995 * (j + 1)) & 0xFFFFFFFF)
+            )
+            target = jnp.where(
+                n_active > 0,
+                (pick % jnp.maximum(n_active, 1).astype(jnp.uint32)).astype(jnp.int32)
+                + 1,
+                1,
+            )
+            coords.append(jnp.argmax(active & (active_rank == target)).astype(jnp.int32))
 
-        # Coordinator value-pick rule over the quorum's (vrnd, vval) pairs.
-        voters = q1 & (cp_vval_src >= 0)
-        mv_r = jnp.max(jnp.where(voters, cp_vrnd_r, -1))
-        mv_i = jnp.max(jnp.where(voters & (cp_vrnd_r == mv_r), cp_vrnd_i, -1))
-        at_max = voters & (cp_vrnd_r == mv_r) & (cp_vrnd_i == mv_i)
-        cohort_ids = jnp.arange(c, dtype=jnp.int32)
-        max_counts = jnp.sum(
-            at_max[None, :] & (cp_vval_src[None, :] == cohort_ids[:, None]),
-            axis=1,
-            dtype=jnp.int32,
-        )
-        # Value pick: the plurality among max-vrnd accepted values (a safe
-        # instance of Paxos.java:287-308 — a fast-chosen value necessarily
-        # holds > N/4 of any majority quorum, and at most one value can be
-        # fast-chosen, so the plurality contains it whenever one exists). If
-        # NO quorum member has accepted anything, safety permits a free
-        # choice: the coordinator proposes an announced cut
-        # (Paxos.java:310-326's any-proposed-value clause) — without this, a
-        # cut whose only voters crashed would stall every rotation until
-        # failure detection caught up.
-        chosen = jnp.where(
-            jnp.any(max_counts > 0),
-            jnp.argmax(max_counts).astype(jnp.int32),
-            jnp.where(jnp.any(announced), jnp.argmax(announced).astype(jnp.int32), -1),
-        )
+        # Distinct racers only: a duplicate pick would duplicate a rank.
+        valid = []
+        for j, coord in enumerate(coords):
+            v = jnp.bool_(True)
+            for prev in coords[:j]:
+                v = v & (coord != prev)
+            valid.append(v)
 
-        # Phase 2a/2b: reachable acceptors accept the coordinator's
-        # rank/value (Paxos.java:195-216); decision at a majority of accepts
-        # (Paxos.java:223-238 — phase2b is broadcast; tallied globally here).
-        can_accept = (
-            phase1_ok
-            & (chosen >= 0)
-            & hears_coord
-            & ~rank_gt(cp_rnd_r, cp_rnd_i, round_num, coord)
-        )
-        accept_count = jnp.sum(can_accept, dtype=jnp.int32)
-        fb_decided = phase1_ok & (chosen >= 0) & (accept_count >= majority)
+        # Phase 1a/1b per racer. Arrival in rank order within the attempt
+        # means a lower-ranked phase1a is never blocked by a concurrent
+        # higher one — each racer collects promises from every reachable
+        # acceptor whose rnd predates this attempt (Paxos.java:118-148).
+        per = []
+        for coord, v in zip(coords, valid):
+            coord_cohort = state.cohort_of[coord]
+            hears_coord = active & v & ~faults.rx_block[state.cohort_of, coord]
+            coord_hears = active & v & ~faults.rx_block[coord_cohort, slot_ids]
+            promise = hears_coord & rank_gt(round_num, coord, cp_rnd_r, cp_rnd_i)
+            q1 = promise & coord_hears
+            phase1_ok = jnp.sum(q1, dtype=jnp.int32) >= majority
+
+            # Coordinator value-pick rule over the quorum's (vrnd, vval)
+            # pairs — the plurality among max-vrnd accepted values (a safe
+            # instance of Paxos.java:287-308: a fast-chosen value holds
+            # > N/4 of any majority quorum and at most one value can be
+            # fast-chosen, so the plurality contains it whenever one
+            # exists). If NO quorum member has accepted anything, safety
+            # permits a free choice: propose an announced cut
+            # (Paxos.java:310-326's any-proposed-value clause).
+            voters = q1 & (cp_vval_src >= 0)
+            mv_r = jnp.max(jnp.where(voters, cp_vrnd_r, -1))
+            mv_i = jnp.max(jnp.where(voters & (cp_vrnd_r == mv_r), cp_vrnd_i, -1))
+            at_max = voters & (cp_vrnd_r == mv_r) & (cp_vrnd_i == mv_i)
+            max_counts = jnp.sum(
+                at_max[None, :] & (cp_vval_src[None, :] == cohort_ids[:, None]),
+                axis=1,
+                dtype=jnp.int32,
+            )
+            chosen = jnp.where(
+                jnp.any(max_counts > 0),
+                jnp.argmax(max_counts).astype(jnp.int32),
+                jnp.where(
+                    jnp.any(announced), jnp.argmax(announced).astype(jnp.int32), -1
+                ),
+            )
+            per.append((coord, hears_coord, promise, phase1_ok, chosen))
+
+        # After every phase1a has arrived, an acceptor's rnd is the max rank
+        # it heard (promises in rank order).
+        rnd1_r, rnd1_i = cp_rnd_r, cp_rnd_i
+        for coord, hears_coord, promise, _, _ in per:
+            bump = promise & rank_gt(round_num, coord, rnd1_r, rnd1_i)
+            rnd1_r = jnp.where(bump, round_num, rnd1_r)
+            rnd1_i = jnp.where(bump, coord, rnd1_i)
+
+        # Phase 2a/2b: an acceptor accepts only a phase2a matching its final
+        # rnd (Paxos.java:195-216) — so where a higher rank's phase1a
+        # reached, the lower racer's phase2a is rejected. Ranks are distinct,
+        # hence at most one accept per acceptor. Decision at a majority of
+        # accepts for one rank (Paxos.java:223-238).
+        acc_r, acc_i = cp_vrnd_r, cp_vrnd_i
+        acc_src = cp_vval_src
+        fb_decided = jnp.bool_(False)
+        chosen_winner = jnp.int32(-1)
+        any_promise = jnp.zeros((n,), dtype=bool)
+        any_accept = jnp.zeros((n,), dtype=bool)
+        for coord, hears_coord, promise, phase1_ok, chosen in per:
+            # A heard acceptor's final rnd is >= this racer's rank (it
+            # promised in rank order), so acceptance means equality: this
+            # racer was the highest rank the acceptor heard.
+            can_accept = (
+                phase1_ok
+                & (chosen >= 0)
+                & hears_coord
+                & (rnd1_r == round_num)
+                & (rnd1_i == coord)
+            )
+            accept_count = jnp.sum(can_accept, dtype=jnp.int32)
+            won = phase1_ok & (chosen >= 0) & (accept_count >= majority)
+            fb_decided = fb_decided | won
+            chosen_winner = jnp.where(won, chosen, chosen_winner)
+            acc_r = jnp.where(can_accept, round_num, acc_r)
+            acc_i = jnp.where(can_accept, coord, acc_i)
+            acc_src = jnp.where(can_accept, chosen, acc_src)
+            any_promise = any_promise | promise
+            any_accept = any_accept | can_accept
 
         return (
-            jnp.where(promise | can_accept, round_num, cp_rnd_r),
-            jnp.where(promise | can_accept, coord, cp_rnd_i),
-            jnp.where(can_accept, round_num, cp_vrnd_r),
-            jnp.where(can_accept, coord, cp_vrnd_i),
-            jnp.where(can_accept, chosen, cp_vval_src),
+            jnp.where(any_promise | any_accept, rnd1_r, cp_rnd_r),
+            jnp.where(any_promise | any_accept, rnd1_i, cp_rnd_i),
+            acc_r,
+            acc_i,
+            acc_src,
             fb_decided,
-            chosen,
+            chosen_winner,
         )
 
     def no_attempt(cp):
@@ -340,6 +432,8 @@ def _compute_round(
     round_state = state._replace(
         fd_count=fd_count,
         fd_fired=fd_fired,
+        fire_round=fire_round,
+        round_idx=state.round_idx + 1,
         report_bits=report_bits,
         seen_down=seen_down,
         released=released,
@@ -365,8 +459,24 @@ def _compute_round(
         alerts_emitted=alerts_emitted,
         total_votes=tally.total_votes,
         max_votes=tally.max_count,
+        prop_hi=prop_hi,
+        prop_lo=prop_lo,
     )
     return round_state, decided, winner_mask, events
+
+
+def classic_coordinator_targets(epoch: int, n_active: int, racers: int):
+    """Host-side replica of the classic fallback's coordinator rotation:
+    the 1-based active-rank target of each racer at ``epoch``. Uses the same
+    ``mix32`` as ``classic_attempt`` so tests and diagnostics predict picks
+    from one definition."""
+    mask = 0xFFFFFFFF
+    targets = []
+    for j in range(racers):
+        seed = np.uint32(((epoch * 0x9E3779B1) + (0x5BD1E995 * (j + 1) & mask)) & mask)
+        pick = int(mix32(seed))
+        targets.append(pick % max(n_active, 1) + 1)
+    return targets
 
 
 def apply_view_change_impl(
@@ -389,6 +499,7 @@ def apply_view_change_impl(
         n_members=jnp.sum(alive2, dtype=jnp.int32),
         fd_count=jnp.zeros((n, k), dtype=jnp.int32),
         fd_fired=jnp.zeros((n, k), dtype=bool),
+        fire_round=jnp.full((n, k), FIRE_NEVER, dtype=jnp.int32),
         join_pending=state.join_pending & ~winner_mask,
         report_bits=jnp.zeros((c, n), dtype=jnp.uint32),
         seen_down=jnp.zeros((c,), dtype=bool),
@@ -407,6 +518,7 @@ def apply_view_change_impl(
         cp_vrnd_i=jnp.zeros((n,), dtype=jnp.int32),
         cp_vval_src=jnp.full((n,), -1, dtype=jnp.int32),
         classic_epoch=jnp.int32(0),
+        round_idx=jnp.int32(0),
     )
 
 
@@ -503,6 +615,8 @@ class VirtualCluster:
         seed: int = 0,
         use_pallas: bool = False,
         fallback_rounds: int = 8,
+        delivery_spread: int = 0,
+        concurrent_coordinators: int = 1,
     ) -> "VirtualCluster":
         """Synthetic cluster: slot identities are random 64-bit lanes (the
         host never materializes 100K endpoint strings; interop deployments
@@ -512,6 +626,8 @@ class VirtualCluster:
         cfg = EngineConfig(
             n=n, k=k, h=h, l=l, c=cohorts, fd_threshold=fd_threshold,
             use_pallas=use_pallas, fallback_rounds=fallback_rounds,
+            delivery_spread=delivery_spread,
+            concurrent_coordinators=concurrent_coordinators,
         )
         rng = np.random.default_rng(seed)
         key_hi = rng.integers(0, 2**32, size=(k, n), dtype=np.uint32)
@@ -536,6 +652,8 @@ class VirtualCluster:
         fd_threshold: int = 3,
         use_pallas: bool = False,
         fallback_rounds: int = 8,
+        delivery_spread: int = 0,
+        concurrent_coordinators: int = 1,
     ) -> "VirtualCluster":
         """Build from real endpoints with the host view's exact ring keys, so
         the engine's topology matches a host MembershipView bit-for-bit."""
@@ -544,6 +662,8 @@ class VirtualCluster:
         cfg = EngineConfig(
             n=n, k=k, h=h, l=l, c=cohorts, fd_threshold=fd_threshold,
             use_pallas=use_pallas, fallback_rounds=fallback_rounds,
+            delivery_spread=delivery_spread,
+            concurrent_coordinators=concurrent_coordinators,
         )
         key_hi0, key_lo0 = endpoint_ring_keys(endpoints, k)
         key_hi = np.zeros((k, n), dtype=np.uint32)
@@ -625,6 +745,12 @@ class VirtualCluster:
     def assign_cohorts(self, cohort_of: np.ndarray) -> None:
         self.state = self.state._replace(cohort_of=jnp.asarray(cohort_of, dtype=jnp.int32))
 
+    def assign_cohorts_roundrobin(self) -> None:
+        """Spread the N slots evenly over the C receiver cohorts — the
+        sampled-divergence deployment: each cohort is an independently
+        jittered receiver whose fast-round vote is shared by ~N/C members."""
+        self.assign_cohorts(np.arange(self.cfg.n, dtype=np.int32) % self.cfg.c)
+
     def set_rx_block(self, rx_block: np.ndarray) -> None:
         self.faults = self.faults._replace(rx_block=jnp.asarray(rx_block, dtype=bool))
 
@@ -662,17 +788,25 @@ class VirtualCluster:
                 return round_idx + 1, events
         return max_steps, None
 
-    def run_to_decision(self, max_steps: int = 64) -> Tuple[int, bool, jnp.ndarray]:
+    def run_to_decision(self, max_steps: int = 64) -> Tuple[int, bool, jnp.ndarray, int]:
         """Single-dispatch convergence: the whole round loop runs on device
-        (lax.while_loop); returns (rounds, decided, winner_mask). The winner
-        mask stays on device — only two scalars cross the tunnel."""
+        (lax.while_loop); returns (rounds, decided, winner_mask, n_members).
+        The winner mask stays on device — every scalar observation travels in
+        ONE packed fetch (a device->host fetch is a full tunnel round trip),
+        including the post-cut membership so churn loops don't pay an extra
+        RTT per view change."""
+        assert max_steps <= 255, "steps pack into 8 bits"
         self.state, steps, decided, winner = run_to_decision(
             self.cfg, self.state, self.faults, jnp.int32(max_steps)
         )
-        # One scalar readback total: every device->host fetch is a full
-        # tunnel round trip, so steps and the decided bit travel packed.
-        packed = int(steps | (decided.astype(jnp.int32) << 30))
-        return packed & ~(1 << 30), bool(packed >> 30), winner
+        # Layout: bits 0-7 steps, bit 8 decided, bits 9+ membership
+        # (n <= ~4M keeps the int32 positive).
+        packed = int(
+            steps
+            | (decided.astype(jnp.int32) << 8)
+            | (self.state.n_members << 9)
+        )
+        return packed & 0xFF, bool((packed >> 8) & 1), winner, packed >> 9
 
     def timed_convergence(self, max_steps: int = 64) -> Tuple[int, float]:
         """(rounds, wall_ms) for a convergence run, excluding compilation

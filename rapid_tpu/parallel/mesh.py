@@ -52,6 +52,7 @@ def state_shardings(mesh: Mesh) -> EngineState:
         n_members=sh(),
         fd_count=sh(NODE_AXIS, None),
         fd_fired=sh(NODE_AXIS, None),
+        fire_round=sh(NODE_AXIS, None),
         join_pending=sh(NODE_AXIS),
         cohort_of=sh(NODE_AXIS),
         report_bits=sh(None, NODE_AXIS),
@@ -71,6 +72,7 @@ def state_shardings(mesh: Mesh) -> EngineState:
         cp_vrnd_i=sh(NODE_AXIS),
         cp_vval_src=sh(NODE_AXIS),
         classic_epoch=sh(),
+        round_idx=sh(),
     )
 
 

@@ -74,7 +74,7 @@ def save_engine_state(path, cfg: "EngineConfig", state: "EngineState") -> None:
 
 
 def load_engine_state(path) -> Tuple["EngineConfig", "EngineState"]:
-    from rapid_tpu.models.state import EngineConfig, EngineState
+    from rapid_tpu.models.state import FIRE_NEVER, EngineConfig, EngineState
 
     with np.load(path) as data:
         cfg = EngineConfig(*(int(v) for v in data["__cfg__"]))
@@ -90,6 +90,12 @@ def load_engine_state(path) -> Tuple["EngineConfig", "EngineState"]:
             "cp_vrnd_i": lambda: jnp.zeros((cfg.n,), dtype=jnp.int32),
             "cp_vval_src": lambda: jnp.full((cfg.n,), -1, dtype=jnp.int32),
             "classic_epoch": lambda: jnp.int32(0),
+            "fire_round": lambda: jnp.where(
+                jnp.asarray(data["fd_fired"]),
+                jnp.int32(0),
+                jnp.int32(FIRE_NEVER),
+            ),
+            "round_idx": lambda: jnp.int32(0),
         }
         arrays = {}
         for field in EngineState._fields:

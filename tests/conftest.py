@@ -9,10 +9,13 @@ Environment must be set before jax is first imported.
 # real TPU tunnel), which tests must not depend on.
 from rapid_tpu.utils.platform import force_platform
 
-assert force_platform("cpu", n_host_devices=8), (
-    "could not force the CPU platform: a jax backend was initialized before "
-    "tests/conftest.py ran; tests must not touch the axon tunnel"
-)
+# Not an assert: python -O would strip it, silently leaving tests on the
+# accelerator tunnel.
+if not force_platform("cpu", n_host_devices=8):
+    raise RuntimeError(
+        "could not force the CPU platform: a jax backend was initialized "
+        "before tests/conftest.py ran; tests must not touch the axon tunnel"
+    )
 
 
 # Build the native host library once per test session (load-only at runtime).

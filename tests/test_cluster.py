@@ -447,3 +447,44 @@ async def test_rejoin_after_crash_with_new_identity():
         assert await wait_until(lambda: all_converged(clusters, 6))
     finally:
         await shutdown_all(clusters)
+
+
+@async_test
+async def test_concurrent_joins_and_failures():
+    # ClusterTest.java:229-243 (concurrentNodeJoinsAndFails): a 30-node
+    # cluster fails 5 members WHILE 10 new nodes join through the seed; the
+    # cluster must converge on exactly the surviving 35.
+    network = InProcessNetwork()
+    fd = StaticFailureDetectorFactory()
+    settings = fast_settings()
+    seed = await Cluster.start(ep(0), settings=settings, network=network,
+                               fd_factory=fd, rng=random.Random(0))
+    joiners = await asyncio.gather(
+        *(
+            Cluster.join(ep(0), ep(i), settings=settings, network=network,
+                         fd_factory=fd, rng=random.Random(i))
+            for i in range(1, 30)
+        )
+    )
+    clusters = [seed] + list(joiners)
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 30), timeout_s=40)
+
+        # Fail 5 and start 10 joins in the same breath — no barrier between.
+        victims = clusters[2:7]
+        for victim in victims:
+            network.blackholed.add(victim.listen_address)
+        fd.add_failed_nodes([v.listen_address for v in victims])
+        join_tasks = [
+            asyncio.ensure_future(
+                Cluster.join(ep(0), ep(200 + i), settings=settings, network=network,
+                             fd_factory=fd, rng=random.Random(200 + i))
+            )
+            for i in range(10)
+        ]
+        wave = await asyncio.gather(*join_tasks)
+        clusters += list(wave)  # before any assert: finally must reap the wave
+        survivors = [c for c in clusters if c not in victims]
+        assert await wait_until(lambda: all_converged(survivors, 35), timeout_s=40)
+    finally:
+        await shutdown_all(clusters)

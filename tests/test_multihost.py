@@ -1,0 +1,74 @@
+"""Exercise the multi-host entry (rapid_tpu.parallel.multihost): a real
+single-process ``jax.distributed`` job — coordinator bring-up, global mesh
+construction, and a sharded engine step over that mesh — so the DCN-story
+module runs under test, not just its argument handling.
+
+``jax.distributed.initialize`` must run before ANY backend initialization, so
+the job executes in a fresh subprocess (the rest of the suite has long since
+initialized the in-process CPU backend).
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+_JOB = """
+import numpy as np
+from rapid_tpu.utils.platform import force_platform
+
+assert force_platform("cpu", n_host_devices=8)
+
+import jax
+
+from rapid_tpu.parallel import multihost
+
+multihost.initialize_multihost(
+    coordinator_address="127.0.0.1:47310", num_processes=1, process_id=0
+)
+try:
+    assert multihost.is_coordinator()
+    assert multihost.local_device_count() == 8
+
+    from rapid_tpu.models.virtual_cluster import VirtualCluster
+    from rapid_tpu.parallel.mesh import make_sharded_step, shard_faults, shard_state
+
+    mesh = multihost.global_mesh()
+    assert mesh.devices.size == 8
+
+    vc = VirtualCluster.create(60, n_slots=64, fd_threshold=2, seed=0)
+    vc.crash([3, 17])
+    step = make_sharded_step(vc.cfg, mesh)
+    state = shard_state(vc.state, mesh)
+    faults = shard_faults(vc.faults, mesh)
+    decided = False
+    for _ in range(16):
+        state, events = step(state, faults)
+        if bool(events.decided):
+            decided = True
+            break
+    assert decided
+    alive = np.asarray(state.alive)
+    assert not alive[[3, 17]].any()
+    assert int(state.n_members) == 58
+    print("MULTIHOST_JOB_OK")
+finally:
+    jax.distributed.shutdown()
+"""
+
+
+def test_single_process_distributed_job_runs_sharded_step():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{REPO}:" + env.get("PYTHONPATH", "")
+    result = subprocess.run(
+        [sys.executable, "-c", _JOB],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env=env,
+        cwd=REPO,
+    )
+    assert result.returncode == 0, f"job failed:\n{result.stdout}\n{result.stderr}"
+    assert "MULTIHOST_JOB_OK" in result.stdout

@@ -303,3 +303,143 @@ def test_duplicate_and_stale_votes_ignored():
         FastRoundPhase2bMessage(sender=ep(102), configuration_id=999, endpoints=proposal)
     )
     assert decided == []
+
+
+# ---------------------------------------------------------------------------
+# Coordinator-rule case tables (reference: PaxosTests.java:195-397): the full
+# (N, vote-distribution) families, each against 100 shuffled quorums.
+# ---------------------------------------------------------------------------
+
+P1 = (ep(5891), ep(5821))
+P2 = (ep(5821), ep(5872))
+NOISE = (ep(1), ep(2))
+_PN = (P1, P2, NOISE)
+_PN_SWAP = (P2, P1, NOISE)
+_INT_MAX = 2**31 - 1
+
+
+# (n, p1n, p2n, proposals, valid proposal indices) — PaxosTests.java:256-303.
+# p1n messages carry proposals[0] at rank (1, 1); p2n messages carry
+# proposals[1] at rank (0, INT_MAX); the rest carry the noise proposal at
+# rank (0, i).
+DIFFERENT_RANK_CASES = [
+    # Fast Paxos quorum of highest-ranked proposal (p1n + p2n == N).
+    (6, 4, 2, _PN, {0}),
+    (6, 5, 1, _PN, {0}),
+    (6, 6, 0, _PN, {0}),
+    (9, 6, 3, _PN, {0, 1}),
+    (9, 7, 2, _PN, {0}),
+    (9, 8, 1, _PN, {0}),
+    # One / two votes of highest rank: may or may not be picked.
+    (6, 1, 5, _PN, {0, 1}),
+    (6, 2, 4, _PN, {0, 1}),
+    # intersection(R, Q) of highest rank.
+    (6, 3, 3, _PN, {0}),
+    (6, 3, 3, _PN_SWAP, {0}),
+    # p1n + p2n < N.
+    (6, 4, 1, _PN, {0}),
+    (6, 5, 1, _PN, {0}),
+    (9, 6, 1, _PN, {0, 1, 2}),
+    (9, 7, 1, _PN, {0}),
+    (9, 8, 1, _PN, {0}),
+    (6, 1, 2, _PN, {0, 1, 2}),
+    (6, 2, 1, _PN, {0, 1, 2}),
+    (6, 3, 0, _PN, {0}),
+    (6, 3, 0, _PN_SWAP, {0}),
+]
+
+# Same-rank table (PaxosTests.java:305-397): p1n AND p2n messages both carry
+# rank (1, 1); the rest carry the noise proposal at rank (0, i).
+SAME_RANK_CASES = [
+    (6, 4, 2, _PN, {0, 1}),
+    (6, 5, 1, _PN, {0}),
+    (6, 6, 0, _PN, {0}),
+    (9, 6, 3, _PN, {0, 1}),
+    (9, 7, 2, _PN, {0}),
+    (9, 8, 1, _PN, {0}),
+    (6, 3, 3, _PN, {0, 1}),
+    (6, 3, 3, _PN_SWAP, {0, 1}),
+    (6, 4, 1, _PN, {0, 1}),
+    (6, 5, 0, _PN, {0}),
+    (9, 6, 1, _PN, {0, 1, 2}),
+    (9, 7, 1, _PN, {0}),
+    (9, 8, 1, _PN, {0}),
+    (6, 1, 2, _PN, {0, 1, 2}),
+    (6, 2, 1, _PN, {0, 1, 2}),
+    (6, 3, 0, _PN, {0}),
+    (6, 3, 0, _PN_SWAP, {0}),
+]
+
+
+def _run_rule_table_case(n, p1n, p2n, proposals, valid, same_rank: bool):
+    valid_values = {proposals[i] for i in valid}
+    rank1 = Rank(1, 1)
+    rank2 = rank1 if same_rank else Rank(0, _INT_MAX)
+    rng = random.Random((n, p1n, p2n, same_rank).__hash__())
+    for _ in range(100):
+        msgs = []
+        for i in range(p1n):
+            msgs.append(p1b(i, CRND, rank1, proposals[0]))
+        for i in range(p2n):
+            msgs.append(p1b(p1n + i, CRND, rank2, proposals[1]))
+        for i in range(p1n + p2n, n):
+            msgs.append(p1b(i, CRND, Rank(0, i), proposals[2]))
+        rng.shuffle(msgs)
+        quorum = msgs[: n // 2 + 1]
+        chosen = select_proposal_using_coordinator_rule(quorum, n)
+        assert chosen in valid_values, (
+            f"chose {chosen} outside valid set for case "
+            f"(n={n}, p1n={p1n}, p2n={p2n}, same_rank={same_rank})"
+        )
+
+
+@pytest.mark.parametrize("n,p1n,p2n,proposals,valid", DIFFERENT_RANK_CASES)
+def test_coordinator_rule_table_different_ranks(n, p1n, p2n, proposals, valid):
+    _run_rule_table_case(n, p1n, p2n, proposals, valid, same_rank=False)
+
+
+@pytest.mark.parametrize("n,p1n,p2n,proposals,valid", SAME_RANK_CASES)
+def test_coordinator_rule_table_same_rank(n, p1n, p2n, proposals, valid):
+    _run_rule_table_case(n, p1n, p2n, proposals, valid, same_rank=True)
+
+
+# Classic-round-after-silenced-fast-round table
+# (PaxosTests.java:141-191's testClassicRoundAfterSuccessfulFastRoundMixedValues):
+# proposal-1 gets N - p2votes of the fast votes, all fast-round phase2b
+# messages are dropped, then one node drives a classic round. When one
+# proposal held a fast quorum of the (never-delivered) votes, the classic
+# round MUST relearn exactly it; otherwise any proposed value may win.
+CLASSIC_AFTER_MIXED_CASES = [
+    (6, 5, "p2"),
+    (6, 1, "p1"),
+    (6, 4, "any"),
+    (6, 2, "any"),
+    (5, 4, "p2"),
+    (5, 1, "p1"),
+    (10, 4, "any"),
+    (10, 1, "any"),
+]
+
+
+@pytest.mark.parametrize("n,p2votes,expected", CLASSIC_AFTER_MIXED_CASES)
+def test_classic_round_after_mixed_fast_round_table(n, p2votes, expected):
+    network = DirectNetwork()
+    decisions: Dict[Endpoint, Tuple[Endpoint, ...]] = {}
+    build_cluster(n, network, decisions)
+    network.drop_types = [FastRoundPhase2bMessage]
+    va, vb = (ep(9999),), (ep(8888),)
+    for i, instance in enumerate(network.instances.values()):
+        instance.propose(va if i < n - p2votes else vb, recovery_delay_ms=1e9)
+    assert decisions == {}
+    network.drop_types = []
+    network.instances[ep(0)].start_classic_paxos_round()
+    assert len(decisions) == n
+    chosen = set(decisions.values())
+    assert len(chosen) == 1
+    winner = chosen.pop()
+    if expected == "p1":
+        assert winner == va
+    elif expected == "p2":
+        assert winner == vb
+    else:
+        assert winner in (va, vb)
