@@ -94,7 +94,11 @@ def main() -> None:
 
     from rapid_tpu.utils.platform import force_platform
 
-    force_platform(args.platform)
+    if not force_platform(args.platform):
+        raise RuntimeError(
+            f"could not force jax platform {args.platform!r} (a backend was "
+            "already initialized); refusing to sweep on an unintended backend"
+        )
 
     k = 10
     print(f"N={args.n}, K={k}, cohorts={args.cohorts}, reps={args.reps}")

@@ -297,10 +297,7 @@ def _compute_round(
         # is escaped in O(1) expected attempts.
         coords = []
         for j in range(cfg.concurrent_coordinators):
-            pick = mix32(
-                state.classic_epoch.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
-                + jnp.uint32((0x5BD1E995 * (j + 1)) & 0xFFFFFFFF)
-            )
+            pick = mix32(_rotation_seed(state.classic_epoch.astype(jnp.uint32), j))
             target = jnp.where(
                 n_active > 0,
                 (pick % jnp.maximum(n_active, 1).astype(jnp.uint32)).astype(jnp.int32)
@@ -465,16 +462,23 @@ def _compute_round(
     return round_state, decided, winner_mask, events
 
 
+def _rotation_seed(epoch_u32, j: int):
+    """Per-racer hash-stream seed for coordinator rotation — THE definition,
+    shared by the device attempt and the host predictor (uint32 wraparound
+    semantics in both)."""
+    return epoch_u32 * jnp.uint32(0x9E3779B1) + jnp.uint32(
+        (0x5BD1E995 * (j + 1)) & 0xFFFFFFFF
+    )
+
+
 def classic_coordinator_targets(epoch: int, n_active: int, racers: int):
     """Host-side replica of the classic fallback's coordinator rotation:
     the 1-based active-rank target of each racer at ``epoch``. Uses the same
-    ``mix32`` as ``classic_attempt`` so tests and diagnostics predict picks
-    from one definition."""
-    mask = 0xFFFFFFFF
+    ``_rotation_seed``/``mix32`` the device attempt uses, so tests and
+    diagnostics predict picks from one definition."""
     targets = []
     for j in range(racers):
-        seed = np.uint32(((epoch * 0x9E3779B1) + (0x5BD1E995 * (j + 1) & mask)) & mask)
-        pick = int(mix32(seed))
+        pick = int(mix32(_rotation_seed(jnp.uint32(epoch & 0xFFFFFFFF), j)))
         targets.append(pick % max(n_active, 1) + 1)
     return targets
 
@@ -795,18 +799,24 @@ class VirtualCluster:
         ONE packed fetch (a device->host fetch is a full tunnel round trip),
         including the post-cut membership so churn loops don't pay an extra
         RTT per view change."""
-        assert max_steps <= 255, "steps pack into 8 bits"
+        if max_steps > 255:  # not an assert: python -O must not skip this
+            raise ValueError(f"max_steps packs into 8 bits, got {max_steps}")
         self.state, steps, decided, winner = run_to_decision(
             self.cfg, self.state, self.faults, jnp.int32(max_steps)
         )
-        # Layout: bits 0-7 steps, bit 8 decided, bits 9+ membership
-        # (n <= ~4M keeps the int32 positive).
-        packed = int(
-            steps
-            | (decided.astype(jnp.int32) << 8)
-            | (self.state.n_members << 9)
-        )
-        return packed & 0xFF, bool((packed >> 8) & 1), winner, packed >> 9
+        if self.cfg.n < (1 << 22):
+            # Layout: bits 0-7 steps, bit 8 decided, bits 9-30 membership —
+            # one scalar fetch total.
+            packed = int(
+                steps
+                | (decided.astype(jnp.int32) << 8)
+                | (self.state.n_members << 9)
+            )
+            return packed & 0xFF, bool((packed >> 8) & 1), winner, packed >> 9
+        # Membership no longer fits beside the flags in a positive int32:
+        # pay a second fetch rather than return garbage.
+        packed = int(steps | (decided.astype(jnp.int32) << 8))
+        return packed & 0xFF, bool(packed >> 8), winner, int(self.state.n_members)
 
     def timed_convergence(self, max_steps: int = 64) -> Tuple[int, float]:
         """(rounds, wall_ms) for a convergence run, excluding compilation

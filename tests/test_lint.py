@@ -51,16 +51,16 @@ def test_no_unused_imports():
                     bound = alias.asname or alias.name
                     imports.append((node.lineno, bound))
         used = {n.id for n in ast.walk(tree) if isinstance(n, ast.Name)}
-        # Re-exports and __all__ entries appear as string constants.
-        strings = [
+        # Re-exports: an __all__ entry (or any other string constant EXACTLY
+        # equal to the name) counts as a use. Substring matching would let a
+        # docstring containing "host" excuse an unused `import os`.
+        exact_strings = {
             n.value
             for n in ast.walk(tree)
             if isinstance(n, ast.Constant) and isinstance(n.value, str)
-        ]
+        }
         for lineno, name in imports:
-            if name in used:
-                continue
-            if any(name in s for s in strings):
+            if name in used or name in exact_strings:
                 continue
             offenders.append(f"{path.relative_to(REPO)}:{lineno}: unused import {name!r}")
     assert not offenders, "\n".join(offenders)
