@@ -8,7 +8,8 @@ cohorts (delivery-delay skew + staggered failure detectors), the implicit-
 invalidation pass live (joins in flight while DOWN alerts spread), and two
 racing classic-fallback coordinators armed. Measured: wall-clock from fault
 injection to the cluster converging on the final membership (every churn
-event resolved through consensus — typically two committed view changes).
+event resolved through consensus — one combined UP+DOWN cut, or two
+sequential cuts, depending on how the jittered deliveries interleave).
 Target: < 500 ms on one TPU v5e chip. The same scenario also runs at the
 1M-member point (1% crash) by default.
 

@@ -50,7 +50,11 @@ def main() -> None:
     if args.platform:
         from rapid_tpu.utils.platform import force_platform
 
-        force_platform(args.platform)
+        if not force_platform(args.platform):
+            raise RuntimeError(
+                f"could not force jax platform {args.platform!r} (a backend "
+                "was already initialized); refusing to time the wrong backend"
+            )
 
     import jax
     import jax.numpy as jnp
@@ -71,8 +75,9 @@ def main() -> None:
     def run(use_pallas: bool):
         def call():
             bits, cls = watermark_merge_classify(old, new, mask, h, l, use_pallas=use_pallas)
-            # Scalar fetch = the only true barrier on tunnel backends.
-            return int(bits[0, 0]) + int(cls[0, 0])
+            # ONE combined scalar fetch = the only true barrier on tunnel
+            # backends (two fetches would double the per-sample RTT).
+            return int(bits[0, 0] + cls[0, 0].astype(jnp.uint32))
 
         return timed(call)
 

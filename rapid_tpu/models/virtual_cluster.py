@@ -216,8 +216,24 @@ def _compute_round(
     #    (the device analog of UnicastToAllBroadcaster + drop interceptors +
     #    arrival-timing skew). Delivered alerts pack straight into
     #    per-subject ring bitmasks.
-    new_bits = _deliver_alerts(cfg, state, fire_round, blocked_words)
-    heard_down = jnp.any(new_bits != 0, axis=1)  # [c] — cohort heard >=1 alert
+    #    Delivery work is cond-skipped once every fired alert has matured:
+    #    delays and rx-blocks are fixed between view changes, so past
+    #    max(fire_round) + spread the delivered mask is static and already
+    #    OR-merged into report_bits — recomputing it adds nothing.
+    fired_any = jnp.any(fd_fired)
+    last_mature = (
+        jnp.max(jnp.where(fd_fired, fire_round, jnp.int32(-1)))
+        + cfg.delivery_spread
+    )
+    need_delivery = fired_any & (state.round_idx <= last_mature)
+    new_bits = jax.lax.cond(
+        need_delivery,
+        lambda: _deliver_alerts(cfg, state, fire_round, blocked_words),
+        lambda: jnp.zeros((c, n), dtype=jnp.uint32),
+    )
+    # Alerts for ALIVE subjects are DOWN reports; join-pending subjects'
+    # reports are UP and must not arm implicit invalidation.
+    heard_down = jnp.any((new_bits != 0) & state.alive[None, :], axis=1)  # [c]
 
     # 3. Cut detection per cohort.
     report_bits, released, announced, seen_down, proposed_now, prop_masks = _cohort_cut_detection(
@@ -711,39 +727,49 @@ class VirtualCluster:
     def inject_join_wave(self, slots: Sequence[int]) -> None:
         """Admit a batch of joiners: their gatekeepers (ring predecessors)
         emit UP alerts on all rings at once — the batched equivalent of the
-        two-phase join's phase 2 (Cluster.java:406-437)."""
+        two-phase join's phase 2 (Cluster.java:406-437).
+
+        The UP alerts ride the SAME delivery machinery as DOWN alerts: the
+        gatekeeper becomes the joiner slot's observer (`obs_idx`), the edge
+        is marked fired this round, and ``_deliver_alerts`` then applies the
+        per-cohort rx-block masks and delivery-delay jitter — so receivers
+        diverge on join reports exactly as they do on failure reports."""
         slots = np.asarray(slots)
         state = self.state
         join_pending = np.asarray(state.join_pending).copy()
         join_pending[slots] = True
 
-        # Expected observers of each joiner, for implicit invalidation parity.
+        # Expected observers (gatekeepers) of each joiner: the alive ring
+        # predecessors of its keys.
         qhi = np.asarray(state.key_hi)[:, slots]
         qlo = np.asarray(state.key_lo)[:, slots]
-        pred = predecessor_of_keys(
-            state.key_hi, state.key_lo, state.alive, jnp.asarray(qhi), jnp.asarray(qlo)
-        )
-        inval_obs = np.asarray(state.inval_obs).copy()
-        inval_obs[:, slots] = np.asarray(pred)
+        pred = np.asarray(
+            predecessor_of_keys(
+                state.key_hi, state.key_lo, state.alive, jnp.asarray(qhi), jnp.asarray(qlo)
+            )
+        )  # [k, j]
 
-        # Gatekeepers report all K rings for each joiner, riding the same
-        # broadcast path as DOWN alerts: cohort c only receives ring k's
-        # report if it can hear that ring's gatekeeper (rx-block parity with
-        # the failure-detector alert delivery).
-        pred_np = np.asarray(pred)  # [k, j] gatekeeper slots
-        rx_block = np.asarray(self.faults.rx_block)  # [c, n]
-        report_bits = np.asarray(state.report_bits).copy()
-        for c in range(self.cfg.c):
-            heard = ~rx_block[c][pred_np]  # [k, j]
-            bits = np.zeros(len(slots), dtype=np.uint32)
-            for k in range(self.cfg.k):
-                bits |= heard[k].astype(np.uint32) << np.uint32(k)
-            report_bits[c, slots] |= bits
+        # The gatekeeper IS the joiner's observer pre-admission (for both
+        # alert delivery and implicit invalidation).
+        obs_idx = np.asarray(state.obs_idx).copy()
+        obs_idx[:, slots] = pred
+        inval_obs = np.asarray(state.inval_obs).copy()
+        inval_obs[:, slots] = pred
+
+        # Mark each (joiner, ring) edge as fired now where a gatekeeper
+        # exists; delivery (rx-block + jitter) happens in the round body.
+        exists = (pred >= 0).T  # [j, k]
+        fd_fired = np.asarray(state.fd_fired).copy()
+        fd_fired[slots] = exists
+        fire_round = np.asarray(state.fire_round).copy()
+        fire_round[slots] = np.where(exists, int(state.round_idx), FIRE_NEVER)
 
         self.state = state._replace(
             join_pending=jnp.asarray(join_pending),
+            obs_idx=jnp.asarray(obs_idx),
             inval_obs=jnp.asarray(inval_obs),
-            report_bits=jnp.asarray(report_bits),
+            fd_fired=jnp.asarray(fd_fired),
+            fire_round=jnp.asarray(fire_round),
         )
 
     def assign_cohorts(self, cohort_of: np.ndarray) -> None:
@@ -756,7 +782,19 @@ class VirtualCluster:
         self.assign_cohorts(np.arange(self.cfg.n, dtype=np.int32) % self.cfg.c)
 
     def set_rx_block(self, rx_block: np.ndarray) -> None:
+        """Change per-cohort receive blocking. Re-stamps every fired edge to
+        the current round: the round body cond-skips delivery work once all
+        fired alerts have matured (their delivered set is static while
+        rx-blocks are fixed), so healing a partition mid-configuration must
+        re-open delivery or newly-hearable cohorts would never receive the
+        old alerts. Re-stamped alerts redeliver within ``delivery_spread``
+        rounds — a re-broadcast after the topology change."""
         self.faults = self.faults._replace(rx_block=jnp.asarray(rx_block, dtype=bool))
+        self.state = self.state._replace(
+            fire_round=jnp.where(
+                self.state.fd_fired, self.state.round_idx, self.state.fire_round
+            )
+        )
 
     # -- execution ------------------------------------------------------
 

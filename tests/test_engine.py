@@ -496,3 +496,64 @@ def test_concurrent_coordinators_partitioned_higher_rank_lower_wins():
         accepted_ranks = set(np.asarray(round_state.cp_vrnd_i)[acc].tolist())
         assert lo in accepted_ranks
         assert hi not in accepted_ranks
+
+
+def test_join_reports_respect_delivery_jitter():
+    # Join (UP) gatekeeper reports ride the same delayed-delivery machinery
+    # as DOWN alerts: with a delivery spread, some cohorts hear a joiner's
+    # rings strictly later, so the join cut takes at least as many rounds as
+    # the zero-jitter run — and never decides before ANY ring could arrive.
+    def run(spread):
+        vc = VirtualCluster.create(
+            60, n_slots=72, cohorts=16, fd_threshold=2, seed=21,
+            delivery_spread=spread,
+        )
+        vc.assign_cohorts_roundrobin()
+        vc.inject_join_wave(list(range(60, 72)))
+        rounds, events = vc.run_until_converged(max_steps=64)
+        assert events is not None
+        assert vc.membership_size == 72
+        return rounds
+
+    fast = run(0)
+    slow = run(5)
+    # Strict: with 16 cohorts x 12 joiners x 10 rings and spread 5, the
+    # deterministic per-(cohort, edge) hash draws make at least one needed
+    # ring arrive late in every cohort's tally — equality would mean the
+    # jitter was ignored entirely.
+    assert slow > fast
+
+
+def test_healed_partition_redelivers_old_alerts():
+    # A cohort blocked from every observer misses the DOWN alerts; after the
+    # delivery window matures the round body cond-skips delivery work. When
+    # the partition heals mid-configuration (set_rx_block), the old alerts
+    # must still reach the healed cohort (fired edges are re-stamped), or
+    # the fast round would stay short of quorum forever.
+    n = 100
+    vc = VirtualCluster.create(
+        n, cohorts=2, fd_threshold=2, seed=31, delivery_spread=2,
+        fallback_rounds=64,  # keep the classic fallback out of the way
+    )
+    cohort_of = np.zeros(n, dtype=np.int32)
+    cohort_of[60:] = 1  # 40% of members: fast quorum unreachable without them
+    vc.assign_cohorts(cohort_of)
+    victim = 33
+    vc.crash([victim])
+    rx = np.zeros((2, n), dtype=bool)
+    rx[1, :] = True  # cohort 1 hears nobody
+    vc.set_rx_block(rx)
+    for _ in range(20):  # well past max(fire_round) + spread
+        events = vc.step()
+        assert not bool(events.decided)
+    assert int(np.asarray(vc.state.report_bits)[1].sum()) == 0
+    # Heal the partition: cohort 1 must now receive the OLD alerts.
+    vc.set_rx_block(np.zeros((2, n), dtype=bool))
+    decided = False
+    for _ in range(16):
+        events = vc.step()
+        if bool(events.decided):
+            decided = True
+            break
+    assert decided, "healed cohort never received re-delivered alerts"
+    assert not vc.alive_mask[victim]
