@@ -32,8 +32,21 @@ def timed(label, fn):
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--small", action="store_true", help="scale down for quick runs")
+    parser.add_argument(
+        "--platform",
+        default=None,
+        help="force a jax platform (e.g. cpu). Default: the environment's "
+        "accelerator — pass cpu explicitly when the accelerator tunnel is "
+        "unavailable (jax.devices() hangs on a dead tunnel otherwise)",
+    )
     args = parser.parse_args()
     scale = 10 if args.small else 1
+
+    if args.platform:
+        from rapid_tpu.utils.platform import force_platform
+
+        if not force_platform(args.platform):
+            raise RuntimeError(f"could not force jax platform {args.platform!r}")
 
     import jax
     from rapid_tpu.models.virtual_cluster import VirtualCluster

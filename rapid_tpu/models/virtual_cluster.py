@@ -503,24 +503,32 @@ def apply_view_change_impl(
     cfg: EngineConfig, state: EngineState, winner_mask
 ) -> EngineState:
     """Commit a decided cut: flip membership, re-derive ring topology, reset
-    all per-configuration state (MembershipService.java:385-444)."""
+    all per-configuration state (MembershipService.java:385-444).
+
+    Joiners NOT in this cut stay pending into the new configuration: their
+    UP edges remain armed (gatekeeper observers kept, fired edges re-stamped
+    to round 0) so the alerts redeliver and a later cut admits them — unlike
+    DOWN alerts, which re-fire from the persistent crash masks, a wiped UP
+    edge would never re-fire and the joiner would be stranded forever."""
     n, k, c = cfg.n, cfg.k, cfg.c
     alive2 = state.alive ^ winner_mask
     topo = ring_topology(state.key_hi, state.key_lo, alive2)
     config_hi, config_lo = masked_set_hash(state.id_hi, state.id_lo, alive2)
+    still_pending = state.join_pending & ~winner_mask  # [n]
+    fd_fired2 = state.fd_fired & still_pending[:, None]
     return state._replace(
         alive=alive2,
-        obs_idx=topo.obs_idx,
+        obs_idx=jnp.where(still_pending[None, :], state.obs_idx, topo.obs_idx),
         subj_idx=topo.subj_idx,
-        inval_obs=topo.obs_idx + 0,
+        inval_obs=jnp.where(still_pending[None, :], state.inval_obs, topo.obs_idx),
         config_epoch=state.config_epoch + 1,
         config_hi=config_hi,
         config_lo=config_lo,
         n_members=jnp.sum(alive2, dtype=jnp.int32),
         fd_count=jnp.zeros((n, k), dtype=jnp.int32),
-        fd_fired=jnp.zeros((n, k), dtype=bool),
-        fire_round=jnp.full((n, k), FIRE_NEVER, dtype=jnp.int32),
-        join_pending=state.join_pending & ~winner_mask,
+        fd_fired=fd_fired2,
+        fire_round=jnp.where(fd_fired2, 0, FIRE_NEVER),
+        join_pending=still_pending,
         report_bits=jnp.zeros((c, n), dtype=jnp.uint32),
         seen_down=jnp.zeros((c,), dtype=bool),
         released=jnp.zeros((c, n), dtype=bool),

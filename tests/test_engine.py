@@ -557,3 +557,51 @@ def test_healed_partition_redelivers_old_alerts():
             break
     assert decided, "healed cohort never received re-delivered alerts"
     assert not vc.alive_mask[victim]
+
+
+def test_pending_joiner_survives_intervening_view_change():
+    # A joiner whose gatekeeper alerts are blocked for every cohort misses
+    # the first cut (a DOWN-only view change from a concurrent crash). Its
+    # UP edges must stay armed ACROSS that view change: once the block
+    # heals, the alerts redeliver in the new configuration and a later cut
+    # admits it — previously the view change wiped the fired-edge state and
+    # the joiner was stranded forever.
+    n = 100
+    h, l = 7, 3  # margin: blocking gatekeepers may cost other subjects rings
+    vc = VirtualCluster.create(n, n_slots=101, h=h, l=l, cohorts=2,
+                               fd_threshold=2, seed=41)
+    cohort_of = np.zeros(vc.cfg.n, dtype=np.int32)
+    cohort_of[50:] = 1
+    vc.assign_cohorts(cohort_of)
+    joiner = 100
+    vc.inject_join_wave([joiner])
+    gatekeepers = np.unique(np.asarray(vc.state.obs_idx)[:, joiner])
+    gatekeepers = set(gatekeepers[gatekeepers >= 0].tolist())
+    # Pick a victim whose cut detection survives the gatekeeper block: at
+    # most K - H of its observer rings may be blocked.
+    obs = np.asarray(vc.state.obs_idx)
+    victim = None
+    for cand in range(n):
+        overlap = sum(1 for s in obs[:, cand].tolist() if s in gatekeepers)
+        if cand not in gatekeepers and overlap <= vc.cfg.k - h:
+            victim = cand
+            break
+    assert victim is not None, "no victim candidate clears the precondition"
+    rx = np.zeros((vc.cfg.c, vc.cfg.n), dtype=bool)
+    rx[:, sorted(gatekeepers)] = True
+    vc.set_rx_block(rx)
+    vc.crash([victim])
+
+    rounds, events = vc.run_until_converged(max_steps=48)
+    assert events is not None
+    # First cut: DOWN-only (the joiner's reports never arrived anywhere).
+    assert not vc.alive_mask[victim]
+    assert vc.membership_size == n - 1
+    assert bool(np.asarray(vc.state.join_pending)[joiner])
+
+    # Heal: the joiner's old UP alerts must redeliver in the NEW config.
+    vc.set_rx_block(np.zeros((vc.cfg.c, vc.cfg.n), dtype=bool))
+    rounds, events = vc.run_until_converged(max_steps=48)
+    assert events is not None, "stranded joiner: UP edges were wiped by the view change"
+    assert vc.membership_size == n
+    assert bool(vc.alive_mask[joiner])
