@@ -9,6 +9,7 @@ u64 identifiers.
 
 from __future__ import annotations
 
+import functools
 import struct
 from typing import Dict, List, Tuple, Type
 
@@ -229,7 +230,14 @@ _RESPONSE_TAGS: Dict[Type, int] = {
 }
 
 
+@functools.lru_cache(maxsize=8)
 def encode_request(request: RapidRequest) -> bytes:
+    """Encode a request envelope. Memoized: broadcast fan-out sends the SAME
+    (frozen, hashable) request to every member, and a cache hit costs ~1/5 of
+    re-packing — the bytes are immutable, so sharing them is safe. The cache
+    is deliberately tiny: the reuse window is the handful of broadcasts whose
+    fan-out futures are interleaved on the loop at once, and a small LRU
+    avoids pinning dead request batches for the process lifetime."""
     w = _Writer()
     tag = _REQUEST_TAGS.get(type(request))
     if tag is None:
