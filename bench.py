@@ -135,6 +135,15 @@ def main() -> None:
 
     platform = jax.devices()[0].platform
 
+    # The Mosaic kernel path is strictly an optimization: smoke-test it once
+    # (pallas_usable) and drop to the bit-identical jnp core if it fails,
+    # rather than dying mid-benchmark on the accelerator.
+    from rapid_tpu.ops.pallas_kernels import pallas_usable
+
+    use_pallas = pallas_usable()
+    if platform == "tpu" and not use_pallas:
+        print("bench: pallas kernel unusable; using jnp core", file=sys.stderr)
+
     def build(seed: int):
         vc = VirtualCluster.create(
             n,
@@ -145,7 +154,7 @@ def main() -> None:
             cohorts=cohorts,
             fd_threshold=fd_threshold,
             seed=seed,
-            use_pallas=(platform == "tpu"),
+            use_pallas=use_pallas,
             delivery_spread=delivery_spread,
             concurrent_coordinators=2,
         )
@@ -230,7 +239,7 @@ def main() -> None:
                 cohorts=8,
                 fd_threshold=fd_threshold,
                 seed=seed,
-                use_pallas=(platform == "tpu"),
+                use_pallas=use_pallas,
                 delivery_spread=delivery_spread,
             )
             vcx.assign_cohorts_roundrobin()

@@ -230,14 +230,27 @@ _RESPONSE_TAGS: Dict[Type, int] = {
 }
 
 
-@functools.lru_cache(maxsize=8)
 def encode_request(request: RapidRequest) -> bytes:
-    """Encode a request envelope. Memoized: broadcast fan-out sends the SAME
-    (frozen, hashable) request to every member, and a cache hit costs ~1/5 of
-    re-packing — the bytes are immutable, so sharing them is safe. The cache
-    is deliberately tiny: the reuse window is the handful of broadcasts whose
-    fan-out futures are interleaved on the loop at once, and a small LRU
-    avoids pinning dead request batches for the process lifetime."""
+    """Encode a request envelope. Memoized when the request is hashable:
+    broadcast fan-out sends the SAME (frozen) request to every member, and a
+    cache hit costs ~1/5 of re-packing — the bytes are immutable, so sharing
+    them is safe. A request built with unhashable sequence fields (e.g.
+    lists) still encodes, just uncached."""
+    try:
+        return _encode_request_cached(request)
+    except TypeError:  # unhashable field values — encode without the cache
+        return _encode_request_impl(request)
+
+
+# Deliberately tiny cache: the reuse window is the handful of broadcasts
+# whose fan-out futures are interleaved on the loop at once, and a small LRU
+# avoids pinning dead request batches for the process lifetime.
+@functools.lru_cache(maxsize=8)
+def _encode_request_cached(request: RapidRequest) -> bytes:
+    return _encode_request_impl(request)
+
+
+def _encode_request_impl(request: RapidRequest) -> bytes:
     w = _Writer()
     tag = _REQUEST_TAGS.get(type(request))
     if tag is None:

@@ -121,6 +121,31 @@ def watermark_merge_classify(
     return bits.reshape(total)[:n].reshape(shape), cls.reshape(total)[:n].reshape(shape)
 
 
+@functools.lru_cache(maxsize=1)
+def pallas_usable() -> bool:
+    """Smoke-test the Mosaic kernel once on tiny shapes: True iff the pallas
+    path compiles, runs, and classifies correctly on the current backend.
+
+    Callers that embed ``use_pallas=True`` inside a LARGER jitted program
+    (the engine) cannot catch a Mosaic failure at their own compile time, so
+    they should consult this before opting in — the kernel is strictly an
+    optimization over the bit-identical jnp core. (``python -O`` safe: the
+    wrong-result check is a real branch, not an assert.)"""
+    if not (_HAS_PALLAS and jax.default_backend() == "tpu"):
+        return False
+    try:
+        zb = jnp.zeros((4, 2048), jnp.uint32)
+        _, cls = watermark_merge_classify(
+            zb, zb | jnp.uint32(0x1FF), jnp.ones((4, 2048), bool), 9, 4,
+            use_pallas=True,
+        )
+        if int(cls[0, 0]) != 2:  # popcount(0x1FF) = 9 >= H
+            raise RuntimeError("pallas kernel misclassified the smoke input")
+        return True
+    except Exception:  # noqa: BLE001 — any kernel failure means "don't use it"
+        return False
+
+
 def reports_matrix_to_bits(reports: jnp.ndarray) -> jnp.ndarray:
     """[..., n, k] bool report matrix -> [..., n] uint32 bitmasks."""
     k = reports.shape[-1]
