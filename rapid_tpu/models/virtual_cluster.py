@@ -717,6 +717,37 @@ class VirtualCluster:
         idx = jnp.asarray(np.asarray(slots, dtype=np.int32))
         self.faults = self.faults._replace(crashed=self.faults.crashed.at[idx].set(False))
 
+    def _stamp_fired_edges(self, slots: np.ndarray, edge_mask: np.ndarray) -> None:
+        """Mark (slot, ring) edges as fired at the current round (host-side
+        scatter); the round body's delivery machinery then applies per-cohort
+        rx-block masks and delay jitter. Shared by join waves and leaves."""
+        state = self.state
+        fd_fired = np.asarray(state.fd_fired).copy()
+        fire_round = np.asarray(state.fire_round).copy()
+        fd_fired[slots] = edge_mask
+        fire_round[slots] = np.where(edge_mask, int(state.round_idx), FIRE_NEVER)
+        self.state = state._replace(
+            fd_fired=jnp.asarray(fd_fired), fire_round=jnp.asarray(fire_round)
+        )
+
+    def initiate_leave(self, slots: Sequence[int]) -> None:
+        """Graceful batched leave: the LEAVER broadcasts its own departure as
+        a DOWN alert on every ring (LeaveMessage semantics,
+        MembershipService.java:296-307) — no fd_threshold detection delay.
+        The alert source is the leaver itself, so each slot becomes its own
+        column's observer: per-cohort delivery gates on hearing the LEAVER
+        (not its ring observers), exactly like the reference's self-broadcast.
+        Leavers also stop responding (crashed), so they cannot vote in their
+        own eviction. Implicit-invalidation observers (inval_obs) keep the
+        real ring topology."""
+        slots = np.asarray(slots, dtype=np.int32)
+        state = self.state
+        obs_idx = np.asarray(state.obs_idx).copy()
+        obs_idx[:, slots] = slots[None, :]
+        self.state = state._replace(obs_idx=jnp.asarray(obs_idx))
+        self._stamp_fired_edges(slots, np.ones((len(slots), self.cfg.k), dtype=bool))
+        self.crash(slots)
+
     def set_flaky_edges(self, probe_fail: np.ndarray) -> None:
         """Arbitrary per-(subject, ring) probe failures — asymmetric/one-way
         link patterns."""
@@ -764,21 +795,14 @@ class VirtualCluster:
         inval_obs = np.asarray(state.inval_obs).copy()
         inval_obs[:, slots] = pred
 
-        # Mark each (joiner, ring) edge as fired now where a gatekeeper
-        # exists; delivery (rx-block + jitter) happens in the round body.
-        exists = (pred >= 0).T  # [j, k]
-        fd_fired = np.asarray(state.fd_fired).copy()
-        fd_fired[slots] = exists
-        fire_round = np.asarray(state.fire_round).copy()
-        fire_round[slots] = np.where(exists, int(state.round_idx), FIRE_NEVER)
-
         self.state = state._replace(
             join_pending=jnp.asarray(join_pending),
             obs_idx=jnp.asarray(obs_idx),
             inval_obs=jnp.asarray(inval_obs),
-            fd_fired=jnp.asarray(fd_fired),
-            fire_round=jnp.asarray(fire_round),
         )
+        # Mark each (joiner, ring) edge as fired now where a gatekeeper
+        # exists; delivery (rx-block + jitter) happens in the round body.
+        self._stamp_fired_edges(slots, (pred >= 0).T)
 
     def assign_cohorts(self, cohort_of: np.ndarray) -> None:
         self.state = self.state._replace(cohort_of=jnp.asarray(cohort_of, dtype=jnp.int32))

@@ -605,3 +605,26 @@ def test_pending_joiner_survives_intervening_view_change():
     assert events is not None, "stranded joiner: UP edges were wiped by the view change"
     assert vc.membership_size == n
     assert bool(vc.alive_mask[joiner])
+
+
+def test_graceful_leave_converges_faster_than_crash():
+    # A graceful leave pre-fires the DOWN alerts (LeaveMessage semantics):
+    # the cut must commit without waiting fd_threshold probe windows, i.e.
+    # strictly faster than detecting the same member crashing.
+    def run(leave: bool):
+        vc = VirtualCluster.create(80, fd_threshold=4, seed=51)
+        if leave:
+            vc.initiate_leave([12, 40])
+        else:
+            vc.crash([12, 40])
+        rounds, events = vc.run_until_converged(max_steps=32)
+        assert events is not None
+        assert vc.membership_size == 78
+        assert not vc.alive_mask[[12, 40]].any()
+        return rounds
+
+    leave_rounds = run(True)
+    crash_rounds = run(False)
+    assert leave_rounds < crash_rounds
+    # No detection delay at all: decision lands within a couple of rounds.
+    assert leave_rounds <= 3
