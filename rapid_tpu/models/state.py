@@ -121,6 +121,11 @@ class EngineState(NamedTuple):
     # Rounds elapsed in this configuration (drives delivery-delay maturity).
     round_idx: jnp.ndarray  # int32
 
+    # Slots removed by some past view change: their identity lanes are spent
+    # (the engine's UUIDAlreadySeenError — re-admitting one would replay an
+    # old configuration id). Rejoiners must use fresh slots.
+    retired: jnp.ndarray  # [n] bool
+
 
 def initial_state(cfg: EngineConfig, key_hi, key_lo, id_hi, id_lo, alive) -> EngineState:
     """Build a configuration-consistent state from identity arrays."""
@@ -177,6 +182,7 @@ def initial_state(cfg: EngineConfig, key_hi, key_lo, id_hi, id_lo, alive) -> Eng
         cp_vval_src=jnp.full((n,), -1, dtype=jnp.int32),
         classic_epoch=jnp.int32(0),
         round_idx=jnp.int32(0),
+        retired=jnp.zeros((n,), dtype=bool),
     )
 
 

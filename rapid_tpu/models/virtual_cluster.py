@@ -518,6 +518,8 @@ def apply_view_change_impl(
     fd_fired2 = state.fd_fired & still_pending[:, None]
     return state._replace(
         alive=alive2,
+        # Departing members' identity lanes are spent forever.
+        retired=state.retired | (winner_mask & state.alive),
         obs_idx=jnp.where(still_pending[None, :], state.obs_idx, topo.obs_idx),
         subj_idx=topo.subj_idx,
         inval_obs=jnp.where(still_pending[None, :], state.inval_obs, topo.obs_idx),
@@ -772,10 +774,28 @@ class VirtualCluster:
         gatekeeper becomes the joiner slot's observer (`obs_idx`), the edge
         is marked fired this round, and ``_deliver_alerts`` then applies the
         per-cohort rx-block masks and delivery-delay jitter — so receivers
-        diverge on join reports exactly as they do on failure reports."""
+        diverge on join reports exactly as they do on failure reports.
+
+        Rejoin discipline: a node returning after removal must be admitted
+        through a FRESH slot (new identity lanes), never by re-admitting its
+        old slot — slot identities are the engine's UUIDs, and reusing one
+        would reproduce a previous configuration id (the reference rejects
+        reused UUIDs outright, UUIDAlreadySeenError)."""
         slots = np.asarray(slots)
         state = self.state
-        join_pending = np.asarray(state.join_pending).copy()
+        # Enforce the rejoin discipline host-side (the engine's
+        # UUIDAlreadySeenError): current members, already-pending joiners,
+        # and retired identity lanes are not admissible.
+        alive = np.asarray(state.alive)
+        pending = np.asarray(state.join_pending)
+        retired = np.asarray(state.retired)
+        bad = alive[slots] | pending[slots] | retired[slots]
+        if bad.any():
+            raise ValueError(
+                f"slots not admissible as joiners (member/pending/retired): "
+                f"{np.asarray(slots)[bad].tolist()}"
+            )
+        join_pending = pending.copy()
         join_pending[slots] = True
 
         # Expected observers (gatekeepers) of each joiner: the alive ring

@@ -73,6 +73,7 @@ def state_shardings(mesh: Mesh) -> EngineState:
         cp_vval_src=sh(NODE_AXIS),
         classic_epoch=sh(),
         round_idx=sh(),
+        retired=sh(NODE_AXIS),
     )
 
 

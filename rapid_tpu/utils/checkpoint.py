@@ -96,6 +96,7 @@ def load_engine_state(path) -> Tuple["EngineConfig", "EngineState"]:
                 jnp.int32(FIRE_NEVER),
             ),
             "round_idx": lambda: jnp.int32(0),
+            "retired": lambda: jnp.zeros((cfg.n,), dtype=bool),
         }
         arrays = {}
         for field in EngineState._fields:

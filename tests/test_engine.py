@@ -628,3 +628,46 @@ def test_graceful_leave_converges_faster_than_crash():
     assert leave_rounds < crash_rounds
     # No detection delay at all: decision lands within a couple of rounds.
     assert leave_rounds <= 3
+
+
+def test_rejoin_after_removal_uses_fresh_slot():
+    # Engine rejoin discipline: a removed member comes back through a FRESH
+    # slot (new identity lanes), mirroring the reference's new-UUID rejoin
+    # rule. The configuration id after rejoin must differ from every earlier
+    # configuration even though the "same node" is back.
+    vc = VirtualCluster.create(50, n_slots=52, fd_threshold=2, seed=61)
+    config0 = vc.config_id
+    victim = 9
+    vc.crash([victim])
+    rounds, events = vc.run_until_converged(max_steps=32)
+    assert events is not None
+    config1 = vc.config_id
+    assert config1 != config0
+    # The node returns as a new identity in slot 50.
+    vc.inject_join_wave([50])
+    rounds, events = vc.run_until_converged(max_steps=32)
+    assert events is not None
+    assert vc.membership_size == 50
+    assert bool(vc.alive_mask[50]) and not vc.alive_mask[victim]
+    config2 = vc.config_id
+    assert config2 not in (config0, config1)
+
+
+def test_readmitting_retired_slot_is_rejected():
+    # The engine's UUIDAlreadySeenError: identity lanes of a removed member
+    # are spent — re-admitting the slot would replay a prior configuration
+    # id, so inject_join_wave must refuse it.
+    import pytest
+
+    vc = VirtualCluster.create(50, n_slots=52, fd_threshold=2, seed=62)
+    vc.crash([9])
+    rounds, events = vc.run_until_converged(max_steps=32)
+    assert events is not None and not vc.alive_mask[9]
+    with pytest.raises(ValueError, match="retired"):
+        vc.inject_join_wave([9])
+    # Current members and already-pending joiners are equally inadmissible.
+    with pytest.raises(ValueError):
+        vc.inject_join_wave([3])
+    vc.inject_join_wave([50])
+    with pytest.raises(ValueError):
+        vc.inject_join_wave([50])
