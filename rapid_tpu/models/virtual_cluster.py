@@ -785,17 +785,16 @@ class VirtualCluster:
         state = self.state
         # Enforce the rejoin discipline host-side (the engine's
         # UUIDAlreadySeenError): current members, already-pending joiners,
-        # and retired identity lanes are not admissible.
-        alive = np.asarray(state.alive)
-        pending = np.asarray(state.join_pending)
-        retired = np.asarray(state.retired)
-        bad = alive[slots] | pending[slots] | retired[slots]
+        # and retired identity lanes are not admissible. One fused
+        # device->host fetch (a fetch is a full tunnel round trip).
+        inadmissible = np.asarray(state.alive | state.join_pending | state.retired)
+        bad = inadmissible[slots]
         if bad.any():
             raise ValueError(
                 f"slots not admissible as joiners (member/pending/retired): "
                 f"{np.asarray(slots)[bad].tolist()}"
             )
-        join_pending = pending.copy()
+        join_pending = np.asarray(state.join_pending).copy()
         join_pending[slots] = True
 
         # Expected observers (gatekeepers) of each joiner: the alive ring

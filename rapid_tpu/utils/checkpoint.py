@@ -13,9 +13,23 @@ and streamed to every joiner. This module makes that durable:
 
 from __future__ import annotations
 
+import logging
 from typing import TYPE_CHECKING, Tuple
 
 import numpy as np
+
+LOG = logging.getLogger(__name__)
+
+
+def _default_retired(cfg):
+    import jax.numpy as jnp
+
+    LOG.warning(
+        "checkpoint predates the 'retired' field: retirement history is "
+        "unrecoverable — do not re-admit previously-removed slots after "
+        "this resume"
+    )
+    return jnp.zeros((cfg.n,), dtype=bool)
 
 from rapid_tpu.messaging.codec import (
     Reader,
@@ -96,7 +110,12 @@ def load_engine_state(path) -> Tuple["EngineConfig", "EngineState"]:
                 jnp.int32(FIRE_NEVER),
             ),
             "round_idx": lambda: jnp.int32(0),
-            "retired": lambda: jnp.zeros((cfg.n,), dtype=bool),
+            # NOT per-configuration state: retirement is cross-configuration
+            # history and cannot be reconstructed from an old checkpoint.
+            # Resuming one forgets which identity lanes were spent — callers
+            # must not re-admit previously-removed slots after such a resume
+            # (warned below).
+            "retired": lambda: _default_retired(cfg),
         }
         arrays = {}
         for field in EngineState._fields:

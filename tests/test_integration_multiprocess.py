@@ -96,3 +96,17 @@ def test_five_agents_converge_and_survive_a_kill(runner):
     runner.kill(victim)
     survivors = [p for p in ports if p != victim]
     assert runner.wait_for_size(survivors, 4, timeout_s=120)
+
+
+def test_ten_agents_converge(runner):
+    # RapidNodeRunnerTest's 10-JVM bring-up (RapidNodeRunnerTest.java:28-57):
+    # ten real OS processes join through one seed and all converge on the
+    # same membership size.
+    ports = [BASE_PORT + 40 + i for i in range(10)]
+    runner.spawn(ports[0], ports[0])
+    assert runner.wait_for_size([ports[0]], 1, timeout_s=30)
+    for port in ports[1:]:
+        runner.spawn(port, ports[0])
+    assert runner.wait_for_size(ports, 10, timeout_s=90)
+    for port in ports:
+        assert runner.procs[port].poll() is None  # every agent still alive
