@@ -8,6 +8,10 @@ from rapid_tpu.monitoring.ping_pong import (
     PingPongFailureDetectorFactory,
 )
 from rapid_tpu.monitoring.static_fd import StaticFailureDetector, StaticFailureDetectorFactory
+from rapid_tpu.monitoring.windowed import (
+    WindowedFailureDetector,
+    WindowedFailureDetectorFactory,
+)
 
 __all__ = [
     "EdgeFailureDetector",
@@ -17,4 +21,6 @@ __all__ = [
     "PingPongFailureDetectorFactory",
     "StaticFailureDetector",
     "StaticFailureDetectorFactory",
+    "WindowedFailureDetector",
+    "WindowedFailureDetectorFactory",
 ]

@@ -1,0 +1,140 @@
+"""Windowed-fraction failure detector (the paper's §7 policy): fail when
+>= 40% of the last 10 probes failed; transient blips age out of the window
+(unlike the shipped counter policy, which latches them)."""
+
+import asyncio
+import functools
+
+from rapid_tpu.monitoring.windowed import WindowedFailureDetector
+from rapid_tpu.types import Endpoint, NodeStatus, ProbeResponse
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        asyncio.run(asyncio.wait_for(fn(*args, **kwargs), timeout=30))
+
+    return wrapper
+
+
+class ScriptedClient:
+    """Probe responses played from a script: True = OK, False = drop."""
+
+    def __init__(self, script):
+        self.script = list(script)
+
+    async def send_best_effort(self, remote, request):
+        ok = self.script.pop(0) if self.script else True
+        return ProbeResponse(status=NodeStatus.OK) if ok else None
+
+
+def make_fd(script, fired):
+    return WindowedFailureDetector(
+        my_addr=Endpoint("127.0.0.1", 1),
+        subject=Endpoint("127.0.0.1", 2),
+        client=ScriptedClient(script),
+        notifier=lambda: fired.append(True),
+        window=10,
+        fail_fraction=0.4,
+    )
+
+
+@async_test
+async def test_four_of_ten_failures_fire():
+    fired = []
+    fd = make_fd([True] * 6 + [False] * 4, fired)
+    for _ in range(10):
+        await fd.tick()
+    assert fired == [True]
+
+
+@async_test
+async def test_three_of_ten_failures_do_not_fire():
+    fired = []
+    fd = make_fd([True, False] * 3 + [True] * 10, fired)  # never 4 in-window
+    for _ in range(16):
+        await fd.tick()
+    assert fired == []
+
+
+@async_test
+async def test_transient_blips_age_out_of_window():
+    # 3 early failures, then healthy: the failures scroll out and later
+    # isolated blips never accumulate to the threshold — unlike the shipped
+    # counter policy, which would latch all of them forever.
+    fired = []
+    script = [False] * 3 + [True] * 10 + [False] + [True] * 10 + [False] + [True] * 10
+    fd = make_fd(script, fired)
+    for _ in range(len(script)):
+        await fd.tick()
+    assert fired == []
+
+
+@async_test
+async def test_window_must_fill_before_firing():
+    fired = []
+    fd = make_fd([False] * 9, fired)  # 9 failures but window of 10 not full
+    for _ in range(9):
+        await fd.tick()
+    assert fired == []
+    await fd.tick()  # 10th probe (script empty -> OK): window full, 9/10 fail
+    assert fired == [True]
+
+
+@async_test
+async def test_fires_only_once():
+    fired = []
+    fd = make_fd([False] * 20, fired)
+    for _ in range(20):
+        await fd.tick()
+    assert fired == [True]
+
+
+@async_test
+async def test_windowed_fd_drives_cluster_eviction():
+    # End-to-end: an in-process cluster monitored by the WINDOWED policy
+    # detects a blackholed member and evicts it through consensus.
+    import random
+
+    from rapid_tpu.messaging.inprocess import (
+        InProcessClient,
+        InProcessNetwork,
+        InProcessServer,
+    )
+    from rapid_tpu.monitoring.windowed import WindowedFailureDetectorFactory
+    from rapid_tpu.protocol.cluster import Cluster
+    from rapid_tpu.settings import Settings
+
+    network = InProcessNetwork()
+    s = Settings()
+    s.batching_window_ms = 20
+    s.failure_detector_interval_ms = 25
+    eps = [Endpoint("127.0.0.1", 46200 + i) for i in range(4)]
+    clusters = []
+    try:
+        for i, e in enumerate(eps):
+            client = InProcessClient(network, e, s)
+            server = InProcessServer(network, e)
+            fd = WindowedFailureDetectorFactory(e, client, window=4, fail_fraction=0.5)
+            if i == 0:
+                c = await Cluster.start(e, settings=s, client=client, server=server,
+                                        fd_factory=fd, rng=random.Random(0))
+            else:
+                c = await Cluster.join(eps[0], e, settings=s, client=client,
+                                       server=server, fd_factory=fd,
+                                       rng=random.Random(i))
+            clusters.append(c)
+
+        async def converged(cs, size):
+            for _ in range(600):
+                if all(c.membership_size == size for c in cs):
+                    return True
+                await asyncio.sleep(0.02)
+            return all(c.membership_size == size for c in cs)
+
+        assert await converged(clusters, 4)
+        victim = clusters[3]
+        network.blackholed.add(victim.listen_address)
+        assert await converged(clusters[:3], 3)
+    finally:
+        await asyncio.gather(*(c.shutdown() for c in clusters), return_exceptions=True)
