@@ -28,6 +28,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from rapid_tpu.messaging.tcp import TcpClient, TcpServer
 from rapid_tpu.messaging.udp import UdpHybridClient, UdpHybridServer
+from rapid_tpu.monitoring.windowed import WindowedFailureDetectorFactory
 from rapid_tpu.protocol.cluster import Cluster
 from rapid_tpu.protocol.events import ClusterEvents
 from rapid_tpu.settings import Settings
@@ -60,15 +61,22 @@ async def run(args) -> None:
     else:
         client, server = TcpClient(listen, settings), TcpServer(listen)
 
+    fd_factory = None  # default: ping-pong consecutive-failure counter
+    if args.fd == "windowed":
+        # The paper's stated policy: >=40% of the last 10 probes failed.
+        fd_factory = WindowedFailureDetectorFactory(listen, client)
+
     if listen == seed:
         LOG.info("starting cluster as seed at %s", listen)
         cluster = await Cluster.start(
-            listen, settings=settings, client=client, server=server, metadata=metadata
+            listen, settings=settings, client=client, server=server,
+            metadata=metadata, fd_factory=fd_factory,
         )
     else:
         LOG.info("joining cluster at %s from %s", seed, listen)
         cluster = await Cluster.join(
-            seed, listen, settings=settings, client=client, server=server, metadata=metadata
+            seed, listen, settings=settings, client=client, server=server,
+            metadata=metadata, fd_factory=fd_factory,
         )
 
     for event in (
@@ -104,6 +112,10 @@ def main() -> None:
     parser.add_argument("--role", default="", help="role metadata tag shared with the cluster")
     parser.add_argument("--transport", choices=("tcp", "udp"), default="tcp",
                         help="tcp: everything over TCP; udp: hybrid with datagram alerts/votes")
+    parser.add_argument("--fd", choices=("pingpong", "windowed"), default="pingpong",
+                        help="failure-detection policy: pingpong = consecutive-failure "
+                        "counter (the reference code's); windowed = fraction of the "
+                        "last-N probes (the paper's)")
     parser.add_argument("--report-interval", type=float, default=1.0)
     args = parser.parse_args()
     logging.basicConfig(

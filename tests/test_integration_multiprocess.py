@@ -26,7 +26,7 @@ class AgentRunner:
         self.tmp_path = tmp_path
         self.procs = {}
 
-    def spawn(self, port: int, seed_port: int, role: str = "") -> None:
+    def spawn(self, port: int, seed_port: int, role: str = "", extra=()) -> None:
         log = open(self.tmp_path / f"agent-{port}.log", "wb")
         env = dict(os.environ)
         env["PYTHONPATH"] = f"{REPO}:{env.get('PYTHONPATH', '')}"
@@ -39,6 +39,7 @@ class AgentRunner:
         ]
         if role:
             args += ["--role", role]
+        args += list(extra)
         self.procs[port] = subprocess.Popen(
             args, stdout=log, stderr=subprocess.STDOUT, env=env, cwd=str(REPO)
         )
@@ -110,3 +111,16 @@ def test_ten_agents_converge(runner):
     assert runner.wait_for_size(ports, 10, timeout_s=90)
     for port in ports:
         assert runner.procs[port].poll() is None  # every agent still alive
+
+
+def test_windowed_fd_agents_detect_kill(runner):
+    # Real processes on the PAPER's failure-detection policy (--fd windowed):
+    # a SIGKILLed member is detected and evicted by the survivors.
+    ports = [BASE_PORT + 60 + i for i in range(3)]
+    runner.spawn(ports[0], ports[0], extra=["--fd", "windowed"])
+    assert runner.wait_for_size([ports[0]], 1, timeout_s=30)
+    for port in ports[1:]:
+        runner.spawn(port, ports[0], extra=["--fd", "windowed"])
+    assert runner.wait_for_size(ports, 3, timeout_s=60)
+    runner.kill(ports[2], signal.SIGKILL)
+    assert runner.wait_for_size(ports[:2], 2, timeout_s=90)
