@@ -60,6 +60,14 @@ class EngineConfig(NamedTuple):
     # coordinator can win phase 1 yet have its phase 2a rejected wherever a
     # higher rank's phase 1a also arrived.
     concurrent_coordinators: int = 1
+    # Failure-detection policy (NEW FIELDS APPEND HERE: EngineConfig loads
+    # positionally from checkpoints). 0 = the reference code's
+    # cumulative-failure counter (fd_count >= fd_threshold). W in [1, 32] =
+    # the PAPER's windowed policy: an edge fires when >= fd_threshold of its
+    # last W probe windows failed — kept per edge as a uint32 bit-history
+    # (shift + popcount per round; rapid_tpu/monitoring/windowed.py is the
+    # host twin). Intermittent blips age out instead of accumulating forever.
+    fd_window: int = 0
 
 
 class EngineState(NamedTuple):
@@ -81,7 +89,8 @@ class EngineState(NamedTuple):
     n_members: jnp.ndarray  # int32 — membership size of this configuration
 
     # Failure-detector state per monitoring edge (subject, ring).
-    fd_count: jnp.ndarray  # [n, k] int32 consecutive failed windows
+    fd_count: jnp.ndarray  # [n, k] int32 cumulative failed windows
+    fd_hist: jnp.ndarray  # [n, k] uint32 bit-history of outcomes (windowed mode)
     fd_fired: jnp.ndarray  # [n, k] bool alert already emitted
     fire_round: jnp.ndarray  # [n, k] int32 round the alert fired (FIRE_NEVER if not)
 
@@ -140,6 +149,16 @@ def initial_state(cfg: EngineConfig, key_hi, key_lo, id_hi, id_lo, alive) -> Eng
         )
     if cfg.delivery_spread < 0:
         raise ValueError(f"delivery_spread must be >= 0, got {cfg.delivery_spread}")
+    if not 0 <= cfg.fd_window <= 32:
+        raise ValueError(
+            f"fd_window must be 0 (counter mode) or 1..32 (uint32 bit-history), "
+            f"got {cfg.fd_window}"
+        )
+    if cfg.fd_window and cfg.fd_threshold > cfg.fd_window:
+        raise ValueError(
+            f"fd_threshold ({cfg.fd_threshold}) cannot exceed fd_window "
+            f"({cfg.fd_window}): the edge could never fire"
+        )
     alive = jnp.asarray(alive, dtype=bool)
     topo = ring_topology(jnp.asarray(key_hi), jnp.asarray(key_lo), alive)
     config_hi, config_lo = masked_set_hash(jnp.asarray(id_hi), jnp.asarray(id_lo), alive)
@@ -160,6 +179,7 @@ def initial_state(cfg: EngineConfig, key_hi, key_lo, id_hi, id_lo, alive) -> Eng
         config_lo=config_lo,
         n_members=jnp.sum(alive, dtype=jnp.int32),
         fd_count=jnp.zeros((n, k), dtype=jnp.int32),
+        fd_hist=jnp.zeros((n, k), dtype=jnp.uint32),
         fd_fired=jnp.zeros((n, k), dtype=bool),
         fire_round=jnp.full((n, k), FIRE_NEVER, dtype=jnp.int32),
         join_pending=jnp.zeros((n,), dtype=bool),

@@ -74,14 +74,39 @@ def _edge_masks(cfg: EngineConfig, state: EngineState, faults: FaultInputs):
 def _fd_tick(cfg: EngineConfig, state: EngineState, faults: FaultInputs, observer_active):
     """Every observer probes its subjects; edges past the failure threshold
     emit one DOWN alert (semantics of PingPongFailureDetector + the
-    edge-failure notification path, MembershipService.java:472-495)."""
+    edge-failure notification path, MembershipService.java:472-495).
+
+    Two policies (cfg.fd_window): the reference code's cumulative counter,
+    or the paper's windowed fraction — a uint32 bit-history per edge, fire
+    when >= fd_threshold of the last fd_window probe outcomes failed.
+    Intermittent blips age out of the window; the counter latches them."""
     subject_down = faults.crashed[:, None] | faults.probe_fail
     probe_failed = observer_active & subject_down & state.alive[:, None]
 
-    fd_count = jnp.where(probe_failed, state.fd_count + 1, state.fd_count)
-    fire = (fd_count >= cfg.fd_threshold) & ~state.fd_fired & state.alive[:, None]
+    if cfg.fd_window:
+        # Windowed mode, matching the host twin exactly: the history shifts
+        # only when a probe actually happened (an inactive observer
+        # contributes no outcome — implicit successes would decay real
+        # failure history), and the edge cannot fire until a FULL window of
+        # probes has been observed. fd_count counts PROBES here (its only
+        # windowed-mode meaning), so stagger_fd_counts' negative offsets
+        # still jitter detection by delaying window-full.
+        probed = observer_active & state.alive[:, None]
+        fd_count = jnp.where(probed, state.fd_count + 1, state.fd_count)
+        window_mask = jnp.uint32((1 << cfg.fd_window) - 1)
+        shifted = ((state.fd_hist << 1) | probe_failed.astype(jnp.uint32)) & window_mask
+        fd_hist = jnp.where(probed, shifted, state.fd_hist)
+        past_threshold = (_popcount32(fd_hist) >= cfg.fd_threshold) & (
+            fd_count >= cfg.fd_window
+        )
+    else:
+        # Counter mode (the reference code): fd_count counts FAILURES.
+        fd_count = jnp.where(probe_failed, state.fd_count + 1, state.fd_count)
+        fd_hist = state.fd_hist
+        past_threshold = fd_count >= cfg.fd_threshold
+    fire = past_threshold & ~state.fd_fired & state.alive[:, None]
     fd_fired = state.fd_fired | fire
-    return fd_count, fd_fired, fire
+    return fd_count, fd_hist, fd_fired, fire
 
 
 def _deliver_alerts(cfg: EngineConfig, state: EngineState, fire_round, blocked_words):
@@ -206,7 +231,7 @@ def _compute_round(
     if edge_masks is None:
         edge_masks = _edge_masks(cfg, state, faults)
     observer_active, blocked_words = edge_masks
-    fd_count, fd_fired, fire = _fd_tick(cfg, state, faults, observer_active)
+    fd_count, fd_hist, fd_fired, fire = _fd_tick(cfg, state, faults, observer_active)
     fire_round = jnp.where(fire, state.round_idx, state.fire_round)
     alerts_emitted = jnp.sum(fire, dtype=jnp.int32)
 
@@ -444,6 +469,7 @@ def _compute_round(
 
     round_state = state._replace(
         fd_count=fd_count,
+        fd_hist=fd_hist,
         fd_fired=fd_fired,
         fire_round=fire_round,
         round_idx=state.round_idx + 1,
@@ -528,6 +554,7 @@ def apply_view_change_impl(
         config_lo=config_lo,
         n_members=jnp.sum(alive2, dtype=jnp.int32),
         fd_count=jnp.zeros((n, k), dtype=jnp.int32),
+        fd_hist=jnp.zeros((n, k), dtype=jnp.uint32),
         fd_fired=fd_fired2,
         fire_round=jnp.where(fd_fired2, 0, FIRE_NEVER),
         join_pending=still_pending,
@@ -647,6 +674,7 @@ class VirtualCluster:
         fallback_rounds: int = 8,
         delivery_spread: int = 0,
         concurrent_coordinators: int = 1,
+        fd_window: int = 0,
     ) -> "VirtualCluster":
         """Synthetic cluster: slot identities are random 64-bit lanes (the
         host never materializes 100K endpoint strings; interop deployments
@@ -658,6 +686,7 @@ class VirtualCluster:
             use_pallas=use_pallas, fallback_rounds=fallback_rounds,
             delivery_spread=delivery_spread,
             concurrent_coordinators=concurrent_coordinators,
+            fd_window=fd_window,
         )
         rng = np.random.default_rng(seed)
         key_hi = rng.integers(0, 2**32, size=(k, n), dtype=np.uint32)
@@ -684,6 +713,7 @@ class VirtualCluster:
         fallback_rounds: int = 8,
         delivery_spread: int = 0,
         concurrent_coordinators: int = 1,
+        fd_window: int = 0,
     ) -> "VirtualCluster":
         """Build from real endpoints with the host view's exact ring keys, so
         the engine's topology matches a host MembershipView bit-for-bit."""
@@ -694,6 +724,7 @@ class VirtualCluster:
             use_pallas=use_pallas, fallback_rounds=fallback_rounds,
             delivery_spread=delivery_spread,
             concurrent_coordinators=concurrent_coordinators,
+            fd_window=fd_window,
         )
         key_hi0, key_lo0 = endpoint_ring_keys(endpoints, k)
         key_hi = np.zeros((k, n), dtype=np.uint32)

@@ -51,6 +51,7 @@ def state_shardings(mesh: Mesh) -> EngineState:
         config_lo=sh(),
         n_members=sh(),
         fd_count=sh(NODE_AXIS, None),
+        fd_hist=sh(NODE_AXIS, None),
         fd_fired=sh(NODE_AXIS, None),
         fire_round=sh(NODE_AXIS, None),
         join_pending=sh(NODE_AXIS),

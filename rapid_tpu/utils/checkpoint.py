@@ -110,6 +110,7 @@ def load_engine_state(path) -> Tuple["EngineConfig", "EngineState"]:
                 jnp.int32(FIRE_NEVER),
             ),
             "round_idx": lambda: jnp.int32(0),
+            "fd_hist": lambda: jnp.zeros((cfg.n, cfg.k), dtype=jnp.uint32),
             # NOT per-configuration state: retirement is cross-configuration
             # history and cannot be reconstructed from an old checkpoint.
             # Resuming one forgets which identity lanes were spent — callers

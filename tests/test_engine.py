@@ -671,3 +671,45 @@ def test_readmitting_retired_slot_is_rejected():
     vc.inject_join_wave([50])
     with pytest.raises(ValueError):
         vc.inject_join_wave([50])
+
+
+def test_windowed_fd_mode_forgives_intermittent_blips():
+    # Device-side windowed policy (cfg.fd_window, the paper's rule): an edge
+    # failing 1 round in every 4 never accumulates fd_threshold failures
+    # within the window, so it NEVER fires — while the reference code's
+    # cumulative counter latches every blip and eventually evicts. A
+    # persistent failure still fires in both modes.
+    n = 60
+
+    def run(fd_window, flaky_period, rounds):
+        vc = VirtualCluster.create(
+            n, k=10, h=7, l=3, fd_threshold=4, seed=71, fd_window=fd_window
+        )
+        probe_fail = np.zeros((vc.cfg.n, vc.cfg.k), dtype=bool)
+        on = np.zeros_like(probe_fail)
+        on[13, :] = True  # all of subject 13's edges blip together
+        decided = False
+        for r in range(rounds):
+            vc.set_flaky_edges(on if r % flaky_period == 0 else probe_fail)
+            events = vc.step()
+            decided |= bool(events.decided)
+        return decided, vc
+
+    # Intermittent (1-in-4): windowed mode (window 8, threshold 4) forgives.
+    decided, vc = run(fd_window=8, flaky_period=4, rounds=40)
+    assert not decided
+    assert vc.membership_size == n
+    # Same blips under the cumulative counter: latched and evicted.
+    decided, vc = run(fd_window=0, flaky_period=4, rounds=40)
+    assert decided
+    assert vc.membership_size == n - 1
+
+    # Persistent failure fires in windowed mode too — but never before a
+    # FULL window of probes has been observed (host-twin parity: the
+    # sliding window must fill first).
+    vc = VirtualCluster.create(n, fd_threshold=4, seed=72, fd_window=8)
+    vc.crash([21])
+    rounds, events = vc.run_until_converged(max_steps=32)
+    assert events is not None
+    assert not vc.alive_mask[21]
+    assert rounds >= 8
