@@ -107,3 +107,52 @@ def test_host_rejoin_cycles():
             await c.shutdown()
 
     asyncio.run(asyncio.wait_for(scenario(), timeout=90))
+
+
+def test_engine_mixed_lifecycle_soak_with_jitter_and_windowed_fd():
+    # Long-haul: 16 configurations of mixed churn (crashes, joins, graceful
+    # leaves) under delivery jitter, many cohorts, racing coordinators, and
+    # the windowed FD policy — the cross-configuration state carried between
+    # epochs (retired lanes, pending joiners, fd histories, delivery stamps)
+    # must stay exact the whole way.
+    n_slots = 512
+    vc = VirtualCluster.create(
+        360, n_slots=n_slots, fd_threshold=3, seed=30, cohorts=32,
+        delivery_spread=2, concurrent_coordinators=2, fd_window=8,
+    )
+    vc.assign_cohorts_roundrobin()
+    rng = np.random.default_rng(30)
+    expected = 360
+    gone: set = set()
+    next_join = 360
+
+    for epoch in range(16):
+        kind = epoch % 4
+        if kind in (0, 2):
+            alive_slots = np.nonzero(vc.alive_mask)[0]
+            victims = rng.choice(alive_slots, size=4, replace=False)
+            if kind == 0:
+                vc.crash(victims)
+            else:
+                vc.initiate_leave(victims)
+            gone.update(int(v) for v in victims)
+            expected -= len(victims)
+        else:
+            wave = list(range(next_join, min(next_join + 8, n_slots)))
+            if not wave:
+                continue
+            vc.inject_join_wave(wave)
+            next_join += len(wave)
+            expected += len(wave)
+
+        rounds, events = vc.run_until_converged(max_steps=64)
+        assert events is not None, f"epoch {epoch} did not converge"
+        assert vc.membership_size == expected, f"epoch {epoch}"
+        alive = vc.alive_mask
+        assert not any(alive[g] for g in gone), "a departed slot came back"
+        # Departed lanes are retired; none is ever admissible again.
+        retired = np.asarray(vc.state.retired)
+        assert all(retired[g] for g in gone)
+
+    assert int(vc.state.rounds_undecided) == 0
+    assert not bool(np.asarray(vc.state.announced).any())
