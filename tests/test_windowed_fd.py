@@ -138,3 +138,68 @@ async def test_windowed_fd_drives_cluster_eviction():
         assert await converged(clusters[:3], 3)
     finally:
         await asyncio.gather(*(c.shutdown() for c in clusters), return_exceptions=True)
+
+
+@async_test
+async def test_host_and_device_windowed_rules_agree():
+    # The ACTUAL engine rule (_fd_tick with cfg.fd_window) must fire on
+    # exactly the same probe index as the host detector for any outcome
+    # script — driven through the real device code, not a replica.
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rapid_tpu.models.state import EngineConfig, FaultInputs, initial_state
+    from rapid_tpu.models.virtual_cluster import _fd_tick
+
+    window, threshold = 6, 3
+    n, k = 4, 3
+    cfg = EngineConfig(n=n, k=k, h=3, l=1, c=1, fd_threshold=threshold,
+                       fd_window=window)
+    rng = np.random.default_rng(5)
+    key = rng.integers(0, 2**32, size=(k, n), dtype=np.uint32)
+    ids = rng.integers(0, 2**32, size=(n,), dtype=np.uint32)
+    base_state = initial_state(cfg, key, key, ids, ids, np.ones(n, dtype=bool))
+    observer_active = jnp.ones((n, k), dtype=bool)
+    edge = (1, 0)  # subject 1, ring 0
+
+    for trial in range(50):
+        script = (rng.random(40) < 0.35).tolist()  # True = probe FAILED
+
+        # Host twin (client script: True = OK, so invert).
+        fired = []
+        fd = WindowedFailureDetector(
+            my_addr=Endpoint("127.0.0.1", 1),
+            subject=Endpoint("127.0.0.1", 2),
+            client=ScriptedClient([not failed for failed in script]),
+            notifier=lambda: fired.append(True),
+            window=window,
+            fail_fraction=threshold / window,
+        )
+        host_fire = None
+        for i in range(len(script)):
+            await fd.tick()
+            if fired and host_fire is None:
+                host_fire = i
+
+        # Device side: step the REAL _fd_tick with the same outcome per
+        # round on one edge.
+        state = base_state
+        dev_fire = None
+        for i, failed in enumerate(script):
+            probe_fail = np.zeros((n, k), dtype=bool)
+            probe_fail[edge] = failed
+            faults = FaultInputs.none(cfg)._replace(
+                probe_fail=jnp.asarray(probe_fail)
+            )
+            fd_count, fd_hist, fd_fired, fire = _fd_tick(
+                cfg, state, faults, observer_active
+            )
+            state = state._replace(
+                fd_count=fd_count, fd_hist=fd_hist, fd_fired=fd_fired
+            )
+            if dev_fire is None and bool(np.asarray(fire)[edge]):
+                dev_fire = i
+
+        assert host_fire == dev_fire, (
+            f"trial {trial}: host fired at {host_fire}, device at {dev_fire}"
+        )
