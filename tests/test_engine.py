@@ -713,3 +713,20 @@ def test_windowed_fd_mode_forgives_intermittent_blips():
     assert events is not None
     assert not vc.alive_mask[21]
     assert rounds >= 8
+
+
+def test_ring_count_boundaries_converge():
+    # K=3 (the protocol minimum) and K=32 (the uint32 ring-bitmask width)
+    # must both drive a full crash convergence — no hidden K=10 assumptions
+    # in packing, delivery, or the watermark pass.
+    for k, h, l in ((3, 3, 1), (16, 14, 5), (32, 29, 10)):
+        vc = VirtualCluster.create(
+            80, k=k, h=h, l=l, fd_threshold=2, seed=81, cohorts=4,
+            delivery_spread=1,
+        )
+        vc.assign_cohorts_roundrobin()
+        vc.crash([11, 42])
+        rounds, events = vc.run_until_converged(max_steps=48)
+        assert events is not None, f"K={k} did not converge"
+        assert vc.membership_size == 78
+        assert not vc.alive_mask[[11, 42]].any()
