@@ -217,7 +217,14 @@ async def test_one_failure_out_of_ten():
 async def test_three_failures_out_of_fifteen_single_cut():
     network = InProcessNetwork()
     fd = StaticFailureDetectorFactory()
-    clusters = await start_cluster(15, network, fd_factory=fd)
+    # A generous batching window: the single-cut assertion below is about
+    # the BATCHING invariant, not about timing luck — under host CPU
+    # contention the three detections can straddle a 20 ms quiescence window
+    # and legitimately split into two cuts, which is not what this test is
+    # probing.
+    settings = fast_settings()
+    settings.batching_window_ms = 200
+    clusters = await start_cluster(15, network, fd_factory=fd, settings=settings)
     try:
         assert await wait_until(lambda: all_converged(clusters, 15))
         victims = [clusters[3], clusters[8], clusters[12]]
