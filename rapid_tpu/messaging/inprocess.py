@@ -69,13 +69,21 @@ class ClientDelayer:
     def __init__(self, message_type: Type) -> None:
         self._type = message_type
         self._event = asyncio.Event()
+        # Messages currently parked on the latch — tests sequence on this
+        # instead of sleeping (a fixed sleep can miss the interleaving and
+        # silently skip the path under test).
+        self.held = 0
 
     def open(self) -> None:
         self._event.set()
 
     async def maybe_delay(self, request: RapidRequest) -> None:
         if isinstance(request, self._type) and not self._event.is_set():
-            await self._event.wait()
+            self.held += 1
+            try:
+                await self._event.wait()
+            finally:
+                self.held -= 1
 
 
 class InProcessServer(MessagingServer):

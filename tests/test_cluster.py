@@ -9,12 +9,18 @@ import random
 import pytest
 
 from rapid_tpu.errors import JoinError
-from rapid_tpu.messaging.inprocess import InProcessNetwork, ServerDropFirstN
+from rapid_tpu.messaging.inprocess import (
+    ClientDelayer,
+    InProcessClient,
+    InProcessNetwork,
+    InProcessServer,
+    ServerDropFirstN,
+)
 from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
 from rapid_tpu.protocol.cluster import Cluster
 from rapid_tpu.protocol.events import ClusterEvents
 from rapid_tpu.settings import Settings
-from rapid_tpu.types import Endpoint, PreJoinMessage
+from rapid_tpu.types import Endpoint, JoinMessage, PreJoinMessage
 
 BASE_PORT = 1234
 
@@ -493,5 +499,82 @@ async def test_concurrent_joins_and_failures():
         clusters += list(wave)  # before any assert: finally must reap the wave
         survivors = [c for c in clusters if c not in victims]
         assert await wait_until(lambda: all_converged(survivors, 35), timeout_s=40)
+    finally:
+        await shutdown_all(clusters)
+
+
+@async_test
+async def test_phase2_drops_within_rpc_retries():
+    # ClusterTest.phase2MessageDropsRpcRetries: the seed drops phase-2
+    # JoinMessages retries-1 times — RPC-level retries alone must get the
+    # joiner through, without re-initiating the join.
+    network = InProcessNetwork()
+    settings = fast_settings()
+    seed = await Cluster.start(ep(0), settings=settings, network=network,
+                               fd_factory=StaticFailureDetectorFactory())
+    network.servers[ep(0)].drop_interceptors.append(
+        ServerDropFirstN(JoinMessage, settings.rpc_default_retries - 1)
+    )
+    joiner = await Cluster.join(ep(0), ep(1), settings=settings, network=network,
+                                fd_factory=StaticFailureDetectorFactory())
+    clusters = [seed, joiner]
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 2))
+    finally:
+        await shutdown_all(clusters)
+
+
+@async_test
+async def test_phase2_drops_force_join_reattempt():
+    # ClusterTest.phase2JoinAttemptRetry: the seed drops MORE phase-2
+    # messages than the RPC retry budget — the first join attempt fails and
+    # the client must re-initiate the whole join, which then succeeds.
+    network = InProcessNetwork()
+    settings = fast_settings()
+    seed = await Cluster.start(ep(0), settings=settings, network=network,
+                               fd_factory=StaticFailureDetectorFactory())
+    network.servers[ep(0)].drop_interceptors.append(
+        ServerDropFirstN(JoinMessage, settings.rpc_default_retries + 1)
+    )
+    joiner = await Cluster.join(ep(0), ep(1), settings=settings, network=network,
+                                fd_factory=StaticFailureDetectorFactory())
+    clusters = [seed, joiner]
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 2))
+    finally:
+        await shutdown_all(clusters)
+
+
+@async_test
+async def test_phase2_join_retry_with_config_change():
+    # ClusterTest.phase2JoinAttemptRetryWithConfigChange: joiner A's phase-2
+    # message is latched at ITS client while another node joins, making A's
+    # phase-1 configuration stale; once released, A must take the
+    # CONFIG_CHANGED retry path and still end up in the cluster.
+    network = InProcessNetwork()
+    settings = fast_settings()
+    fd = StaticFailureDetectorFactory()
+    seed = await Cluster.start(ep(0), settings=settings, network=network,
+                               fd_factory=fd)
+    client_a = InProcessClient(network, ep(1), settings)
+    server_a = InProcessServer(network, ep(1))
+    delayer = ClientDelayer(JoinMessage)
+    client_a.delayers.append(delayer)
+    join_a = asyncio.ensure_future(
+        Cluster.join(ep(0), ep(1), settings=settings, client=client_a,
+                     server=server_a, fd_factory=fd)
+    )
+    # Deterministic sequencing: wait until A's phase-2 message is actually
+    # parked on the latch (A finished phase 1 under the 2-node config), so
+    # B's join below genuinely stales A's configuration.
+    assert await wait_until(lambda: delayer.held > 0, timeout_s=10)
+    assert not join_a.done()
+    b = await Cluster.join(ep(0), ep(2), settings=settings, network=network,
+                           fd_factory=fd)  # renders A's configuration stale
+    delayer.open()
+    a = await join_a
+    clusters = [seed, a, b]
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 3))
     finally:
         await shutdown_all(clusters)
