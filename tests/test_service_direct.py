@@ -17,9 +17,11 @@ from rapid_tpu.protocol.cut_detector import MultiNodeCutDetector
 from rapid_tpu.protocol.service import MembershipService
 from rapid_tpu.protocol.view import MembershipView
 from rapid_tpu.settings import Settings
+from rapid_tpu.protocol.events import ClusterEvents
 from rapid_tpu.types import (
     Endpoint,
     FastRoundPhase2bMessage,
+    JoinMessage,
     JoinResponse,
     JoinStatusCode,
     NodeId,
@@ -39,11 +41,15 @@ def async_test(fn):
     return wrapper
 
 
-def make_service(n_members, k=10, h=9, l=4, base_port=40000):
+def make_service(n_members, k=10, h=9, l=4, base_port=40000, loopback=False):
     """A single MembershipService with a synthetic n-member view
-    (MessagingTest.java:151+'s 1000-node configuration scenario)."""
+    (MessagingTest.java:151+'s 1000-node configuration scenario). With
+    ``loopback`` a server is registered for the service's own address (so it
+    hears its own broadcasts) and returned as a third element — the caller
+    must ``await server.start()``/``shutdown()`` and ``service.start()``."""
     settings = Settings()
     settings.k, settings.h, settings.l = k, h, l
+    settings.batching_window_ms = 20
     network = InProcessNetwork()
     my_addr = Endpoint("127.0.0.1", base_port)
     endpoints = [Endpoint("127.0.0.1", base_port + i) for i in range(n_members)]
@@ -58,7 +64,20 @@ def make_service(n_members, k=10, h=9, l=4, base_port=40000):
         fd_factory=StaticFailureDetectorFactory(),
         rng=random.Random(0),
     )
+    if loopback:
+        server = InProcessServer(network, my_addr)
+        server.set_membership_service(service)
+        return service, endpoints, server
     return service, endpoints
+
+
+async def wait_until(predicate, timeout_s=10.0, interval_s=0.02):
+    deadline = asyncio.get_event_loop().time() + timeout_s
+    while asyncio.get_event_loop().time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval_s)
+    return predicate()
 
 
 @async_test
@@ -115,8 +134,6 @@ async def test_decision_with_unknown_joiner_triggers_rejoin_not_corruption():
     # them as droppable datagrams). The service must apply NOTHING and signal
     # KICKED for rejoin — not KeyError mid-mutation (the reference NPEs,
     # MembershipService.java:401-404).
-    from rapid_tpu.protocol.events import ClusterEvents
-
     n = 20
     service, endpoints = make_service(n)
     config_id = service.view.configuration_id
@@ -173,4 +190,57 @@ async def test_client_delayer_latch():
     await probe_task
     assert len(received) == 1
     await client.shutdown()
+    await server.shutdown()
+
+
+@async_test
+async def test_lost_phase2_response_recovers_via_config_minus_one():
+    # Cluster.java:374-381's HOSTNAME_ALREADY_IN_RING recovery: a joiner was
+    # admitted by consensus but its phase-2 JoinResponse was lost. On retry,
+    # phase 1 answers HOSTNAME_ALREADY_IN_RING, and a phase-2 JoinMessage
+    # with configuration_id = -1 (never a real config id) must stream the
+    # full configuration back (MembershipService.java:255-286: host AND
+    # identifier present).
+    n = 8
+    service, endpoints, server = make_service(n, base_port=43000, loopback=True)
+    await server.start()
+    await service.start()  # arms the alert batcher
+    k = service.settings.k
+
+    joiner = Endpoint("127.0.0.1", 58000)
+    joiner_id = NodeId(11, 22)
+    config_id = service.view.configuration_id
+
+    # Phase 2 under the CORRECT config: consensus admits the joiner (every
+    # member's fast votes arrive), but pretend the joiner never saw the
+    # response future resolve.
+    pending = service.handle_message(
+        JoinMessage(sender=joiner, node_id=joiner_id, ring_numbers=tuple(range(k)),
+                    configuration_id=config_id)
+    )
+    fut = asyncio.ensure_future(pending)
+    # The alert batch must flush and announce the cut (recording the
+    # joiner's UUID) before any decision applies.
+    assert await wait_until(lambda: service._announced_proposal)
+    for i in range(n):
+        await service.handle_message(
+            FastRoundPhase2bMessage(sender=endpoints[i],
+                                    configuration_id=config_id,
+                                    endpoints=(joiner,))
+        )
+    await asyncio.wait_for(fut, timeout=5)
+    assert service.membership_size == n + 1
+
+    # Retry path: phase 1 now reports the hostname as already present...
+    phase1 = await service.handle_message(PreJoinMessage(sender=joiner, node_id=joiner_id))
+    assert phase1.status_code == JoinStatusCode.HOSTNAME_ALREADY_IN_RING
+    # ...and phase 2 with config -1 streams the configuration.
+    response = await service.handle_message(
+        JoinMessage(sender=joiner, node_id=joiner_id, ring_numbers=(0,),
+                    configuration_id=-1)
+    )
+    assert response.status_code == JoinStatusCode.SAFE_TO_JOIN
+    assert joiner in response.endpoints
+    assert joiner_id in response.identifiers
+    await service.shutdown()
     await server.shutdown()
