@@ -1,0 +1,14 @@
+"""Shared test helpers (imported by the async test suites)."""
+
+import asyncio
+
+
+async def wait_until(predicate, timeout_s=20.0, interval_s=0.02):
+    """Poll ``predicate`` until true or the deadline passes; returns its
+    final value. One definition — per-file copies drifted on defaults."""
+    deadline = asyncio.get_event_loop().time() + timeout_s
+    while asyncio.get_event_loop().time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval_s)
+    return predicate()

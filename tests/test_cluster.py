@@ -22,6 +22,8 @@ from rapid_tpu.protocol.events import ClusterEvents
 from rapid_tpu.settings import Settings
 from rapid_tpu.types import Endpoint, JoinMessage, PreJoinMessage
 
+from helpers import wait_until
+
 BASE_PORT = 1234
 
 
@@ -52,14 +54,6 @@ def fast_settings() -> Settings:
 def ep(i: int) -> Endpoint:
     return Endpoint("127.0.0.1", BASE_PORT + i)
 
-
-async def wait_until(predicate, timeout_s=20.0, interval_s=0.02):
-    deadline = asyncio.get_event_loop().time() + timeout_s
-    while asyncio.get_event_loop().time() < deadline:
-        if predicate():
-            return True
-        await asyncio.sleep(interval_s)
-    return predicate()
 
 
 async def start_cluster(n, network, fd_factory=None, settings=None, seed_subs=None):

@@ -11,6 +11,8 @@ from rapid_tpu.protocol.cluster import Cluster
 from rapid_tpu.settings import Settings
 from rapid_tpu.types import Endpoint
 
+from helpers import wait_until
+
 BASE_PORT = 23100
 
 
@@ -39,14 +41,6 @@ def fast_settings() -> Settings:
 def ep(i: int) -> Endpoint:
     return Endpoint("127.0.0.1", BASE_PORT + i)
 
-
-async def wait_until(predicate, timeout_s=20.0):
-    deadline = asyncio.get_event_loop().time() + timeout_s
-    while asyncio.get_event_loop().time() < deadline:
-        if predicate():
-            return True
-        await asyncio.sleep(0.02)
-    return predicate()
 
 
 def tcp_transport(addr: Endpoint, settings: Settings):

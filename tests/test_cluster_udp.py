@@ -11,6 +11,8 @@ from rapid_tpu.protocol.cluster import Cluster
 from rapid_tpu.settings import Settings
 from rapid_tpu.types import Endpoint, FastRoundPhase2bMessage
 
+from helpers import wait_until
+
 BASE_PORT = 37200
 
 
@@ -39,14 +41,6 @@ def fast_settings() -> Settings:
 def ep(i: int) -> Endpoint:
     return Endpoint("127.0.0.1", BASE_PORT + i)
 
-
-async def wait_until(predicate, timeout_s=20.0):
-    deadline = asyncio.get_event_loop().time() + timeout_s
-    while asyncio.get_event_loop().time() < deadline:
-        if predicate():
-            return True
-        await asyncio.sleep(0.02)
-    return predicate()
 
 
 @async_test
@@ -90,3 +84,46 @@ async def test_six_nodes_over_hybrid_udp_with_failure():
         assert all(victim.listen_address not in c.membership for c in survivors)
     finally:
         await asyncio.gather(*(c.shutdown() for c in clusters), return_exceptions=True)
+
+
+@async_test
+async def test_hybrid_point_to_point_roundtrip_and_datagram_oneway():
+    # NettyClientServerTest analog for the alternate transport: a one-way
+    # consensus message genuinely arrives as a datagram (proven by the
+    # client holding NO TCP connection when it lands — a TCP fallback would
+    # have created one), then a request/response round-trip rides TCP.
+    from rapid_tpu.types import ProbeMessage, Response
+
+    s = Settings()
+    a, b = Endpoint("127.0.0.1", 37290), Endpoint("127.0.0.1", 37291)
+    received = []
+
+    class Recorder:
+        async def handle_message(self, request):
+            received.append(request)
+            return Response()
+
+    server = UdpHybridServer(b)
+    server.set_membership_service(Recorder())
+    await server.start()
+    client = UdpHybridClient(a, s)
+    try:
+        assert FastRoundPhase2bMessage in ONEWAY_TYPES  # travels as datagram
+        # Datagram FIRST, before any TCP traffic exists.
+        client.send_nowait(
+            b, FastRoundPhase2bMessage(sender=a, configuration_id=1, endpoints=(a,))
+        )
+        assert await wait_until(
+            lambda: any(isinstance(r, FastRoundPhase2bMessage) for r in received)
+        )
+        # Delivery used the datagram path: no TCP connection was ever made
+        # (the silent TCP fallback would have cached one).
+        assert not client._connections
+        # Round-trip over the reliable path.
+        resp = await client.send(b, ProbeMessage(sender=a))
+        assert isinstance(resp, Response)
+        assert any(isinstance(r, ProbeMessage) for r in received)
+        assert client._connections  # the round-trip DID use TCP
+    finally:
+        await client.shutdown()
+        await server.shutdown()

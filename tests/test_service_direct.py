@@ -29,6 +29,8 @@ from rapid_tpu.types import (
     ProbeMessage,
 )
 
+from helpers import wait_until
+
 
 def async_test(fn):
     @functools.wraps(fn)
@@ -70,14 +72,6 @@ def make_service(n_members, k=10, h=9, l=4, base_port=40000, loopback=False):
         return service, endpoints, server
     return service, endpoints
 
-
-async def wait_until(predicate, timeout_s=10.0, interval_s=0.02):
-    deadline = asyncio.get_event_loop().time() + timeout_s
-    while asyncio.get_event_loop().time() < deadline:
-        if predicate():
-            return True
-        await asyncio.sleep(interval_s)
-    return predicate()
 
 
 @async_test
