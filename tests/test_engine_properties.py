@@ -41,7 +41,7 @@ def run_scenario(seed, victims, joiners, n_cohorts_used, spread_used):
     return vc
 
 
-@settings(max_examples=30, deadline=None)
+@settings(max_examples=60, deadline=None)
 @given(
     seed=st.integers(0, 2**31 - 1),
     n_victims=st.integers(0, 6),
