@@ -17,7 +17,15 @@ The scenario is deliberately hard enough that the CPU fallback cannot hide
 behind it: per round it does O(C·N·K) delivery work that the TPU's VPU chews
 through in microseconds.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+Execution structure: the accelerator attempt runs in a WATCHDOGGED CHILD
+process. The axon tunnel backend can wedge such that any device call blocks
+forever and the wedged process survives SIGKILL (observed whenever a client
+is killed mid-device-operation); running the whole attempt in a child whose
+liveness is judged by its progress marks means the bench always terminates:
+if the child goes silent past its idle budget (or blows the hard deadline),
+the parent abandons it and re-runs the workload on CPU. The bench therefore
+always emits its ONE JSON line:
+{"metric", "value", "unit", "vs_baseline", ...extras}.
 """
 
 from __future__ import annotations
@@ -28,8 +36,7 @@ import subprocess
 import sys
 import time
 
-_PROBE_ATTEMPTS = 2
-_PROBE_TIMEOUT_S = 150
+_START = time.monotonic()
 
 
 def _env_flag(name: str) -> bool:
@@ -37,76 +44,26 @@ def _env_flag(name: str) -> bool:
     return os.environ.get(name, "").lower() not in ("", "0", "false")
 
 
-def _probe_backend_once() -> tuple:
-    """(ok, detail): init devices in a subprocess with a timeout."""
-    detail = "probe timed out"
-    # Manual poll loop instead of subprocess.run: run()'s TimeoutExpired path
-    # does kill()+wait() with no bound, and a child wedged in an
-    # uninterruptible driver call (the exact failure this guards against)
-    # survives SIGKILL — the reap must be abandonable.
-    probe = subprocess.Popen(
-        [sys.executable, "-c", "import jax; jax.devices()"],
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.PIPE,
-    )
-    deadline = time.monotonic() + _PROBE_TIMEOUT_S
-    while time.monotonic() < deadline:
-        code = probe.poll()
-        if code is not None:
-            if code == 0:
-                return True, ""
-            # Surface the real diagnostic: a nonzero exit is a misconfigured
-            # backend (missing/broken driver), not a wedge.
-            try:
-                detail = (probe.stderr.read() or b"").decode(errors="replace")[-800:]
-            except Exception:  # noqa: BLE001 — diagnostics are best-effort
-                pass
-            return False, detail
-        time.sleep(1)
-    probe.kill()
+def _env_int(name: str, default: int) -> int:
     try:
-        probe.wait(timeout=5)
-    except subprocess.TimeoutExpired:
-        pass  # unreapable (D-state) child: abandon it, fall back anyway
-    return False, detail
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
 
 
-def _ensure_responsive_backend() -> None:
-    """The axon tunnel backend can wedge such that ``jax.devices()`` blocks
-    forever (observed after killed mid-device-operation sessions). Probe
-    device init in a subprocess with a timeout, RETRYING once (transient
-    tunnel hiccups recover between attempts); only if every attempt hangs or
-    fails, re-exec on CPU so the bench always emits its JSON line instead of
-    hanging the driver. Skip with RAPID_TPU_BENCH_NO_PROBE=1."""
-    if _env_flag("RAPID_TPU_BENCH_NO_PROBE") or os.environ.get("JAX_PLATFORMS") == "cpu":
-        return
-    detail = ""
-    for attempt in range(_PROBE_ATTEMPTS):
-        ok, detail = _probe_backend_once()
-        if ok:
-            return
-        print(
-            f"bench: accelerator probe attempt {attempt + 1}/{_PROBE_ATTEMPTS} "
-            f"failed ({detail or 'hang'})",
-            file=sys.stderr,
-        )
-        if attempt + 1 < _PROBE_ATTEMPTS:
-            time.sleep(15)
-    print(
-        "bench: accelerator backend unresponsive after "
-        f"{_PROBE_ATTEMPTS} attempts; falling back to CPU",
-        file=sys.stderr,
-    )
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["RAPID_TPU_BENCH_NO_PROBE"] = "1"
-    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+def _mark(msg: str) -> None:
+    """Timestamped progress line on stderr: the parent watchdog treats each
+    mark as proof of liveness, and a driver-side timeout log shows exactly
+    how far the run got."""
+    print(f"bench[{time.monotonic() - _START:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
-def main() -> None:
-    _ensure_responsive_backend()
-    import jax
+# ---------------------------------------------------------------------------
+# The workload (runs inside the watchdogged child, or inline on CPU).
+# ---------------------------------------------------------------------------
 
+
+def run_workload() -> None:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         # sitecustomize imported jax before us; env alone is too late — and
         # the axon plugin initializes its backend even under
@@ -114,15 +71,24 @@ def main() -> None:
         from rapid_tpu.utils.platform import force_platform
 
         force_platform("cpu")
+    import jax
+
+    platform = jax.devices()[0].platform
+    _mark(f"devices initialized: platform={platform} count={len(jax.devices())}")
+
     import numpy as np
 
     from rapid_tpu.utils._native import ensure_built
 
     ensure_built()  # compile the native host library outside any event loop
+    _mark("native library built")
 
     from rapid_tpu.models.virtual_cluster import VirtualCluster
 
-    n = 100_000
+    # N is env-overridable for smoke-testing the bench machinery itself
+    # (watchdog, fallback, JSON shape) at small scale; the real scenario is
+    # the 100K default.
+    n = _env_int("RAPID_TPU_BENCH_N", 100_000)
     churn_frac = 0.05  # BASELINE config 4: 5% churn (half joins, half crashes)
     n_join = int(n * churn_frac / 2)
     n_crash = int(n * churn_frac / 2)
@@ -133,14 +99,13 @@ def main() -> None:
     baseline_target_ms = 500.0
     max_view_changes = 4  # churn resolves in >=2 cuts; allow stragglers
 
-    platform = jax.devices()[0].platform
-
     # The Mosaic kernel path is strictly an optimization: smoke-test it once
     # (pallas_usable) and drop to the bit-identical jnp core if it fails,
     # rather than dying mid-benchmark on the accelerator.
     from rapid_tpu.ops.pallas_kernels import pallas_usable
 
     use_pallas = pallas_usable()
+    _mark(f"pallas kernel usable: {use_pallas}")
     if platform == "tpu" and not use_pallas:
         print("bench: pallas kernel unusable; using jnp core", file=sys.stderr)
 
@@ -188,7 +153,9 @@ def main() -> None:
     # view-change application, second-cut re-entry).
     vc, _ = build(seed=0)
     vc.sync()
+    _mark(f"N={n} state built and on device; compiling engine (warm-up run)")
     resolve_churn(vc)
+    _mark("warm-up convergence done (executables cached)")
 
     # Timed runs on fresh state (same shapes -> cached executables).
     samples = []
@@ -208,6 +175,7 @@ def main() -> None:
         assert vc.alive_mask[n : n + n_join].all()
         samples.append(elapsed_ms)
         cuts_per_sample.append(cuts)
+        _mark(f"sample {rep + 1}/3: {elapsed_ms:.1f} ms ({cuts} view changes)")
 
     # Fixed device<->host round-trip latency of this environment (the axon
     # tunnel); a co-located deployment would not pay it.
@@ -223,10 +191,17 @@ def main() -> None:
     # accelerator per the BASELINE scale story. On the CPU fallback it is
     # skipped (a 1M-member CPU run adds many minutes for a number that only
     # matters on the accelerator — the fallback must still emit its JSON
-    # line within the driver's budget); RAPID_TPU_BENCH_XL=1 forces it,
+    # line within the driver's budget), as it is when the run is already
+    # past the XL time budget (a slow tunnel day must not starve the
+    # headline number). RAPID_TPU_BENCH_XL=1 forces it,
     # RAPID_TPU_BENCH_NO_XL=1 suppresses it everywhere.
     xl_ms = None
+    xl_budget_s = _env_int("RAPID_TPU_BENCH_XL_BUDGET_S", 1500)
     run_xl = (platform == "tpu") or _env_flag("RAPID_TPU_BENCH_XL")
+    if time.monotonic() - _START > xl_budget_s and not _env_flag("RAPID_TPU_BENCH_XL"):
+        if run_xl:
+            _mark(f"skipping 1M point: already {time.monotonic() - _START:.0f}s elapsed")
+        run_xl = False
     if run_xl and not _env_flag("RAPID_TPU_BENCH_NO_XL"):
         n_xl = 1_000_000
 
@@ -250,6 +225,7 @@ def main() -> None:
 
         vcx = build_xl(7)
         vcx.sync()
+        _mark("1M state on device; compiling 1M executable (warm-up)")
         vcx.run_to_decision(max_steps=96)  # warm-up/compile
         vcx = build_xl(8)
         vcx.sync()
@@ -257,6 +233,7 @@ def main() -> None:
         _, decided_xl, _, _ = vcx.run_to_decision(max_steps=96)
         xl_ms = (time.perf_counter() - t0) * 1000.0
         assert decided_xl and vcx.membership_size == n_xl - n_xl // 100
+        _mark(f"1M point: {xl_ms:.1f} ms")
 
     value = min(samples)
     print(
@@ -283,8 +260,139 @@ def main() -> None:
                 "device_rtt_ms": round(rtt_ms, 3),
                 **({"n1M_crash1pct_ms": round(xl_ms, 3)} if xl_ms is not None else {}),
             }
-        )
+        ),
+        flush=True,
     )
+
+
+# ---------------------------------------------------------------------------
+# Watchdog orchestration (parent).
+# ---------------------------------------------------------------------------
+
+
+def _run_child_watchdogged() -> bool:
+    """Run the workload in a child on the accelerator; True iff it printed
+    its JSON line. Liveness = progress marks: a silent child past the idle
+    budget (or the hard deadline) is abandoned, not waited on — a wedged
+    axon client can survive SIGKILL in an uninterruptible device call, so
+    the reap itself must be abandonable."""
+    first_mark_timeout = _env_int("RAPID_TPU_BENCH_INIT_TIMEOUT_S", 240)
+    idle_timeout = _env_int("RAPID_TPU_BENCH_IDLE_TIMEOUT_S", 900)
+    hard_deadline = _env_int("RAPID_TPU_BENCH_DEADLINE_S", 2700)
+
+    env = dict(os.environ)
+    env["RAPID_TPU_BENCH_CHILD"] = "1"
+    child = subprocess.Popen(
+        [sys.executable, "-u", os.path.abspath(__file__)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        env=env,
+    )
+    os.set_blocking(child.stdout.fileno(), False)
+    os.set_blocking(child.stderr.fileno(), False)
+
+    got_json = False
+    saw_mark = False
+    last_alive = time.monotonic()
+    start = last_alive
+    cpu_at_last_alive = 0.0
+    buf_out = b""
+    buf_err = b""
+    while True:
+        for stream, is_err in ((child.stdout, False), (child.stderr, True)):
+            chunk = None
+            try:
+                chunk = stream.read()
+            except (BlockingIOError, OSError):
+                pass
+            if not chunk:
+                continue
+            last_alive = time.monotonic()
+            if is_err:
+                buf_err += chunk
+                while b"\n" in buf_err:
+                    line, buf_err = buf_err.split(b"\n", 1)
+                    text = line.decode(errors="replace")
+                    print(text, file=sys.stderr, flush=True)
+                    if text.startswith("bench["):
+                        saw_mark = True
+            else:
+                buf_out += chunk
+                while b"\n" in buf_out:
+                    line, buf_out = buf_out.split(b"\n", 1)
+                    text = line.decode(errors="replace").strip()
+                    if text.startswith("{") and '"metric"' in text:
+                        print(text, flush=True)
+                        got_json = True
+        # Marks only appear at stage boundaries; between them (e.g. a long
+        # XLA compile) the child's CPU clock is the liveness signal — a
+        # compiling child burns CPU continuously. Liveness needs >= 1s of
+        # ACCUMULATED CPU since the last liveness event: a wedged axon
+        # client still ticks a few ms/min of heartbeat-thread CPU, and a
+        # single-tick test would let that trickle hold the watchdog open
+        # forever (observed).
+        cpu_s = _child_cpu_seconds(child.pid)
+        if cpu_s is not None and cpu_s - cpu_at_last_alive >= 1.0:
+            cpu_at_last_alive = cpu_s
+            last_alive = time.monotonic()
+        code = child.poll()
+        if code is not None:
+            _flush_partials(buf_out, buf_err)
+            # A child that printed its JSON line succeeded even if the flaky
+            # axon client then crashed interpreter teardown (nonzero exit):
+            # re-running on CPU would emit a SECOND JSON line.
+            return got_json
+        now = time.monotonic()
+        # Until the first mark (devices initialized), a tight budget: the
+        # wedged-tunnel signature is exactly "init never completes".
+        budget = idle_timeout if saw_mark else first_mark_timeout
+        if now - last_alive > budget or now - start > hard_deadline:
+            why = "hard deadline" if now - start > hard_deadline else "went silent"
+            print(
+                f"bench: accelerator child {why} "
+                f"({now - start:.0f}s elapsed, {now - last_alive:.0f}s idle); abandoning",
+                file=sys.stderr,
+                flush=True,
+            )
+            child.kill()
+            try:
+                child.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass  # unreapable (D-state) child: abandon it
+            _flush_partials(buf_out, buf_err)
+            return got_json
+        time.sleep(1)
+
+
+def _flush_partials(buf_out: bytes, buf_err: bytes) -> None:
+    """Surface any final newline-less fragments (a segfault or OOM kill cuts
+    the child mid-line, and that fragment is usually the best diagnostic)."""
+    for buf in (buf_out, buf_err):
+        if buf.strip():
+            print(buf.decode(errors="replace"), file=sys.stderr, flush=True)
+
+
+def _child_cpu_seconds(pid: int):
+    """utime+stime of the child in seconds, or None (non-Linux / gone)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            fields = f.read().split(b") ", 1)[1].split()
+        return (int(fields[11]) + int(fields[12])) / os.sysconf("SC_CLK_TCK")
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+def main() -> None:
+    if _env_flag("RAPID_TPU_BENCH_CHILD") or os.environ.get("JAX_PLATFORMS") == "cpu":
+        run_workload()
+        return
+    if _run_child_watchdogged():
+        return
+    print("bench: falling back to CPU", file=sys.stderr, flush=True)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("RAPID_TPU_BENCH_CHILD", None)
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
 if __name__ == "__main__":

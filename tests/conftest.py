@@ -6,16 +6,29 @@ Environment must be set before jax is first imported.
 """
 
 # Force (override) CPU: the global environment pins JAX_PLATFORMS=axon (the
-# real TPU tunnel), which tests must not depend on.
+# real TPU tunnel), which tests must not depend on. Accelerator capture
+# sessions opt out explicitly (RAPID_TPU_TEST_PLATFORM=tpu) to run the
+# TPU-gated tests (e.g. the Mosaic-vs-jnp equivalence check) on real
+# hardware.
+import os
+
 from rapid_tpu.utils.platform import force_platform
 
-# Not an assert: python -O would strip it, silently leaving tests on the
-# accelerator tunnel.
-if not force_platform("cpu", n_host_devices=8):
+_plat = os.environ.get("RAPID_TPU_TEST_PLATFORM", "cpu")
+if _plat not in ("cpu", "tpu"):
+    # A typo must not silently route the whole suite onto the live tunnel.
     raise RuntimeError(
-        "could not force the CPU platform: a jax backend was initialized "
-        "before tests/conftest.py ran; tests must not touch the axon tunnel"
+        f"RAPID_TPU_TEST_PLATFORM={_plat!r}: expected 'cpu' (default) or "
+        "'tpu' (accelerator capture sessions)"
     )
+if _plat == "cpu":
+    # Not an assert: python -O would strip it, silently leaving tests on the
+    # accelerator tunnel.
+    if not force_platform("cpu", n_host_devices=8):
+        raise RuntimeError(
+            "could not force the CPU platform: a jax backend was initialized "
+            "before tests/conftest.py ran; tests must not touch the axon tunnel"
+        )
 
 
 # Build the native host library once per test session (load-only at runtime).

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Capture the full TPU evidence set in one sitting, for when the axon tunnel
+# is responsive (it wedges for hours at a time; see bench.py's watchdog
+# docstring). Each stage is independently timeboxed so one wedge cannot eat
+# the session. Results land in $OUT (default /tmp/tpu_evidence).
+#
+#   bash tools/capture_tpu_evidence.sh
+#
+# Stages:
+#   1. bench.py            -> bench.json        (the driver artifact's twin)
+#   2. pallas_microbench   -> microbench.json   (Mosaic vs jnp kernel timing)
+#   3. TPU-gated pytest    -> pytest_tpu.log    (Mosaic/jnp equivalence on HW)
+#   4. profiled convergence-> profile/          (op-level trace of one churn)
+set -u
+OUT="${OUT:-/tmp/tpu_evidence}"
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+run_stage() { # name timeout_s command...
+  local name="$1" tmo="$2"; shift 2
+  echo "=== $name (timeout ${tmo}s) ==="
+  timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
+  local rc=$?
+  echo "rc=$rc"
+  tail -5 "$OUT/$name.log"
+}
+
+run_stage bench 2900 python -u bench.py
+grep -h '"metric"' "$OUT/bench.log" | tail -1 > "$OUT/bench.json"
+
+run_stage microbench 1200 python -u examples/pallas_microbench.py
+grep -h '"platform"' "$OUT/microbench.log" | tail -1 > "$OUT/microbench.json"
+
+run_stage pytest_tpu 1200 env RAPID_TPU_TEST_PLATFORM=tpu \
+  python -m pytest tests/test_pallas_kernels.py -v
+
+run_stage profile 1800 python -u examples/pallas_microbench.py \
+  --n 100000 --profile "$OUT/profile"
+
+echo "=== captured ==="
+ls -la "$OUT"
+cat "$OUT/bench.json" "$OUT/microbench.json" 2>/dev/null
