@@ -386,8 +386,19 @@ def main() -> None:
     if _env_flag("RAPID_TPU_BENCH_CHILD") or os.environ.get("JAX_PLATFORMS") == "cpu":
         run_workload()
         return
-    if _run_child_watchdogged():
-        return
+    # Bounded retry: transient tunnel hiccups recover between attempts
+    # (observed); only a persistent wedge should cost the TPU number.
+    attempts = max(1, _env_int("RAPID_TPU_BENCH_ATTEMPTS", 2))
+    for attempt in range(attempts):
+        if _run_child_watchdogged():
+            return
+        if attempt + 1 < attempts:
+            print(
+                f"bench: accelerator attempt {attempt + 1}/{attempts} failed; retrying",
+                file=sys.stderr,
+                flush=True,
+            )
+            time.sleep(15)
     print("bench: falling back to CPU", file=sys.stderr, flush=True)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

@@ -66,17 +66,26 @@ async def run(args) -> None:
         # The paper's stated policy: >=40% of the last 10 probes failed.
         fd_factory = WindowedFailureDetectorFactory(listen, client)
 
+    broadcaster_factory = None  # default: unicast-to-all
+    if args.broadcast == "gossip":
+        # Epidemic relay: per-node egress O(log N) instead of origin O(N).
+        from rapid_tpu.messaging.gossip import GossipBroadcaster
+
+        broadcaster_factory = GossipBroadcaster.factory()
+
     if listen == seed:
         LOG.info("starting cluster as seed at %s", listen)
         cluster = await Cluster.start(
             listen, settings=settings, client=client, server=server,
             metadata=metadata, fd_factory=fd_factory,
+            broadcaster_factory=broadcaster_factory,
         )
     else:
         LOG.info("joining cluster at %s from %s", seed, listen)
         cluster = await Cluster.join(
             seed, listen, settings=settings, client=client, server=server,
             metadata=metadata, fd_factory=fd_factory,
+            broadcaster_factory=broadcaster_factory,
         )
 
     for event in (
@@ -116,6 +125,10 @@ def main() -> None:
                         help="failure-detection policy: pingpong = consecutive-failure "
                         "counter (the reference code's); windowed = fraction of the "
                         "last-N probes (the paper's)")
+    parser.add_argument("--broadcast", choices=("unicast", "gossip"), default="unicast",
+                        help="broadcast strategy: unicast-to-all (the reference's "
+                        "default) or epidemic gossip relay (the alternate "
+                        "IBroadcaster impl its docs name)")
     parser.add_argument("--report-interval", type=float, default=1.0)
     args = parser.parse_args()
     logging.basicConfig(

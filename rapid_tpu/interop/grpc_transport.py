@@ -113,6 +113,10 @@ class GrpcClient(MessagingClient):
     """grpc.aio client with a channel cache and per-message-type deadlines
     (GrpcClient.java:85-95, 194-203)."""
 
+    # The reference's rapid.proto has no gossip envelope; GossipBroadcaster
+    # refuses this transport at wiring time (see rapid_tpu.messaging.gossip).
+    supports_gossip = False
+
     def __init__(self, my_addr: Endpoint, settings: Optional[Settings] = None) -> None:
         self.my_addr = my_addr
         self._settings = settings if settings is not None else Settings()

@@ -21,6 +21,7 @@ from rapid_tpu.types import (
     EdgeStatus,
     Endpoint,
     FastRoundPhase2bMessage,
+    GossipMessage,
     JoinMessage,
     JoinResponse,
     JoinStatusCode,
@@ -220,6 +221,7 @@ _REQUEST_TAGS: Dict[Type, int] = {
     Phase2aMessage: 8,
     Phase2bMessage: 9,
     LeaveMessage: 10,
+    GossipMessage: 11,
 }
 
 _RESPONSE_TAGS: Dict[Type, int] = {
@@ -298,6 +300,16 @@ def _encode_request_impl(request: RapidRequest) -> bytes:
         _w_endpoints(w, request.endpoints)
     elif isinstance(request, LeaveMessage):
         _w_endpoint(w, request.sender)
+    elif isinstance(request, GossipMessage):
+        if isinstance(request.payload, GossipMessage):
+            raise CodecError("nested GossipMessage payload")
+        if not 0 <= request.ttl <= 255:
+            raise CodecError(f"gossip ttl out of u8 range: {request.ttl}")
+        _w_endpoint(w, request.origin)
+        w.u64(request.msg_id)
+        w.u8(request.ttl)
+        # Nested envelope: the payload is a complete request of its own.
+        w.blob(_encode_request_impl(request.payload))
     return w.getvalue()
 
 
@@ -331,6 +343,16 @@ def decode_request(data: bytes) -> RapidRequest:
         out = Phase2bMessage(_r_endpoint(r), r.i64(), _r_rank(r), _r_endpoints(r))
     elif tag == 10:
         out = LeaveMessage(_r_endpoint(r))
+    elif tag == 11:
+        origin = _r_endpoint(r)
+        msg_id = r.u64()
+        ttl = r.u8()
+        payload = decode_request(r.blob())
+        if isinstance(payload, GossipMessage):
+            # One level of nesting only: a gossiped gossip envelope is
+            # meaningless and unbounded recursion is a parser DoS.
+            raise CodecError("nested GossipMessage payload")
+        out = GossipMessage(origin, msg_id, ttl, payload)
     else:
         raise CodecError(f"unknown request tag {tag}")
     if not r.done():

@@ -33,6 +33,7 @@ from rapid_tpu.settings import Settings
 from rapid_tpu.types import (
     BatchedAlertMessage,
     Endpoint,
+    GossipMessage,
     FastRoundPhase2bMessage,
     LeaveMessage,
     Phase1aMessage,
@@ -45,10 +46,13 @@ from rapid_tpu.types import (
 
 LOG = logging.getLogger(__name__)
 
-# One-way message types: no caller consumes their response.
+# One-way message types: no caller consumes their response. GossipMessage
+# envelopes are fire-and-forget relays (GossipRouter discards the response),
+# so --transport udp --broadcast gossip keeps the datagram fast path.
 ONEWAY_TYPES = (
     BatchedAlertMessage,
     FastRoundPhase2bMessage,
+    GossipMessage,
     Phase1aMessage,
     Phase2aMessage,
     Phase2bMessage,

@@ -107,12 +107,17 @@ class Cluster:
         rng: Optional[random.Random] = None,
         cut_detector_factory=None,
         vote_tally_factory=None,
+        broadcaster_factory=None,
     ) -> "Cluster":
         """Bootstrap a one-node cluster (Cluster.java:255-280).
         ``cut_detector_factory(k, h, l)`` swaps the detector implementation
         (e.g. DeviceCutDetector); ``vote_tally_factory(membership_size)``
         swaps the consensus vote tally (e.g. DeviceVoteTally) — together they
-        put both halves of the protocol hot path on the accelerator."""
+        put both halves of the protocol hot path on the accelerator.
+        ``broadcaster_factory(client, listen_address, rng)`` swaps the
+        broadcast strategy (e.g. ``GossipBroadcaster.factory()``); a factory
+        whose product has a ``router`` method gets it wrapped around the
+        service at the server seam (gossip unwrap/relay)."""
         settings = settings if settings is not None else Settings()
         settings.validate()
         client, server = cls._make_transport(listen_address, settings, network, client, server)
@@ -122,6 +127,9 @@ class Cluster:
         detector_factory = cut_detector_factory or MultiNodeCutDetector
         cut_detector = detector_factory(settings.k, settings.h, settings.l)
         metadata_map = {listen_address: metadata} if metadata else {}
+        broadcaster = (
+            broadcaster_factory(client, listen_address, rng) if broadcaster_factory else None
+        )
         service = MembershipService(
             my_addr=listen_address,
             cut_detector=cut_detector,
@@ -134,11 +142,21 @@ class Cluster:
             clock=clock,
             rng=rng,
             vote_tally_factory=vote_tally_factory,
+            broadcaster=broadcaster,
         )
-        server.set_membership_service(service)
+        server.set_membership_service(cls._server_handler(broadcaster, service))
         await server.start()
         await service.start()
         return cls(listen_address, service, server, client)
+
+    @staticmethod
+    def _server_handler(broadcaster, service):
+        """The object the server dispatches to: the service itself, or the
+        broadcaster's router facade when the broadcast strategy needs to see
+        inbound envelopes (gossip relay)."""
+        if broadcaster is not None and hasattr(broadcaster, "router"):
+            return broadcaster.router(service)
+        return service
 
     @classmethod
     async def join(
@@ -156,6 +174,7 @@ class Cluster:
         rng: Optional[random.Random] = None,
         cut_detector_factory=None,
         vote_tally_factory=None,
+        broadcaster_factory=None,
     ) -> "Cluster":
         """Two-phase join through ``seed_address`` with retries
         (Cluster.java:303-344)."""
@@ -174,7 +193,7 @@ class Cluster:
                     return await cls._join_attempt(
                         seed_address, listen_address, node_id, settings, client, server,
                         fd_factory, metadata, subscriptions, clock, rng,
-                        cut_detector_factory, vote_tally_factory,
+                        cut_detector_factory, vote_tally_factory, broadcaster_factory,
                     )
                 except JoinPhaseOneError as exc:
                     status = exc.join_response.status_code
@@ -224,7 +243,7 @@ class Cluster:
     async def _join_attempt(
         cls, seed_address, listen_address, node_id, settings, client, server,
         fd_factory, metadata, subscriptions, clock, rng, cut_detector_factory=None,
-        vote_tally_factory=None,
+        vote_tally_factory=None, broadcaster_factory=None,
     ) -> "Cluster":
         """One join attempt: phase 1 at the seed, phase 2 at the observers
         (Cluster.java:352-401)."""
@@ -276,7 +295,7 @@ class Cluster:
                 return cls._from_join_response(
                     response, listen_address, settings, client, server,
                     fd_factory, subscriptions, clock, rng, cut_detector_factory,
-                    vote_tally_factory,
+                    vote_tally_factory, broadcaster_factory,
                 )
         raise JoinPhaseTwoError()
 
@@ -284,7 +303,7 @@ class Cluster:
     def _from_join_response(
         cls, response: JoinResponse, listen_address, settings, client, server,
         fd_factory, subscriptions, clock, rng, cut_detector_factory=None,
-        vote_tally_factory=None,
+        vote_tally_factory=None, broadcaster_factory=None,
     ) -> "Cluster":
         """Build the node from a streamed configuration (Cluster.java:442-474)."""
         assert response.endpoints and response.identifiers
@@ -294,6 +313,9 @@ class Cluster:
         metadata_map = dict(zip(response.metadata_keys, response.metadata_values))
         detector_factory = cut_detector_factory or MultiNodeCutDetector
         cut_detector = detector_factory(settings.k, settings.h, settings.l)
+        broadcaster = (
+            broadcaster_factory(client, listen_address, rng) if broadcaster_factory else None
+        )
         service = MembershipService(
             my_addr=listen_address,
             cut_detector=cut_detector,
@@ -306,8 +328,9 @@ class Cluster:
             clock=clock,
             rng=rng,
             vote_tally_factory=vote_tally_factory,
+            broadcaster=broadcaster,
         )
-        server.set_membership_service(service)
+        server.set_membership_service(cls._server_handler(broadcaster, service))
         cluster = cls(listen_address, service, server, client)
         asyncio.ensure_future(service.start())
         return cluster

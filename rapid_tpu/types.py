@@ -194,6 +194,20 @@ class LeaveMessage:
     sender: Endpoint
 
 
+@dataclass(frozen=True)
+class GossipMessage:
+    """Epidemic-relay envelope for broadcast traffic — the alternate
+    broadcast strategy ``IBroadcaster.java:24-29``'s docs name but the
+    reference never ships. ``payload`` is the broadcast request being
+    spread; (origin, msg_id) dedups redeliveries; ttl bounds relay depth.
+    """
+
+    origin: Endpoint
+    msg_id: int  # uint64, drawn per broadcast
+    ttl: int
+    payload: "RapidRequest"
+
+
 RapidRequest = Union[
     PreJoinMessage,
     JoinMessage,
@@ -205,6 +219,7 @@ RapidRequest = Union[
     Phase2aMessage,
     Phase2bMessage,
     LeaveMessage,
+    GossipMessage,
 ]
 
 CONSENSUS_MESSAGE_TYPES = (

@@ -25,6 +25,7 @@ from rapid_tpu.types import (
     EdgeStatus,
     Endpoint,
     FastRoundPhase2bMessage,
+    GossipMessage,
     JoinMessage,
     JoinResponse,
     JoinStatusCode,
@@ -76,6 +77,7 @@ ALL_REQUESTS = [
     Phase2aMessage(EP1, 1, Rank(2, 77), (EP2, EP1)),
     Phase2bMessage(EP1, 1, Rank(2, 77), (EP2,)),
     LeaveMessage(EP1),
+    GossipMessage(EP1, 0xDEADBEEFCAFEF00D, 7, FastRoundPhase2bMessage(EP2, 3, (EP1,))),
 ]
 
 ALL_RESPONSES = [

@@ -25,7 +25,11 @@ run_stage() { # name timeout_s command...
   tail -5 "$OUT/$name.log"
 }
 
-run_stage bench 2900 python -u bench.py
+# Keep (accelerator attempt deadline) + (CPU fallback, ~10 min at N=100K)
+# safely inside the stage timeout, or a wedged-tunnel day kills the fallback
+# before its JSON line: one 1500s attempt + fallback < 3300s.
+run_stage bench 3300 env RAPID_TPU_BENCH_DEADLINE_S=1500 RAPID_TPU_BENCH_ATTEMPTS=1 \
+  python -u bench.py
 grep -h '"metric"' "$OUT/bench.log" | tail -1 > "$OUT/bench.json"
 
 run_stage microbench 1200 python -u examples/pallas_microbench.py
