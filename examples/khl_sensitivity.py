@@ -29,13 +29,14 @@ import numpy as np
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
-def run_once(n, k, h, l, f, cohorts, seed, delivery_spread=1, stagger=1, loss=0.0) -> tuple:
+def run_once(n, k, h, l, f, cohorts, seed, delivery_spread=1, stagger=1, loss=0.0,
+             delay_permille=1000) -> tuple:
     from rapid_tpu.models.virtual_cluster import VirtualCluster
 
     rng = np.random.default_rng(seed)
     vc = VirtualCluster.create(
         n, k=k, h=h, l=l, cohorts=cohorts, fd_threshold=2, seed=seed,
-        delivery_spread=delivery_spread,
+        delivery_spread=delivery_spread, delivery_prob_permille=delay_permille,
     )
     # Receivers split into cohorts; every cohort gets an independent
     # per-edge delivery-delay draw (delivery_spread). The paper's Fig. 11
@@ -81,6 +82,12 @@ def main() -> None:
                         help="max extra rounds of per-(cohort, edge) delivery delay")
     parser.add_argument("--stagger", type=int, default=1,
                         help="max rounds of per-edge detection jitter")
+    parser.add_argument("--delay-permille", type=int, default=1000,
+                        help="probability (permille, per cohort-edge) of a nonzero "
+                        "delivery delay: sub-round skew granularity (1000 = the "
+                        "full uniform [0, spread] draw; one engine round is the "
+                        "coarsest quantum, the paper's continuous-latency sim "
+                        "sits below it)")
     parser.add_argument("--loss", type=float, default=0.0,
                         help="one-way loss fraction per non-primary cohort (paper sim: 0)")
     parser.add_argument(
@@ -116,6 +123,7 @@ def main() -> None:
                         delivery_spread=args.delivery_spread,
                         stagger=args.stagger,
                         loss=args.loss,
+                        delay_permille=args.delay_permille,
                     )
                     conflicts += int(conflict)
                     rounds_sum += rounds

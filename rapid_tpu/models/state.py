@@ -68,6 +68,17 @@ class EngineConfig(NamedTuple):
     # (shift + popcount per round; rapid_tpu/monitoring/windowed.py is the
     # host twin). Intermittent blips age out instead of accumulating forever.
     fd_window: int = 0
+    # Sub-round delivery-skew granularity. Values 0..999: probability (in
+    # permille, per (cohort, edge)) that a delivery draws a NONZERO delay,
+    # uniform in [1, delivery_spread] — P(delayed) is exactly permille/1000,
+    # interpolating between "no timing divergence" (0) and "every delivery
+    # skewed" (→1000). The default 1000 is a distinct LEGACY mode, not the
+    # continuum endpoint: the original uniform draw over [0,
+    # delivery_spread], whose delayed fraction is spread/(spread+1) (e.g.
+    # 0.5 at spread=1 ≙ permille 500 on the dial). The paper's
+    # continuous-latency simulation (Fig. 11) sits below one full round of
+    # skew; see EVALUATION.md §2 for the calibration.
+    delivery_prob_permille: int = 1000
 
 
 class EngineState(NamedTuple):

@@ -141,7 +141,18 @@ def _deliver_alerts(cfg: EngineConfig, state: EngineState, fire_round, blocked_w
                 ^ jnp.uint32((ring * 0xC2B2AE3D) & 0xFFFFFFFF)
                 ^ epoch_salt
             )
-            delay = (rnd % jnp.uint32(cfg.delivery_spread + 1)).astype(jnp.int32)
+            if cfg.delivery_prob_permille >= 1000:
+                delay = (rnd % jnp.uint32(cfg.delivery_spread + 1)).astype(jnp.int32)
+            else:
+                # Sub-round skew: delay is nonzero (uniform in
+                # [1, delivery_spread]) only with probability p; an
+                # independent hash stream gates so magnitude and gate are
+                # uncorrelated.
+                gate = (mix32(rnd ^ jnp.uint32(0xA511E9B3)) % jnp.uint32(1000)) < jnp.uint32(
+                    cfg.delivery_prob_permille
+                )
+                magnitude = 1 + (rnd % jnp.uint32(cfg.delivery_spread)).astype(jnp.int32)
+                delay = jnp.where(gate, magnitude, 0)
         else:
             delay = 0
         delivered = (age[:, ring][None, :] >= delay) & (blocked == 0)  # [c, n]
@@ -675,18 +686,27 @@ class VirtualCluster:
         delivery_spread: int = 0,
         concurrent_coordinators: int = 1,
         fd_window: int = 0,
+        delivery_prob_permille: int = 1000,
     ) -> "VirtualCluster":
         """Synthetic cluster: slot identities are random 64-bit lanes (the
         host never materializes 100K endpoint strings; interop deployments
         use from_endpoints)."""
         n = n_slots if n_slots is not None else n_members
         assert n >= n_members
+        if not 0 <= delivery_prob_permille <= 1000:
+            # A negative value would wrap through uint32 in the delivery
+            # gate and silently behave as p=1.
+            raise ValueError(
+                f"delivery_prob_permille must be in [0, 1000], got "
+                f"{delivery_prob_permille}"
+            )
         cfg = EngineConfig(
             n=n, k=k, h=h, l=l, c=cohorts, fd_threshold=fd_threshold,
             use_pallas=use_pallas, fallback_rounds=fallback_rounds,
             delivery_spread=delivery_spread,
             concurrent_coordinators=concurrent_coordinators,
             fd_window=fd_window,
+            delivery_prob_permille=delivery_prob_permille,
         )
         rng = np.random.default_rng(seed)
         key_hi = rng.integers(0, 2**32, size=(k, n), dtype=np.uint32)

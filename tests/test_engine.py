@@ -338,6 +338,75 @@ def test_delivery_jitter_causes_receiver_divergence():
     assert saw_divergence, "no run produced divergent cohort proposals"
 
 
+def test_delivery_prob_zero_means_no_divergence():
+    # delivery_prob_permille=0 with a nonzero spread draws every delay as 0:
+    # all cohorts hear identical alert subsets each round, so every announced
+    # proposal is the same cut — the "no timing divergence" end of the
+    # sub-round skew dial.
+    n = 128
+    for seed in range(4):
+        vc = VirtualCluster.create(
+            n, cohorts=32, k=10, h=6, l=2, fd_threshold=2, seed=seed,
+            delivery_spread=4, delivery_prob_permille=0,
+        )
+        vc.assign_cohorts_roundrobin()
+        rng = np.random.default_rng(seed)
+        vc.stagger_fd_counts(rng, spread_rounds=3)
+        vc.crash(rng.choice(n, size=4, replace=False))
+        proposals = set()
+        for _ in range(64):
+            events = vc.step()
+            announced = np.asarray(events.proposals_announced)
+            if announced.any():
+                hi = np.asarray(events.prop_hi)
+                lo = np.asarray(events.prop_lo)
+                for ci in np.nonzero(announced)[0]:
+                    proposals.add((int(hi[ci]), int(lo[ci])))
+            if bool(events.decided):
+                break
+        assert bool(events.decided)
+        assert len(proposals) == 1, "prob=0 must eliminate cohort divergence"
+
+
+def test_delivery_prob_sets_first_round_delivered_fraction():
+    # The sub-round dial's distribution, measured directly: with spread=1 a
+    # (cohort, edge) delivery is delayed one round with probability
+    # permille/1000, so the fraction of (cohort, edge) alert bits landing in
+    # the fire round itself must track 1 - p. (permille=1000 keeps the
+    # original uniform [0, spread] draw: p = 1/2.)
+    n = 64
+    c = 256
+
+    def first_round_fraction(permille: int) -> float:
+        vc = VirtualCluster.create(
+            n, cohorts=c, k=10, h=9, l=4, fd_threshold=1, seed=5,
+            delivery_spread=1, delivery_prob_permille=permille,
+        )
+        vc.assign_cohorts_roundrobin()
+        vc.crash([11])
+        events = vc.step()  # detectors fire and delay-0 deliveries land
+        assert not bool(events.decided)
+        bits = np.asarray(vc.state.report_bits)  # [c, n] uint32
+        delivered = sum(bin(int(b)).count("1") for b in bits[:, 11])
+        fired = int(np.asarray(vc.state.fd_fired)[11].sum())
+        assert fired > 0
+        return delivered / (c * fired)
+
+    frac_low = first_round_fraction(250)
+    frac_full = first_round_fraction(1000)
+    assert 0.65 < frac_low < 0.85, frac_low  # expect ~0.75
+    assert 0.40 < frac_full < 0.60, frac_full  # expect ~0.5
+
+    # Out-of-range probabilities fail fast (negative would wrap through
+    # uint32 in the delivery gate and silently mean p=1).
+    import pytest
+
+    with pytest.raises(ValueError):
+        VirtualCluster.create(16, delivery_spread=1, delivery_prob_permille=-1)
+    with pytest.raises(ValueError):
+        VirtualCluster.create(16, delivery_spread=1, delivery_prob_permille=1001)
+
+
 def test_rx_block_past_word_boundary():
     # Cohort indices above 31 live in the second packed uint32 word; a
     # blocked cohort there must genuinely miss alerts (regression for the
