@@ -94,6 +94,43 @@ def main() -> None:
         results["note"] = "Pallas path is TPU-gated; re-run on the accelerator"
     print(json.dumps(results))
 
+    # Delivery kernel: the fused (cohort-word x ring) pass vs the engine's
+    # jnp loop, at engine-realistic shapes ([w*k, n] packed rx-block rows).
+    from rapid_tpu.models.virtual_cluster import VirtualCluster, _deliver_alerts, _edge_masks
+
+    def delivery_run(use_pallas: bool, n: int, c: int) -> float:
+        vc = VirtualCluster.create(
+            n, cohorts=c, fd_threshold=1, seed=1, use_pallas=use_pallas,
+            delivery_spread=2,
+        )
+        vc.assign_cohorts_roundrobin()
+        vc.crash(np.asarray(rng.choice(n, size=max(1, n // 100), replace=False)))
+        vc.step()  # compile + fire the detectors
+
+        cfg, state, faults = vc.cfg, vc.state, vc.faults
+
+        @jax.jit
+        def one_delivery(state, faults):
+            _, blocked_rows = _edge_masks(cfg, state, faults)
+            return _deliver_alerts(cfg, state, state.fire_round, blocked_rows)
+
+        def call():
+            return int(one_delivery(state, faults)[0, 0])
+
+        return timed(call)
+
+    n_d, c_d = min(args.n, 100_000), 64
+    results_d = {
+        "delivery_shape": [c_d, n_d],
+        "jnp_ms": round(delivery_run(False, n_d, c_d), 3),
+    }
+    if on_tpu:
+        results_d["pallas_ms"] = round(delivery_run(True, n_d, c_d), 3)
+        results_d["speedup"] = round(results_d["jnp_ms"] / results_d["pallas_ms"], 2)
+    else:
+        results_d["pallas_ms"] = None
+    print(json.dumps(results_d))
+
     if args.profile:
         from rapid_tpu.models.virtual_cluster import VirtualCluster
         from rapid_tpu.utils.profiling import trace

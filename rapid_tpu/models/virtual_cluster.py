@@ -33,7 +33,11 @@ from rapid_tpu.models.state import (
 )
 from rapid_tpu.ops.consensus import tally_candidates
 from rapid_tpu.ops.hashing import masked_set_hash, mix32
-from rapid_tpu.ops.pallas_kernels import _popcount32, watermark_merge_classify
+from rapid_tpu.ops.pallas_kernels import (
+    _popcount32,
+    delivery_new_bits_pallas,
+    watermark_merge_classify,
+)
 from rapid_tpu.ops.rings import endpoint_ring_keys, predecessor_of_keys, ring_topology
 
 
@@ -42,18 +46,29 @@ def cohort_words(c: int) -> int:
     return (c + 31) // 32
 
 
-def _edge_masks(cfg: EngineConfig, state: EngineState, faults: FaultInputs):
-    """Per-edge observer masks: (observer_active[n,k], blocked_words[w,n,k]).
+def _validate_delivery_prob(permille: int) -> None:
+    """A negative value would wrap through uint32 in the delivery gate and
+    silently behave as p=1; every constructor funnels through this."""
+    if not 0 <= permille <= 1000:
+        raise ValueError(
+            f"delivery_prob_permille must be in [0, 1000], got {permille}"
+        )
 
-    ``blocked_words`` packs "cohort c cannot hear the observer of edge
-    (subject, ring)" bitwise over cohorts — bit j of word w is cohort
-    ``32w + j`` — so the hoisted delivery mask costs O(K·N·C/32) uint32
-    instead of O(K·N·C) bools, which is what lets C scale to hundreds of
-    independently-diverging receiver cohorts. Both outputs depend only on
-    (topology, faults), fixed between view changes, so convergence loops
-    hoist this out of the round body entirely.
+
+def _edge_masks(cfg: EngineConfig, state: EngineState, faults: FaultInputs):
+    """Per-edge observer masks: (observer_active[n,k], blocked_rows[w*k,n]).
+
+    ``blocked_rows`` packs "cohort c cannot hear the observer of edge
+    (subject, ring)" bitwise over cohorts — row ``wi*k + ring``, bit j of a
+    word covers cohort ``32*wi + j`` — so the hoisted delivery mask costs
+    O(K·N·C/32) uint32 instead of O(K·N·C) bools, which is what lets C
+    scale to hundreds of independently-diverging receiver cohorts. (Slots
+    on the last axis: the layout the delivery kernel tiles over lanes.)
+    Both outputs depend only on (topology, faults), fixed between view
+    changes, so convergence loops hoist this out of the round body
+    entirely.
     """
-    n, c = cfg.n, cfg.c
+    n, k, c = cfg.n, cfg.k, cfg.c
     w = cohort_words(c)
     obs = state.obs_idx.T  # [n, k] — observer of (subject s, ring k)
     obs_clamped = jnp.clip(obs, 0, n - 1)
@@ -67,8 +82,8 @@ def _edge_masks(cfg: EngineConfig, state: EngineState, faults: FaultInputs):
     rxb = rxb.reshape(w, 32, n)
     bit_weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
     words = jnp.sum(rxb * bit_weights[None, :, None], axis=1, dtype=jnp.uint32)  # [w, n]
-    blocked_words = words[:, obs_clamped]  # [w, n, k] — THE gather
-    return observer_active, blocked_words
+    blocked_rows = words[:, obs_clamped.T].reshape(w * k, n)  # THE gather
+    return observer_active, blocked_rows
 
 
 def _fd_tick(cfg: EngineConfig, state: EngineState, faults: FaultInputs, observer_active):
@@ -109,31 +124,46 @@ def _fd_tick(cfg: EngineConfig, state: EngineState, faults: FaultInputs, observe
     return fd_count, fd_hist, fd_fired, fire
 
 
-def _deliver_alerts(cfg: EngineConfig, state: EngineState, fire_round, blocked_words):
+def _deliver_alerts(cfg: EngineConfig, state: EngineState, fire_round, blocked_rows):
     """Per-cohort delivered alert bitmasks, ``new_bits[c, n]`` (bit k = ring
     k's alert for subject n has reached cohort c).
 
     The device analog of UnicastToAllBroadcaster + per-receiver arrival
     timing: an alert fired at round f reaches cohort c at round
     ``f + delay(c, edge)`` where the delay is drawn deterministically from a
-    hash of (cohort, edge, configuration) in ``[0, delivery_spread]`` —
-    different cohorts genuinely hear different alert subsets at any instant,
-    which is where almost-everywhere-agreement conflicts come from (paper
-    Fig. 11). Delivery is recomputed cumulatively each round (cheap bitwise
-    work); the OR-merge into ``report_bits`` makes redelivery idempotent.
-    Materializes [c, n] per ring — never [c, n, k].
+    hash of (cohort, edge, configuration) in ``[0, delivery_spread]``
+    (sub-round granularity via cfg.delivery_prob_permille) — different
+    cohorts genuinely hear different alert subsets at any instant, which is
+    where almost-everywhere-agreement conflicts come from (paper Fig. 11).
+    Delivery is recomputed cumulatively each round (cheap bitwise work); the
+    OR-merge into ``report_bits`` makes redelivery idempotent. Materializes
+    [c, n] per ring — never [c, n, k]. With cfg.use_pallas the whole
+    (cohort-word x ring) loop nest runs as one fused VMEM kernel
+    (rapid_tpu.ops.pallas_kernels.delivery_new_bits_pallas, hash-stream
+    bit-identical to this path).
     """
     n, k, c = cfg.n, cfg.k, cfg.c
+    age_kn = state.round_idx - fire_round.T  # [k, n]; hugely negative if unfired
+    if cfg.use_pallas:
+        out = delivery_new_bits_pallas(
+            blocked_rows,
+            age_kn,
+            state.config_epoch.astype(jnp.uint32).reshape(1),
+            cfg.k,
+            cfg.delivery_spread,
+            cfg.delivery_prob_permille,
+        )
+        return out[:c, :]
+
     c_ids = jnp.arange(c, dtype=jnp.uint32)
     word_idx = (c_ids // 32).astype(jnp.int32)  # [c]
     bit_idx = c_ids % 32  # [c]
-    age = state.round_idx - fire_round  # [n, k]; hugely negative if unfired
     slot_salt = jnp.arange(n, dtype=jnp.uint32) * jnp.uint32(0x85EBCA77)
     epoch_salt = state.config_epoch.astype(jnp.uint32) * jnp.uint32(0x27D4EB2F)
 
     new_bits = jnp.zeros((c, n), dtype=jnp.uint32)
     for ring in range(k):
-        blocked = (blocked_words[word_idx, :, ring] >> bit_idx[:, None]) & 1  # [c, n]
+        blocked = (blocked_rows[word_idx * k + ring, :] >> bit_idx[:, None]) & 1  # [c, n]
         if cfg.delivery_spread > 0:
             rnd = mix32(
                 (c_ids[:, None] * jnp.uint32(0x9E3779B1))
@@ -155,7 +185,7 @@ def _deliver_alerts(cfg: EngineConfig, state: EngineState, fire_round, blocked_w
                 delay = jnp.where(gate, magnitude, 0)
         else:
             delay = 0
-        delivered = (age[:, ring][None, :] >= delay) & (blocked == 0)  # [c, n]
+        delivered = (age_kn[ring][None, :] >= delay) & (blocked == 0)  # [c, n]
         new_bits = new_bits | (delivered.astype(jnp.uint32) << jnp.uint32(ring))
     return new_bits
 
@@ -241,7 +271,7 @@ def _compute_round(
     # 1. Failure-detector tick -> fresh DOWN alerts per (subject, ring) edge.
     if edge_masks is None:
         edge_masks = _edge_masks(cfg, state, faults)
-    observer_active, blocked_words = edge_masks
+    observer_active, blocked_rows = edge_masks
     fd_count, fd_hist, fd_fired, fire = _fd_tick(cfg, state, faults, observer_active)
     fire_round = jnp.where(fire, state.round_idx, state.fire_round)
     alerts_emitted = jnp.sum(fire, dtype=jnp.int32)
@@ -264,7 +294,7 @@ def _compute_round(
     need_delivery = fired_any & (state.round_idx <= last_mature)
     new_bits = jax.lax.cond(
         need_delivery,
-        lambda: _deliver_alerts(cfg, state, fire_round, blocked_words),
+        lambda: _deliver_alerts(cfg, state, fire_round, blocked_rows),
         lambda: jnp.zeros((c, n), dtype=jnp.uint32),
     )
     # Alerts for ALIVE subjects are DOWN reports; join-pending subjects'
@@ -693,13 +723,7 @@ class VirtualCluster:
         use from_endpoints)."""
         n = n_slots if n_slots is not None else n_members
         assert n >= n_members
-        if not 0 <= delivery_prob_permille <= 1000:
-            # A negative value would wrap through uint32 in the delivery
-            # gate and silently behave as p=1.
-            raise ValueError(
-                f"delivery_prob_permille must be in [0, 1000], got "
-                f"{delivery_prob_permille}"
-            )
+        _validate_delivery_prob(delivery_prob_permille)
         cfg = EngineConfig(
             n=n, k=k, h=h, l=l, c=cohorts, fd_threshold=fd_threshold,
             use_pallas=use_pallas, fallback_rounds=fallback_rounds,
@@ -734,17 +758,20 @@ class VirtualCluster:
         delivery_spread: int = 0,
         concurrent_coordinators: int = 1,
         fd_window: int = 0,
+        delivery_prob_permille: int = 1000,
     ) -> "VirtualCluster":
         """Build from real endpoints with the host view's exact ring keys, so
         the engine's topology matches a host MembershipView bit-for-bit."""
         n_members = len(endpoints)
         n = n_slots if n_slots is not None else n_members
+        _validate_delivery_prob(delivery_prob_permille)
         cfg = EngineConfig(
             n=n, k=k, h=h, l=l, c=cohorts, fd_threshold=fd_threshold,
             use_pallas=use_pallas, fallback_rounds=fallback_rounds,
             delivery_spread=delivery_spread,
             concurrent_coordinators=concurrent_coordinators,
             fd_window=fd_window,
+            delivery_prob_permille=delivery_prob_permille,
         )
         key_hi0, key_lo0 = endpoint_ring_keys(endpoints, k)
         key_hi = np.zeros((k, n), dtype=np.uint32)

@@ -20,6 +20,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from rapid_tpu.ops.hashing import mix32 as _mix32
+
 try:  # pallas is TPU/Mosaic-gated; keep import soft for CPU-only installs
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -121,6 +123,103 @@ def watermark_merge_classify(
     return bits.reshape(total)[:n].reshape(shape), cls.reshape(total)[:n].reshape(shape)
 
 
+def _delivery_kernel(k, w, spread, permille, blocked_ref, age_ref, epoch_ref, out_ref):
+    """Fused per-cohort alert delivery for one 128-slot tile.
+
+    The engine's delivery pass (virtual_cluster._deliver_alerts) is, per
+    round, K iterations of [c, n] bitwise work over gathered rx-block words
+    plus a per-(cohort, edge) hash draw — bandwidth-bound elementwise
+    traffic. This kernel runs the whole (cohort-word x ring) loop nest in
+    VMEM: one read of the blocked words and ages, one write of the packed
+    result, nothing materialized per ring.
+
+    Layout: 32 cohorts per uint32 word ride the sublane axis as a [32, 128]
+    tile; slots ride lanes; cohort words and rings are static Python loops.
+    Hash streams are bit-identical to the jnp path.
+    """
+    lane = jax.lax.broadcasted_iota(jnp.uint32, (32, _LANES), 1)
+    j = jax.lax.broadcasted_iota(jnp.uint32, (32, _LANES), 0)  # cohort-in-word
+    tile = pl.program_id(0)
+    slot = tile.astype(jnp.uint32) * jnp.uint32(_LANES) + lane
+    slot_salt = slot * jnp.uint32(0x85EBCA77)
+    epoch_salt = epoch_ref[0] * jnp.uint32(0x27D4EB2F)
+    for wi in range(w):
+        acc = jnp.zeros((32, _LANES), jnp.uint32)
+        cohort_term = (jnp.uint32(wi * 32) + j) * jnp.uint32(0x9E3779B1)
+        for ring in range(k):
+            words = blocked_ref[wi * k + ring : wi * k + ring + 1, :]  # [1, 128]
+            blocked_bit = (jnp.broadcast_to(words, (32, _LANES)) >> j) & jnp.uint32(1)
+            age = jnp.broadcast_to(age_ref[ring : ring + 1, :], (32, _LANES))
+            if spread > 0:
+                rnd = _mix32(
+                    cohort_term
+                    ^ slot_salt
+                    ^ jnp.uint32((ring * 0xC2B2AE3D) & 0xFFFFFFFF)
+                    ^ epoch_salt
+                )
+                if permille >= 1000:
+                    delay = (rnd % jnp.uint32(spread + 1)).astype(jnp.int32)
+                else:
+                    gate = (
+                        _mix32(rnd ^ jnp.uint32(0xA511E9B3)) % jnp.uint32(1000)
+                    ) < jnp.uint32(permille)
+                    delay = jnp.where(
+                        gate, 1 + (rnd % jnp.uint32(spread)).astype(jnp.int32), 0
+                    )
+            else:
+                delay = jnp.int32(0)
+            delivered = (age >= delay) & (blocked_bit == 0)
+            acc = acc | (delivered.astype(jnp.uint32) << jnp.uint32(ring))
+        out_ref[wi * 32 : (wi + 1) * 32, :] = acc
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "spread", "permille", "interpret")
+)
+def delivery_new_bits_pallas(
+    blocked_rows: jnp.ndarray,
+    age_kn: jnp.ndarray,
+    epoch: jnp.ndarray,
+    k: int,
+    spread: int,
+    permille: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused delivery pass: ``new_bits[w*32, n]`` from packed rx-block rows.
+
+    blocked_rows: [w*k, n] uint32 — row wi*k+ring = the wi-th cohort word of
+    ring's per-slot block bits (virtual_cluster._edge_masks layout).
+    age_kn: [k, n] int32 rounds since each edge fired (negative = unfired).
+    epoch: [1] uint32 configuration epoch (salts the delay draws).
+    Returns all w*32 cohort lanes; callers slice [:c]. Slots are padded to
+    the 128-lane tile internally (padding ages are hugely negative, so the
+    pad lanes deliver nothing).
+    """
+    wk, n = blocked_rows.shape
+    w = wk // k
+    n_pad = (-n) % _LANES
+    if n_pad:
+        blocked_rows = jnp.pad(blocked_rows, ((0, 0), (0, n_pad)))
+        age_kn = jnp.pad(age_kn, ((0, 0), (0, n_pad)), constant_values=-(1 << 29))
+    total = n + n_pad
+    grid = (total // _LANES,)
+    out = pl.pallas_call(
+        functools.partial(_delivery_kernel, k, w, spread, permille),
+        out_shape=jax.ShapeDtypeStruct((w * 32, total), jnp.uint32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((wk, _LANES), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, _LANES), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (w * 32, _LANES), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(blocked_rows, age_kn, epoch.astype(jnp.uint32))
+    return out[:, :n]
+
+
 @functools.lru_cache(maxsize=1)
 def pallas_usable() -> bool:
     """Smoke-test the Mosaic kernel once on tiny shapes: True iff the pallas
@@ -141,6 +240,17 @@ def pallas_usable() -> bool:
         )
         if int(cls[0, 0]) != 2:  # popcount(0x1FF) = 9 >= H
             raise RuntimeError("pallas kernel misclassified the smoke input")
+        # The engine's use_pallas flag turns on BOTH kernels; smoke the
+        # delivery kernel too (k=3, one cohort word, all edges fired at
+        # round 0 and unblocked: every bit must deliver at age >= spread).
+        k = 3
+        blocked = jnp.zeros((k, 256), jnp.uint32)
+        age = jnp.full((k, 256), 9, jnp.int32)
+        bits = delivery_new_bits_pallas(
+            blocked, age, jnp.zeros((1,), jnp.uint32), k, 2, 1000
+        )
+        if int(bits[0, 0]) != (1 << k) - 1:
+            raise RuntimeError("delivery kernel missed matured alerts")
         return True
     except Exception:  # noqa: BLE001 — any kernel failure means "don't use it"
         return False
