@@ -106,12 +106,25 @@ def make_sharded_step(cfg: EngineConfig, mesh: Mesh):
     )
 
 
+def shard_pytree(tree, shardings):
+    """Place host-computed arrays onto a mesh — single-process OR global
+    (multi-controller). ``jax.device_put`` only targets addressable devices,
+    so every leaf is assembled via ``jax.make_array_from_callback``: each
+    process supplies exactly its addressable shards. In a multi-controller
+    job this requires every process to have computed identical host values
+    (deterministic seeds) — the standard multi-controller contract."""
+
+    def place(x, sharding):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+    return jax.tree.map(place, tree, shardings)
+
+
 def shard_state(state: EngineState, mesh: Mesh) -> EngineState:
     """Place an existing (host/single-device) state onto the mesh."""
-    shardings = state_shardings(mesh)
-    return jax.tree.map(jax.device_put, state, shardings)
+    return shard_pytree(state, state_shardings(mesh))
 
 
 def shard_faults(faults: FaultInputs, mesh: Mesh) -> FaultInputs:
-    shardings = fault_shardings(mesh)
-    return jax.tree.map(jax.device_put, faults, shardings)
+    return shard_pytree(faults, fault_shardings(mesh))

@@ -8,9 +8,11 @@ over ICI within a host/pod and DCN between them — no NCCL/MPI analog to
 manage.
 
 Single-host (and CPU dry-run) paths work without initialization; this module
-is the thin entry for real multi-host jobs. It cannot be exercised in a
-single-host environment beyond argument handling — the driver's
-``dryrun_multichip`` validates the sharded program itself on a virtual mesh.
+is the thin entry for real multi-host jobs. It is exercised by real
+``jax.distributed`` jobs in ``tests/test_multihost.py`` — a single-process
+job and a true two-process multi-controller run (virtual CPU devices, one
+global mesh, cross-process collectives); the driver's ``dryrun_multichip``
+additionally validates the sharded program on a virtual mesh.
 """
 
 from __future__ import annotations
@@ -19,7 +21,7 @@ from typing import Optional
 
 import jax
 
-from rapid_tpu.parallel.mesh import make_mesh
+from rapid_tpu.parallel.mesh import make_mesh, shard_pytree
 
 
 def initialize_multihost(
@@ -50,3 +52,9 @@ def local_device_count() -> int:
 
 def is_coordinator() -> bool:
     return jax.process_index() == 0
+
+
+# Multi-controller-safe placement lives in mesh.py (one mechanism for both
+# single-process and global meshes); re-exported here as the multi-host
+# entry point's natural vocabulary.
+shard_host_pytree = shard_pytree

@@ -11,6 +11,7 @@ initialized the in-process CPU backend).
 import os
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
@@ -72,3 +73,95 @@ def test_single_process_distributed_job_runs_sharded_step():
     )
     assert result.returncode == 0, f"job failed:\n{result.stdout}\n{result.stderr}"
     assert "MULTIHOST_JOB_OK" in result.stdout
+
+
+# Real multi-controller: TWO processes, 4 virtual CPU devices each, one
+# global 8-device mesh. Each process computes identical host state
+# (deterministic seed), assembles globally-sharded arrays from its own
+# addressable shards (shard_host_pytree), and runs the SPMD engine step —
+# the actual DCN execution model, with cross-process collectives for the
+# engine's global reductions.
+_JOB2 = """
+import sys
+process_id = int(sys.argv[1])
+
+from rapid_tpu.utils.platform import force_platform
+assert force_platform("cpu", n_host_devices=4)
+
+import jax
+from rapid_tpu.parallel import multihost
+
+multihost.initialize_multihost(
+    coordinator_address="127.0.0.1:47321", num_processes=2, process_id=process_id
+)
+try:
+    assert jax.process_count() == 2
+    assert multihost.local_device_count() == 4
+    assert len(jax.devices()) == 8
+
+    from rapid_tpu.models.virtual_cluster import VirtualCluster
+    from rapid_tpu.parallel.mesh import (
+        fault_shardings,
+        make_sharded_step,
+        state_shardings,
+    )
+
+    mesh = multihost.global_mesh()
+    assert mesh.devices.size == 8
+
+    vc = VirtualCluster.create(60, n_slots=64, fd_threshold=2, seed=0)
+    vc.crash([3, 17])
+    step = make_sharded_step(vc.cfg, mesh)
+    state = multihost.shard_host_pytree(vc.state, state_shardings(mesh))
+    faults = multihost.shard_host_pytree(vc.faults, fault_shardings(mesh))
+    decided = False
+    for _ in range(16):
+        state, events = step(state, faults)
+        if bool(events.decided):  # replicated scalar: addressable everywhere
+            decided = True
+            break
+    assert decided
+    assert int(state.n_members) == 58
+    from jax.experimental import multihost_utils
+
+    alive = multihost_utils.process_allgather(state.alive, tiled=True)
+    assert not alive[[3, 17]].any()
+    assert alive.sum() == 58
+    print(f"MULTIHOST2_OK_{process_id}")
+finally:
+    jax.distributed.shutdown()
+"""
+
+
+def test_two_process_distributed_job_runs_sharded_step():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{REPO}:" + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _JOB2, str(pid)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=REPO,
+        )
+        for pid in (0, 1)
+    ]
+    # Drain as processes exit rather than sequentially: if one crashes at
+    # init the other blocks at the distributed barrier, and a sequential
+    # communicate() on the hung one would time out WITHOUT ever reading the
+    # crashed one's traceback — the diagnostic that matters.
+    deadline = time.monotonic() + 240
+    while any(p.poll() is None for p in procs) and time.monotonic() < deadline:
+        time.sleep(0.5)
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    outputs = [p.communicate()[0] for p in procs]
+    for pid, (proc, out) in enumerate(zip(procs, outputs)):
+        all_out = "\n".join(
+            f"--- process {i} (rc={q.returncode}) ---\n{o}"
+            for i, (q, o) in enumerate(zip(procs, outputs))
+        )
+        assert proc.returncode == 0, f"process {pid} failed:\n{all_out}"
+        assert f"MULTIHOST2_OK_{pid}" in out, all_out
