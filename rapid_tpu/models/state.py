@@ -40,8 +40,10 @@ class EngineConfig(NamedTuple):
     l: int  # low watermark
     c: int = 2  # receiver cohorts
     fd_threshold: int = 3  # consecutive failed probe windows before alerting
-    # Run the cut detector's merge+classify through the Pallas TPU kernel
-    # (rapid_tpu.ops.pallas_kernels); off for sharded/CPU runs.
+    # Run the engine's Pallas TPU kernels (rapid_tpu.ops.pallas_kernels) —
+    # in practice the fused delivery kernel, the measured winner; the
+    # watermark kernel additionally needs pallas_watermark below. Off for
+    # sharded/CPU runs.
     use_pallas: bool = False
     # Rounds an announced proposal may sit undecided before the classic-Paxos
     # fallback fires (models FastPaxos.java:106-107's jittered recovery; the
@@ -79,6 +81,15 @@ class EngineConfig(NamedTuple):
     # continuous-latency simulation (Fig. 11) sits below one full round of
     # skew; see EVALUATION.md §2 for the calibration.
     delivery_prob_permille: int = 1000
+    # Route the watermark merge+classify through the Pallas kernel too. Off
+    # by default even when use_pallas is set: slope-based microbenchmarks on
+    # the v5e (evidence/round2/) put XLA's own fusion of the elementwise
+    # watermark pass AHEAD of the hand-written kernel (2.5 ms vs 3.7 ms at
+    # [8, 1M]) while the fused delivery kernel wins 2.25× — so use_pallas
+    # gates delivery only. Opting in here re-enables the watermark kernel
+    # (equivalence tests, future re-measurement); consult
+    # ops.pallas_kernels.pallas_watermark_usable() first, as with use_pallas.
+    pallas_watermark: bool = False
 
 
 class EngineState(NamedTuple):

@@ -197,8 +197,9 @@ def _cohort_cut_detection(cfg: EngineConfig, state: EngineState, new_bits, heard
     MembershipService.java:318-348).
 
     The merge + popcount + H/L classification runs through the Pallas TPU
-    kernel when cfg.use_pallas is set (single-device TPU runs); otherwise the
-    bit-identical jnp core. The implicit-invalidation gather only runs when
+    kernel only when cfg.pallas_watermark is ALSO set (measured slower than
+    XLA's own fusion of the elementwise pass at engine shapes — see
+    EngineConfig.pallas_watermark); by default the bit-identical jnp core. The implicit-invalidation gather only runs when
     some cohort actually has subjects in flux after a DOWN event (lax.cond):
     in pure crash/join rounds every subject jumps straight past H, so the
     expensive gather is skipped.
@@ -213,7 +214,7 @@ def _cohort_cut_detection(cfg: EngineConfig, state: EngineState, new_bits, heard
         jnp.broadcast_to(subject_mask[None, :], (c, n)),
         cfg.h,
         cfg.l,
-        use_pallas=cfg.use_pallas,
+        use_pallas=cfg.use_pallas and cfg.pallas_watermark,
     )
     seen_down = state.seen_down | heard_down  # [c]
     stable = cls == 2
@@ -717,6 +718,7 @@ class VirtualCluster:
         concurrent_coordinators: int = 1,
         fd_window: int = 0,
         delivery_prob_permille: int = 1000,
+        pallas_watermark: bool = False,
     ) -> "VirtualCluster":
         """Synthetic cluster: slot identities are random 64-bit lanes (the
         host never materializes 100K endpoint strings; interop deployments
@@ -731,6 +733,7 @@ class VirtualCluster:
             concurrent_coordinators=concurrent_coordinators,
             fd_window=fd_window,
             delivery_prob_permille=delivery_prob_permille,
+            pallas_watermark=pallas_watermark,
         )
         rng = np.random.default_rng(seed)
         key_hi = rng.integers(0, 2**32, size=(k, n), dtype=np.uint32)
@@ -759,6 +762,7 @@ class VirtualCluster:
         concurrent_coordinators: int = 1,
         fd_window: int = 0,
         delivery_prob_permille: int = 1000,
+        pallas_watermark: bool = False,
     ) -> "VirtualCluster":
         """Build from real endpoints with the host view's exact ring keys, so
         the engine's topology matches a host MembershipView bit-for-bit."""
@@ -772,6 +776,7 @@ class VirtualCluster:
             concurrent_coordinators=concurrent_coordinators,
             fd_window=fd_window,
             delivery_prob_permille=delivery_prob_permille,
+            pallas_watermark=pallas_watermark,
         )
         key_hi0, key_lo0 = endpoint_ring_keys(endpoints, k)
         key_hi = np.zeros((k, n), dtype=np.uint32)

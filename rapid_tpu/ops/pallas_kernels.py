@@ -233,16 +233,12 @@ def pallas_usable() -> bool:
     if not (_HAS_PALLAS and jax.default_backend() == "tpu"):
         return False
     try:
-        zb = jnp.zeros((4, 2048), jnp.uint32)
-        _, cls = watermark_merge_classify(
-            zb, zb | jnp.uint32(0x1FF), jnp.ones((4, 2048), bool), 9, 4,
-            use_pallas=True,
-        )
-        if int(cls[0, 0]) != 2:  # popcount(0x1FF) = 9 >= H
-            raise RuntimeError("pallas kernel misclassified the smoke input")
-        # The engine's use_pallas flag turns on BOTH kernels; smoke the
-        # delivery kernel too (k=3, one cohort word, all edges fired at
-        # round 0 and unblocked: every bit must deliver at age >= spread).
+        # The engine's use_pallas flag gates the DELIVERY kernel (the
+        # measured winner; the watermark kernel sits behind the additional
+        # pallas_watermark flag), so fitness is the delivery kernel's alone:
+        # a watermark-only Mosaic regression must not disable it. Smoke:
+        # k=3, one cohort word, all edges fired at round 0 and unblocked —
+        # every bit must deliver at age >= spread.
         k = 3
         blocked = jnp.zeros((k, 256), jnp.uint32)
         age = jnp.full((k, 256), 9, jnp.int32)
@@ -251,6 +247,28 @@ def pallas_usable() -> bool:
         )
         if int(bits[0, 0]) != (1 << k) - 1:
             raise RuntimeError("delivery kernel missed matured alerts")
+        return True
+    except Exception:  # noqa: BLE001 — any kernel failure means "don't use it"
+        return False
+
+
+def pallas_watermark_usable() -> bool:
+    """Fitness check for the WATERMARK kernel, for callers opting in via
+    EngineConfig.pallas_watermark (off by default; ``pallas_usable`` covers
+    only the delivery kernel that ``use_pallas`` alone gates). Same
+    contract: the kernel runs inside larger jitted programs where a Mosaic
+    failure cannot be caught at the caller's compile time, so consult this
+    before enabling."""
+    if not (_HAS_PALLAS and jax.default_backend() == "tpu"):
+        return False
+    try:
+        zb = jnp.zeros((4, 2048), jnp.uint32)
+        _, cls = watermark_merge_classify(
+            zb, zb | jnp.uint32(0x1FF), jnp.ones((4, 2048), bool), 9, 4,
+            use_pallas=True,
+        )
+        if int(cls[0, 0]) != 2:  # popcount(0x1FF) = 9 >= H
+            raise RuntimeError("pallas kernel misclassified the smoke input")
         return True
     except Exception:  # noqa: BLE001 — any kernel failure means "don't use it"
         return False
