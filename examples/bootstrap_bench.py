@@ -1,0 +1,158 @@
+"""Bootstrap benchmark: the paper's Fig. 5 / Table 1 scenario on the engine.
+
+The reference paper's headline comparison is cluster BOOTSTRAP: N processes
+join through a seed as fast as the protocol admits them (Rapid converges
+2-2.32x faster than Memberlist and 3.23-5.81x faster than ZooKeeper at
+N=2000, paper Fig. 5), and — Table 1 — does so through a handful of large
+cuts: 4-10 unique intermediate cluster sizes where ZK/Memberlist pass
+through ~N one-at-a-time sizes. The cleanliness comes from alert batching +
+multi-node cut detection agreeing on whole join waves
+(MembershipService.java:613-637, Cluster.java:406-437).
+
+This script replays that scenario on the virtual-cluster engine: a small
+seed cluster is up; the remaining members all request admission
+concurrently, arriving in ``--waves`` batches (the engine analog of the
+reference's 100 ms alert-batching windows slicing one thundering herd into
+a few batched cuts); each batch is admitted through full consensus with
+jittered per-cohort delivery. Reported per run:
+
+  - wall_ms            end-to-end bootstrap time on this hardware
+  - view_changes       consensus decisions taken (Table 1: O(waves), not O(N))
+  - unique_sizes       every intermediate membership size observed
+  - rounds             protocol rounds executed across all decisions
+
+Usage:
+    python examples/bootstrap_bench.py                  # N=2000, paper scale
+    python examples/bootstrap_bench.py --n 100000       # TPU scale
+    python examples/bootstrap_bench.py --waves 8 --seed-size 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+
+def run_bootstrap(
+    n_total: int,
+    seed_size: int,
+    waves: int,
+    cohorts: int,
+    delivery_spread: int,
+    seed: int = 0,
+    use_pallas: bool = False,
+    max_steps: int = 64,
+) -> dict:
+    """Bootstrap seed_size -> n_total through `waves` batched join cuts."""
+    from rapid_tpu.models.virtual_cluster import VirtualCluster
+
+    vc = VirtualCluster.create(
+        seed_size,
+        n_slots=n_total,
+        cohorts=cohorts,
+        fd_threshold=3,
+        seed=seed,
+        delivery_spread=delivery_spread,
+        use_pallas=use_pallas,
+    )
+    vc.assign_cohorts_roundrobin()
+
+    joiners = np.arange(seed_size, n_total)
+    batches = np.array_split(joiners, waves)
+
+    sizes = [vc.membership_size]
+    total_rounds = 0
+    view_changes = 0
+    vc.sync()
+    t0 = time.perf_counter()
+    for batch in batches:
+        if batch.size == 0:
+            continue
+        vc.inject_join_wave(batch)
+        # One wave may land as one cut or (under delivery jitter) a couple;
+        # keep deciding until every joiner in the batch is admitted.
+        # run_to_decision's packed fetch already carries the membership, so
+        # the loop condition reads sizes[-1] instead of paying a device
+        # fetch (a full tunnel RTT) per check.
+        target = sizes[-1] + batch.size
+        while sizes[-1] < target:
+            rounds, decided, _, n_members = vc.run_to_decision(max_steps=max_steps)
+            total_rounds += rounds
+            if not decided:
+                raise RuntimeError(
+                    f"no decision within {max_steps} rounds at size {n_members}"
+                )
+            view_changes += 1
+            sizes.append(n_members)
+    wall_ms = (time.perf_counter() - t0) * 1000.0
+
+    if sizes[-1] != n_total:
+        raise RuntimeError(f"bootstrap ended at {sizes[-1]} != {n_total}")
+    return {
+        "scenario": "bootstrap",
+        "n_total": n_total,
+        "seed_size": seed_size,
+        "waves": waves,
+        "wall_ms": round(wall_ms, 3),
+        "view_changes": view_changes,
+        "rounds": total_rounds,
+        "unique_sizes": sizes,
+        "cohorts": cohorts,
+        "delivery_spread": delivery_spread,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--platform", default=None,
+                        help="force a jax platform (e.g. cpu)")
+    parser.add_argument("--n", type=int, default=2000,
+                        help="total cluster size (paper Fig. 5 uses 2000)")
+    parser.add_argument("--seed-size", type=int, default=64,
+                        help="members already up before the herd arrives")
+    parser.add_argument("--waves", type=int, default=8,
+                        help="batching windows the joiner herd arrives in "
+                             "(Table 1 reports 4-10 intermediate sizes)")
+    parser.add_argument("--cohorts", type=int, default=16)
+    parser.add_argument("--delivery-spread", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    if args.platform:
+        from rapid_tpu.utils.platform import force_platform
+
+        if not force_platform(args.platform):
+            raise RuntimeError(f"could not force platform {args.platform!r}")
+
+    import jax
+
+    from rapid_tpu.ops.pallas_kernels import pallas_usable
+
+    platform = jax.devices()[0].platform
+    use_pallas = pallas_usable()
+
+    # Warm the executables on a throwaway bootstrap, then measure.
+    run_bootstrap(args.n, args.seed_size, args.waves, args.cohorts,
+                  args.delivery_spread, seed=args.seed + 1,
+                  use_pallas=use_pallas)
+    result = run_bootstrap(args.n, args.seed_size, args.waves, args.cohorts,
+                           args.delivery_spread, seed=args.seed,
+                           use_pallas=use_pallas)
+    result["platform"] = platform
+    # Table 1's metric: intermediate sizes the cluster passed through —
+    # O(waves) for Rapid vs ~N for ZK/Memberlist. The paper's wall-clock bar
+    # (Memberlist ~95 s at N=2000) is a real-network number; the engine's
+    # wall_ms shows the protocol itself is not the bottleneck.
+    result["cleanliness"] = len(result["unique_sizes"])
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

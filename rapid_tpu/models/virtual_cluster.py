@@ -792,27 +792,43 @@ class VirtualCluster:
 
     # -- fault & membership injection ----------------------------------
 
+    def _slot_index(self, slots: Sequence[int]) -> jnp.ndarray:
+        """Host-side bounds check, then upload. jnp's gather/scatter CLAMPS
+        out-of-range indices instead of raising (a typo'd slot would silently
+        inspect/mutate slot n-1), so every lifecycle mutation validates on
+        host where it is free — no extra fetch, the indices originate here."""
+        arr = np.asarray(slots, dtype=np.int32)
+        if arr.size and (arr.min() < 0 or arr.max() >= self.cfg.n):
+            raise IndexError(
+                f"slot indices out of range [0, {self.cfg.n}): "
+                f"{arr[(arr < 0) | (arr >= self.cfg.n)].tolist()}"
+            )
+        return jnp.asarray(arr)
+
     def crash(self, slots: Sequence[int]) -> None:
         """Crash-stop the given slots (unresponsive until revived). Device-side
         scatter: only the slot indices cross the host->device boundary."""
-        idx = jnp.asarray(np.asarray(slots, dtype=np.int32))
+        idx = self._slot_index(slots)
         self.faults = self.faults._replace(crashed=self.faults.crashed.at[idx].set(True))
 
     def revive(self, slots: Sequence[int]) -> None:
-        idx = jnp.asarray(np.asarray(slots, dtype=np.int32))
+        idx = self._slot_index(slots)
         self.faults = self.faults._replace(crashed=self.faults.crashed.at[idx].set(False))
 
-    def _stamp_fired_edges(self, slots: np.ndarray, edge_mask: np.ndarray) -> None:
-        """Mark (slot, ring) edges as fired at the current round (host-side
-        scatter); the round body's delivery machinery then applies per-cohort
-        rx-block masks and delay jitter. Shared by join waves and leaves."""
+    def _stamp_fired_edges(self, idx: jnp.ndarray, edge_mask) -> None:
+        """Mark (slot, ring) edges as fired at the current round (device-side
+        scatter — only slot indices and the [j, k] mask cross the boundary);
+        the round body's delivery machinery then applies per-cohort rx-block
+        masks and delay jitter. Shared by join waves and leaves, which pass
+        the ALREADY-UPLOADED bounds-checked index array (an np.asarray here
+        would round-trip it back through the host)."""
         state = self.state
-        fd_fired = np.asarray(state.fd_fired).copy()
-        fire_round = np.asarray(state.fire_round).copy()
-        fd_fired[slots] = edge_mask
-        fire_round[slots] = np.where(edge_mask, int(state.round_idx), FIRE_NEVER)
+        em = jnp.asarray(edge_mask)  # [j, k] bool
         self.state = state._replace(
-            fd_fired=jnp.asarray(fd_fired), fire_round=jnp.asarray(fire_round)
+            fd_fired=state.fd_fired.at[idx].set(em),
+            fire_round=state.fire_round.at[idx].set(
+                jnp.where(em, state.round_idx, jnp.int32(FIRE_NEVER))
+            ),
         )
 
     def initiate_leave(self, slots: Sequence[int]) -> None:
@@ -827,10 +843,13 @@ class VirtualCluster:
         real ring topology."""
         slots = np.asarray(slots, dtype=np.int32)
         state = self.state
-        obs_idx = np.asarray(state.obs_idx).copy()
-        obs_idx[:, slots] = slots[None, :]
-        self.state = state._replace(obs_idx=jnp.asarray(obs_idx))
-        self._stamp_fired_edges(slots, np.ones((len(slots), self.cfg.k), dtype=bool))
+        idx = self._slot_index(slots)
+        self.state = state._replace(
+            obs_idx=state.obs_idx.at[:, idx].set(
+                jnp.broadcast_to(idx[None, :], (self.cfg.k, len(slots)))
+            )
+        )
+        self._stamp_fired_edges(idx, np.ones((len(slots), self.cfg.k), dtype=bool))
         self.crash(slots)
 
     def set_flaky_edges(self, probe_fail: np.ndarray) -> None:
@@ -866,45 +885,38 @@ class VirtualCluster:
         reused UUIDs outright, UUIDAlreadySeenError)."""
         slots = np.asarray(slots)
         state = self.state
+        idx = self._slot_index(slots)
         # Enforce the rejoin discipline host-side (the engine's
         # UUIDAlreadySeenError): current members, already-pending joiners,
-        # and retired identity lanes are not admissible. One fused
-        # device->host fetch (a fetch is a full tunnel round trip).
-        inadmissible = np.asarray(state.alive | state.join_pending | state.retired)
-        bad = inadmissible[slots]
+        # and retired identity lanes are not admissible. Index on device
+        # first so the ONE device->host fetch (a full tunnel round trip)
+        # carries [j] bools, not the whole [n] state.
+        bad = np.asarray((state.alive | state.join_pending | state.retired)[idx])
         if bad.any():
             raise ValueError(
                 f"slots not admissible as joiners (member/pending/retired): "
-                f"{np.asarray(slots)[bad].tolist()}"
+                f"{slots[bad].tolist()}"
             )
-        join_pending = np.asarray(state.join_pending).copy()
-        join_pending[slots] = True
 
         # Expected observers (gatekeepers) of each joiner: the alive ring
-        # predecessors of its keys.
-        qhi = np.asarray(state.key_hi)[:, slots]
-        qlo = np.asarray(state.key_lo)[:, slots]
-        pred = np.asarray(
-            predecessor_of_keys(
-                state.key_hi, state.key_lo, state.alive, jnp.asarray(qhi), jnp.asarray(qlo)
-            )
+        # predecessors of its keys. Everything below is device-side
+        # gather/scatter — only the slot indices cross the boundary, which
+        # is what keeps a bootstrap wave from paying O(k*n) tunnel traffic.
+        pred = predecessor_of_keys(
+            state.key_hi, state.key_lo, state.alive,
+            state.key_hi[:, idx], state.key_lo[:, idx],
         )  # [k, j]
 
         # The gatekeeper IS the joiner's observer pre-admission (for both
         # alert delivery and implicit invalidation).
-        obs_idx = np.asarray(state.obs_idx).copy()
-        obs_idx[:, slots] = pred
-        inval_obs = np.asarray(state.inval_obs).copy()
-        inval_obs[:, slots] = pred
-
         self.state = state._replace(
-            join_pending=jnp.asarray(join_pending),
-            obs_idx=jnp.asarray(obs_idx),
-            inval_obs=jnp.asarray(inval_obs),
+            join_pending=state.join_pending.at[idx].set(True),
+            obs_idx=state.obs_idx.at[:, idx].set(pred),
+            inval_obs=state.inval_obs.at[:, idx].set(pred),
         )
         # Mark each (joiner, ring) edge as fired now where a gatekeeper
         # exists; delivery (rx-block + jitter) happens in the round body.
-        self._stamp_fired_edges(slots, (pred >= 0).T)
+        self._stamp_fired_edges(idx, (pred >= 0).T)
 
     def assign_cohorts(self, cohort_of: np.ndarray) -> None:
         self.state = self.state._replace(cohort_of=jnp.asarray(cohort_of, dtype=jnp.int32))

@@ -252,6 +252,7 @@ def pallas_usable() -> bool:
         return False
 
 
+@functools.lru_cache(maxsize=1)
 def pallas_watermark_usable() -> bool:
     """Fitness check for the WATERMARK kernel, for callers opting in via
     EngineConfig.pallas_watermark (off by default; ``pallas_usable`` covers

@@ -1,0 +1,78 @@
+"""Bootstrap-wave scenario (paper Fig. 5 / Table 1 analog).
+
+The paper's Table 1 cleanliness claim: a thundering herd of joiners is
+admitted through a handful of large batched cuts (4-10 unique intermediate
+cluster sizes at N=2000), not ~N one-at-a-time view changes. The engine
+replays this in examples/bootstrap_bench.py; these tests pin the invariants
+at test scale.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from examples.bootstrap_bench import run_bootstrap
+
+
+def test_bootstrap_wave_admits_everyone_in_one_cut_per_wave():
+    r = run_bootstrap(
+        n_total=256, seed_size=16, waves=4, cohorts=8, delivery_spread=0
+    )
+    assert r["unique_sizes"][0] == 16
+    assert r["unique_sizes"][-1] == 256
+    # Without delivery jitter each wave lands as exactly one consensus cut.
+    assert r["view_changes"] == 4
+    assert len(r["unique_sizes"]) == 5  # Table 1: O(waves), not O(N)
+    sizes = r["unique_sizes"]
+    assert all(a < b for a, b in zip(sizes, sizes[1:])), "growth is monotone"
+
+
+def test_bootstrap_under_delivery_jitter_still_admits_everyone():
+    r = run_bootstrap(
+        n_total=192, seed_size=12, waves=3, cohorts=16, delivery_spread=2,
+        seed=7,
+    )
+    assert r["unique_sizes"][-1] == 192
+    # Jitter may split a wave into a couple of cuts, never into ~N.
+    assert r["view_changes"] <= 2 * r["waves"]
+
+
+def test_bootstrap_single_giant_wave():
+    """The whole herd in ONE batching window — the hardest cleanliness case:
+    a 15x-membership join wave lands in a bounded number of cuts."""
+    r = run_bootstrap(
+        n_total=512, seed_size=32, waves=1, cohorts=8, delivery_spread=1,
+        seed=3,
+    )
+    assert r["unique_sizes"][-1] == 512
+    assert r["view_changes"] <= 4
+
+
+def test_bootstrap_refuses_double_admission():
+    from rapid_tpu.models.virtual_cluster import VirtualCluster
+
+    vc = VirtualCluster.create(8, n_slots=16, cohorts=2, seed=0)
+    vc.inject_join_wave([8, 9])
+    with pytest.raises(ValueError, match="not admissible"):
+        vc.inject_join_wave([9])  # already pending
+    with pytest.raises(ValueError, match="not admissible"):
+        vc.inject_join_wave([0])  # already a member
+
+
+def test_lifecycle_mutations_reject_out_of_range_slots():
+    """jnp scatter CLAMPS out-of-range indices; the engine must raise
+    instead of silently mutating slot n-1 (or no-opping a join)."""
+    from rapid_tpu.models.virtual_cluster import VirtualCluster
+
+    vc = VirtualCluster.create(8, n_slots=16, cohorts=2, seed=0)
+    for mutate, arg in [
+        (vc.inject_join_wave, [16]),
+        (vc.crash, [-17]),
+        (vc.revive, [16]),
+        (vc.initiate_leave, [99]),
+    ]:
+        with pytest.raises(IndexError, match="out of range"):
+            mutate(arg)
