@@ -18,7 +18,9 @@ from typing import Dict, List, Optional, Type
 
 from rapid_tpu.errors import ShuttingDownError
 from rapid_tpu.messaging.base import MessagingClient, MessagingServer
+from rapid_tpu.messaging.codec import encode_request, encode_response
 from rapid_tpu.messaging.retries import call_with_retries
+from rapid_tpu.messaging.stats import TransportStats
 from rapid_tpu.settings import Settings
 from rapid_tpu.types import (
     Endpoint,
@@ -36,12 +38,18 @@ class InProcessNetwork:
     """A registry of in-process servers, shared by the clients of one test or
     one co-located deployment."""
 
-    def __init__(self) -> None:
+    def __init__(self, count_wire_bytes: bool = False) -> None:
         self.servers: Dict[Endpoint, "InProcessServer"] = {}
         # Endpoints listed here are unreachable (simulated crash/partition).
         self.blackholed: set = set()
         # Directional blackholes: (src, dst) pairs that drop.
         self.blackholed_links: set = set()
+        # Account wire-EQUIVALENT bytes (what the codec would put on a TCP
+        # frame) in every client/server TransportStats. Off by default: no
+        # bytes actually move in-process, and encoding every message only
+        # to measure it would tax the big cluster tests. Message counts are
+        # always kept.
+        self.count_wire_bytes = count_wire_bytes
 
     def server_for(self, endpoint: Endpoint) -> Optional["InProcessServer"]:
         return self.servers.get(endpoint)
@@ -93,6 +101,7 @@ class InProcessServer(MessagingServer):
         self._service = None
         self._started = False
         self.drop_interceptors: List[ServerDropFirstN] = []
+        self.stats = TransportStats()  # paper Table 2 accounting
 
     def set_membership_service(self, service) -> None:
         self._service = service
@@ -108,6 +117,9 @@ class InProcessServer(MessagingServer):
     async def handle(self, request: RapidRequest) -> RapidResponse:
         if not self._started:
             raise ConnectionError(f"server {self.listen_address} not started")
+        self.stats.rx(
+            len(encode_request(request)) if self._network.count_wire_bytes else 0
+        )
         for interceptor in self.drop_interceptors:
             if interceptor.should_drop(request):
                 raise ConnectionError("dropped by interceptor")
@@ -115,9 +127,19 @@ class InProcessServer(MessagingServer):
             # Answer probes while bootstrapping; joiners' FDs tolerate this
             # status (GrpcServer.java:77-96).
             if isinstance(request, ProbeMessage):
-                return ProbeResponse(status=NodeStatus.BOOTSTRAPPING)
-            raise ConnectionError(f"server {self.listen_address} has no service yet")
-        return await self._service.handle_message(request)
+                response: RapidResponse = ProbeResponse(status=NodeStatus.BOOTSTRAPPING)
+            else:
+                raise ConnectionError(
+                    f"server {self.listen_address} has no service yet"
+                )
+        else:
+            response = await self._service.handle_message(request)
+        # Account the response direction too (TCP counts both ways; without
+        # this the in-process Table 2 numbers omit all response traffic).
+        self.stats.tx(
+            len(encode_response(response)) if self._network.count_wire_bytes else 0
+        )
+        return response
 
 
 class InProcessClient(MessagingClient):
@@ -132,6 +154,7 @@ class InProcessClient(MessagingClient):
         self._settings = settings if settings is not None else Settings()
         self._shut_down = False
         self.delayers: List[ClientDelayer] = []
+        self.stats = TransportStats()  # paper Table 2 accounting
 
     def _timeout_ms_for(self, request: RapidRequest) -> float:
         # Per-message-type deadlines (GrpcClient.java:194-203).
@@ -153,11 +176,18 @@ class InProcessClient(MessagingClient):
         server = self._network.server_for(remote)
         if server is None:
             raise ConnectionError(f"no server at {remote}")
+        self.stats.tx(
+            len(encode_request(request)) if self._network.count_wire_bytes else 0
+        )
         # Yield to the loop so in-process delivery preserves async semantics.
         await asyncio.sleep(0)
-        return await asyncio.wait_for(
+        response = await asyncio.wait_for(
             server.handle(request), timeout=self._timeout_ms_for(request) / 1000.0
         )
+        self.stats.rx(
+            len(encode_response(response)) if self._network.count_wire_bytes else 0
+        )
+        return response
 
     async def send(self, remote: Endpoint, request: RapidRequest) -> RapidResponse:
         return await call_with_retries(

@@ -27,6 +27,7 @@ from rapid_tpu.messaging.codec import (
     encode_response,
 )
 from rapid_tpu.messaging.retries import call_with_retries
+from rapid_tpu.messaging.stats import TransportStats
 from rapid_tpu.settings import Settings
 from rapid_tpu.types import (
     Endpoint,
@@ -66,6 +67,7 @@ class TcpServer(MessagingServer):
         self._service = None
         self._server: Optional[asyncio.AbstractServer] = None
         self._connections: set = set()
+        self.stats = TransportStats()  # paper Table 2 accounting
         # Strong references to in-flight handlers: the event loop only holds
         # tasks weakly, so without this a handler can be garbage-collected
         # mid-flight and the request silently dropped.
@@ -106,6 +108,7 @@ class TcpServer(MessagingServer):
         try:
             while True:
                 correlation_id, kind, payload = await _read_frame(reader)
+                self.stats.rx(_HEADER.size + len(payload))
                 if kind != 0:
                     raise ConnectionError("client sent non-request frame")
                 task = asyncio.ensure_future(
@@ -131,16 +134,24 @@ class TcpServer(MessagingServer):
                     return  # no service yet; let the sender time out and retry
             else:
                 response = await self._service.handle_message(request)
-            _write_frame(writer, correlation_id, 1, encode_response(response))
+            payload_out = encode_response(response)
+            _write_frame(writer, correlation_id, 1, payload_out)
+            self.stats.tx(_HEADER.size + len(payload_out))
             await writer.drain()
         except Exception as exc:  # noqa: BLE001 — connection-level fault isolation
             LOG.debug("server %s failed handling request: %r", self.listen_address, exc)
 
 
 class _Connection:
-    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        stats: TransportStats,
+    ) -> None:
         self.reader = reader
         self.writer = writer
+        self.stats = stats
         self.pending: Dict[int, asyncio.Future] = {}
         self.reader_task = asyncio.ensure_future(self._read_loop())
 
@@ -148,6 +159,10 @@ class _Connection:
         try:
             while True:
                 correlation_id, kind, payload = await _read_frame(self.reader)
+                # Count at the frame-read site: a response that lands after
+                # its request timed out still crossed the wire (exactly the
+                # slow-RPC regime Table 2 measures).
+                self.stats.rx(_HEADER.size + len(payload))
                 future = self.pending.pop(correlation_id, None)
                 if future is not None and not future.done():
                     future.set_result(payload)
@@ -173,6 +188,7 @@ class TcpClient(MessagingClient):
         self._connect_locks: Dict[Endpoint, asyncio.Lock] = {}
         self._correlation = itertools.count(1)
         self._shut_down = False
+        self.stats = TransportStats()  # paper Table 2 accounting
 
     def _timeout_ms_for(self, request: RapidRequest) -> float:
         if isinstance(request, (JoinMessage, PreJoinMessage)):
@@ -190,7 +206,7 @@ class TcpClient(MessagingClient):
             if conn is not None and not conn.writer.is_closing():
                 return conn
             reader, writer = await asyncio.open_connection(remote.hostname, remote.port)
-            conn = _Connection(reader, writer)
+            conn = _Connection(reader, writer, self.stats)
             self._connections[remote] = conn
             return conn
 
@@ -208,7 +224,9 @@ class TcpClient(MessagingClient):
         future: asyncio.Future = asyncio.get_event_loop().create_future()
         conn.pending[correlation_id] = future
         try:
-            _write_frame(conn.writer, correlation_id, 0, encode_request(request))
+            payload_out = encode_request(request)
+            _write_frame(conn.writer, correlation_id, 0, payload_out)
+            self.stats.tx(_HEADER.size + len(payload_out))
             await conn.writer.drain()
             payload = await asyncio.wait_for(future, timeout=timeout_s)
             return decode_response(payload)

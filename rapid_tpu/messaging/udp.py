@@ -103,6 +103,7 @@ class UdpHybridServer(TcpServer):
 
     async def _handle_datagram(self, data: bytes) -> None:
         try:
+            self.stats.rx(len(data))
             request = decode_request(data)
             if self._service is not None:
                 await self._service.handle_message(request)
@@ -164,6 +165,7 @@ class UdpHybridClient(TcpClient):
                     try:
                         transport = await self._udp(ip.version)
                         transport.sendto(payload, (remote.hostname, remote.port))
+                        self.stats.tx(len(payload))
                         return Response()  # fire-and-forget: no ack exists
                     except Exception as exc:  # noqa: BLE001 — fall back to TCP
                         LOG.debug(

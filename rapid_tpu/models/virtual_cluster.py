@@ -850,7 +850,9 @@ class VirtualCluster:
             )
         )
         self._stamp_fired_edges(idx, np.ones((len(slots), self.cfg.k), dtype=bool))
-        self.crash(slots)
+        # Inline crash scatter with the already-validated, already-uploaded
+        # index (a self.crash(slots) call would bounds-check and upload again).
+        self.faults = self.faults._replace(crashed=self.faults.crashed.at[idx].set(True))
 
     def set_flaky_edges(self, probe_fail: np.ndarray) -> None:
         """Arbitrary per-(subject, ring) probe failures — asymmetric/one-way
