@@ -41,6 +41,9 @@ run_stage pytest_tpu 1200 env RAPID_TPU_TEST_PLATFORM=tpu \
 run_stage profile 1800 python -u examples/pallas_microbench.py \
   --n 100000 --profile "$OUT/profile"
 
+run_stage bootstrap 1200 python -u examples/bootstrap_bench.py --n 100000 --seed-size 1000
+grep -h '"scenario"' "$OUT/bootstrap.log" | tail -1 > "$OUT/bootstrap.json"
+
 echo "=== captured ==="
 ls -la "$OUT"
 cat "$OUT/bench.json" "$OUT/microbench.json" 2>/dev/null
