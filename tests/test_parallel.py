@@ -25,6 +25,27 @@ def run_single(n, victims, steps):
     return vc, decided_at
 
 
+def run_sharded(step, state, faults, steps):
+    """Drive a sharded step for `steps` rounds; (state, first decided round)."""
+    decided_at = None
+    for i in range(steps):
+        state, events = step(state, faults)
+        if bool(events.decided) and decided_at is None:
+            decided_at = i
+    return state, decided_at
+
+
+def assert_equivalent(state, single):
+    """Sharded outcome must be bit-identical to the single-device run."""
+    np.testing.assert_array_equal(np.asarray(state.alive), single.alive_mask)
+    assert int(state.n_members) == single.membership_size
+    assert int(state.config_hi) == int(single.state.config_hi)
+    assert int(state.config_lo) == int(single.state.config_lo)
+    np.testing.assert_array_equal(
+        np.asarray(state.obs_idx), np.asarray(single.state.obs_idx)
+    )
+
+
 def test_mesh_has_eight_devices():
     assert len(jax.devices()) == 8
 
@@ -41,19 +62,10 @@ def test_sharded_engine_matches_single_device():
     step = make_sharded_step(vc.cfg, mesh)
     state = shard_state(vc.state, mesh)
     faults = shard_faults(vc.faults, mesh)
-    decided_sharded = None
-    for i in range(steps):
-        state, events = step(state, faults)
-        if bool(events.decided) and decided_sharded is None:
-            decided_sharded = i
+    state, decided_sharded = run_sharded(step, state, faults, steps)
 
     assert decided_sharded == decided_single
-    np.testing.assert_array_equal(np.asarray(state.alive), single.alive_mask)
-    assert int(state.n_members) == single.membership_size
-    assert int(state.config_hi) == int(single.state.config_hi)
-    assert int(state.config_lo) == int(single.state.config_lo)
-    # Topology identical across the mesh boundary.
-    np.testing.assert_array_equal(np.asarray(state.obs_idx), np.asarray(single.state.obs_idx))
+    assert_equivalent(state, single)
 
 
 def test_sharded_state_is_actually_distributed():
@@ -64,3 +76,43 @@ def test_sharded_state_is_actually_distributed():
     assert sharding.num_devices == 8
     # The N axis is partitioned, not replicated.
     assert not sharding.is_fully_replicated
+
+
+def test_sharded_join_wave_matches_single_device():
+    """The JOIN path under a mesh: inject_join_wave's device-side
+    gather/scatter (ring-predecessor lookup, obs_idx/fd columns) runs on
+    already-sharded arrays, and the admitted configuration must be
+    bit-identical to the single-device run."""
+    n_members, n_slots, steps = 192, 256, 8
+    joiners = np.arange(n_members, n_members + 48)
+
+    def build():
+        vc = VirtualCluster.create(
+            n_members, n_slots=n_slots, fd_threshold=2, seed=0,
+            delivery_spread=1,
+        )
+        return vc
+
+    single = build()
+    single.inject_join_wave(joiners)
+    decided_single = None
+    for i in range(steps):
+        events = single.step()
+        if bool(events.decided) and decided_single is None:
+            decided_single = i
+
+    vc = build()
+    mesh = make_mesh()
+    # Shard FIRST, inject after: the wave's gathers/scatters must work on
+    # sharded device arrays, which is the deployment order (state lives on
+    # the mesh; joiners arrive later).
+    vc.state = shard_state(vc.state, mesh)
+    vc.faults = shard_faults(vc.faults, mesh)
+    vc.inject_join_wave(joiners)
+    step = make_sharded_step(vc.cfg, mesh)
+    state, decided_sharded = run_sharded(step, vc.state, vc.faults, steps)
+
+    assert decided_single is not None
+    assert decided_sharded == decided_single
+    assert single.membership_size == n_members + 48
+    assert_equivalent(state, single)
