@@ -1,0 +1,19 @@
+#!/bin/bash
+# The build gate, as one command — the analog of the reference's
+# error-prone -Werror + findbugs + checkstyle Maven phase (root pom.xml,
+# build-common/): static checks first, then the full suite on the virtual
+# 8-device CPU mesh, then the driver gates. CI or a pre-push hook runs this.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== static checks (AST lint gate) =="
+python -m pytest tests/test_lint.py -q
+
+echo "== full suite (CPU, 8 virtual devices) =="
+python -m pytest tests/ -q
+
+echo "== driver gates =="
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python -c "import __graft_entry__ as g; fn, a = g.entry(); fn(*a); g.dryrun_multichip(8)"
+
+echo "ALL CHECKS PASSED"
