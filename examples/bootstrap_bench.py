@@ -89,6 +89,13 @@ def run_bootstrap(
                 raise RuntimeError(
                     f"no decision within {max_steps} rounds at size {n_members}"
                 )
+            if n_members <= sizes[-1]:
+                # Every decision in a pure join wave must admit someone;
+                # a non-growing decision would spin this loop forever (and
+                # pad unique_sizes with duplicates).
+                raise RuntimeError(
+                    f"decision did not grow membership ({sizes[-1]} -> {n_members})"
+                )
             view_changes += 1
             sizes.append(n_members)
     wall_ms = (time.perf_counter() - t0) * 1000.0

@@ -11,8 +11,9 @@ What counts: the TCP paths count real wire bytes (header + payload) per
 frame; the UDP datagram path counts datagram payloads; the in-process
 transport counts messages always and wire-EQUIVALENT bytes (the codec
 encoding the message would have on the TCP transport) when constructed
-with ``count_wire_bytes=True`` — encoding is memoized for broadcast
-fan-out, so accounting a fan-out costs one encode, not N.
+with ``count_wire_bytes=True``. Request encoding is memoized (small LRU,
+hashable messages only) so accounting a broadcast fan-out costs one
+encode, not N; responses are not fanned out and are encoded per send.
 """
 
 from __future__ import annotations

@@ -80,6 +80,11 @@ class TcpServer(MessagingServer):
         self._server = await asyncio.start_server(
             self._on_connection, host=self.listen_address.hostname, port=self.listen_address.port
         )
+        if self.listen_address.port == 0:
+            # Ephemeral bind: adopt the kernel-assigned port so callers can
+            # advertise a real, reachable address.
+            port = self._server.sockets[0].getsockname()[1]
+            self.listen_address = Endpoint(self.listen_address.hostname, port)
 
     async def shutdown(self) -> None:
         if self._server is not None:

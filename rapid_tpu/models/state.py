@@ -40,10 +40,9 @@ class EngineConfig(NamedTuple):
     l: int  # low watermark
     c: int = 2  # receiver cohorts
     fd_threshold: int = 3  # consecutive failed probe windows before alerting
-    # Run the engine's Pallas TPU kernels (rapid_tpu.ops.pallas_kernels) —
-    # in practice the fused delivery kernel, the measured winner; the
-    # watermark kernel additionally needs pallas_watermark below. Off for
-    # sharded/CPU runs.
+    # Run the engine's Pallas TPU kernel (rapid_tpu.ops.pallas_kernels) —
+    # the fused alert-delivery kernel, measured 2.25x over XLA's fusion. Off
+    # for sharded/CPU runs.
     use_pallas: bool = False
     # Rounds an announced proposal may sit undecided before the classic-Paxos
     # fallback fires (models FastPaxos.java:106-107's jittered recovery; the
@@ -81,15 +80,10 @@ class EngineConfig(NamedTuple):
     # continuous-latency simulation (Fig. 11) sits below one full round of
     # skew; see EVALUATION.md §2 for the calibration.
     delivery_prob_permille: int = 1000
-    # Route the watermark merge+classify through the Pallas kernel too. Off
-    # by default even when use_pallas is set: slope-based microbenchmarks on
-    # the v5e (evidence/round2/) put XLA's own fusion of the elementwise
-    # watermark pass AHEAD of the hand-written kernel (2.5 ms vs 3.7 ms at
-    # [8, 1M]) while the fused delivery kernel wins 2.25× — so use_pallas
-    # gates delivery only. Opting in here re-enables the watermark kernel
-    # (equivalence tests, future re-measurement); consult
-    # ops.pallas_kernels.pallas_watermark_usable() first, as with use_pallas.
-    pallas_watermark: bool = False
+    # (A pallas_watermark field once followed: a Mosaic watermark kernel
+    # measured SLOWER than XLA's own fusion — 2.52 ms vs 3.67 ms at [8, 1M],
+    # evidence/round2/microbench_slope.json — and was deleted. Checkpoint
+    # loads drop the stale trailing value; see utils/checkpoint.py.)
 
 
 class EngineState(NamedTuple):
@@ -249,6 +243,10 @@ class StepEvents(NamedTuple):
     """Observable outcomes of one engine step (host-side driver reads these)."""
 
     decided: jnp.ndarray  # scalar bool — consensus reached this step
+    # Which path decided: True = one-step fast round; False = the classic
+    # fallback's coordinator rule (only meaningful when decided). The engine
+    # twin of the host event VIEW_CHANGE_ONE_STEP_FAILED.
+    fast_decided: jnp.ndarray  # scalar bool
     winner_mask: jnp.ndarray  # [n] bool — the decided cut (flip set)
     proposals_announced: jnp.ndarray  # [c] bool — cohorts that proposed this step
     alerts_emitted: jnp.ndarray  # int32 — new edge alerts this step

@@ -196,25 +196,23 @@ def _cohort_cut_detection(cfg: EngineConfig, state: EngineState, new_bits, heard
     by the per-configuration announced-proposal flag,
     MembershipService.java:318-348).
 
-    The merge + popcount + H/L classification runs through the Pallas TPU
-    kernel only when cfg.pallas_watermark is ALSO set (measured slower than
-    XLA's own fusion of the elementwise pass at engine shapes — see
-    EngineConfig.pallas_watermark); by default the bit-identical jnp core. The implicit-invalidation gather only runs when
-    some cohort actually has subjects in flux after a DOWN event (lax.cond):
-    in pure crash/join rounds every subject jumps straight past H, so the
-    expensive gather is skipped.
+    The merge + popcount + H/L classification is plain elementwise jnp: XLA's
+    own fusion measured faster than a hand-written Mosaic version at engine
+    shapes (ops/pallas_kernels.py module docstring). The
+    implicit-invalidation gather only runs when some cohort actually has
+    subjects in flux after a DOWN event (lax.cond): in pure crash/join rounds
+    every subject jumps straight past H, so the expensive gather is skipped.
     """
     n, c = cfg.n, cfg.c
     subject_mask = state.alive | state.join_pending  # [n]
-    # [c, n] stays intact: the jnp core is elementwise (no resharding of the
-    # node-sharded axis); the Pallas path flattens/pads internally.
+    # [c, n] stays intact: the core is elementwise (no resharding of the
+    # node-sharded axis).
     report_bits, cls = watermark_merge_classify(
         state.report_bits,
         new_bits,
         jnp.broadcast_to(subject_mask[None, :], (c, n)),
         cfg.h,
         cfg.l,
-        use_pallas=cfg.use_pallas and cfg.pallas_watermark,
     )
     seen_down = state.seen_down | heard_down  # [c]
     stable = cls == 2
@@ -535,6 +533,7 @@ def _compute_round(
     )
     events = StepEvents(
         decided=decided,
+        fast_decided=fast_decided,
         winner_mask=winner_mask,
         proposals_announced=proposed_now,
         alerts_emitted=alerts_emitted,
@@ -718,7 +717,6 @@ class VirtualCluster:
         concurrent_coordinators: int = 1,
         fd_window: int = 0,
         delivery_prob_permille: int = 1000,
-        pallas_watermark: bool = False,
     ) -> "VirtualCluster":
         """Synthetic cluster: slot identities are random 64-bit lanes (the
         host never materializes 100K endpoint strings; interop deployments
@@ -733,7 +731,6 @@ class VirtualCluster:
             concurrent_coordinators=concurrent_coordinators,
             fd_window=fd_window,
             delivery_prob_permille=delivery_prob_permille,
-            pallas_watermark=pallas_watermark,
         )
         rng = np.random.default_rng(seed)
         key_hi = rng.integers(0, 2**32, size=(k, n), dtype=np.uint32)
@@ -762,7 +759,6 @@ class VirtualCluster:
         concurrent_coordinators: int = 1,
         fd_window: int = 0,
         delivery_prob_permille: int = 1000,
-        pallas_watermark: bool = False,
     ) -> "VirtualCluster":
         """Build from real endpoints with the host view's exact ring keys, so
         the engine's topology matches a host MembershipView bit-for-bit."""
@@ -776,7 +772,6 @@ class VirtualCluster:
             concurrent_coordinators=concurrent_coordinators,
             fd_window=fd_window,
             delivery_prob_permille=delivery_prob_permille,
-            pallas_watermark=pallas_watermark,
         )
         key_hi0, key_lo0 = endpoint_ring_keys(endpoints, k)
         key_hi = np.zeros((k, n), dtype=np.uint32)

@@ -1,15 +1,20 @@
-"""Pallas TPU kernels for the protocol hot path.
+"""Pallas TPU kernel for the protocol hot path, plus the uint32-bitmask
+watermark core it rides on.
 
-The densest per-round computation is the cut detector's watermark pass: merge
-this round's report bits into the accumulated per-(subject, ring) reports,
-count reports per subject, and classify each subject against the H/L
-watermarks (``MultiNodeCutDetector.java:84-128``). With reports held as one
-uint32 *bitmask per subject* (bit k = ring k reported; dedup is the OR), the
-whole pass is a single VMEM-resident sweep: OR + popcount + compares, one HBM
-read and one write per word instead of XLA's materialized [n, k] bool
-intermediates.
+The hot per-round computation is the ALERT DELIVERY pass: per (cohort, ring)
+bitwise work over gathered rx-block words plus a per-edge jitter hash draw.
+The Mosaic kernel below (``delivery_new_bits_pallas``) runs the whole
+(cohort-word x ring) loop nest in VMEM — measured 2.25x over XLA's fusion at
+engine shapes (evidence/round2/microbench_slope.json) and on by default on
+TPU via ``EngineConfig.use_pallas``.
 
-Falls back to an identical jnp implementation off-TPU (and for testing).
+The cut detector's watermark pass (merge report bits, popcount, classify
+against H/L — ``MultiNodeCutDetector.java:84-128``) lives here too as
+``watermark_merge_classify``, but as a plain jnp elementwise core: a
+hand-written Mosaic version of it was benchmarked at 0.69x of XLA's own
+fusion at engine shapes (2.52 ms vs 3.67 ms at [8, 1M], EVALUATION.md) and
+was deleted — XLA already fuses an elementwise OR+popcount+compare sweep
+optimally, so the kernel carried maintenance cost for negative return.
 """
 
 from __future__ import annotations
@@ -31,8 +36,6 @@ except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
 _LANES = 128
-_SUBLANES = 8
-_BLOCK = _SUBLANES * _LANES  # 1024 subjects per grid step
 
 
 def _popcount32(v):
@@ -43,84 +46,31 @@ def _popcount32(v):
     return ((v * jnp.uint32(0x01010101)) >> 24).astype(jnp.int32)
 
 
-def _watermark_kernel(h: int, l: int, old_ref, new_ref, mask_ref, bits_ref, cls_ref):
-    """One [8, 128] tile: merge report bits, classify against watermarks.
-
-    cls encoding per subject: 0 none, 1 flux (L <= tally < H), 2 stable (>= H).
-    """
-    merged = jnp.where(mask_ref[:], old_ref[:] | new_ref[:], jnp.uint32(0))
-    tally = _popcount32(merged)
-    stable = tally >= h
-    flux = (tally >= l) & (tally < h)
-    bits_ref[:] = merged
-    cls_ref[:] = jnp.where(stable, jnp.int32(2), jnp.where(flux, jnp.int32(1), jnp.int32(0)))
-
-
-def _watermark_jnp(old_bits, new_bits, subject_mask, h: int, l: int):
-    merged = jnp.where(subject_mask, old_bits | new_bits, jnp.uint32(0))
-    tally = _popcount32(merged)
-    stable = tally >= h
-    flux = (tally >= l) & (tally < h)
-    cls = jnp.where(stable, jnp.int32(2), jnp.where(flux, jnp.int32(1), jnp.int32(0)))
-    return merged, cls
-
-
-@functools.partial(jax.jit, static_argnames=("h", "l", "use_pallas"))
+@functools.partial(jax.jit, static_argnames=("h", "l"))
 def watermark_merge_classify(
     old_bits: jnp.ndarray,
     new_bits: jnp.ndarray,
     subject_mask: jnp.ndarray,
     h: int,
     l: int,
-    use_pallas: bool = True,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Merge per-subject report bitmasks and classify against H/L.
 
     old_bits/new_bits: uint32 ring-report bitmasks; subject_mask: bool
     (present members + pending joiners — reports for anything else clear to 0,
-    the filter invariant of MembershipService.java:644-675). Any shape: the
-    jnp path is elementwise and preserves it (no resharding of distributed
-    inputs); the Pallas path flattens/pads internally.
+    the filter invariant of MembershipService.java:644-675). Any shape:
+    elementwise, shape-preserving (no resharding of distributed inputs); XLA
+    fuses the whole sweep (see module docstring for why there is deliberately
+    no Mosaic version).
     Returns (merged_bits uint32, cls int32: 0 none / 1 flux / 2 stable),
     shaped like the inputs.
     """
-    on_tpu = _HAS_PALLAS and use_pallas and jax.default_backend() == "tpu"
-    if not on_tpu:
-        return _watermark_jnp(old_bits, new_bits, subject_mask, h, l)
-
-    shape = old_bits.shape
-    old_bits = old_bits.reshape(-1)
-    new_bits = new_bits.reshape(-1)
-    subject_mask = subject_mask.reshape(-1)
-    n = old_bits.shape[0]
-
-    # Pad to a whole number of [8, 128] tiles; padding has subject_mask=False,
-    # so it classifies to 0 and is sliced away.
-    n_pad = (-n) % _BLOCK
-    if n_pad:
-        old_bits = jnp.pad(old_bits, (0, n_pad))
-        new_bits = jnp.pad(new_bits, (0, n_pad))
-        subject_mask = jnp.pad(subject_mask, (0, n_pad))
-    total = n + n_pad
-
-    shape2d = (total // _LANES, _LANES)
-    grid = (total // _BLOCK,)
-    block = pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
-    bits, cls = pl.pallas_call(
-        functools.partial(_watermark_kernel, h, l),
-        out_shape=(
-            jax.ShapeDtypeStruct(shape2d, jnp.uint32),
-            jax.ShapeDtypeStruct(shape2d, jnp.int32),
-        ),
-        grid=grid,
-        in_specs=[block, block, block],
-        out_specs=(block, block),
-    )(
-        old_bits.reshape(shape2d),
-        new_bits.reshape(shape2d),
-        subject_mask.reshape(shape2d),
-    )
-    return bits.reshape(total)[:n].reshape(shape), cls.reshape(total)[:n].reshape(shape)
+    merged = jnp.where(subject_mask, old_bits | new_bits, jnp.uint32(0))
+    tally = _popcount32(merged)
+    stable = tally >= h
+    flux = (tally >= l) & (tally < h)
+    cls = jnp.where(stable, jnp.int32(2), jnp.where(flux, jnp.int32(1), jnp.int32(0)))
+    return merged, cls
 
 
 def _delivery_kernel(k, w, spread, permille, blocked_ref, age_ref, epoch_ref, out_ref):
@@ -233,10 +183,8 @@ def pallas_usable() -> bool:
     if not (_HAS_PALLAS and jax.default_backend() == "tpu"):
         return False
     try:
-        # The engine's use_pallas flag gates the DELIVERY kernel (the
-        # measured winner; the watermark kernel sits behind the additional
-        # pallas_watermark flag), so fitness is the delivery kernel's alone:
-        # a watermark-only Mosaic regression must not disable it. Smoke:
+        # The engine's use_pallas flag gates the DELIVERY kernel, so fitness
+        # is the delivery kernel's alone. Smoke:
         # k=3, one cohort word, all edges fired at round 0 and unblocked —
         # every bit must deliver at age >= spread.
         k = 3
@@ -247,29 +195,6 @@ def pallas_usable() -> bool:
         )
         if int(bits[0, 0]) != (1 << k) - 1:
             raise RuntimeError("delivery kernel missed matured alerts")
-        return True
-    except Exception:  # noqa: BLE001 — any kernel failure means "don't use it"
-        return False
-
-
-@functools.lru_cache(maxsize=1)
-def pallas_watermark_usable() -> bool:
-    """Fitness check for the WATERMARK kernel, for callers opting in via
-    EngineConfig.pallas_watermark (off by default; ``pallas_usable`` covers
-    only the delivery kernel that ``use_pallas`` alone gates). Same
-    contract: the kernel runs inside larger jitted programs where a Mosaic
-    failure cannot be caught at the caller's compile time, so consult this
-    before enabling."""
-    if not (_HAS_PALLAS and jax.default_backend() == "tpu"):
-        return False
-    try:
-        zb = jnp.zeros((4, 2048), jnp.uint32)
-        _, cls = watermark_merge_classify(
-            zb, zb | jnp.uint32(0x1FF), jnp.ones((4, 2048), bool), 9, 4,
-            use_pallas=True,
-        )
-        if int(cls[0, 0]) != 2:  # popcount(0x1FF) = 9 >= H
-            raise RuntimeError("pallas kernel misclassified the smoke input")
         return True
     except Exception:  # noqa: BLE001 — any kernel failure means "don't use it"
         return False

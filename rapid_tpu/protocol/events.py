@@ -12,8 +12,10 @@ from rapid_tpu.types import EdgeStatus, Endpoint
 
 
 class ClusterEvents(enum.Enum):
-    """ClusterEvents.java:19-23 (VIEW_CHANGE_ONE_STEP_FAILED is declared but
-    never fired by the reference either; kept for API parity)."""
+    """ClusterEvents.java:19-23. The reference declares
+    VIEW_CHANGE_ONE_STEP_FAILED but never fires it; here the declared API is
+    completed: it fires when the jittered classic-Paxos fallback engages
+    because the fast round could not clear (service._on_fast_round_failed)."""
 
     VIEW_CHANGE_PROPOSAL = "VIEW_CHANGE_PROPOSAL"
     VIEW_CHANGE = "VIEW_CHANGE"

@@ -53,6 +53,7 @@ class FastPaxos:
         consensus_fallback_base_delay_ms: int = BASE_DELAY_MS,
         rng: Optional[random.Random] = None,
         vote_tally=None,
+        on_classic_round=None,
     ) -> None:
         self.my_addr = my_addr
         self.configuration_id = configuration_id
@@ -65,6 +66,11 @@ class FastPaxos:
         # turns each vote into a device-array write with the quorum check on
         # the accelerator (rapid_tpu.protocol.device_vote_tally).
         self._vote_tally = vote_tally
+        # Observer hook: fires when the jittered fallback actually engages a
+        # classic round (i.e. the fast round failed to clear in time). The
+        # membership service routes this to the declared-but-never-fired
+        # reference event VIEW_CHANGE_ONE_STEP_FAILED.
+        self._on_classic_round = on_classic_round
         self._votes_per_proposal: Dict[Tuple[Endpoint, ...], int] = {}
         self._votes_received: Set[Endpoint] = set()
         self.decided = False
@@ -144,6 +150,8 @@ class FastPaxos:
         """Fallback entry: classic rounds always start at round 2
         (FastPaxos.java:189-195)."""
         if not self.decided:
+            if self._on_classic_round is not None:
+                self._on_classic_round()
             self.paxos.start_phase1a(2)
 
     def cancel_fallback(self) -> None:

@@ -426,6 +426,23 @@ class MembershipService:
             consensus_fallback_base_delay_ms=self.settings.consensus_fallback_base_delay_ms,
             rng=self.rng,
             vote_tally=vote_tally,
+            on_classic_round=self._on_fast_round_failed,
+        )
+
+    def _on_fast_round_failed(self) -> None:
+        """The jittered fallback fired before a fast-round quorum formed:
+        classic Paxos is engaging. The reference DECLARES this event but
+        never fires it (ClusterEvents.java:19-23); here the declared API is
+        completed — subscribers learn exactly when one-step consensus failed
+        and the metrics record how often the slow path runs."""
+        self.metrics.inc("classic_rounds_started")
+        self._notify(
+            ClusterEvents.VIEW_CHANGE_ONE_STEP_FAILED,
+            ClusterStatusChange(
+                configuration_id=self.view.configuration_id,
+                membership=tuple(self.view.ring(0)),
+                status_changes=(),
+            ),
         )
 
     def _respond_to_joiners(self, proposal: Tuple[Endpoint, ...]) -> None:

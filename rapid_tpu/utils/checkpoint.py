@@ -83,6 +83,10 @@ def save_engine_state(path, cfg: "EngineConfig", state: "EngineState") -> None:
     np.savez_compressed(
         path,
         __cfg__=np.asarray(list(cfg), dtype=np.int64),
+        # Field names pin value->field pairing across EngineConfig schema
+        # changes: positional loading silently misassigns values once any
+        # non-trailing field is added/removed.
+        __cfg_fields__=np.asarray(cfg._fields, dtype=np.str_),
         **arrays,
     )
 
@@ -91,7 +95,19 @@ def load_engine_state(path) -> Tuple["EngineConfig", "EngineState"]:
     from rapid_tpu.models.state import FIRE_NEVER, EngineConfig, EngineState
 
     with np.load(path) as data:
-        cfg = EngineConfig(*(int(v) for v in data["__cfg__"]))
+        vals = [int(v) for v in data["__cfg__"]]
+        if "__cfg_fields__" in data:
+            # Name-keyed: removed fields' saved values are dropped, fields
+            # added since the checkpoint fill from EngineConfig defaults.
+            saved = dict(zip([str(f) for f in data["__cfg_fields__"]], vals))
+            cfg = EngineConfig(**{
+                f: saved[f] for f in EngineConfig._fields if f in saved
+            })
+        else:
+            # Legacy checkpoints (no name map): values are positional. The
+            # only schema change they can span is the round-3 removal of the
+            # TRAILING pallas_watermark field, so truncation is exact.
+            cfg = EngineConfig(*vals[: len(EngineConfig._fields)])
         import jax.numpy as jnp
 
         # Fields added after a checkpoint was written fill with their

@@ -27,15 +27,21 @@ from helpers import wait_until
 BASE_PORT = 1234
 
 
-def async_test(fn):
-    @functools.wraps(fn)
-    def wrapper(*args, **kwargs):
-        async def with_timeout():
-            await asyncio.wait_for(fn(*args, **kwargs), timeout=60)
+def async_test_timeout(seconds):
+    def decorate(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            async def with_timeout():
+                await asyncio.wait_for(fn(*args, **kwargs), timeout=seconds)
 
-        asyncio.run(with_timeout())
+            asyncio.run(with_timeout())
 
-    return wrapper
+        return wrapper
+
+    return decorate
+
+
+async_test = async_test_timeout(60)
 
 
 def fast_settings() -> Settings:
@@ -154,6 +160,19 @@ async def test_fifty_node_cluster_with_multi_failure():
     network = InProcessNetwork()
     fd = StaticFailureDetectorFactory()
     settings = fast_settings()
+    clusters = await _bring_up_fifty(network, fd, settings)
+    try:
+        victims = [clusters[7], clusters[21], clusters[33], clusters[44]]
+        for victim in victims:
+            network.blackholed.add(victim.listen_address)
+        fd.add_failed_nodes([v.listen_address for v in victims])
+        survivors = [c for c in clusters if c not in victims]
+        assert await wait_until(lambda: all_converged(survivors, 46), timeout_s=40)
+    finally:
+        await shutdown_all(clusters)
+
+
+async def _bring_up_fifty(network, fd, settings):
     seed = await Cluster.start(ep(0), settings=settings, network=network,
                                fd_factory=fd, rng=random.Random(0))
     joiners = await asyncio.gather(
@@ -166,12 +185,78 @@ async def test_fifty_node_cluster_with_multi_failure():
     clusters = [seed] + list(joiners)
     try:
         assert await wait_until(lambda: all_converged(clusters, 50), timeout_s=40)
-        victims = [clusters[7], clusters[21], clusters[33], clusters[44]]
+    except BaseException:
+        # A failed bring-up must not leak 50 live clusters into the loop
+        # teardown (the cascade of secondary errors buries the real one).
+        await shutdown_all(clusters)
+        raise
+    return clusters
+
+
+@async_test_timeout(120)
+async def test_twelve_failures_out_of_fifty():
+    """The reference's heavier crash fraction (ClusterTest.java crashes 12 of
+    50): the largest simultaneous cut the fast round can still clear — the 38
+    survivors are EXACTLY the fast-paxos quorum N - floor((N-1)/4) = 38."""
+    network = InProcessNetwork()
+    fd = StaticFailureDetectorFactory()
+    settings = fast_settings()
+    # Generous batching so staggered detections coalesce (the point is the
+    # near-quorum cut, not timing luck), and a short fallback base so that if
+    # votes DO split across two cuts, classic recovery is quick.
+    settings.batching_window_ms = 300
+    settings.consensus_fallback_base_delay_ms = 500
+    clusters = await _bring_up_fifty(network, fd, settings)
+    try:
+        victims = clusters[3:48:4]
+        assert len(victims) == 12
         for victim in victims:
             network.blackholed.add(victim.listen_address)
         fd.add_failed_nodes([v.listen_address for v in victims])
         survivors = [c for c in clusters if c not in victims]
-        assert await wait_until(lambda: all_converged(survivors, 46), timeout_s=40)
+        assert await wait_until(lambda: all_converged(survivors, 38), timeout_s=60)
+        victim_eps = {v.listen_address for v in victims}
+        for c in survivors:
+            assert victim_eps.isdisjoint(set(c.membership))
+    finally:
+        await shutdown_all(clusters)
+
+
+@async_test_timeout(180)  # > 40s bring-up bound + 90s convergence bound
+async def test_sixteen_failures_out_of_fifty_requires_classic_fallback():
+    """The reference's heaviest crash fraction (ClusterTest.java crashes 16
+    of 50). The 34 survivors sit BELOW the fast-round quorum (38 of the
+    configuration's 50), so no cut can one-step: convergence must go through
+    the jittered classic-Paxos fallback — observable here because the
+    declared VIEW_CHANGE_ONE_STEP_FAILED event fires when it engages (classic
+    needs only a majority of the survivors: 34 > 25)."""
+    network = InProcessNetwork()
+    fd = StaticFailureDetectorFactory()
+    settings = fast_settings()
+    settings.batching_window_ms = 300
+    settings.consensus_fallback_base_delay_ms = 500
+    clusters = await _bring_up_fifty(network, fd, settings)
+    try:
+        victims = clusters[1:49:3]
+        assert len(victims) == 16
+        fallback_engaged = []
+        for c in clusters:
+            if c not in victims:
+                c.register_subscription(
+                    ClusterEvents.VIEW_CHANGE_ONE_STEP_FAILED,
+                    lambda change: fallback_engaged.append(change),
+                )
+        for victim in victims:
+            network.blackholed.add(victim.listen_address)
+        fd.add_failed_nodes([v.listen_address for v in victims])
+        survivors = [c for c in clusters if c not in victims]
+        assert await wait_until(lambda: all_converged(survivors, 34), timeout_s=90)
+        victim_eps = {v.listen_address for v in victims}
+        for c in survivors:
+            assert victim_eps.isdisjoint(set(c.membership))
+        # The fast round could never have cleared the first cut (34 voters <
+        # 38 quorum), so at least one survivor must have engaged classic.
+        assert fallback_engaged, "no survivor reported one-step failure"
     finally:
         await shutdown_all(clusters)
 

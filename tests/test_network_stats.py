@@ -40,8 +40,7 @@ def test_snapshot_rates():
 
 @async_test
 async def test_tcp_transport_counts_real_wire_bytes():
-    server_addr = Endpoint("127.0.0.1", 29871)
-    server = TcpServer(server_addr)
+    server = TcpServer(Endpoint("127.0.0.1", 0))  # ephemeral port
 
     class _Probes:
         async def handle_message(self, request):
@@ -49,7 +48,8 @@ async def test_tcp_transport_counts_real_wire_bytes():
 
     server.set_membership_service(_Probes())
     await server.start()
-    client = TcpClient(Endpoint("127.0.0.1", 29872))
+    server_addr = server.listen_address  # kernel-assigned
+    client = TcpClient(Endpoint("127.0.0.1", 0))
     try:
         for _ in range(3):
             await client.send(server_addr, ProbeMessage(sender=client.my_addr))
@@ -94,7 +94,12 @@ async def test_steady_state_traffic_is_o_k_per_node():
             # Each node probes its <= K subjects once per FD interval (plus
             # slack for batcher/in-flight rounding). With N=10 < K=10 every
             # node monitors all 9 others; the bound is K per tick either way.
-            assert 0 < snap["msgs_tx"] <= (ticks + 2) * k, snap
+            # Derive the tick count from the window's OBSERVED elapsed time:
+            # under CI load the sleep can overshoot and extra FD ticks fire
+            # before the snapshot — the law is per-elapsed-tick, not
+            # per-nominal-tick.
+            observed_ticks = snap["elapsed_s"] / interval_s
+            assert 0 < snap["msgs_tx"] <= (observed_ticks + 2) * k, snap
             assert snap["bytes_tx"] > 0  # wire-equivalent accounting is on
     finally:
         await shutdown_all(clusters)

@@ -29,8 +29,27 @@ run_stage() { # name timeout_s command...
 # safely inside the stage timeout, or a wedged-tunnel day kills the fallback
 # before its JSON line: one 1500s attempt + fallback < 3300s.
 run_stage bench 3300 env RAPID_TPU_BENCH_DEADLINE_S=1500 RAPID_TPU_BENCH_ATTEMPTS=1 \
-  python -u bench.py
+  RAPID_TPU_BENCH_NO_SNAPSHOT=1 python -u bench.py
 grep -h '"metric"' "$OUT/bench.log" | tail -1 > "$OUT/bench.json"
+# Stamp provenance into the capture so bench.py's snapshot fallback (and any
+# reader) can tell when/what this measurement was taken from.
+python - "$OUT/bench.json" <<'EOF'
+import json, subprocess, sys, time
+path = sys.argv[1]
+try:
+    data = json.loads(open(path).read().strip() or "null")
+except json.JSONDecodeError:
+    data = None
+if isinstance(data, dict):
+    data["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    try:
+        data["git_rev"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True, text=True
+        ).stdout.strip()
+    except OSError:
+        pass
+    open(path, "w").write(json.dumps(data) + "\n")
+EOF
 
 run_stage microbench 1200 python -u examples/pallas_microbench.py
 grep -h '"platform"' "$OUT/microbench.log" | tail -1 > "$OUT/microbench.json"

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Probe the axon TPU tunnel until it answers, then exit 0.
+#
+# The tunnel wedges for hours at a time (bench.py watchdog docstring); any
+# jax.devices() call blocks forever while wedged, so each probe is timeboxed.
+# Run this in the background for the whole session; the moment it exits 0,
+# kick off tools/capture_tpu_evidence.sh — a live window may be short.
+#
+#   bash tools/tunnel_probe.sh [interval_s] [probe_timeout_s]
+set -u
+INTERVAL="${1:-120}"
+PROBE_TIMEOUT="${2:-90}"
+cd "$(dirname "$0")/.."
+n=0
+while true; do
+  n=$((n + 1))
+  out=$(timeout "$PROBE_TIMEOUT" python -c "
+import jax
+ds = jax.devices()
+print(ds[0].platform, len(ds))
+" 2>&1)
+  rc=$?
+  plat=$(echo "$out" | tail -1)
+  echo "$(date -u +%H:%M:%S) probe $n rc=$rc [$plat]"
+  if [ $rc -eq 0 ] && ! echo "$plat" | grep -q '^cpu'; then
+    echo "$(date -u +%H:%M:%S) TUNNEL ALIVE: $plat"
+    exit 0
+  fi
+  sleep "$INTERVAL"
+done
