@@ -759,11 +759,25 @@ class VirtualCluster:
         concurrent_coordinators: int = 1,
         fd_window: int = 0,
         delivery_prob_permille: int = 1000,
+        n_members: Optional[int] = None,
     ) -> "VirtualCluster":
         """Build from real endpoints with the host view's exact ring keys, so
-        the engine's topology matches a host MembershipView bit-for-bit."""
-        n_members = len(endpoints)
-        n = n_slots if n_slots is not None else n_members
+        the engine's topology matches a host MembershipView bit-for-bit.
+
+        ``n_members`` (default: all) marks how many of ``endpoints`` start as
+        live members; the rest become keyed-but-dead slots reserved for a
+        later ``inject_join_wave`` — their ring keys are already the host
+        view's keys for those endpoints, so a join admits them at exactly the
+        ring positions the host stack would."""
+        if n_members is None:
+            n_members = len(endpoints)
+        if not 0 < n_members <= len(endpoints):
+            # Not an assert: python -O must not skip this — slots past the
+            # keyed endpoints would go live with all-zero ring keys.
+            raise ValueError(
+                f"n_members must be in [1, {len(endpoints)}], got {n_members}"
+            )
+        n = n_slots if n_slots is not None else len(endpoints)
         _validate_delivery_prob(delivery_prob_permille)
         cfg = EngineConfig(
             n=n, k=k, h=h, l=l, c=cohorts, fd_threshold=fd_threshold,
@@ -776,8 +790,8 @@ class VirtualCluster:
         key_hi0, key_lo0 = endpoint_ring_keys(endpoints, k)
         key_hi = np.zeros((k, n), dtype=np.uint32)
         key_lo = np.zeros((k, n), dtype=np.uint32)
-        key_hi[:, :n_members] = np.asarray(key_hi0)
-        key_lo[:, :n_members] = np.asarray(key_lo0)
+        key_hi[:, : len(endpoints)] = np.asarray(key_hi0)
+        key_lo[:, : len(endpoints)] = np.asarray(key_lo0)
         rng = np.random.default_rng(1234)
         id_hi = rng.integers(0, 2**32, size=(n,), dtype=np.uint32)
         id_lo = rng.integers(0, 2**32, size=(n,), dtype=np.uint32)

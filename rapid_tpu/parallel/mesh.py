@@ -5,7 +5,13 @@ every per-slot array partitions on its N dimension; ring/cohort axes and
 scalars replicate. All of the engine's global reductions (watermark tallies,
 vote counts, set hashes) are sums/anys over N, which XLA lowers to psum over
 ICI; the per-ring argsort in ``ring_topology`` runs only on view changes and
-is the one collective-heavy op (XLA inserts the gather it needs).
+is the one collective-heavy op (XLA inserts the gather it needs). This is
+not just a docstring claim: ``tools/collective_audit.py`` classifies every
+collective in the compiled HLO (EVALUATION.md §3c), and
+``tests/test_parallel.py::test_round_body_collectives_are_reductions_only``
+pins the invariants — the convergence hot loop's unconditional traffic is
+~1.2 KB of all-reduces per round, with [c,n]-scale gathers confined to
+lax.cond branches.
 
 This is the TPU equivalent of the reference's scale story (§ SURVEY 5.7):
 the reference keeps per-node load O(K) as N grows; here the whole cluster's

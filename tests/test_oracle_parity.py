@@ -1,0 +1,251 @@
+"""Engine<->host scenario-parity oracle.
+
+The same scenario — staggered crashes, then a join wave, then a one-way
+partition, N=32 — driven through BOTH stacks:
+
+  host:   full asyncio `Cluster` instances over the in-process transport,
+          static failure detectors, ManualClock (the reference architecture,
+          ClusterTest.java:229-337 scenario family), and
+  engine: the fused single-program `VirtualCluster`, built via
+          `from_endpoints` so its ring topology is the host view's
+          bit-for-bit, with matched detection/batching semantics,
+
+asserting the two produce the IDENTICAL cut sequence (each cut as a set of
+(endpoint, UP/DOWN)) and the identical final membership. Kernel-level
+equivalence tests pin each device op against a host function; this is the
+missing cross-STACK oracle at scenario granularity: grouping of staggered
+faults into cuts, join-gatekeeper semantics, re-detection of a fault whose
+alerts straddle a configuration change, and eviction of a one-way-partitioned
+node must all agree end to end.
+
+Timing map (the "matched FD/batching parameters"): one engine round models
+one failure-detector interval (1000 ms sim). The host's StaticFailureDetector
+notifies on the first tick after blacklisting == engine `fd_threshold=1`;
+`delivery_spread=0` == the in-process transport's same-window delivery.
+Faults are injected between convergences in both stacks (sub-interval
+injection phase is not representable in the round-granular engine — a
+documented semantic choice of the model, DESIGN.md).
+"""
+
+import asyncio
+import functools
+import random
+
+import numpy as np
+
+from rapid_tpu.messaging.inprocess import InProcessNetwork
+from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
+from rapid_tpu.protocol.cluster import Cluster
+from rapid_tpu.protocol.events import ClusterEvents
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import EdgeStatus, Endpoint
+from rapid_tpu.utils.clock import ManualClock
+
+N0 = 32  # initial members
+JOINERS = 4
+ALL = N0 + JOINERS
+ENDPOINTS = [Endpoint(f"10.9.{i // 250}.{i % 250}", 7000 + i) for i in range(ALL)]
+
+# Scenario cast (slot indices == ENDPOINTS indices).
+CRASH_WAVE_1 = [5, 11]  # staggered crash, first group
+CRASH_WAVE_2 = [23]  # second group, one detection interval later
+JOIN_SLOTS = list(range(N0, ALL))
+PARTITIONED = 17  # one-way (ingress) partition victim
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        async def with_timeout():
+            await asyncio.wait_for(fn(*args, **kwargs), timeout=120)
+
+        asyncio.run(with_timeout())
+
+    return wrapper
+
+
+async def _drain(loop_yields=60):
+    for _ in range(loop_yields):
+        await asyncio.sleep(0)
+
+
+async def _advance(clock: ManualClock, total_ms: float, step_ms: float = 50):
+    advanced = 0.0
+    while advanced < total_ms:
+        clock.advance_ms(step_ms)
+        advanced += step_ms
+        await _drain()
+
+
+async def _run_host_scenario():
+    """Returns (cut_sequence, final_membership) from the asyncio stack.
+
+    cut_sequence: list of frozensets of (Endpoint, EdgeStatus).
+    """
+    settings = Settings()  # reference-default: 1 s FD interval, 100 ms batch
+    network = InProcessNetwork()
+    clock = ManualClock()
+    fd = StaticFailureDetectorFactory()
+
+    clusters = {}
+    clusters[0] = await Cluster.start(
+        ENDPOINTS[0], settings=settings, network=network, fd_factory=fd,
+        clock=clock, rng=random.Random(0),
+    )
+    for i in range(1, N0):
+        task = asyncio.ensure_future(
+            Cluster.join(ENDPOINTS[0], ENDPOINTS[i], settings=settings,
+                         network=network, fd_factory=fd, clock=clock,
+                         rng=random.Random(i))
+        )
+        while not task.done():
+            await _advance(clock, 200)
+        clusters[i] = task.result()
+    assert all(c.membership_size == N0 for c in clusters.values())
+
+    # Observe the cut sequence from node 0 (never faulted in this scenario).
+    cuts = []
+    clusters[0].register_subscription(
+        ClusterEvents.VIEW_CHANGE,
+        lambda change: cuts.append(
+            frozenset((sc.endpoint, sc.status) for sc in change.status_changes)
+        ),
+    )
+
+    async def converge_members(expected: int, budget_ms=8_000):
+        for _ in range(int(budget_ms // 400)):
+            await _advance(clock, 400)
+            live = [c for i, c in clusters.items() if i in live_ids]
+            if all(c.membership_size == expected for c in live):
+                return
+        raise AssertionError(
+            f"host did not converge to {expected}: "
+            f"{[clusters[i].membership_size for i in sorted(live_ids)]}"
+        )
+
+    live_ids = set(range(N0))
+
+    # Phase A: staggered crashes — wave 2 lands one detection interval after
+    # wave 1 (its alerts straddle wave 1's configuration change and must be
+    # re-detected in the new configuration).
+    for s in CRASH_WAVE_1:
+        network.blackholed.add(ENDPOINTS[s])
+    fd.add_failed_nodes([ENDPOINTS[s] for s in CRASH_WAVE_1])
+    live_ids -= set(CRASH_WAVE_1)
+    await _advance(clock, 1_050)  # one FD interval: wave 1 detected
+    for s in CRASH_WAVE_2:
+        network.blackholed.add(ENDPOINTS[s])
+    fd.add_failed_nodes([ENDPOINTS[s] for s in CRASH_WAVE_2])
+    live_ids -= set(CRASH_WAVE_2)
+    await converge_members(N0 - 3)
+
+    # Phase B: a 4-node join wave through one seed.
+    join_tasks = [
+        asyncio.ensure_future(
+            Cluster.join(ENDPOINTS[0], ENDPOINTS[s], settings=settings,
+                         network=network, fd_factory=fd, clock=clock,
+                         rng=random.Random(s))
+        )
+        for s in JOIN_SLOTS
+    ]
+    while not all(t.done() for t in join_tasks):
+        await _advance(clock, 200)
+    for s, t in zip(JOIN_SLOTS, join_tasks):
+        clusters[s] = t.result()
+    live_ids |= set(JOIN_SLOTS)
+    await converge_members(N0 - 3 + JOINERS)
+
+    # Phase C: one-way partition — everything INTO the victim drops (it can
+    # still send), its observers stop getting probe responses (modeled by the
+    # static FD blacklist, as in the reference's asymmetric-failure tests).
+    for i in range(ALL):
+        if i != PARTITIONED:
+            network.blackholed_links.add((ENDPOINTS[i], ENDPOINTS[PARTITIONED]))
+    fd.add_failed_nodes([ENDPOINTS[PARTITIONED]])
+    live_ids -= {PARTITIONED}
+    await converge_members(N0 - 3 + JOINERS - 1)
+
+    final = set(clusters[0].membership)
+    assert len({tuple(clusters[i].membership) for i in live_ids}) == 1
+    await asyncio.gather(
+        *(c.shutdown() for c in clusters.values()), return_exceptions=True
+    )
+    return cuts, final
+
+
+def _run_engine_scenario():
+    """The same scenario through the fused engine; same return shape."""
+    from rapid_tpu.models.virtual_cluster import (
+        VirtualCluster,
+        engine_step_nodonate,
+    )
+
+    vc = VirtualCluster.from_endpoints(
+        ENDPOINTS, n_slots=ALL, n_members=N0, k=10, h=9, l=4,
+        fd_threshold=1,  # static FD notifies on the first tick
+        delivery_spread=0,  # in-process transport: same-window delivery
+    )
+    cuts = []
+
+    def run_to_decision(max_steps=24):
+        nonlocal_state = {"state": vc.state}
+        for _ in range(max_steps):
+            before = nonlocal_state["state"]
+            was_alive = np.asarray(before.alive)
+            state, events = engine_step_nodonate(vc.cfg, before, vc.faults)
+            nonlocal_state["state"] = state
+            if bool(events.decided):
+                mask = np.asarray(events.winner_mask)
+                cut = frozenset(
+                    (
+                        ENDPOINTS[s],
+                        EdgeStatus.DOWN if was_alive[s] else EdgeStatus.UP,
+                    )
+                    for s in np.nonzero(mask)[0].tolist()
+                )
+                cuts.append(cut)
+                vc.state = state
+                return
+        raise AssertionError("engine did not decide")
+
+    # Phase A: wave 1, then wave 2 one round (= one FD interval) later —
+    # wave 2's detection straddles wave 1's view change, as on the host.
+    vc.crash(CRASH_WAVE_1)
+    run_to_decision()
+    vc.crash(CRASH_WAVE_2)
+    run_to_decision()
+
+    # Phase B: the join wave.
+    vc.inject_join_wave(JOIN_SLOTS)
+    run_to_decision()
+
+    # Phase C: the one-way partition. In the round-granular engine a node
+    # whose ingress is fully cut is detector-indistinguishable from a
+    # crash-stop: its observers' probes go unanswered and it casts no vote
+    # (it hears no proposals). `crash` models exactly that pair.
+    vc.crash([PARTITIONED])
+    run_to_decision()
+
+    alive = np.asarray(vc.state.alive)
+    final = {ENDPOINTS[s] for s in np.nonzero(alive)[0].tolist()}
+    return cuts, final
+
+
+@async_test
+async def test_host_and_engine_agree_on_cut_sequence_and_membership():
+    host_cuts, host_final = await _run_host_scenario()
+    engine_cuts, engine_final = _run_engine_scenario()
+
+    expected_final = {
+        ENDPOINTS[i]
+        for i in range(ALL)
+        if i not in CRASH_WAVE_1 + CRASH_WAVE_2 + [PARTITIONED]
+    }
+    assert host_final == expected_final
+    assert engine_final == expected_final
+
+    # The oracle: identical cut GROUPING and contents, in order.
+    assert [sorted(map(repr, c)) for c in host_cuts] == [
+        sorted(map(repr, c)) for c in engine_cuts
+    ], f"cut sequences diverged:\n host={host_cuts}\n engine={engine_cuts}"
+    assert len(host_cuts) == 4  # wave1, wave2, join wave, partition
