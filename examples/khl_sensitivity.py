@@ -1,21 +1,34 @@
 """K/H/L sensitivity of almost-everywhere agreement (paper Fig. 11 analog).
 
-The reference paper measures, by simulation at N=1000 over 20 repetitions per
-combination, how often the multi-node cut detector yields *conflicting*
-proposals (different nodes proposing different cuts) for K=10,
-H in {6..9}, L in {1..4}, F concurrent failures in {2,4,8,16}: ~2% conflicts
-at H-L=5 with F=2, improving ~4x per extra watermark gap.
+The paper's experiment (§Evaluation, "K, H, L sensitivity study"): 1000
+processes, F random failures; "We generate alert messages from the F
+processes' observers and deliver these alerts to each process in a uniform
+random order. We count the number of processes that announce a membership
+proposal that did not include all F processes (a conflict)." — i.e. the
+receivers differ ONLY in alert arrival ORDER, each order an independent
+uniform permutation of the F*K alerts, and the conflict rate is the
+FRACTION OF PROCESSES that announced early (a proposal missing >= 1 victim).
 
-This reproduces the experiment on the TPU engine: F crashed members,
-per-edge detection jitter (staggered failure detectors), and 64 (default)
-independently-diverging receiver cohorts — each with its own per-edge
-delivery-delay draw (``delivery_spread``; optional one-way loss via
-``loss``) — the sampled analog of the reference's N independent per-node
-cut detectors (MultiNodeCutDetector.java:31-37). A run conflicts when more
-than one distinct cut proposal was announced (the paper's metric) or no
-decision landed within the round budget.
+The engine reproduces that model BY DERIVATION, not tuning:
 
-Usage: python examples/khl_sensitivity.py [--n 1000] [--reps 10] [--cohorts 64]
+  * every (cohort, edge) delivery delay is an independent uniform draw in
+    [0, spread] (hash streams, `_deliver_alerts`); as spread grows, the
+    induced per-cohort arrival order converges to exactly the paper's
+    independent uniform permutation (ties have probability 1/(spread+1)
+    per pair and vanish);
+  * all alerts fire simultaneously (stagger=0), matching "we generate
+    alert messages from the F processes' observers" as one event;
+  * the metric is the paper's: the fraction of receiver cohorts whose
+    FIRST announced proposal misses >= 1 victim. (Each cohort is one
+    sampled receiver state shared by ~N/C members.)
+
+The only approximation is time discretization: simultaneous arrivals within
+one round are tallied atomically, which can only HIDE an early announcement
+(the batch is the favorable order), so measured rates approach the paper's
+from below as --delivery-spread grows. Default 128 puts the per-pair tie
+probability under 1%. No parameter is fitted to the paper's reported rates.
+
+Usage: python examples/khl_sensitivity.py [--n 1000] [--reps 20] [--cohorts 64]
 """
 
 from __future__ import annotations
@@ -29,20 +42,84 @@ import numpy as np
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
-def run_once(n, k, h, l, f, cohorts, seed, delivery_spread=1, stagger=1, loss=0.0,
-             delay_permille=1000) -> tuple:
-    from rapid_tpu.models.virtual_cluster import VirtualCluster
+def _detector_experiment_fn():
+    """Build the jitted detector-only experiment (cached across cells).
+
+    The paper's Fig. 11 study has NO consensus — it is a pure cut-detector
+    experiment run until every receiver announces. Driving the full engine
+    would let the cluster DECIDE (and apply the view change) long before
+    slow receivers announce, truncating the sample; so this loop drives
+    exactly the engine's delivery + cut-detection kernels
+    (`_deliver_alerts` + `_cohort_cut_detection`, the same code the engine
+    executes per round) and latches each cohort's FIRST announced proposal
+    mask, entirely on device in one dispatch per run.
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from rapid_tpu.models.virtual_cluster import (
+        _cohort_cut_detection,
+        _deliver_alerts,
+    )
+
+    @functools.partial(jax.jit, static_argnums=(0, 3))
+    def experiment(cfg, state, blocked_rows, budget):
+        def cond(carry):
+            _, _, got, t = carry
+            return (~jnp.all(got)) & (t < budget)
+
+        def body(carry):
+            state, first_mask, got, t = carry
+            new_bits = _deliver_alerts(cfg, state, state.fire_round, blocked_rows)
+            heard_down = jnp.any((new_bits != 0) & state.alive[None, :], axis=1)
+            (report_bits, released, announced, seen_down, proposed_now,
+             prop_masks) = _cohort_cut_detection(cfg, state, new_bits, heard_down)
+            state = state._replace(
+                report_bits=report_bits, released=released,
+                announced=announced, seen_down=seen_down,
+                round_idx=state.round_idx + 1,
+            )
+            newly = proposed_now & ~got
+            first_mask = jnp.where(newly[:, None], prop_masks, first_mask)
+            return (state, first_mask, got | proposed_now, t + 1)
+
+        init = (
+            state,
+            jnp.zeros((cfg.c, cfg.n), dtype=bool),
+            jnp.zeros((cfg.c,), dtype=bool),
+            jnp.int32(0),
+        )
+        _, first_mask, got, t = jax.lax.while_loop(cond, body, init)
+        return first_mask, got, t
+
+    return experiment
+
+
+_EXPERIMENT = None
+
+
+def run_once(n, k, h, l, f, cohorts, seed, delivery_spread=128, stagger=0,
+             loss=0.0, delay_permille=1000) -> tuple:
+    """One paper-experiment run.
+
+    Returns (conflicted_cohorts, announced_cohorts, rounds_to_all_announced).
+    A cohort is conflicted iff its first announced proposal differs from the
+    full victim set (the paper's per-process conflict metric)."""
+    global _EXPERIMENT
+    import jax.numpy as jnp
+
+    from rapid_tpu.models.virtual_cluster import VirtualCluster, _edge_masks
+
+    if _EXPERIMENT is None:
+        _EXPERIMENT = _detector_experiment_fn()
 
     rng = np.random.default_rng(seed)
     vc = VirtualCluster.create(
-        n, k=k, h=h, l=l, cohorts=cohorts, fd_threshold=2, seed=seed,
+        n, k=k, h=h, l=l, cohorts=cohorts, fd_threshold=1, seed=seed,
         delivery_spread=delivery_spread, delivery_prob_permille=delay_permille,
     )
-    # Receivers split into cohorts; every cohort gets an independent
-    # per-edge delivery-delay draw (delivery_spread). The paper's Fig. 11
-    # simulation models pure timing divergence, so one-way loss defaults to
-    # 0; pass loss > 0 to additionally blind each non-primary cohort to a
-    # random fraction of sources.
     cohort_of = rng.integers(0, cohorts, size=n).astype(np.int32)
     vc.assign_cohorts(cohort_of)
     if loss > 0:
@@ -53,41 +130,53 @@ def run_once(n, k, h, l, f, cohorts, seed, delivery_spread=1, stagger=1, loss=0.
 
     victims = rng.choice(n, size=f, replace=False)
     vc.crash(victims)
-    vc.stagger_fd_counts(rng, spread_rounds=stagger)
+    # "We generate alert messages from the F processes' observers": fire all
+    # victim edges as one event (stamped at the current round; optional
+    # per-edge stagger delays firing like real detection jitter would).
+    vc._stamp_fired_edges(jnp.asarray(victims), np.ones((f, k), dtype=bool))
+    if stagger:
+        # Spread fire rounds over [0, stagger] (delivery uses
+        # round - fire_round). np.array, not asarray: jax buffers view as
+        # read-only numpy.
+        offs = rng.integers(0, stagger + 1, size=(f, k)).astype(np.int32)
+        fire = np.array(vc.state.fire_round)
+        fire[victims] = offs  # [f, k] rows for victim slots
+        vc.state = vc.state._replace(fire_round=jnp.asarray(fire))
 
-    proposals = set()
-    for round_idx in range(64):
-        events = vc.step()
-        announced = np.asarray(events.proposals_announced)
-        if announced.any():
-            # Read the hashes from the EVENTS (pre-view-change capture): on a
-            # deciding round, vc.state.prop_* is already reset to zeros.
-            hi = np.asarray(events.prop_hi)
-            lo = np.asarray(events.prop_lo)
-            for ci in np.nonzero(announced)[0]:
-                proposals.add((int(hi[ci]), int(lo[ci])))
-        if bool(events.decided):
-            # The paper's metric: did receivers PROPOSE different cuts?
-            # (Fig. 11 counts conflicting proposals, not vote dissent.)
-            return len(proposals) > 1, round_idx + 1
-    return True, 64  # no decision within budget counts as conflicted
+    _, blocked_rows = _edge_masks(vc.cfg, vc.state, vc.faults)
+    budget = delivery_spread + stagger + 64
+    first_mask, got, t = _EXPERIMENT(vc.cfg, vc.state, blocked_rows, budget)
+
+    got = np.asarray(got)
+    first_mask = np.asarray(first_mask)
+    victims_mask = np.zeros(n, dtype=bool)
+    victims_mask[victims] = True
+    conflicted = int(
+        (got & (first_mask[:, :n] != victims_mask[None, :]).any(axis=1)).sum()
+    )
+    return conflicted, int(got.sum()), int(t)
 
 
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--n", type=int, default=1000)
-    parser.add_argument("--reps", type=int, default=10)
-    parser.add_argument("--cohorts", type=int, default=64)
-    parser.add_argument("--delivery-spread", type=int, default=1,
-                        help="max extra rounds of per-(cohort, edge) delivery delay")
-    parser.add_argument("--stagger", type=int, default=1,
-                        help="max rounds of per-edge detection jitter")
+    parser.add_argument("--reps", type=int, default=20,
+                        help="paper: 20 repetitions per combination")
+    parser.add_argument("--cohorts", type=int, default=64,
+                        help="independent receiver states sampled per run")
+    parser.add_argument("--delivery-spread", type=int, default=128,
+                        help="uniform delay support per (cohort, edge); large "
+                        "spread => per-cohort arrival order converges to the "
+                        "paper's independent uniform permutation (see module "
+                        "docstring — derived, not tuned)")
+    parser.add_argument("--stagger", type=int, default=0,
+                        help="max rounds of per-edge detection jitter (paper "
+                        "model: 0 — alerts all generated at once)")
     parser.add_argument("--delay-permille", type=int, default=1000,
-                        help="probability (permille, per cohort-edge) of a nonzero "
-                        "delivery delay: sub-round skew granularity (1000 = the "
-                        "full uniform [0, spread] draw; one engine round is the "
-                        "coarsest quantum, the paper's continuous-latency sim "
-                        "sits below it)")
+                        help="probability (permille, per cohort-edge) of a "
+                        "nonzero delay — models milder-than-paper sub-round "
+                        "skew; 1000 = the full uniform draw the paper model "
+                        "derives to")
     parser.add_argument("--loss", type=float, default=0.0,
                         help="one-way loss fraction per non-primary cohort (paper sim: 0)")
     parser.add_argument(
@@ -108,16 +197,19 @@ def main() -> None:
         )
 
     k = 10
-    print(f"N={args.n}, K={k}, cohorts={args.cohorts}, reps={args.reps}")
-    print(f"{'H':>3} {'L':>3} {'F':>4} {'conflict%':>10} {'avg rounds':>11}")
+    print(f"N={args.n}, K={k}, cohorts={args.cohorts}, reps={args.reps}, "
+          f"spread={args.delivery_spread} (paper-permutation mode)")
+    print(f"{'H':>3} {'L':>3} {'F':>4} {'conflict%':>10} {'silent%':>8} "
+          f"{'avg rounds':>11}")
     for h in (9, 8, 7, 6):
         for l in (1, 2, 3, 4):
             if l >= h:
                 continue
             for f in (2, 4, 8, 16):
-                conflicts, rounds_sum = 0, 0
+                conflicted_total, announced_total, rounds_sum = 0, 0, 0
+                total = args.cohorts * args.reps
                 for rep in range(args.reps):
-                    conflict, rounds = run_once(
+                    conflicted, announced, rounds = run_once(
                         args.n, k, h, l, f, args.cohorts,
                         seed=hash((h, l, f, rep)) % 2**31,
                         delivery_spread=args.delivery_spread,
@@ -125,10 +217,17 @@ def main() -> None:
                         loss=args.loss,
                         delay_permille=args.delay_permille,
                     )
-                    conflicts += int(conflict)
+                    conflicted_total += conflicted
+                    announced_total += announced
                     rounds_sum += rounds
+                # Conflict rate over ANNOUNCED receivers; cohorts that never
+                # announced (possible only under --loss, which can blind a
+                # cohort below H forever) are surfaced as silent%, never
+                # silently counted as conflict-free.
                 print(
-                    f"{h:>3} {l:>3} {f:>4} {100.0 * conflicts / args.reps:>9.1f}% "
+                    f"{h:>3} {l:>3} {f:>4} "
+                    f"{100.0 * conflicted_total / max(announced_total, 1):>9.2f}% "
+                    f"{100.0 * (total - announced_total) / total:>7.1f}% "
                     f"{rounds_sum / args.reps:>11.1f}"
                 )
 
