@@ -123,6 +123,9 @@ def run_workload() -> None:
             use_pallas=use_pallas,
             delivery_spread=delivery_spread,
             concurrent_coordinators=2,
+            # Delivery-kernel lane-tile width; autotuned per shape on
+            # hardware (examples/delivery_autotune.py).
+            pallas_lanes=_env_int("RAPID_TPU_BENCH_LANES_100K", 128),
         )
         vc.assign_cohorts_roundrobin()
         rng = np.random.default_rng(seed + 1000)
@@ -134,21 +137,22 @@ def run_workload() -> None:
         return vc, victims
 
     def resolve_churn(vc) -> int:
-        """Run single-dispatch convergences until the churn is fully
-        resolved; returns the number of committed view changes. One packed
-        scalar fetch per cut (membership rides along — no extra RTT)."""
-        cuts = 0
-        members = -1
-        for _ in range(max_view_changes):
-            _, decided, _, members = vc.run_to_decision(max_steps=96)
-            assert decided, "engine did not converge"
-            cuts += 1
-            if members == n:  # joins in, crashes out
-                return cuts
-        raise AssertionError(
-            f"churn unresolved after {max_view_changes} view changes "
-            f"(membership {members})"
+        """Resolve the whole churn in ONE device dispatch: the multi-cut
+        loop applies every view change on device and the observation comes
+        back in one small fetch — zero per-cut round trips (each would be a
+        full tunnel RTT)."""
+        # min_cuts=1: joins == crashes, so the TARGET equals the starting
+        # membership — at least one committed cut distinguishes "resolved"
+        # from "never started".
+        rounds, cuts, resolved, sizes = vc.run_until_membership(
+            n, max_steps=96 * max_view_changes, max_cuts=max_view_changes,
+            min_cuts=1,
         )
+        assert resolved, (
+            f"churn unresolved after {cuts} view changes in {rounds} rounds "
+            f"(sizes {sizes})"
+        )
+        return cuts
 
     # Warm-up: compile every branch the timed run takes (convergence loop,
     # view-change application, second-cut re-entry).
@@ -217,6 +221,7 @@ def run_workload() -> None:
                 seed=seed,
                 use_pallas=use_pallas,
                 delivery_spread=delivery_spread,
+                pallas_lanes=_env_int("RAPID_TPU_BENCH_LANES_1M", 128),
             )
             vcx.assign_cohorts_roundrobin()
             vcx.crash(

@@ -82,22 +82,28 @@ def run_bootstrap(
         # the loop condition reads sizes[-1] instead of paying a device
         # fetch (a full tunnel RTT) per check.
         target = sizes[-1] + batch.size
-        while sizes[-1] < target:
-            rounds, decided, _, n_members = vc.run_to_decision(max_steps=max_steps)
-            total_rounds += rounds
-            if not decided:
+        # One device dispatch per WAVE (view changes applied on device; the
+        # per-cut intermediate sizes — the paper Table 1 instrument — ride
+        # back in the same fetch). Zero per-cut tunnel round trips.
+        rounds, cuts, resolved, cut_sizes = vc.run_until_membership(
+            target, max_steps=max_steps * 8, max_cuts=8
+        )
+        total_rounds += rounds
+        if not resolved:
+            raise RuntimeError(
+                f"wave unresolved after {cuts} cuts / {rounds} rounds "
+                f"(sizes {cut_sizes}, target {target})"
+            )
+        for size in cut_sizes:
+            if size <= sizes[-1]:
+                # Every decision in a pure join wave must admit someone; a
+                # non-growing cut would corrupt the Table 1 instrument
+                # (duplicate unique_sizes inflate cleanliness).
                 raise RuntimeError(
-                    f"no decision within {max_steps} rounds at size {n_members}"
+                    f"decision did not grow membership ({sizes[-1]} -> {size})"
                 )
-            if n_members <= sizes[-1]:
-                # Every decision in a pure join wave must admit someone;
-                # a non-growing decision would spin this loop forever (and
-                # pad unique_sizes with duplicates).
-                raise RuntimeError(
-                    f"decision did not grow membership ({sizes[-1]} -> {n_members})"
-                )
-            view_changes += 1
-            sizes.append(n_members)
+            sizes.append(size)
+        view_changes += cuts
     wall_ms = (time.perf_counter() - t0) * 1000.0
 
     if sizes[-1] != n_total:

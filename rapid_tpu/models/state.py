@@ -80,10 +80,15 @@ class EngineConfig(NamedTuple):
     # continuous-latency simulation (Fig. 11) sits below one full round of
     # skew; see EVALUATION.md §2 for the calibration.
     delivery_prob_permille: int = 1000
-    # (A pallas_watermark field once followed: a Mosaic watermark kernel
+    # (A pallas_watermark field once sat here: a Mosaic watermark kernel
     # measured SLOWER than XLA's own fusion — 2.52 ms vs 3.67 ms at [8, 1M],
     # evidence/round2/microbench_slope.json — and was deleted. Checkpoint
-    # loads drop the stale trailing value; see utils/checkpoint.py.)
+    # loads drop the stale value; see utils/checkpoint.py.)
+    # Lane-tile width for the Pallas delivery kernel (multiple of 128).
+    # Wider tiles amortize per-grid-step overhead at large N; outputs are
+    # bit-identical across widths. Tune per shape with
+    # examples/delivery_autotune.py on hardware.
+    pallas_lanes: int = 128
 
 
 class EngineState(NamedTuple):

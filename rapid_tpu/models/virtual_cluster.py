@@ -152,6 +152,7 @@ def _deliver_alerts(cfg: EngineConfig, state: EngineState, fire_round, blocked_r
             cfg.k,
             cfg.delivery_spread,
             cfg.delivery_prob_permille,
+            lanes=cfg.pallas_lanes,
         )
         return out[:c, :]
 
@@ -684,6 +685,96 @@ run_to_decision = jax.jit(
 )
 
 
+def run_until_membership_impl(
+    cfg: EngineConfig,
+    state: EngineState,
+    faults: FaultInputs,
+    target,
+    max_steps,
+    max_cuts,
+    min_cuts,
+):
+    """Protocol rounds through MULTIPLE view changes until the membership
+    reaches ``target`` — one device dispatch for a whole churn/bootstrap
+    wave instead of one per cut.
+
+    Structure: an outer loop of convergences, each of which (a) re-derives
+    the hoisted per-edge masks (topology and the implicit-alert stamps
+    change at every view change, so the prologue gather must re-run per
+    cut — still once per CUT, not per round), (b) runs the same sort-free
+    inner round loop as ``run_to_decision_impl``, and (c) applies the view
+    change. On a tunnel/remote backend each dispatch+fetch pair costs a
+    full RTT, so resolving a 2-cut churn or a bootstrap admission wave in
+    one dispatch removes that many round trips from the measured wall
+    clock (EVALUATION.md §1's device_rtt_ms).
+
+    Returns (state, total_steps, cuts_committed, resolved, sizes) where
+    ``sizes[i]`` is the membership after the i-th committed cut (-1 beyond
+    ``cuts``) — the paper's Table 1 "intermediate views" instrument,
+    observed without any per-cut fetch. ``max_cuts`` is static (it sizes
+    the sizes buffer). ``min_cuts`` guards the equal-churn trap: a wave of
+    J joins + J crashes TARGETS the starting membership, so "membership ==
+    target" alone would resolve vacuously before the first cut — requiring
+    at least min_cuts committed cuts makes the loop actually run the churn.
+    """
+    n = cfg.n
+
+    def outer_cond(carry):
+        state, steps, cuts, stalled, _ = carry
+        resolved = (state.n_members == target) & (cuts >= min_cuts)
+        return (~resolved) & (~stalled) & (steps < max_steps) & (cuts < max_cuts)
+
+    def outer_body(carry):
+        state, steps, cuts, _, sizes = carry
+        edge_masks = _edge_masks(cfg, state, faults)
+
+        def inner_cond(carry):
+            _, steps, decided, _ = carry
+            return (~decided) & (steps < max_steps)
+
+        def inner_body(carry):
+            state, steps, _, _ = carry
+            round_state, decided, winner_mask, _ = _compute_round(
+                cfg, state, faults, edge_masks
+            )
+            return (round_state, steps + 1, decided, winner_mask)
+
+        init = (state, steps, jnp.bool_(False), jnp.zeros((n,), dtype=bool))
+        state, steps, decided, winner = jax.lax.while_loop(
+            inner_cond, inner_body, init
+        )
+        state = jax.lax.cond(
+            decided,
+            lambda s: apply_view_change_impl(cfg, s, winner),
+            lambda s: s,
+            state,
+        )
+        sizes = jnp.where(
+            decided, sizes.at[cuts].set(state.n_members), sizes
+        )
+        # A convergence that ran out of budget undecided cannot make further
+        # progress (the outer loop would spin): latch and exit.
+        return (state, steps, cuts + decided.astype(jnp.int32), ~decided, sizes)
+
+    init = (
+        state,
+        jnp.int32(0),
+        jnp.int32(0),
+        jnp.bool_(False),
+        jnp.full((max_cuts,), -1, dtype=jnp.int32),
+    )
+    state, steps, cuts, stalled, sizes = jax.lax.while_loop(
+        outer_cond, outer_body, init
+    )
+    resolved = (state.n_members == target) & (cuts >= min_cuts)
+    return (state, steps, cuts, resolved, sizes)
+
+
+run_until_membership = jax.jit(
+    run_until_membership_impl, static_argnums=(0, 5), donate_argnums=(1,)
+)
+
+
 class VirtualCluster:
     """Host driver around the device engine: owns the state, injects faults
     and join waves, and runs rounds until convergence.
@@ -717,6 +808,7 @@ class VirtualCluster:
         concurrent_coordinators: int = 1,
         fd_window: int = 0,
         delivery_prob_permille: int = 1000,
+        pallas_lanes: int = 128,
     ) -> "VirtualCluster":
         """Synthetic cluster: slot identities are random 64-bit lanes (the
         host never materializes 100K endpoint strings; interop deployments
@@ -731,6 +823,7 @@ class VirtualCluster:
             concurrent_coordinators=concurrent_coordinators,
             fd_window=fd_window,
             delivery_prob_permille=delivery_prob_permille,
+            pallas_lanes=pallas_lanes,
         )
         rng = np.random.default_rng(seed)
         key_hi = rng.integers(0, 2**32, size=(k, n), dtype=np.uint32)
@@ -759,6 +852,7 @@ class VirtualCluster:
         concurrent_coordinators: int = 1,
         fd_window: int = 0,
         delivery_prob_permille: int = 1000,
+        pallas_lanes: int = 128,
         n_members: Optional[int] = None,
     ) -> "VirtualCluster":
         """Build from real endpoints with the host view's exact ring keys, so
@@ -786,6 +880,7 @@ class VirtualCluster:
             concurrent_coordinators=concurrent_coordinators,
             fd_window=fd_window,
             delivery_prob_permille=delivery_prob_permille,
+            pallas_lanes=pallas_lanes,
         )
         key_hi0, key_lo0 = endpoint_ring_keys(endpoints, k)
         key_hi = np.zeros((k, n), dtype=np.uint32)
@@ -1012,6 +1107,39 @@ class VirtualCluster:
         # pay a second fetch rather than return garbage.
         packed = int(steps | (decided.astype(jnp.int32) << 8))
         return packed & 0xFF, bool(packed >> 8), winner, int(self.state.n_members)
+
+    def run_until_membership(
+        self, target: int, max_steps: int = 192, max_cuts: int = 8,
+        min_cuts: int = 0,
+    ) -> Tuple[int, int, bool, Tuple[int, ...]]:
+        """Multi-cut single-dispatch: run convergences — view changes
+        applied ON DEVICE between them — until the membership reaches
+        ``target``; returns (rounds, cuts_committed, resolved,
+        intermediate_sizes).
+
+        A churn that resolves in two cuts, or a bootstrap admission wave of
+        several, costs ONE dispatch and ONE small fetch instead of one
+        dispatch+fetch per cut — each saved pair is a full tunnel RTT
+        (~69 ms on the dev tunnel, EVALUATION.md §1). The observation comes
+        back as one small int32 vector (a 16+4*max_cuts-byte transfer is
+        the same round trip a packed scalar is); intermediate_sizes is the
+        membership after each committed cut — the paper's Table 1
+        "intermediate views" instrument for free."""
+        if not 0 <= target <= self.cfg.n:
+            # Not an assert: python -O must not skip this.
+            raise ValueError(f"target must be in [0, {self.cfg.n}]: {target}")
+        self.state, steps, cuts, resolved, sizes = run_until_membership(
+            self.cfg, self.state, self.faults,
+            jnp.int32(target), jnp.int32(max_steps), int(max_cuts),
+            jnp.int32(min_cuts),
+        )
+        obs = np.asarray(
+            jnp.concatenate(
+                [jnp.stack([steps, cuts, resolved.astype(jnp.int32)]), sizes]
+            )
+        )
+        n_cuts = int(obs[1])
+        return int(obs[0]), n_cuts, bool(obs[2]), tuple(obs[3 : 3 + n_cuts].tolist())
 
     def timed_convergence(self, max_steps: int = 64) -> Tuple[int, float]:
         """(rounds, wall_ms) for a convergence run, excluding compilation

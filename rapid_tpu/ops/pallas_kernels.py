@@ -73,8 +73,8 @@ def watermark_merge_classify(
     return merged, cls
 
 
-def _delivery_kernel(k, w, spread, permille, blocked_ref, age_ref, epoch_ref, out_ref):
-    """Fused per-cohort alert delivery for one 128-slot tile.
+def _delivery_kernel(k, w, spread, permille, lanes, blocked_ref, age_ref, epoch_ref, out_ref):
+    """Fused per-cohort alert delivery for one ``lanes``-slot tile.
 
     The engine's delivery pass (virtual_cluster._deliver_alerts) is, per
     round, K iterations of [c, n] bitwise work over gathered rx-block words
@@ -83,23 +83,26 @@ def _delivery_kernel(k, w, spread, permille, blocked_ref, age_ref, epoch_ref, ou
     VMEM: one read of the blocked words and ages, one write of the packed
     result, nothing materialized per ring.
 
-    Layout: 32 cohorts per uint32 word ride the sublane axis as a [32, 128]
-    tile; slots ride lanes; cohort words and rings are static Python loops.
-    Hash streams are bit-identical to the jnp path.
+    Layout: 32 cohorts per uint32 word ride the sublane axis as a
+    [32, lanes] tile; slots ride lanes (lanes = tile width, a multiple of
+    128 — tunable per shape, examples/delivery_autotune.py); cohort words
+    and rings are static Python loops. Hash streams are bit-identical to
+    the jnp path AND across tile widths (the draw is salted by the GLOBAL
+    slot index, tile*lanes + lane).
     """
-    lane = jax.lax.broadcasted_iota(jnp.uint32, (32, _LANES), 1)
-    j = jax.lax.broadcasted_iota(jnp.uint32, (32, _LANES), 0)  # cohort-in-word
+    lane = jax.lax.broadcasted_iota(jnp.uint32, (32, lanes), 1)
+    j = jax.lax.broadcasted_iota(jnp.uint32, (32, lanes), 0)  # cohort-in-word
     tile = pl.program_id(0)
-    slot = tile.astype(jnp.uint32) * jnp.uint32(_LANES) + lane
+    slot = tile.astype(jnp.uint32) * jnp.uint32(lanes) + lane
     slot_salt = slot * jnp.uint32(0x85EBCA77)
     epoch_salt = epoch_ref[0] * jnp.uint32(0x27D4EB2F)
     for wi in range(w):
-        acc = jnp.zeros((32, _LANES), jnp.uint32)
+        acc = jnp.zeros((32, lanes), jnp.uint32)
         cohort_term = (jnp.uint32(wi * 32) + j) * jnp.uint32(0x9E3779B1)
         for ring in range(k):
-            words = blocked_ref[wi * k + ring : wi * k + ring + 1, :]  # [1, 128]
-            blocked_bit = (jnp.broadcast_to(words, (32, _LANES)) >> j) & jnp.uint32(1)
-            age = jnp.broadcast_to(age_ref[ring : ring + 1, :], (32, _LANES))
+            words = blocked_ref[wi * k + ring : wi * k + ring + 1, :]  # [1, lanes]
+            blocked_bit = (jnp.broadcast_to(words, (32, lanes)) >> j) & jnp.uint32(1)
+            age = jnp.broadcast_to(age_ref[ring : ring + 1, :], (32, lanes))
             if spread > 0:
                 rnd = _mix32(
                     cohort_term
@@ -124,7 +127,7 @@ def _delivery_kernel(k, w, spread, permille, blocked_ref, age_ref, epoch_ref, ou
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k", "spread", "permille", "interpret")
+    jax.jit, static_argnames=("k", "spread", "permille", "interpret", "lanes")
 )
 def delivery_new_bits_pallas(
     blocked_rows: jnp.ndarray,
@@ -134,6 +137,7 @@ def delivery_new_bits_pallas(
     spread: int,
     permille: int,
     interpret: bool = False,
+    lanes: int = _LANES,
 ) -> jnp.ndarray:
     """Fused delivery pass: ``new_bits[w*32, n]`` from packed rx-block rows.
 
@@ -142,28 +146,32 @@ def delivery_new_bits_pallas(
     age_kn: [k, n] int32 rounds since each edge fired (negative = unfired).
     epoch: [1] uint32 configuration epoch (salts the delay draws).
     Returns all w*32 cohort lanes; callers slice [:c]. Slots are padded to
-    the 128-lane tile internally (padding ages are hugely negative, so the
-    pad lanes deliver nothing).
+    the ``lanes``-wide tile internally (padding ages are hugely negative,
+    so the pad lanes deliver nothing). ``lanes`` (multiple of 128) sets the
+    per-grid-step tile width — wider tiles amortize grid overhead at large
+    N; outputs are bit-identical across widths.
     """
+    if lanes % _LANES or lanes <= 0:
+        raise ValueError(f"lanes must be a positive multiple of {_LANES}: {lanes}")
     wk, n = blocked_rows.shape
     w = wk // k
-    n_pad = (-n) % _LANES
+    n_pad = (-n) % lanes
     if n_pad:
         blocked_rows = jnp.pad(blocked_rows, ((0, 0), (0, n_pad)))
         age_kn = jnp.pad(age_kn, ((0, 0), (0, n_pad)), constant_values=-(1 << 29))
     total = n + n_pad
-    grid = (total // _LANES,)
+    grid = (total // lanes,)
     out = pl.pallas_call(
-        functools.partial(_delivery_kernel, k, w, spread, permille),
+        functools.partial(_delivery_kernel, k, w, spread, permille, lanes),
         out_shape=jax.ShapeDtypeStruct((w * 32, total), jnp.uint32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((wk, _LANES), lambda i: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((k, _LANES), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((wk, lanes), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, lanes), lambda i: (0, i), memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec(
-            (w * 32, _LANES), lambda i: (0, i), memory_space=pltpu.VMEM
+            (w * 32, lanes), lambda i: (0, i), memory_space=pltpu.VMEM
         ),
         interpret=interpret,
     )(blocked_rows, age_kn, epoch.astype(jnp.uint32))

@@ -104,10 +104,13 @@ def load_engine_state(path) -> Tuple["EngineConfig", "EngineState"]:
                 f: saved[f] for f in EngineConfig._fields if f in saved
             })
         else:
-            # Legacy checkpoints (no name map): values are positional. The
-            # only schema change they can span is the round-3 removal of the
-            # TRAILING pallas_watermark field, so truncation is exact.
-            cfg = EngineConfig(*vals[: len(EngineConfig._fields)])
+            # Legacy checkpoints (no name map, written round <= 2): values
+            # are positional over the 12 pre-round-3 fields, optionally
+            # followed by the since-deleted pallas_watermark — never by any
+            # round-3+ field (those writers always emit the name map). So:
+            # take the stable 12, drop the stale tail, default the rest.
+            legacy_fields = 12  # ... through delivery_prob_permille
+            cfg = EngineConfig(*vals[:legacy_fields])
         import jax.numpy as jnp
 
         # Fields added after a checkpoint was written fill with their

@@ -136,3 +136,35 @@ def test_engine_state_loads_checkpoint_missing_new_fields(tmp_path):
     rounds, events = restored.run_until_converged(max_steps=32)
     assert events is not None
     assert restored.membership_size == 63
+
+
+def test_legacy_positional_config_drops_stale_watermark_value(tmp_path):
+    # Round-<=2 checkpoints carry no __cfg_fields__ name map: 12 positional
+    # values plus (sometimes) the since-deleted pallas_watermark. The legacy
+    # branch must truncate to the stable 12 and default the rest — NOT let
+    # the stale 13th value load as pallas_lanes (lanes=1 would then blow up
+    # the delivery kernel's multiple-of-128 check at call time).
+    from rapid_tpu.models.state import EngineConfig
+    from rapid_tpu.models.virtual_cluster import VirtualCluster
+
+    vc = VirtualCluster.create(32, fd_threshold=2, seed=4, delivery_spread=1)
+    path = tmp_path / "state.npz"
+    save_engine_state(path, vc.cfg, vc.state)
+
+    with np.load(path) as data:
+        kept = {k: data[k] for k in data.files}
+    del kept["__cfg_fields__"]  # legacy writer had no name map...
+    legacy_vals = [int(v) for v in kept["__cfg__"]][:12]
+    legacy_vals.append(1)  # ...and a trailing pallas_watermark=1
+    kept["__cfg__"] = np.asarray(legacy_vals, dtype=np.int64)
+    legacy = tmp_path / "legacy_cfg.npz"
+    np.savez_compressed(legacy, **kept)
+
+    cfg, state = load_engine_state(legacy)
+    assert cfg.pallas_lanes == EngineConfig.__new__.__defaults__[-1] == 128
+    assert cfg._replace(pallas_lanes=vc.cfg.pallas_lanes) == vc.cfg
+    restored = VirtualCluster(cfg, state)
+    restored.crash([3])
+    rounds, events = restored.run_until_converged(max_steps=32)
+    assert events is not None
+    assert restored.membership_size == 31

@@ -801,3 +801,69 @@ def test_ring_count_boundaries_converge():
         assert events is not None, f"K={k} did not converge"
         assert vc.membership_size == 78
         assert not vc.alive_mask[[11, 42]].any()
+
+
+def test_run_until_membership_matches_sequential_decisions():
+    # The multi-cut single-dispatch loop must commit exactly the cuts the
+    # sequential per-decision driver commits: same rounds, same cut count,
+    # same final membership/config — it only removes host round trips.
+    def build():
+        vc = VirtualCluster.create(
+            60, n_slots=72, cohorts=16, fd_threshold=2, seed=11,
+            delivery_spread=1,
+        )
+        vc.assign_cohorts_roundrobin()
+        vc.crash([7, 31])
+        vc.inject_join_wave(list(range(60, 72)))
+        return vc
+
+    # Sequential reference: one dispatch per cut.
+    seq = build()
+    seq_rounds, seq_cuts = 0, 0
+    while seq.membership_size != 70:
+        rounds, decided, _, _ = seq.run_to_decision(max_steps=64)
+        assert decided
+        seq_rounds += rounds
+        seq_cuts += 1
+        assert seq_cuts <= 8
+
+    fused = build()
+    rounds, cuts, resolved, sizes = fused.run_until_membership(70)
+    assert resolved
+    assert (rounds, cuts) == (seq_rounds, seq_cuts)
+    assert fused.membership_size == 70
+    assert len(sizes) == cuts and sizes[-1] == 70  # Table 1 instrument
+    np.testing.assert_array_equal(fused.alive_mask, seq.alive_mask)
+    assert fused.config_id == seq.config_id
+
+
+def test_run_until_membership_reports_unresolved_on_budget():
+    # An unreachable target must come back resolved=False with the stall
+    # latched (no spin): nothing here ever crashes, so no cut can form.
+    vc = VirtualCluster.create(20, fd_threshold=2, seed=0)
+    rounds, cuts, resolved, sizes = vc.run_until_membership(5, max_steps=16)
+    assert not resolved
+    assert cuts == 0 and sizes == ()
+    assert vc.membership_size == 20
+
+
+def test_run_until_membership_equal_churn_needs_min_cuts():
+    # J joins + J crashes target the STARTING membership: without min_cuts
+    # the loop would resolve vacuously before any cut; with min_cuts=1 it
+    # must actually run the churn to completion.
+    def build():
+        vc = VirtualCluster.create(40, n_slots=44, fd_threshold=2, seed=3)
+        vc.crash([5, 11, 21, 33])
+        vc.inject_join_wave([40, 41, 42, 43])
+        return vc
+
+    vacuous = build()
+    rounds, cuts, resolved, _ = vacuous.run_until_membership(40)
+    assert resolved and cuts == 0 and rounds == 0  # the documented trap
+
+    vc = build()
+    rounds, cuts, resolved, sizes = vc.run_until_membership(40, min_cuts=1)
+    assert resolved and cuts >= 1 and rounds > 0
+    assert vc.membership_size == 40
+    assert not vc.alive_mask[[5, 11, 21, 33]].any()
+    assert vc.alive_mask[40:44].all()

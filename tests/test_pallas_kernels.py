@@ -67,14 +67,16 @@ def test_watermark_boundaries():
     np.testing.assert_array_equal(np.asarray(cls)[: len(cases)], expected[: len(cases)])
 
 
-@pytest.mark.parametrize("c,spread,permille", [
-    (2, 0, 1000),     # no jitter
-    (32, 1, 1000),    # one cohort word, legacy uniform draw
-    (64, 2, 1000),    # two words
-    (96, 3, 300),     # three words, sub-round gate
-    (33, 1, 250),     # ragged cohort count past a word boundary
+@pytest.mark.parametrize("c,spread,permille,lanes", [
+    (2, 0, 1000, 128),     # no jitter
+    (32, 1, 1000, 128),    # one cohort word, legacy uniform draw
+    (64, 2, 1000, 128),    # two words
+    (96, 3, 300, 128),     # three words, sub-round gate
+    (33, 1, 250, 128),     # ragged cohort count past a word boundary
+    (64, 2, 1000, 256),    # wide lane tile: bit-identical across widths
+    (8, 2, 1000, 512),     # the 1M-point cohort shape, wider still
 ])
-def test_delivery_kernel_matches_engine_jnp_path(c, spread, permille):
+def test_delivery_kernel_matches_engine_jnp_path(c, spread, permille, lanes):
     # The fused delivery kernel (interpret mode off-TPU, real Mosaic on
     # device) must be bit-identical to the ENGINE's live jnp path — same
     # function, same state — so any drift in either side fails here, not
@@ -116,6 +118,7 @@ def test_delivery_kernel_matches_engine_jnp_path(c, spread, permille):
         spread,
         permille,
         interpret=jax.default_backend() != "tpu",
+        lanes=lanes,
     )[:c]
     assert np.asarray(want).any() or spread == 0  # scenario actually delivers
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

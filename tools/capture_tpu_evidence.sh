@@ -60,6 +60,11 @@ run_stage pytest_tpu 1200 env RAPID_TPU_TEST_PLATFORM=tpu \
 run_stage profile 1800 python -u examples/pallas_microbench.py \
   --n 100000 --profile "$OUT/profile"
 
+# .jsonl: one JSON line per shape (the sibling .json artifacts are single
+# objects; keep that contract distinct).
+run_stage autotune 1500 python -u examples/delivery_autotune.py
+grep -h '"best_width"' "$OUT/autotune.log" > "$OUT/autotune.jsonl"
+
 run_stage bootstrap 1200 python -u examples/bootstrap_bench.py --n 100000 --seed-size 1000
 grep -h '"scenario"' "$OUT/bootstrap.log" | tail -1 > "$OUT/bootstrap.json"
 
