@@ -9,6 +9,7 @@ initialized the in-process CPU backend).
 """
 
 import os
+import socket
 import subprocess
 import sys
 import time
@@ -16,7 +17,17 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 
+
+def _free_port() -> int:
+    """Kernel-assigned coordinator port: a fixed port collides with a
+    lingering coordinator from a killed run (or a parallel session) and
+    flakes the whole job at bind time."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
 _JOB = """
+import sys
 import numpy as np
 from rapid_tpu.utils.platform import force_platform
 
@@ -27,7 +38,7 @@ import jax
 from rapid_tpu.parallel import multihost
 
 multihost.initialize_multihost(
-    coordinator_address="127.0.0.1:47310", num_processes=1, process_id=0
+    coordinator_address=f"127.0.0.1:{sys.argv[1]}", num_processes=1, process_id=0
 )
 try:
     assert multihost.is_coordinator()
@@ -64,7 +75,7 @@ def test_single_process_distributed_job_runs_sharded_step():
     env = dict(os.environ)
     env["PYTHONPATH"] = f"{REPO}:" + env.get("PYTHONPATH", "")
     result = subprocess.run(
-        [sys.executable, "-c", _JOB],
+        [sys.executable, "-c", _JOB, str(_free_port())],
         capture_output=True,
         text=True,
         timeout=240,
@@ -84,6 +95,7 @@ def test_single_process_distributed_job_runs_sharded_step():
 _JOB2 = """
 import sys
 process_id = int(sys.argv[1])
+coordinator_port = int(sys.argv[2])
 
 from rapid_tpu.utils.platform import force_platform
 assert force_platform("cpu", n_host_devices=4)
@@ -92,7 +104,8 @@ import jax
 from rapid_tpu.parallel import multihost
 
 multihost.initialize_multihost(
-    coordinator_address="127.0.0.1:47321", num_processes=2, process_id=process_id
+    coordinator_address=f"127.0.0.1:{coordinator_port}",
+    num_processes=2, process_id=process_id,
 )
 try:
     assert jax.process_count() == 2
@@ -136,9 +149,10 @@ finally:
 def test_two_process_distributed_job_runs_sharded_step():
     env = dict(os.environ)
     env["PYTHONPATH"] = f"{REPO}:" + env.get("PYTHONPATH", "")
+    port = _free_port()  # both processes must agree on the coordinator
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", _JOB2, str(pid)],
+            [sys.executable, "-c", _JOB2, str(pid), str(port)],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
