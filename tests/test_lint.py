@@ -7,25 +7,22 @@ checks over the whole source tree, run as part of the ordinary test session:
 a violation fails the build the same way checkstyle fails the reference's.
 
 Checks: unused module imports, bare ``except:`` clauses, and mutable default
-arguments.
+arguments. The resolution tier — undefined names, call-signature
+conformance — lives in tools/staticcheck.py, gated by
+tests/test_staticcheck.py (the error-prone analog; this file is the
+checkstyle analog).
 """
 
 from __future__ import annotations
 
 import ast
+import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-ROOTS = ["rapid_tpu", "tests", "examples", "bench.py", "__graft_entry__.py"]
+sys.path.insert(0, str(REPO / "tools"))
 
-
-def _py_files():
-    for root in ROOTS:
-        path = REPO / root
-        if path.is_file():
-            yield path
-        else:
-            yield from sorted(path.rglob("*.py"))
+from staticcheck import iter_files as _py_files  # noqa: E402  — one root list for both tiers
 
 
 def _parse(path: Path):

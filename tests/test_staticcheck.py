@@ -1,0 +1,209 @@
+"""Build gate for the resolution-tier static analysis (tools/staticcheck).
+
+Two halves, matching how the reference treats error-prone: the whole tree
+must be finding-free (the gate), and the analyzer itself must demonstrably
+catch the defect classes it claims — a gate that never bites is
+indistinguishable from no gate.
+"""
+
+from __future__ import annotations
+
+import sys
+import textwrap
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+import staticcheck  # noqa: E402
+
+
+def _undefined(src: str):
+    return staticcheck.check_undefined_names(
+        Path("fixture.py"), textwrap.dedent(src)
+    )
+
+
+def test_undefined_name_in_error_branch_is_caught():
+    findings = _undefined(
+        """
+        import os
+
+        def f(a):
+            if a:
+                return os.sep
+            raise RuntimeError(mesage)  # typo: never executed by tests
+        """
+    )
+    assert [f.check for f in findings] == ["undefined-name"]
+    assert "mesage" in findings[0].message
+
+
+def test_global_decl_assignment_binds_at_module_scope():
+    findings = _undefined(
+        """
+        def setup(value):
+            global _CACHE
+            _CACHE = value
+
+        def read():
+            return _CACHE  # bound only via setup()'s global decl
+        """
+    )
+    assert findings == []
+
+
+def test_class_and_comprehension_scopes_resolve():
+    findings = _undefined(
+        """
+        BASE = 2
+
+        class C:
+            x = BASE
+            def m(self):
+                return [BASE + i for i in range(self.x)]
+
+        lam = lambda z: z + BASE
+        """
+    )
+    assert findings == []
+
+
+def test_star_import_is_flagged_not_skipped():
+    findings = _undefined("from os.path import *\n")
+    assert [f.check for f in findings] == ["star-import"]
+
+
+def _caller_findings(tmp_path, monkeypatch, name: str, callee_src: str, caller_src: str):
+    """Materialize a callee+caller module pair under a private root and run
+    the call-conformance check on the caller."""
+    (tmp_path / f"{name}_callee.py").write_text(textwrap.dedent(callee_src))
+    caller = tmp_path / f"{name}_caller.py"
+    caller.write_text(textwrap.dedent(caller_src))
+    monkeypatch.setattr(staticcheck, "REPO", tmp_path)
+    monkeypatch.syspath_prepend(str(tmp_path))
+    return staticcheck.check_call_signatures(caller)
+
+
+def test_wrong_kwarg_and_arity_are_caught(tmp_path, monkeypatch):
+    findings = _caller_findings(
+        tmp_path, monkeypatch, "sigs",
+        """
+        def encode(message, *, deadline_ms=100):
+            return message, deadline_ms
+        """,
+        """
+        import sigs_callee
+
+        def ok():
+            return sigs_callee.encode("m", deadline_ms=5)
+
+        def typo():
+            return sigs_callee.encode("m", deadlne_ms=5)
+
+        def arity():
+            return sigs_callee.encode("m", "extra")
+        """,
+    )
+    assert [f.check for f in findings] == ["call-signature", "call-signature"]
+    assert "deadlne_ms" in findings[0].message
+    assert "too many positional" in findings[1].message
+
+
+def test_stale_module_attribute_is_caught(tmp_path, monkeypatch):
+    findings = _caller_findings(
+        tmp_path, monkeypatch, "attr",
+        "def current(): return 1\n",
+        """
+        import attr_callee
+
+        def f():
+            return attr_callee.renamed_away()
+        """,
+    )
+    assert [f.check for f in findings] == ["missing-attribute"]
+    assert "renamed_away" in findings[0].message
+
+
+def test_shadowed_and_dynamic_call_sites_are_skipped(tmp_path, monkeypatch):
+    findings = _caller_findings(
+        tmp_path, monkeypatch, "shadow",
+        "def g(a, b): return a + b\n",
+        """
+        import shadow_callee
+        from shadow_callee import g
+
+        def shadowed(g):
+            return g(1, 2, 3, 4)  # parameter, not the module-level g
+
+        def splat(args):
+            return g(*args)  # dynamic shape: must not be judged
+
+        def lam():
+            return (lambda g: g(9, 9, 9))(len)
+
+        def comp(items):
+            return [g for g in items if g]
+        """,
+    )
+    assert findings == []
+
+
+def test_str_target_bindings_and_class_bodies_shadow(tmp_path, monkeypatch):
+    # Bindings whose AST target is a plain string (except-as, match capture)
+    # and class-body-level bindings must shadow module-level callables; each
+    # of these produced a spurious build-failing finding before being
+    # handled.
+    findings = _caller_findings(
+        tmp_path, monkeypatch, "strbind",
+        "def handle(a, b): return a, b\n",
+        """
+        from strbind_callee import handle
+
+        def except_as():
+            try:
+                return handle(1, 2)
+            except ValueError as handle:
+                return handle(0)  # the exception object, not the import
+
+        def match_capture(x):
+            match x:
+                case [handle]:
+                    return handle(9)
+                case {**handle}:
+                    return handle()
+            return None
+
+        class Uses:
+            def handle(self):
+                return None
+            value = handle(None)  # class-local binding wins in the body
+        """,
+    )
+    assert findings == []
+
+
+def test_missing_root_fails_loudly():
+    # A typo'd or renamed root must error, not shrink coverage to zero.
+    import pytest
+
+    with pytest.raises(FileNotFoundError, match="no_such_root"):
+        list(staticcheck.iter_files(["no_such_root"]))
+
+
+def test_finding_points_at_the_offending_read():
+    findings = _undefined(
+        """
+        def f(a):
+
+
+            return mesage
+        """
+    )
+    assert [f.lineno for f in findings] == [5]  # the read, not `def f` (2)
+
+
+def test_whole_tree_is_finding_free():
+    # The gate itself: resolution-tier findings fail the build exactly the
+    # way error-prone fails the reference's.
+    findings = staticcheck.run()
+    assert not findings, "\n".join(str(f) for f in findings)

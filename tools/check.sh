@@ -6,11 +6,14 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== static checks (AST lint gate) =="
-python -m pytest tests/test_lint.py -q
+echo "== static checks (AST lint + resolution tier) =="
+python -m pytest tests/test_lint.py tests/test_staticcheck.py -q
 
 echo "== full suite (CPU, 8 virtual devices) =="
-python -m pytest tests/ -q
+# The static gates just ran above; the resolution tier re-imports and
+# re-analyzes the whole tree, so don't pay it twice in one invocation.
+python -m pytest tests/ -q \
+  --ignore=tests/test_lint.py --ignore=tests/test_staticcheck.py
 
 echo "== driver gates =="
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
