@@ -24,7 +24,7 @@ from typing import NamedTuple
 import jax.numpy as jnp
 
 from rapid_tpu.ops.hashing import masked_set_hash
-from rapid_tpu.ops.rings import ring_topology
+from rapid_tpu.ops.rings import ring_perms, ring_topology_from_perm
 
 # Sentinel for "this edge's alert has not fired": far enough in the future
 # that (round_idx - FIRE_NEVER) stays hugely negative in int32.
@@ -98,6 +98,7 @@ class EngineState(NamedTuple):
     # view change).
     key_hi: jnp.ndarray  # [k, n] uint32
     key_lo: jnp.ndarray  # [k, n] uint32
+    ring_perm: jnp.ndarray  # [k, n] int32 — static key-order permutation per ring
     id_hi: jnp.ndarray  # [n] uint32 — node-identity lanes for set hashes
     id_lo: jnp.ndarray  # [n] uint32
     alive: jnp.ndarray  # [n] bool — current membership
@@ -181,12 +182,16 @@ def initial_state(cfg: EngineConfig, key_hi, key_lo, id_hi, id_lo, alive) -> Eng
             f"({cfg.fd_window}): the edge could never fire"
         )
     alive = jnp.asarray(alive, dtype=bool)
-    topo = ring_topology(jnp.asarray(key_hi), jnp.asarray(key_lo), alive)
+    # The one sort: ring keys are static per slot, so every topology after
+    # this (including every view change) is O(N) scans over these perms.
+    perm = ring_perms(jnp.asarray(key_hi), jnp.asarray(key_lo))
+    topo = ring_topology_from_perm(perm, alive)
     config_hi, config_lo = masked_set_hash(jnp.asarray(id_hi), jnp.asarray(id_lo), alive)
     n, k, c = cfg.n, cfg.k, cfg.c
     return EngineState(
         key_hi=jnp.asarray(key_hi, dtype=jnp.uint32),
         key_lo=jnp.asarray(key_lo, dtype=jnp.uint32),
+        ring_perm=perm,
         id_hi=jnp.asarray(id_hi, dtype=jnp.uint32),
         id_lo=jnp.asarray(id_lo, dtype=jnp.uint32),
         alive=alive,

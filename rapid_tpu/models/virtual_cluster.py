@@ -38,7 +38,11 @@ from rapid_tpu.ops.pallas_kernels import (
     delivery_new_bits_pallas,
     watermark_merge_classify,
 )
-from rapid_tpu.ops.rings import endpoint_ring_keys, predecessor_of_keys, ring_topology
+from rapid_tpu.ops.rings import (
+    endpoint_ring_keys,
+    predecessor_of_keys,
+    ring_topology_from_perm,
+)
 
 
 def cohort_words(c: int) -> int:
@@ -580,7 +584,9 @@ def apply_view_change_impl(
     edge would never re-fire and the joiner would be stranded forever."""
     n, k, c = cfg.n, cfg.k, cfg.c
     alive2 = state.alive ^ winner_mask
-    topo = ring_topology(state.key_hi, state.key_lo, alive2)
+    # Sort-free: O(N) scans over the static key-order perms, not a K-ring
+    # argsort — at N=1M the re-sort was the commit path's largest block.
+    topo = ring_topology_from_perm(state.ring_perm, alive2)
     config_hi, config_lo = masked_set_hash(state.id_hi, state.id_lo, alive2)
     still_pending = state.join_pending & ~winner_mask  # [n]
     fd_fired2 = state.fd_fired & still_pending[:, None]

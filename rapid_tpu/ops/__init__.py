@@ -10,7 +10,9 @@ from rapid_tpu.ops.rings import (
     RingTopology,
     endpoint_ring_keys,
     predecessor_of_keys,
+    ring_perms,
     ring_topology,
+    ring_topology_from_perm,
 )
 
 __all__ = [
@@ -29,5 +31,7 @@ __all__ = [
     "RingTopology",
     "endpoint_ring_keys",
     "predecessor_of_keys",
+    "ring_perms",
     "ring_topology",
+    "ring_topology_from_perm",
 ]

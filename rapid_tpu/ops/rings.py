@@ -90,6 +90,76 @@ def ring_topology(key_hi: jnp.ndarray, key_lo: jnp.ndarray, alive: jnp.ndarray) 
     return RingTopology(obs_idx=obs, subj_idx=subj, order=order)
 
 
+def ring_perms(key_hi: jnp.ndarray, key_lo: jnp.ndarray) -> jnp.ndarray:
+    """Static per-ring key-order permutations, [K, N] int32: perm[k, p] is
+    the slot at position p of ring k's FIXED key order (aliveness ignored).
+
+    Ring keys never change after slot creation, so this is computed ONCE;
+    every later topology query is O(N) scans over it
+    (``ring_topology_from_perm``) instead of an O(N log N) re-sort per view
+    change — at N=1M the per-view-change K-ring argsort is the single
+    largest block of the commit path.
+    """
+    # lex_argsort already batches over leading axes (it sorts dimension=-1).
+    return lex_argsort((jnp.asarray(key_hi), jnp.asarray(key_lo))).astype(jnp.int32)
+
+
+def _from_perm_single(perm, alive):
+    """One ring, sort-free: (obs_idx[N], subj_idx[N], order[N]) from the
+    static key order. Successor among alive = next alive position in the
+    fixed circular order (suffix-min scan); predecessor = previous
+    (prefix-max scan); the alive-first ``order`` is a stable partition
+    (rank scans + one scatter). Bit-identical to ``_ring_topology_single``:
+    restricting a fixed total order to the alive subset IS the alive
+    order, and lex_argsort is stable so dead slots tie-break identically.
+    """
+    n = perm.shape[0]
+    ao = alive[perm]  # alive bit per ring position
+    pos = jnp.arange(n, dtype=jnp.int32)
+    n_alive = jnp.sum(ao.astype(jnp.int32))
+
+    idx_succ = jnp.where(ao, pos, n)  # sentinel past the end
+    suffix_min = jax.lax.cummin(idx_succ, reverse=True)
+    first_alive = suffix_min[0]
+    nxt = jnp.concatenate([suffix_min[1:], jnp.full((1,), n, dtype=jnp.int32)])
+    succ_pos = jnp.where(nxt >= n, first_alive, nxt)  # wrap to ring start
+
+    idx_pred = jnp.where(ao, pos, -1)
+    prefix_max = jax.lax.cummax(idx_pred)
+    last_alive = prefix_max[-1]
+    prv = jnp.concatenate([jnp.full((1,), -1, dtype=jnp.int32), prefix_max[:-1]])
+    pred_pos = jnp.where(prv < 0, last_alive, prv)  # wrap to ring end
+
+    valid = ao & (n_alive >= 2)
+    succ_slot = jnp.where(valid, perm[jnp.clip(succ_pos, 0, n - 1)], -1)
+    pred_slot = jnp.where(valid, perm[jnp.clip(pred_pos, 0, n - 1)], -1)
+    # full(-1), not zeros: if perm were ever not a permutation (corrupted
+    # state), unwritten entries must read as the documented "no observer"
+    # sentinel, never as valid slot 0.
+    obs_idx = jnp.full((n,), -1, dtype=jnp.int32).at[perm].set(succ_slot)
+    subj_idx = jnp.full((n,), -1, dtype=jnp.int32).at[perm].set(pred_slot)
+
+    alive_rank = jnp.cumsum(ao.astype(jnp.int32)) - 1
+    dead_rank = n_alive + jnp.cumsum((~ao).astype(jnp.int32)) - 1
+    order = (
+        jnp.zeros((n,), dtype=jnp.int32)
+        .at[jnp.where(ao, alive_rank, dead_rank)]
+        .set(perm)
+    )
+    return obs_idx, subj_idx, order
+
+
+def ring_topology_from_perm(perm: jnp.ndarray, alive: jnp.ndarray) -> RingTopology:
+    """``ring_topology`` without the sort: derive all K rings' topology from
+    the static key-order permutations (``ring_perms``) and the current alive
+    mask with O(N) scans. Output is bit-identical to ``ring_topology``
+    (equivalence pinned in tests/test_ops_rings.py)."""
+    obs, subj, order = jax.vmap(_from_perm_single, in_axes=(0, None))(
+        jnp.asarray(perm), jnp.asarray(alive, dtype=bool)
+    )
+    return RingTopology(obs_idx=obs, subj_idx=subj, order=order)
+
+
 @jax.jit
 def predecessor_of_keys(
     key_hi: jnp.ndarray,

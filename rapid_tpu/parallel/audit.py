@@ -59,7 +59,7 @@ def classify_location(op_name: str) -> str:
 def source_of(op_name: str) -> str:
     """Human label for the jax op a collective lowered from."""
     markers = (
-        ("ring_topology", "view-change ring re-sort"),
+        ("ring_topology", "view-change topology rebuild"),
         ("classic_attempt", "classic-fallback attempt"),
         ("tally_candidates", "fast-round vote tally"),
         ("cumsum", "classic-fallback attempt"),

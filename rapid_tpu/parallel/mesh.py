@@ -4,8 +4,10 @@ Scale axis = N (virtual members), sharded over a 1-D mesh axis ``nodes``:
 every per-slot array partitions on its N dimension; ring/cohort axes and
 scalars replicate. All of the engine's global reductions (watermark tallies,
 vote counts, set hashes) are sums/anys over N, which XLA lowers to psum over
-ICI; the per-ring argsort in ``ring_topology`` runs only on view changes and
-is the one collective-heavy op (XLA inserts the gather it needs). This is
+ICI; ring topology is re-derived only on view changes — sort-free O(N)
+scans over the static key-order perms (``ring_topology_from_perm``; the
+one argsort runs at state creation) — and its cross-shard permutation
+gathers are the one collective-heavy op (XLA inserts what it needs). This is
 not just a docstring claim: ``tools/collective_audit.py`` classifies every
 collective in the compiled HLO (EVALUATION.md §3c), and
 ``tests/test_parallel.py::test_round_body_collectives_are_reductions_only``
@@ -46,6 +48,7 @@ def state_shardings(mesh: Mesh) -> EngineState:
     return EngineState(
         key_hi=sh(None, NODE_AXIS),
         key_lo=sh(None, NODE_AXIS),
+        ring_perm=sh(None, NODE_AXIS),
         id_hi=sh(NODE_AXIS),
         id_lo=sh(NODE_AXIS),
         alive=sh(NODE_AXIS),

@@ -80,6 +80,10 @@ def view_from_configuration(config: Configuration, k: int) -> MembershipView:
 
 def save_engine_state(path, cfg: "EngineConfig", state: "EngineState") -> None:
     arrays = {field: np.asarray(value) for field, value in state._asdict().items()}
+    # Derived data is never persisted: ring_perm is a pure function of the
+    # key lanes, and loading a stale/corrupted copy would silently diverge
+    # topology from the keys. Load always recomputes it (one sort).
+    arrays.pop("ring_perm", None)
     np.savez_compressed(
         path,
         __cfg__=np.asarray(list(cfg), dtype=np.int64),
@@ -113,6 +117,8 @@ def load_engine_state(path) -> Tuple["EngineConfig", "EngineState"]:
             cfg = EngineConfig(*vals[:legacy_fields])
         import jax.numpy as jnp
 
+        from rapid_tpu.ops.rings import ring_perms as _ring_perms
+
         # Fields added after a checkpoint was written fill with their
         # initial-state defaults (per-configuration state is safe to reset:
         # at worst a fallback restarts from round 2).
@@ -136,10 +142,19 @@ def load_engine_state(path) -> Tuple["EngineConfig", "EngineState"]:
             # must not re-admit previously-removed slots after such a resume
             # (warned below).
             "retired": lambda: _default_retired(cfg),
+            # Derived, not stateful: recompute from the (always-saved) key
+            # lanes for checkpoints written before the field existed.
+            "ring_perm": lambda: _ring_perms(
+                jnp.asarray(data["key_hi"]), jnp.asarray(data["key_lo"])
+            ),
         }
         arrays = {}
         for field in EngineState._fields:
-            if field in data:
+            if field == "ring_perm":
+                # Always derived from the key lanes — a persisted copy (from
+                # any writer) is ignored rather than trusted for coherence.
+                arrays[field] = defaults[field]()
+            elif field in data:
                 arrays[field] = jnp.asarray(data[field])
             elif field in defaults:
                 arrays[field] = defaults[field]()

@@ -124,6 +124,7 @@ def test_engine_state_loads_checkpoint_missing_new_fields(tmp_path):
     for legacy_missing in (
         "fire_round", "round_idx", "cp_rnd_r", "cp_rnd_i",
         "cp_vrnd_r", "cp_vrnd_i", "cp_vval_src", "classic_epoch",
+        "ring_perm",  # derived: must backfill from the saved key lanes
     ):
         kept.pop(legacy_missing, None)
     stripped = tmp_path / "legacy.npz"
@@ -131,6 +132,9 @@ def test_engine_state_loads_checkpoint_missing_new_fields(tmp_path):
 
     cfg, state = load_engine_state(stripped)
     assert cfg == vc.cfg
+    np.testing.assert_array_equal(
+        np.asarray(state.ring_perm), np.asarray(vc.state.ring_perm)
+    )
     restored = VirtualCluster(cfg, state)
     restored.crash([7])
     rounds, events = restored.run_until_converged(max_steps=32)

@@ -3,7 +3,13 @@
 import numpy as np
 import pytest
 
-from rapid_tpu.ops.rings import endpoint_ring_keys, predecessor_of_keys, ring_topology
+from rapid_tpu.ops.rings import (
+    endpoint_ring_keys,
+    predecessor_of_keys,
+    ring_perms,
+    ring_topology,
+    ring_topology_from_perm,
+)
 from rapid_tpu.protocol.view import MembershipView
 from rapid_tpu.types import Endpoint, NodeId
 
@@ -76,6 +82,34 @@ def test_topology_single_and_two_nodes():
     topo = ring_topology(key_hi, key_lo, alive)
     assert (np.asarray(topo.obs_idx)[:, 2] == 4).all()
     assert (np.asarray(topo.obs_idx)[:, 4] == 2).all()
+
+
+@pytest.mark.parametrize("n,k,alive_frac", [
+    (4, 3, 1.0),      # minimum viable ring
+    (64, 10, 0.9),    # sparse deaths
+    (257, 7, 0.5),    # half dead, odd N
+    (100, 10, 0.02),  # near-empty: 2 alive
+    (50, 5, 0.0),     # nobody alive
+    (33, 4, None),    # exactly ONE alive (below the 2-node floor)
+])
+def test_from_perm_matches_sorting_topology(n, k, alive_frac):
+    # The sort-free scan path (used by every view change) must be
+    # bit-identical to the argsort definition across the aliveness range,
+    # including the <2-alive floor where every entry is -1.
+    rng = np.random.default_rng(n * 31 + k)
+    key_hi = rng.integers(0, 2**32, size=(k, n), dtype=np.uint32)
+    key_lo = rng.integers(0, 2**32, size=(k, n), dtype=np.uint32)
+    if alive_frac is None:
+        alive = np.zeros(n, dtype=bool)
+        alive[n // 2] = True
+    else:
+        alive = rng.random(n) < alive_frac
+    perm = ring_perms(key_hi, key_lo)
+    want = ring_topology(key_hi, key_lo, alive)
+    got = ring_topology_from_perm(perm, alive)
+    np.testing.assert_array_equal(np.asarray(got.obs_idx), np.asarray(want.obs_idx))
+    np.testing.assert_array_equal(np.asarray(got.subj_idx), np.asarray(want.subj_idx))
+    np.testing.assert_array_equal(np.asarray(got.order), np.asarray(want.order))
 
 
 def test_expected_observers_of_joiners():

@@ -83,7 +83,7 @@ def test_round_body_collectives_are_reductions_only():
     the sharded convergence program, the hot loop's unconditional
     collectives are psum-class all-reduces only, and nothing [c,n]-sized
     moves outside a lax.cond branch (implicit invalidation / classic attempt
-    / view-change re-sort). Bit-identical outputs prove correctness; this
+    / view-change topology rebuild). Bit-identical outputs prove correctness; this
     pins the cost model (parallel/mesh.py's docstring claim, VERDICT r2
     missing #4). Full-size table: tools/collective_audit.py ->
     evidence/round3/collective_audit.json."""

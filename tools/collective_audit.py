@@ -10,7 +10,7 @@ with compiled evidence rather than docstring assertion:
     words, rapid_tpu/models/virtual_cluster.py::_edge_masks) sit OUTSIDE the
     while body — hoisted once per convergence;
   - anything [c,n]-sized or larger moves only inside lax.cond branches that
-    execute on view changes (ring re-sort), classic-fallback attempts, or
+    execute on view changes (sort-free topology rebuild), classic-fallback attempts, or
     the implicit-invalidation pass.
 
 Classification logic lives in rapid_tpu/parallel/audit.py (pinned by
