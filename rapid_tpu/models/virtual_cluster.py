@@ -1017,6 +1017,7 @@ class VirtualCluster:
         pred = predecessor_of_keys(
             state.key_hi, state.key_lo, state.alive,
             state.key_hi[:, idx], state.key_lo[:, idx],
+            perm=state.ring_perm,  # sort-free: this sits in bootstrap's timed path
         )  # [k, j]
 
         # The gatekeeper IS the joiner's observer pre-admission (for both

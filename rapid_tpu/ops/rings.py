@@ -138,15 +138,22 @@ def _from_perm_single(perm, alive):
     # sentinel, never as valid slot 0.
     obs_idx = jnp.full((n,), -1, dtype=jnp.int32).at[perm].set(succ_slot)
     subj_idx = jnp.full((n,), -1, dtype=jnp.int32).at[perm].set(pred_slot)
+    return obs_idx, subj_idx, _alive_first_order(perm, alive)
 
+
+def _alive_first_order(perm, alive):
+    """``lex_argsort((dead, keys...))`` without the sort: stable partition
+    of the static key order into alive-first via rank scans + one scatter."""
+    n = perm.shape[0]
+    ao = alive[perm]
+    n_alive = jnp.sum(ao.astype(jnp.int32))
     alive_rank = jnp.cumsum(ao.astype(jnp.int32)) - 1
     dead_rank = n_alive + jnp.cumsum((~ao).astype(jnp.int32)) - 1
-    order = (
+    return (
         jnp.zeros((n,), dtype=jnp.int32)
         .at[jnp.where(ao, alive_rank, dead_rank)]
         .set(perm)
     )
-    return obs_idx, subj_idx, order
 
 
 def ring_topology_from_perm(perm: jnp.ndarray, alive: jnp.ndarray) -> RingTopology:
@@ -167,6 +174,7 @@ def predecessor_of_keys(
     alive: jnp.ndarray,
     query_hi: jnp.ndarray,
     query_lo: jnp.ndarray,
+    perm: "jnp.ndarray | None" = None,
 ) -> jnp.ndarray:
     """Expected observers of joiners: for each query key (one per ring per
     joiner), the alive slot that precedes it on that ring — the semantics of
@@ -175,14 +183,23 @@ def predecessor_of_keys(
     key_hi/key_lo: [K, N]; query_hi/query_lo: [K, J]. Returns [K, J] slot
     indices (-1 when no node is alive). Rank is computed by a masked
     comparison sum — O(N·J) elementwise work that maps cleanly onto sharded N.
+    With ``perm`` (the static key-order permutations, ``ring_perms``) the
+    alive-first order comes from O(N) partition scans instead of a K-ring
+    argsort — this sits inside a bootstrap wave's timed path, where the
+    engine passes its ``state.ring_perm``. Results are identical either way.
     """
 
     n_alive = jnp.sum(alive.astype(jnp.int32))
-    dead = (~alive).astype(jnp.uint32)
 
-    def one_ring(khi, klo, qhi, qlo):
-        order = lex_argsort((dead, khi, klo))
+    if perm is None:
+        dead = (~alive).astype(jnp.uint32)
+        orders = jax.vmap(lambda h, low: lex_argsort((dead, h, low)))(
+            key_hi, key_lo
+        )
+    else:
+        orders = jax.vmap(_alive_first_order, in_axes=(0, None))(perm, alive)
 
+    def one_ring(khi, klo, qhi, qlo, order):
         def one_query(h, low):
             less = (khi < h) | ((khi == h) & (klo < low))
             rank = jnp.sum((less & alive).astype(jnp.int32))
@@ -192,4 +209,4 @@ def predecessor_of_keys(
 
         return jax.vmap(one_query)(qhi, qlo)
 
-    return jax.vmap(one_ring)(key_hi, key_lo, query_hi, query_lo)
+    return jax.vmap(one_ring)(key_hi, key_lo, query_hi, query_lo, orders)

@@ -111,6 +111,18 @@ def test_from_perm_matches_sorting_topology(n, k, alive_frac):
     np.testing.assert_array_equal(np.asarray(got.subj_idx), np.asarray(want.subj_idx))
     np.testing.assert_array_equal(np.asarray(got.order), np.asarray(want.order))
 
+    # The joiner-gatekeeper query must agree between its sorting and
+    # perm-scan paths too (inject_join_wave passes the engine's perm).
+    j = min(5, n)
+    qhi = rng.integers(0, 2**32, size=(k, j), dtype=np.uint32)
+    qlo = rng.integers(0, 2**32, size=(k, j), dtype=np.uint32)
+    np.testing.assert_array_equal(
+        np.asarray(predecessor_of_keys(key_hi, key_lo, alive, qhi, qlo)),
+        np.asarray(
+            predecessor_of_keys(key_hi, key_lo, alive, qhi, qlo, perm=perm)
+        ),
+    )
+
 
 def test_expected_observers_of_joiners():
     n, k, j = 50, 10, 7
