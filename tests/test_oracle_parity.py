@@ -94,6 +94,13 @@ class _HostHarness:
         self.cuts = []
         self.live_ids = set()
 
+    async def _drive(self, *tasks):
+        """Pump the manual clock until every task completes."""
+        while not all(t.done() for t in tasks):
+            await _advance(self.clock, 200)
+        for t in tasks:
+            t.result()  # surface failures here, not as pending warnings
+
     async def join_one(self, slot):
         task = asyncio.ensure_future(
             Cluster.join(self.endpoints[0], self.endpoints[slot],
@@ -101,8 +108,7 @@ class _HostHarness:
                          fd_factory=self.fd, clock=self.clock,
                          rng=random.Random(slot))
         )
-        while not task.done():
-            await _advance(self.clock, 200)
+        await self._drive(task)
         self.clusters[slot] = task.result()
         self.live_ids.add(slot)
 
@@ -118,8 +124,7 @@ class _HostHarness:
             )
             for s in slots
         ]
-        while not all(t.done() for t in tasks):
-            await _advance(self.clock, 200)
+        await self._drive(*tasks)
         for s, t in zip(slots, tasks):
             self.clusters[s] = t.result()
         self.live_ids |= set(slots)
@@ -160,6 +165,13 @@ class _HostHarness:
             self.network.blackholed.add(self.endpoints[s])
         self.fd.add_failed_nodes([self.endpoints[s] for s in slots])
         self.live_ids -= set(slots)
+
+    async def leave(self, slot):
+        """Graceful departure: the node announces itself DOWN and shuts down
+        (Cluster.leave_gracefully, Cluster.java:145-149 semantics)."""
+        task = asyncio.ensure_future(self.clusters[slot].leave_gracefully())
+        await self._drive(task)
+        self.live_ids -= {slot}
 
     def partition_one_way(self, victim):
         """Everything INTO the victim drops (it can still send)."""
@@ -284,7 +296,7 @@ def _random_schedule(seed: int, n0: int, n_slots: int):
     for _ in range(rng.randint(3, 5)):
         floor = (peak * 2) // 3  # healthy-cluster invariant, vs PEAK size
         removable = len(live) - floor
-        kind = rng.choice(["crash", "join", "partition"])
+        kind = rng.choice(["crash", "join", "partition", "leave"])
         if kind == "join" and pending_pool:
             size = rng.randint(1, min(4, len(pending_pool)))
             slots = [pending_pool.pop(0) for _ in range(size)]
@@ -296,9 +308,9 @@ def _random_schedule(seed: int, n0: int, n_slots: int):
             slots = rng.sample(sorted(live - {0}), size)
             phases.append(("crash", slots))
             live -= set(slots)
-        elif kind == "partition" and removable >= 1:
+        elif kind in ("partition", "leave") and removable >= 1:
             victim = rng.choice(sorted(live - {0}))
-            phases.append(("partition", [victim]))
+            phases.append((kind, [victim]))
             live -= {victim}
         # A fault phase drawn at the floor is skipped, not shrunk past it.
     return phases, sorted(live)
@@ -317,6 +329,10 @@ async def _run_host_phases(phases, n0, endpoints):
         elif kind == "join":
             await h.join_wave(slots)
             members += len(slots)
+        elif kind == "leave":
+            (leaver,) = slots
+            await h.leave(leaver)
+            members -= 1
         else:  # one-way partition
             (victim,) = slots
             h.partition_one_way(victim)
@@ -353,6 +369,8 @@ def _run_engine_phases(phases, n0, endpoints):
     for kind, slots in phases:
         if kind == "join":
             vc.inject_join_wave(slots)
+        elif kind == "leave":
+            vc.initiate_leave(slots)
         else:  # crash and one-way ingress partition are detector-identical
             vc.crash(slots)
         decide()
