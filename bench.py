@@ -264,7 +264,17 @@ def run_workload() -> None:
                     (n_crash + n_join) * k_rings * n / (value / 1000.0), 0
                 ),
                 "device_rtt_ms": round(rtt_ms, 3),
-                **({"n1M_crash1pct_ms": round(xl_ms, 3)} if xl_ms is not None else {}),
+                # Delivery-kernel tile width in effect (autotune provenance);
+                # the 1M width is only meaningful when the 1M point ran.
+                "lanes_100k": _env_int("RAPID_TPU_BENCH_LANES_100K", 128),
+                **(
+                    {
+                        "n1M_crash1pct_ms": round(xl_ms, 3),
+                        "lanes_1m": _env_int("RAPID_TPU_BENCH_LANES_1M", 128),
+                    }
+                    if xl_ms is not None
+                    else {}
+                ),
             }
         ),
         flush=True,

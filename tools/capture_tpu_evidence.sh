@@ -31,9 +31,11 @@ run_stage() { # name timeout_s command...
 run_stage bench 3300 env RAPID_TPU_BENCH_DEADLINE_S=1500 RAPID_TPU_BENCH_ATTEMPTS=1 \
   RAPID_TPU_BENCH_NO_SNAPSHOT=1 python -u bench.py
 grep -h '"metric"' "$OUT/bench.log" | tail -1 > "$OUT/bench.json"
-# Stamp provenance into the capture so bench.py's snapshot fallback (and any
-# reader) can tell when/what this measurement was taken from.
-python - "$OUT/bench.json" <<'EOF'
+# Stamp provenance into a capture so bench.py's snapshot fallback (and any
+# reader) can tell when/what a measurement was taken from. One definition —
+# both bench.json producers (default-width and tuned runs) use it.
+stamp_json() {
+  python - "$1" <<'EOF'
 import json, subprocess, sys, time
 path = sys.argv[1]
 try:
@@ -50,6 +52,8 @@ if isinstance(data, dict):
         pass
     open(path, "w").write(json.dumps(data) + "\n")
 EOF
+}
+stamp_json "$OUT/bench.json"
 
 run_stage microbench 1200 python -u examples/pallas_microbench.py
 grep -h '"platform"' "$OUT/microbench.log" | tail -1 > "$OUT/microbench.json"
@@ -64,6 +68,51 @@ run_stage profile 1800 python -u examples/pallas_microbench.py \
 # objects; keep that contract distinct).
 run_stage autotune 1500 python -u examples/delivery_autotune.py
 grep -h '"best_width"' "$OUT/autotune.log" > "$OUT/autotune.jsonl"
+
+# Re-run the bench with the autotuned tile widths; keep whichever run is
+# better as the headline bench.json (full provenance either way — the JSON
+# carries lanes_100k, and lanes_1m when the 1M point ran). The first,
+# default-width run already secured a capture in case the window dies
+# mid-sweep.
+read -r LANES_100K LANES_1M <<< "$(python - "$OUT/autotune.jsonl" <<'EOF' || echo "128 128"
+import json, sys
+best = {}
+try:
+    for line in open(sys.argv[1]):
+        d = json.loads(line)
+        best[d["shape"][1]] = d.get("best_width")
+except (OSError, json.JSONDecodeError, KeyError, IndexError):
+    pass
+print(best.get(100_000) or 128, best.get(1_000_000) or 128)
+EOF
+)"
+echo "autotuned lanes: 100K=$LANES_100K 1M=$LANES_1M"
+run_stage bench_tuned 3300 env RAPID_TPU_BENCH_DEADLINE_S=1500 \
+  RAPID_TPU_BENCH_ATTEMPTS=1 RAPID_TPU_BENCH_NO_SNAPSHOT=1 \
+  RAPID_TPU_BENCH_LANES_100K="$LANES_100K" RAPID_TPU_BENCH_LANES_1M="$LANES_1M" \
+  python -u bench.py
+grep -h '"metric"' "$OUT/bench_tuned.log" | tail -1 > "$OUT/bench_tuned.json"
+stamp_json "$OUT/bench_tuned.json"
+python - "$OUT/bench.json" "$OUT/bench_tuned.json" <<'EOF'
+import json, sys
+def load(p):
+    try:
+        d = json.loads(open(p).read().strip() or "null")
+        return d if isinstance(d, dict) and d.get("platform") == "tpu" else None
+    except (OSError, json.JSONDecodeError):
+        return None
+base, tuned = load(sys.argv[1]), load(sys.argv[2])
+if tuned and (not base or tuned["value"] < base["value"]):
+    # Never lose session evidence to the swap: if the tuned run skipped the
+    # 1M point (XL budget on a slow-tunnel day) but the base run caught it,
+    # the base measurement rides along with its own width provenance.
+    if base and "n1M_crash1pct_ms" in base and "n1M_crash1pct_ms" not in tuned:
+        tuned["n1M_crash1pct_ms"] = base["n1M_crash1pct_ms"]
+        tuned["lanes_1m"] = base.get("lanes_1m", 128)
+        tuned["n1M_from"] = "default_width_run"
+    open(sys.argv[1], "w").write(json.dumps(tuned) + "\n")
+    print("bench.json <- tuned run (better or only TPU capture)")
+EOF
 
 run_stage bootstrap 1200 python -u examples/bootstrap_bench.py --n 100000 --seed-size 1000
 grep -h '"scenario"' "$OUT/bootstrap.log" | tail -1 > "$OUT/bootstrap.json"
