@@ -123,9 +123,9 @@ def run_workload() -> None:
             use_pallas=use_pallas,
             delivery_spread=delivery_spread,
             concurrent_coordinators=2,
-            # Delivery-kernel lane-tile width; autotuned per shape on
-            # hardware (examples/delivery_autotune.py).
-            pallas_lanes=_env_int("RAPID_TPU_BENCH_LANES_100K", 128),
+            # Delivery-kernel lane-tile width for the MAIN workload (any N);
+            # autotuned per shape on hardware (examples/delivery_autotune.py).
+            pallas_lanes=_env_int("RAPID_TPU_BENCH_LANES", 128),
         )
         vc.assign_cohorts_roundrobin()
         rng = np.random.default_rng(seed + 1000)
@@ -264,9 +264,10 @@ def run_workload() -> None:
                     (n_crash + n_join) * k_rings * n / (value / 1000.0), 0
                 ),
                 "device_rtt_ms": round(rtt_ms, 3),
-                # Delivery-kernel tile width in effect (autotune provenance);
-                # the 1M width is only meaningful when the 1M point ran.
-                "lanes_100k": _env_int("RAPID_TPU_BENCH_LANES_100K", 128),
+                # Delivery-kernel tile width in effect for the main workload
+                # (autotune provenance); the 1M width only when the separate
+                # 1M point ran.
+                "pallas_lanes": _env_int("RAPID_TPU_BENCH_LANES", 128),
                 **(
                     {
                         "n1M_crash1pct_ms": round(xl_ms, 3),
@@ -469,6 +470,16 @@ def main() -> None:
             )
             time.sleep(15)
     if not _env_flag("RAPID_TPU_BENCH_NO_SNAPSHOT") and _emit_tpu_snapshot():
+        return
+    if _env_flag("RAPID_TPU_BENCH_NO_FALLBACK"):
+        # Sweep mode: a dead accelerator must be an EXPLICIT hole in the
+        # curve (and cost no CPU-fallback minutes of a live window), never
+        # a silently missing point.
+        print(json.dumps({
+            "metric": f"churn_resolution_ms_n{_env_int('RAPID_TPU_BENCH_N', 100_000)}",
+            "error": "accelerator_unavailable",
+            "n_members": _env_int("RAPID_TPU_BENCH_N", 100_000),
+        }), flush=True)
         return
     print("bench: falling back to CPU", file=sys.stderr, flush=True)
     env = dict(os.environ)

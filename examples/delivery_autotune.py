@@ -115,11 +115,9 @@ def main() -> None:
                 interpret=args.interpret, lanes=width,
             )
             if baseline_out is None:
-                baseline_out = out
+                baseline_out = np.asarray(out)  # fetch ONCE ([32, n] uint32)
             else:
-                np.testing.assert_array_equal(
-                    np.asarray(out), np.asarray(baseline_out)
-                )
+                np.testing.assert_array_equal(np.asarray(out), baseline_out)
         if result["per_width_ms"]:
             best = min(result["per_width_ms"], key=result["per_width_ms"].get)
             result["best_width"] = int(best)

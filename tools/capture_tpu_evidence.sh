@@ -89,7 +89,7 @@ EOF
 echo "autotuned lanes: 100K=$LANES_100K 1M=$LANES_1M"
 run_stage bench_tuned 3300 env RAPID_TPU_BENCH_DEADLINE_S=1500 \
   RAPID_TPU_BENCH_ATTEMPTS=1 RAPID_TPU_BENCH_NO_SNAPSHOT=1 \
-  RAPID_TPU_BENCH_LANES_100K="$LANES_100K" RAPID_TPU_BENCH_LANES_1M="$LANES_1M" \
+  RAPID_TPU_BENCH_LANES="$LANES_100K" RAPID_TPU_BENCH_LANES_1M="$LANES_1M" \
   python -u bench.py
 grep -h '"metric"' "$OUT/bench_tuned.log" | tail -1 > "$OUT/bench_tuned.json"
 stamp_json "$OUT/bench_tuned.json"
@@ -116,6 +116,25 @@ EOF
 
 run_stage bootstrap 1200 python -u examples/bootstrap_bench.py --n 100000 --seed-size 1000
 grep -h '"scenario"' "$OUT/bootstrap.log" | tail -1 > "$OUT/bootstrap.json"
+
+# N-scaling curve, LAST (each point is a full bench run; a dying window
+# truncates the curve, never the headline artifacts above). 5% churn at
+# every N, same scenario as the headline; one JSON line per point. TPU
+# only (NO_FALLBACK): a wedge mid-sweep writes an explicit
+# accelerator_unavailable hole instead of burning the window on CPU
+# minutes. The 100K point is the bench_tuned run, not a re-measurement.
+: > "$OUT/sweep.jsonl"
+for N in 10000 50000 500000 1000000; do
+  # Nearest autotuned shape: the sweep widths were measured at 100K and 1M.
+  if [ "$N" -ge 500000 ]; then LANES="$LANES_1M"; else LANES="$LANES_100K"; fi
+  run_stage "sweep_$N" 900 env RAPID_TPU_BENCH_N="$N" RAPID_TPU_BENCH_NO_XL=1 \
+    RAPID_TPU_BENCH_DEADLINE_S=600 RAPID_TPU_BENCH_ATTEMPTS=1 \
+    RAPID_TPU_BENCH_NO_SNAPSHOT=1 RAPID_TPU_BENCH_NO_FALLBACK=1 \
+    RAPID_TPU_BENCH_LANES="$LANES" \
+    python -u bench.py
+  grep -h '"metric"' "$OUT/sweep_$N.log" | tail -1 >> "$OUT/sweep.jsonl"
+done
+grep -h '"metric"' "$OUT/bench_tuned.json" >> "$OUT/sweep.jsonl" || true
 
 echo "=== captured ==="
 ls -la "$OUT"
