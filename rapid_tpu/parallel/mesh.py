@@ -29,7 +29,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from rapid_tpu.models.state import EngineConfig, EngineState, FaultInputs
-from rapid_tpu.models.virtual_cluster import engine_step_impl
+from rapid_tpu.models.virtual_cluster import (
+    engine_step_impl,
+    run_until_membership_impl,
+)
 
 NODE_AXIS = "nodes"
 
@@ -111,6 +114,28 @@ def make_sharded_step(cfg: EngineConfig, mesh: Mesh):
         lambda state, faults: engine_step_impl(cfg, state, faults),
         in_shardings=(st_sh, ft_sh),
         out_shardings=None,  # let XLA propagate; state stays node-sharded
+        donate_argnums=(0,),
+    )
+
+
+def make_sharded_wave(cfg: EngineConfig, mesh: Mesh, max_cuts: int = 8):
+    """jit the whole-wave convergence loop (``run_until_membership_impl`` —
+    multiple view changes in one dispatch) with node-axis shardings: the
+    multi-chip twin of the single-chip bench hot path. Returns
+    ``wave(state, faults, target, max_steps, min_cuts) ->
+    (state, steps, cuts, resolved, sizes)``; the scalar observations and
+    the [max_cuts] sizes vector replicate."""
+    st_sh = state_shardings(mesh)
+    ft_sh = fault_shardings(mesh)
+
+    return jax.jit(
+        lambda state, faults, target, max_steps, min_cuts: (
+            run_until_membership_impl(
+                cfg, state, faults, target, max_steps, max_cuts, min_cuts
+            )
+        ),
+        in_shardings=(st_sh, ft_sh, None, None, None),
+        out_shardings=None,  # XLA propagates; state stays node-sharded
         donate_argnums=(0,),
     )
 

@@ -168,6 +168,46 @@ def test_sharded_convergence_parity_at_10k():
     assert_equivalent(state, single)
 
 
+def test_sharded_whole_wave_loop_matches_single_device():
+    """The multi-cut whole-wave loop (run_until_membership) under the mesh:
+    a churn that resolves through MULTIPLE sharded view changes in one
+    dispatch must match the single-device fused loop exactly — rounds,
+    cuts, per-cut sizes, final state."""
+    import jax.numpy as jnp
+
+    from rapid_tpu.parallel.mesh import make_sharded_wave
+
+    def build():
+        vc = VirtualCluster.create(
+            60, n_slots=72, cohorts=16, fd_threshold=2, seed=11,
+            delivery_spread=1,
+        )
+        vc.assign_cohorts_roundrobin()
+        vc.crash([7, 31])
+        # Staggered detection pushes the crash cut BEHIND the join cut, so
+        # the wave must commit >= 2 sharded view changes in one dispatch.
+        vc.stagger_fd_counts(np.random.default_rng(5), spread_rounds=8)
+        vc.inject_join_wave(list(range(60, 72)))
+        return vc
+
+    single = build()
+    r1, c1, resolved1, sizes1 = single.run_until_membership(70, min_cuts=1)
+    assert resolved1 and c1 >= 2  # the scenario genuinely multi-cuts
+
+    vc = build()
+    mesh = make_mesh()
+    wave = make_sharded_wave(vc.cfg, mesh, max_cuts=8)
+    state, steps, cuts, resolved, sizes = wave(
+        shard_state(vc.state, mesh), shard_faults(vc.faults, mesh),
+        jnp.int32(70), jnp.int32(192), jnp.int32(1),
+    )
+    assert bool(resolved)
+    assert (int(steps), int(cuts)) == (r1, c1)
+    assert tuple(np.asarray(sizes)[: int(cuts)].tolist()) == sizes1
+    assert int(state.n_members) == 70
+    assert_equivalent(state, single)
+
+
 def test_sharded_join_wave_matches_single_device():
     """The JOIN path under a mesh: inject_join_wave's device-side
     gather/scatter (ring-predecessor lookup, obs_idx/fd columns) runs on
