@@ -91,3 +91,48 @@ def test_decided_cuts_touch_only_faulted_and_joining(
     healthy = np.ones(N, dtype=bool)
     healthy[victims] = False
     assert alive[:N][healthy].all(), "healthy member evicted"
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    seed=st.integers(0, 2**31 - 1),
+    n_victims=st.integers(1, 6),
+    n_joiners=st.integers(0, 8),
+    spread_used=st.booleans(),
+)
+def test_fused_wave_matches_sequential_decisions(
+    seed, n_victims, n_joiners, spread_used
+):
+    # For EVERY fault/join pattern, the whole-wave single-dispatch loop
+    # (run_until_membership) must commit exactly what the per-decision
+    # driver commits: same rounds, same cut count, same final view. Shapes
+    # fixed so all examples share the two compiled executables.
+    rng = np.random.default_rng(seed ^ 0x5A5A)
+    victims = sorted(rng.choice(N, size=n_victims, replace=False).tolist())
+    joiners = list(range(N, N + n_joiners))
+    target = N - n_victims + n_joiners
+
+    def build():
+        return run_scenario(seed, victims, joiners, 8, spread_used)
+
+    seq = build()
+    seq_rounds, seq_cuts = 0, 0
+    while seq.membership_size != target or seq_cuts == 0:
+        rounds, decided, _, _ = seq.run_to_decision(max_steps=64)
+        assert decided, "sequential driver did not converge"
+        seq_rounds += rounds
+        seq_cuts += 1
+        assert seq_cuts <= 8
+
+    fused = build()
+    # Same total budget as the sequential reference (8 cuts x 64 rounds):
+    # the fused loop's max_steps is cumulative across cuts.
+    fused_budget = 8 * 64
+    rounds, cuts, resolved, sizes = fused.run_until_membership(
+        target, max_steps=fused_budget, min_cuts=1
+    )
+    assert resolved
+    assert (rounds, cuts) == (seq_rounds, seq_cuts)
+    assert sizes[-1] == target
+    np.testing.assert_array_equal(fused.alive_mask, seq.alive_mask)
+    assert fused.config_id == seq.config_id
