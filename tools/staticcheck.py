@@ -346,7 +346,9 @@ def check_call_signatures(path: Path, source: Optional[str] = None) -> List[Find
 # Driver
 # ---------------------------------------------------------------------------
 
-DEFAULT_ROOTS = ("rapid_tpu", "tests", "examples", "bench.py", "__graft_entry__.py")
+DEFAULT_ROOTS = (
+    "rapid_tpu", "tests", "examples", "tools", "bench.py", "__graft_entry__.py"
+)
 
 
 def iter_files(roots: Sequence[str] = DEFAULT_ROOTS) -> Iterable[Path]:
@@ -371,10 +373,14 @@ def _rel(path: Path) -> str:
 
 def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
     # Mirror pytest's rootdir behavior: test modules import suite-local
-    # helpers both as `tests.helpers` and bare `helpers`.
+    # helpers both as `tests.helpers` and bare `helpers`. Insert at the
+    # FRONT: `tools`/`tests` are common top-level names, and a foreign
+    # package earlier on sys.path would shadow this repo's namespace
+    # packages and produce spurious import-error findings.
     for entry in (str(REPO), str(REPO / "tests")):
-        if entry not in sys.path:
-            sys.path.append(entry)
+        if entry in sys.path:
+            sys.path.remove(entry)
+        sys.path.insert(0, entry)
     findings: List[Finding] = []
     for path in iter_files(roots):
         findings.extend(check_undefined_names(path))
