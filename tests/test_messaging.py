@@ -235,3 +235,59 @@ async def test_inprocess_broadcast_fan_out():
             break
         await asyncio.sleep(0.01)
     assert all(len(s.received) == 1 for s in services)
+
+
+@async_test
+async def test_tcp_server_survives_hostile_bytes():
+    # Connection-level fault isolation (the reference's gRPC layer gets this
+    # from the framework; our framing must provide it): a peer sending an
+    # oversized frame header or a well-framed but undecodable payload must
+    # cost only ITS connection — a legitimate client is served throughout.
+    server = TcpServer(Endpoint("127.0.0.1", 0))  # ephemeral port
+    server.set_membership_service(EchoService())
+    await server.start()
+    addr = server.listen_address
+    client = TcpClient(Endpoint("127.0.0.1", 0))
+    try:
+        import struct
+
+        # Oversized length in the header: server must drop the connection.
+        r1, w1 = await asyncio.open_connection(addr.hostname, addr.port)
+        w1.write(struct.pack("<IQB", 1 << 30, 0, 0))
+        await w1.drain()
+        assert await r1.read(64) == b""  # peer closed on us
+        w1.close()
+
+        # Valid header, garbage payload: handler swallows the CodecError.
+        from tests.helpers import wait_until
+
+        rx_before = server.stats.msgs_rx
+        r2, w2 = await asyncio.open_connection(addr.hostname, addr.port)
+        payload = b"\xff" * 16
+        w2.write(struct.pack("<IQB", len(payload), 7, 0) + payload)
+        await w2.drain()
+        # Happens-before: the server has READ the hostile frame (rx counts
+        # at frame receipt) before any isolation assertion below — without
+        # this, the probes could win the race and the test pass vacuously.
+        await wait_until(lambda: server.stats.msgs_rx > rx_before)
+
+        # The hostile CONNECTION itself survives a decode failure: a valid
+        # probe on the same socket still gets a framed response.
+        me = Endpoint("127.0.0.1", 0)
+        good = encode_request(ProbeMessage(sender=me))
+        w2.write(struct.pack("<IQB", len(good), 9, 0) + good)
+        await w2.drain()
+        resp_header = await asyncio.wait_for(r2.readexactly(13), timeout=10)
+        resp_len, corr, kind = struct.unpack("<IQB", resp_header)
+        assert (corr, kind) == (9, 1)
+        resp = decode_response(await asyncio.wait_for(r2.readexactly(resp_len), 10))
+        assert resp == ProbeResponse()
+
+        # And the real client is unaffected, before and after the hostile
+        # peer disconnects mid-session.
+        assert await client.send(addr, ProbeMessage(sender=me)) == ProbeResponse()
+        w2.close()
+        assert await client.send(addr, ProbeMessage(sender=me)) == ProbeResponse()
+    finally:
+        await client.shutdown()
+        await server.shutdown()
