@@ -1,0 +1,102 @@
+"""The bench's TPU-snapshot fallback (bench._emit_tpu_snapshot): the driver's
+perf artifact depends on this path whenever the accelerator tunnel is wedged,
+so its gating rules are pinned here — a snapshot only stands in for the SAME
+workload, only ever replays a real TPU capture, prefers the newest stamp, and
+always discloses its provenance.
+"""
+
+import json
+
+import pytest
+
+import bench
+
+
+def _capture(n=100_000, platform="tpu", value=100.9, stamp="2026-07-29T14:06:21Z"):
+    return {
+        "metric": f"churn_resolution_ms_n{n}_churn5pct",
+        "value": value,
+        "unit": "ms",
+        "platform": platform,
+        "n_members": n,
+        "captured_at": stamp,
+    }
+
+
+def _emit(monkeypatch, capsys, files, env=None):
+    """Run _emit_tpu_snapshot against a synthetic evidence set; returns the
+    (bool result, parsed stdout JSON or None)."""
+    # Scrub ambient bench env (a capture/sweep session exports these): the
+    # synthetic evidence set must be the only input.
+    for name in ("RAPID_TPU_BENCH_SNAPSHOT", "RAPID_TPU_BENCH_N"):
+        monkeypatch.delenv(name, raising=False)
+    for name, value in (env or {}).items():
+        monkeypatch.setenv(name, value)
+    monkeypatch.setattr(
+        bench.glob, "glob", lambda pattern: [str(p) for p in files]
+    )
+    ok = bench._emit_tpu_snapshot()
+    out = capsys.readouterr().out.strip()
+    return ok, (json.loads(out) if out else None)
+
+
+def test_replays_newest_tpu_capture_with_provenance(tmp_path, monkeypatch, capsys):
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_capture(value=140.0, stamp="2026-07-28T10:00:00Z")))
+    new = tmp_path / "new.json"
+    new.write_text(json.dumps(_capture(value=100.9, stamp="2026-07-29T14:06:21Z")))
+
+    ok, data = _emit(monkeypatch, capsys, [old, new])
+    assert ok
+    assert data["value"] == 100.9  # newest stamp wins, not best value
+    assert data["platform"] == "tpu"
+    # A replay must be distinguishable from a live run.
+    assert data["capture"] == "session_snapshot"
+    assert data["live_attempt"] == "wedged"
+    assert data["snapshot_path"]
+    assert data["captured_at"] == "2026-07-29T14:06:21Z"
+
+
+def test_never_replays_a_different_workload(tmp_path, monkeypatch, capsys):
+    # A smoke run at N=2000 must not replay the 100K capture, and vice versa.
+    f = tmp_path / "bench.json"
+    f.write_text(json.dumps(_capture(n=100_000)))
+    ok, data = _emit(
+        monkeypatch, capsys, [f], env={"RAPID_TPU_BENCH_N": "2000"}
+    )
+    assert not ok and data is None
+
+
+def test_never_replays_a_cpu_measurement(tmp_path, monkeypatch, capsys):
+    f = tmp_path / "bench.json"
+    f.write_text(json.dumps(_capture(platform="cpu")))
+    ok, data = _emit(monkeypatch, capsys, [f])
+    assert not ok and data is None
+
+
+@pytest.mark.parametrize("content", ["", "not json{", json.dumps(["list"]),
+                                     json.dumps({"platform": "tpu"})])
+def test_tolerates_malformed_or_incomplete_candidates(
+    content, tmp_path, monkeypatch, capsys
+):
+    # Corrupt/incomplete files are skipped, never crash the fallback.
+    bad = tmp_path / "bad.json"
+    bad.write_text(content)
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_capture()))
+    ok, data = _emit(monkeypatch, capsys, [bad, good])
+    assert ok and data["value"] == 100.9
+
+
+def test_explicit_snapshot_env_overrides_discovery(tmp_path, monkeypatch, capsys):
+    chosen = tmp_path / "chosen.json"
+    chosen.write_text(json.dumps(_capture(value=88.8)))
+    ignored = tmp_path / "ignored.json"
+    ignored.write_text(json.dumps(_capture(value=55.5, stamp="2026-07-30T00:00:00Z")))
+
+    # Discovery must not even run (glob would only find the 'ignored' file).
+    ok, data = _emit(
+        monkeypatch, capsys, [ignored],
+        env={"RAPID_TPU_BENCH_SNAPSHOT": str(chosen)},
+    )
+    assert ok and data["value"] == 88.8
