@@ -5,7 +5,7 @@ from rapid_tpu.ops.cut_detection import (
     alerts_to_report_matrix,
     process_alert_batch,
 )
-from rapid_tpu.ops.hashing import join64, lex_argsort, masked_set_hash, mix32, split64
+from rapid_tpu.ops.hashing import lex_argsort, masked_set_hash, mix32
 from rapid_tpu.ops.rings import (
     RingTopology,
     endpoint_ring_keys,
@@ -23,11 +23,9 @@ __all__ = [
     "CutState",
     "alerts_to_report_matrix",
     "process_alert_batch",
-    "join64",
     "lex_argsort",
     "masked_set_hash",
     "mix32",
-    "split64",
     "RingTopology",
     "endpoint_ring_keys",
     "predecessor_of_keys",

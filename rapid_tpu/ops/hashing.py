@@ -9,22 +9,6 @@ compiles to efficient on-device sorts.
 from __future__ import annotations
 
 import jax.numpy as jnp
-import numpy as np
-
-
-def split64(values) -> tuple:
-    """Split an array/list of python 64-bit ints into (hi, lo) uint32 arrays."""
-    arr = np.asarray([int(v) & 0xFFFFFFFFFFFFFFFF for v in values], dtype=np.uint64)
-    hi = (arr >> np.uint64(32)).astype(np.uint32)
-    lo = (arr & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-    return jnp.asarray(hi), jnp.asarray(lo)
-
-
-def join64(hi, lo) -> np.ndarray:
-    """Rejoin device (hi, lo) uint32 lanes into numpy uint64 (host side)."""
-    hi = np.asarray(hi, dtype=np.uint64)
-    lo = np.asarray(lo, dtype=np.uint64)
-    return (hi << np.uint64(32)) | lo
 
 
 def lex_argsort(keys: tuple) -> jnp.ndarray:
