@@ -76,12 +76,6 @@ class NodeStatus(enum.IntEnum):
 Metadata = Dict[str, bytes]
 
 
-def freeze_metadata(metadata: Optional[Metadata]) -> Tuple[Tuple[str, bytes], ...]:
-    if not metadata:
-        return ()
-    return tuple(sorted(metadata.items()))
-
-
 # --------------------------------------------------------------------------
 # Request messages (the RapidRequest oneof, rapid.proto:21-35)
 # --------------------------------------------------------------------------

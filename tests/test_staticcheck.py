@@ -202,6 +202,71 @@ def test_finding_points_at_the_offending_read():
     assert [f.lineno for f in findings] == [5]  # the read, not `def f` (2)
 
 
+def _dead_defs(tmp_path):
+    import ast
+
+    contributions = [
+        (ast.parse(p.read_text()), p.name) for p in sorted(tmp_path.glob("*.py"))
+    ]
+    return staticcheck.check_dead_definitions(contributions)
+
+
+def test_dead_definition_is_caught(tmp_path):
+    (tmp_path / "mod_a.py").write_text(textwrap.dedent(
+        """
+        def used(): return 1
+        def never_called(): return 2
+        class Orphan: pass
+        def lonely_recursive():
+            return lonely_recursive()  # self-reference must not keep it alive
+        """
+    ))
+    (tmp_path / "mod_b.py").write_text("from mod_a import used\nprint(used())\n")
+    assert sorted(f.message for f in _dead_defs(tmp_path)) == [
+        "module-level 'Orphan' is referenced nowhere in the tree",
+        "module-level 'lonely_recursive' is referenced nowhere in the tree",
+        "module-level 'never_called' is referenced nowhere in the tree",
+    ]
+    # The bare re-export import did NOT count as the use — mod_b calling
+    # used() did. Export padding cannot hide dead code:
+    (tmp_path / "mod_b.py").write_text(
+        "from mod_a import never_called\n__all__ = ['never_called']\n"
+    )
+    assert any("never_called" in f.message for f in _dead_defs(tmp_path))
+
+
+def test_dead_definition_liveness_channels(tmp_path):
+    # The ways a def stays alive without a plain call: pytest collection
+    # (test_/Test*), fixture-by-parameter-name, identifiers inside
+    # code-looking strings (subprocess job payloads), and entry points.
+    (tmp_path / "mod.py").write_text(textwrap.dedent(
+        '''
+        def my_fixture(): return 3
+        def job_callee(): return 4
+        def main(): return 5
+        class TestThings:
+            def helper(self): pass
+        def test_stuff(my_fixture):
+            return my_fixture
+        JOB = """
+        from mod import job_callee
+        job_callee()
+        """
+        '''
+    ))
+    assert _dead_defs(tmp_path) == []
+
+
+def test_narrowed_roots_skip_liveness(tmp_path, monkeypatch):
+    # A per-file/per-dir CLI run must not report cross-root consumers'
+    # definitions as dead: liveness only runs on full-tree invocations.
+    (tmp_path / "only.py").write_text("def consumed_elsewhere(): return 1\n")
+    monkeypatch.setattr(staticcheck, "REPO", tmp_path)
+    monkeypatch.syspath_prepend(str(tmp_path))
+    findings = staticcheck.run([str(tmp_path / "only.py")])
+    assert findings == []
+
+
 def test_whole_tree_is_finding_free():
     # The gate itself: resolution-tier findings fail the build exactly the
     # way error-prone fails the reference's.

@@ -40,6 +40,7 @@ import ast
 import builtins
 import importlib
 import inspect
+import re
 import symtable
 import sys
 import types
@@ -120,11 +121,16 @@ def _undefined_in_table(
         _undefined_in_table(child, bound, rel, load_lines, findings)
 
 
-def check_undefined_names(path: Path, source: Optional[str] = None) -> List[Finding]:
+def check_undefined_names(
+    path: Path,
+    source: Optional[str] = None,
+    tree: "Optional[ast.AST]" = None,
+) -> List[Finding]:
     """Every name resolving through the global scope must exist there."""
     src = source if source is not None else path.read_text()
     rel = _rel(path)
-    tree = ast.parse(src, filename=str(path))
+    if tree is None:
+        tree = ast.parse(src, filename=str(path))
     for node in ast.walk(tree):
         if isinstance(node, ast.ImportFrom) and any(
             a.name == "*" for a in node.names
@@ -260,12 +266,17 @@ def _check_one_call(
         )
 
 
-def check_call_signatures(path: Path, source: Optional[str] = None) -> List[Finding]:
+def check_call_signatures(
+    path: Path,
+    source: Optional[str] = None,
+    tree: "Optional[ast.AST]" = None,
+) -> List[Finding]:
     """Arity/keyword conformance for statically-resolvable call sites, plus
     existence of ``mod.attr`` references on module-level module imports."""
     src = source if source is not None else path.read_text()
     rel = _rel(path)
-    tree = ast.parse(src, filename=str(path))
+    if tree is None:
+        tree = ast.parse(src, filename=str(path))
     mod_name = _module_name_for(path)
     if mod_name is None:
         return []
@@ -343,12 +354,97 @@ def check_call_signatures(path: Path, source: Optional[str] = None) -> List[Find
 
 
 # ---------------------------------------------------------------------------
-# Driver
+# Check 3: dead module-level definitions (tree-wide liveness)
 # ---------------------------------------------------------------------------
 
 DEFAULT_ROOTS = (
     "rapid_tpu", "tests", "examples", "tools", "bench.py", "__graft_entry__.py"
 )
+
+_DEF_ALLOW_PREFIXES = ("test_", "Test", "pytest_", "__")
+_DEF_ALLOW_NAMES = {"main", "entry", "dryrun_multichip"}  # external entry points
+_IDENT = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+def _collect_definitions(tree: ast.AST, rel: str):
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            yield node.name, rel, node.lineno
+
+
+def _collect_references(tree: ast.AST) -> set:
+    """Every way a module-level definition can be consumed: name loads,
+    attribute accesses, function parameter names (pytest fixtures are used
+    by naming them as parameters), and identifiers inside CODE-LOOKING
+    string constants (multi-line or call-shaped — subprocess job scripts,
+    ``python -c`` payloads). Single-word strings deliberately do NOT count:
+    an ``__all__`` entry must not keep an otherwise-unreferenced export
+    alive — re-export padding is exactly what this check exists to catch.
+
+    A module-level definition's OWN subtree never contributes its own name:
+    a dead recursive helper (or a class naming itself in a method) must not
+    keep itself alive.
+    """
+
+    def walk(node, self_name):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id != self_name:
+                refs.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            if node.attr != self_name:
+                refs.add(node.attr)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            a = node.args
+            for arg in [*a.posonlyargs, *a.args, *a.kwonlyargs]:
+                refs.add(arg.arg)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if "\n" in node.value or "(" in node.value:
+                refs.update(w for w in _IDENT.findall(node.value) if w != self_name)
+        for child in ast.iter_child_nodes(node):
+            walk(child, self_name)
+
+    refs: set = set()
+    for stmt in getattr(tree, "body", []):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            for child in ast.iter_child_nodes(stmt):
+                walk(child, stmt.name)
+        else:
+            walk(stmt, None)
+    return refs
+
+
+def check_dead_definitions(
+    contributions: "List[Tuple[ast.AST, str]]",
+) -> List[Finding]:
+    """Module-level functions/classes referenced NOWHERE in the tree.
+
+    Takes (tree, relpath) pairs for the WHOLE analyzed tree — liveness is
+    only meaningful over the full root set, so run() skips this check when
+    the CLI narrows the roots. Tree-wide, name-based (not resolution-based):
+    a name collision anywhere keeps a definition alive, so every finding is
+    a definition no file could be using. The repo's standard is that
+    unconsumed code is deleted, not exported (the Mosaic watermark kernel
+    precedent)."""
+    defs: List[Tuple[str, str, int]] = []
+    refs: set = set()
+    for tree, rel in contributions:
+        defs.extend(_collect_definitions(tree, rel))
+        refs |= _collect_references(tree)
+    findings = []
+    for name, rel, lineno in defs:
+        if name.startswith(_DEF_ALLOW_PREFIXES) or name in _DEF_ALLOW_NAMES:
+            continue
+        if name not in refs:
+            findings.append(
+                Finding(rel, lineno, "dead-definition",
+                        f"module-level {name!r} is referenced nowhere in the tree")
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
 
 
 def iter_files(roots: Sequence[str] = DEFAULT_ROOTS) -> Iterable[Path]:
@@ -382,9 +478,18 @@ def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
             sys.path.remove(entry)
         sys.path.insert(0, entry)
     findings: List[Finding] = []
+    trees: List[Tuple[ast.AST, str]] = []  # one parse per file, shared
     for path in iter_files(roots):
-        findings.extend(check_undefined_names(path))
-        findings.extend(check_call_signatures(path))
+        src = path.read_text()
+        tree = ast.parse(src, filename=str(path))
+        trees.append((tree, _rel(path)))
+        findings.extend(check_undefined_names(path, src, tree))
+        findings.extend(check_call_signatures(path, src, tree))
+    if tuple(roots) == DEFAULT_ROOTS:
+        # Liveness is only meaningful over the FULL tree: with narrowed CLI
+        # roots, code consumed from outside the subset would be reported as
+        # dead — so the check runs only on complete invocations.
+        findings.extend(check_dead_definitions(trees))
     return findings
 
 
