@@ -121,12 +121,12 @@ class EchoService:
 
 @async_test
 async def test_tcp_round_trip():
-    addr = Endpoint("127.0.0.1", 19001)
-    server = TcpServer(addr)
+    server = TcpServer(Endpoint("127.0.0.1", 0))  # ephemeral port
     service = EchoService()
     server.set_membership_service(service)
     await server.start()
-    client = TcpClient(Endpoint("127.0.0.1", 19002))
+    addr = server.listen_address
+    client = TcpClient(Endpoint("127.0.0.1", 0))
     try:
         response = await client.send(addr, ProbeMessage(sender=Endpoint("127.0.0.1", 19002)))
         assert response == ProbeResponse()
@@ -140,10 +140,10 @@ async def test_tcp_round_trip():
 
 @async_test
 async def test_tcp_probe_answers_bootstrapping_before_service():
-    addr = Endpoint("127.0.0.1", 19003)
-    server = TcpServer(addr)  # no service set
+    server = TcpServer(Endpoint("127.0.0.1", 0))  # no service set; ephemeral
     await server.start()
-    client = TcpClient(Endpoint("127.0.0.1", 19004))
+    addr = server.listen_address
+    client = TcpClient(Endpoint("127.0.0.1", 0))
     try:
         response = await client.send_best_effort(addr, ProbeMessage(sender=addr))
         assert response == ProbeResponse(NodeStatus.BOOTSTRAPPING)
@@ -156,18 +156,16 @@ async def test_tcp_probe_answers_bootstrapping_before_service():
 async def test_tcp_ten_servers_fan_out():
     # NettyClientServerTest's 10-server round-trip analog.
     servers, services = [], []
-    base = 19010
     for i in range(10):
-        addr = Endpoint("127.0.0.1", base + i)
-        server = TcpServer(addr)
+        server = TcpServer(Endpoint("127.0.0.1", 0))  # ephemeral ports
         service = EchoService()
         server.set_membership_service(service)
         await server.start()
         servers.append(server)
         services.append(service)
-    client = TcpClient(Endpoint("127.0.0.1", 18999))
+    client = TcpClient(Endpoint("127.0.0.1", 0))
     broadcaster = UnicastToAllBroadcaster(client)
-    broadcaster.set_membership([Endpoint("127.0.0.1", base + i) for i in range(10)])
+    broadcaster.set_membership([s.listen_address for s in servers])
     try:
         broadcaster.broadcast(LeaveMessage(sender=Endpoint("127.0.0.1", 18999)))
         for _ in range(100):
