@@ -220,7 +220,9 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
     return fd
 
 
-_FILE = _POOL.Add(_build_file())
+# Register the runtime-built descriptor file; the pool retains it (the
+# binding would never be read — registration is the point).
+_POOL.Add(_build_file())
 
 _CLASSES = {
     name: message_factory.GetMessageClass(_POOL.FindMessageTypeByName(f"remoting.{name}"))

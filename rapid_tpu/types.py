@@ -14,7 +14,7 @@ from __future__ import annotations
 import enum
 import uuid as _uuid
 from dataclasses import dataclass
-from typing import Dict, Optional, Tuple, Union
+from typing import Optional, Tuple, Union
 
 
 @dataclass(frozen=True, order=True)
@@ -71,9 +71,6 @@ class NodeStatus(enum.IntEnum):
 
     OK = 0
     BOOTSTRAPPING = 1
-
-
-Metadata = Dict[str, bytes]
 
 
 # --------------------------------------------------------------------------
@@ -215,14 +212,6 @@ RapidRequest = Union[
     LeaveMessage,
     GossipMessage,
 ]
-
-CONSENSUS_MESSAGE_TYPES = (
-    FastRoundPhase2bMessage,
-    Phase1aMessage,
-    Phase1bMessage,
-    Phase2aMessage,
-    Phase2bMessage,
-)
 
 
 # --------------------------------------------------------------------------

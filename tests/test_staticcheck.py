@@ -219,11 +219,15 @@ def test_dead_definition_is_caught(tmp_path):
         class Orphan: pass
         def lonely_recursive():
             return lonely_recursive()  # self-reference must not keep it alive
+        STALE_TABLE = {"a": 1}
+        RETRY = lambda n: RETRY(n - 1)  # self-mention must not keep it alive
         """
     ))
     (tmp_path / "mod_b.py").write_text("from mod_a import used\nprint(used())\n")
     assert sorted(f.message for f in _dead_defs(tmp_path)) == [
         "module-level 'Orphan' is referenced nowhere in the tree",
+        "module-level 'RETRY' is referenced nowhere in the tree",
+        "module-level 'STALE_TABLE' is referenced nowhere in the tree",
         "module-level 'lonely_recursive' is referenced nowhere in the tree",
         "module-level 'never_called' is referenced nowhere in the tree",
     ]
@@ -252,6 +256,7 @@ def test_dead_definition_liveness_channels(tmp_path):
         from mod import job_callee
         job_callee()
         """
+        print(JOB)
         '''
     ))
     assert _dead_defs(tmp_path) == []

@@ -370,6 +370,15 @@ def _collect_definitions(tree: ast.AST, rel: str):
     for node in tree.body:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
             yield node.name, rel, node.lineno
+        # Simple module constants too (plain Name targets only: tuple
+        # unpacking legitimately discards elements, so it is out of scope;
+        # dunders like __all__ fall to the allowlist).
+        elif isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    yield target.id, rel, node.lineno
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            yield node.target.id, rel, node.lineno
 
 
 def _collect_references(tree: ast.AST) -> set:
@@ -382,8 +391,9 @@ def _collect_references(tree: ast.AST) -> set:
     alive — re-export padding is exactly what this check exists to catch.
 
     A module-level definition's OWN subtree never contributes its own name:
-    a dead recursive helper (or a class naming itself in a method) must not
-    keep itself alive.
+    a dead recursive helper, a class naming itself in a method, or a
+    constant whose initializer/mutation mentions itself must not keep
+    itself alive.
     """
 
     def walk(node, self_name):
@@ -408,6 +418,14 @@ def _collect_references(tree: ast.AST) -> set:
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
             for child in ast.iter_child_nodes(stmt):
                 walk(child, stmt.name)
+        elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and isinstance(
+            stmt.targets[0], ast.Name
+        ):
+            walk(stmt.value, stmt.targets[0].id)
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            walk(stmt.annotation, None)  # the type names ARE references
+            if stmt.value is not None:
+                walk(stmt.value, stmt.target.id)
         else:
             walk(stmt, None)
     return refs
@@ -416,7 +434,7 @@ def _collect_references(tree: ast.AST) -> set:
 def check_dead_definitions(
     contributions: "List[Tuple[ast.AST, str]]",
 ) -> List[Finding]:
-    """Module-level functions/classes referenced NOWHERE in the tree.
+    """Module-level functions/classes/constants referenced NOWHERE in the tree.
 
     Takes (tree, relpath) pairs for the WHOLE analyzed tree — liveness is
     only meaningful over the full root set, so run() skips this check when
