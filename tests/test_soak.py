@@ -156,3 +156,38 @@ def test_engine_mixed_lifecycle_soak_with_jitter_and_windowed_fd():
 
     assert int(vc.state.rounds_undecided) == 0
     assert not bool(np.asarray(vc.state.announced).any())
+
+
+def test_fused_wave_churn_soak_twenty_epochs():
+    # The whole-wave dispatch across MANY configurations: per-configuration
+    # state resets (cut detector, votes, FD counters, classic acceptors)
+    # must survive repeated on-device view-change application inside the
+    # fused loop, not just the per-step driver the soak above exercises.
+    n_slots = 1100
+    vc = VirtualCluster.create(800, n_slots=n_slots, fd_threshold=2, seed=31,
+                               cohorts=16, delivery_spread=2)
+    vc.assign_cohorts_roundrobin()
+    rng = np.random.default_rng(31)
+    expected, dead, next_join = 800, set(), 800
+    for epoch in range(20):
+        if epoch % 2 == 0:
+            alive_slots = np.nonzero(vc.alive_mask)[0]
+            victims = rng.choice(alive_slots, size=max(2, expected // 80),
+                                 replace=False)
+            vc.crash(victims)
+            dead.update(int(v) for v in victims)
+            expected -= len(victims)
+        else:
+            wave = list(range(next_join, min(next_join + 10, n_slots)))
+            if not wave:
+                continue  # no churn injected -> min_cuts=1 could never resolve
+            vc.inject_join_wave(wave)
+            next_join += len(wave)
+            expected += len(wave)
+        rounds, cuts, resolved, sizes = vc.run_until_membership(
+            expected, min_cuts=1, max_steps=512
+        )
+        assert resolved, (epoch, rounds, cuts, sizes, vc.membership_size)
+        assert vc.membership_size == expected
+        assert sizes[-1] == expected  # the instrument agrees with the fetch
+        assert not vc.alive_mask[sorted(dead)].any()
