@@ -35,3 +35,25 @@ if _plat == "cpu":
 from rapid_tpu.utils._native import ensure_built
 
 ensure_built()
+
+
+# Property-test budget dial: HYPOTHESIS_PROFILE=thorough multiplies every
+# property/fuzz test's example budget 5x (nightly / pre-release depth).
+# Hypothesis profiles can't do this (per-test @settings decorators take
+# precedence over a loaded profile), so the dial scales each collected
+# test's decorator settings instead — the attachment point hypothesis
+# reads at call time. Default runs keep the committed per-test budgets.
+import hypothesis
+
+if os.environ.get("HYPOTHESIS_PROFILE") == "thorough":
+
+    def pytest_collection_modifyitems(items):
+        scaled = set()  # parametrized items share one function: scale ONCE
+        for item in items:
+            fn = getattr(item, "function", None)
+            spec = getattr(fn, "_hypothesis_internal_use_settings", None)
+            if spec is not None and id(fn) not in scaled:
+                scaled.add(id(fn))
+                fn._hypothesis_internal_use_settings = hypothesis.settings(
+                    spec, max_examples=spec.max_examples * 5
+                )
