@@ -52,6 +52,45 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _autotuned_lanes(n: int, env_name: str, default: int = 128) -> int:
+    """Delivery-kernel tile width for an N-slot shape: the caller's env
+    override if set (RAPID_TPU_BENCH_LANES for the main workload at any N —
+    the capture sweep plumbs per-point widths through it — and
+    RAPID_TPU_BENCH_LANES_1M for the separate XL point), else the best
+    width from the newest committed autotune evidence
+    (evidence/*/autotune.jsonl by mtime, written on hardware by
+    examples/delivery_autotune.py) for the nearest measured shape — so a
+    driver-invoked live run benefits from captured tuning with no env
+    plumbing. Falls back to the default width on any gap."""
+    if os.environ.get(env_name, ""):
+        return _env_int(env_name, default)
+    root = os.path.dirname(os.path.abspath(__file__))
+    paths = glob.glob(os.path.join(root, "evidence", "*", "autotune.jsonl"))
+    try:
+        paths.sort(key=os.path.getmtime)  # oldest first; newest overwrites
+    except OSError:
+        paths.sort()
+    best: dict = {}
+    for path in paths:
+        try:
+            lines = open(path).read().splitlines()
+        except OSError:
+            continue
+        for line in lines:
+            try:
+                d = json.loads(line)
+                width = d.get("best_width")
+                # Trust only sane hardware-measured widths.
+                if d.get("platform") == "tpu" and width in (128, 256, 512, 1024):
+                    best[d["shape"][1]] = width
+            except (json.JSONDecodeError, KeyError, IndexError, TypeError):
+                continue  # one bad line never poisons the rest
+    if not best:
+        return default
+    nearest = min(best, key=lambda shape_n: abs(shape_n - n))
+    return best[nearest]
+
+
 def _mark(msg: str) -> None:
     """Timestamped progress line on stderr: the parent watchdog treats each
     mark as proof of liveness, and a driver-side timeout log shows exactly
@@ -107,6 +146,9 @@ def run_workload() -> None:
 
     use_pallas = pallas_usable()
     _mark(f"pallas kernel usable: {use_pallas}")
+    # Resolved once: env override or newest committed autotune evidence.
+    lanes_main = _autotuned_lanes(n, "RAPID_TPU_BENCH_LANES")
+    lanes_xl = _autotuned_lanes(1_000_000, "RAPID_TPU_BENCH_LANES_1M")
     if platform == "tpu" and not use_pallas:
         print("bench: pallas kernel unusable; using jnp core", file=sys.stderr)
 
@@ -123,9 +165,7 @@ def run_workload() -> None:
             use_pallas=use_pallas,
             delivery_spread=delivery_spread,
             concurrent_coordinators=2,
-            # Delivery-kernel lane-tile width for the MAIN workload (any N);
-            # autotuned per shape on hardware (examples/delivery_autotune.py).
-            pallas_lanes=_env_int("RAPID_TPU_BENCH_LANES", 128),
+            pallas_lanes=lanes_main,
         )
         vc.assign_cohorts_roundrobin()
         rng = np.random.default_rng(seed + 1000)
@@ -221,7 +261,7 @@ def run_workload() -> None:
                 seed=seed,
                 use_pallas=use_pallas,
                 delivery_spread=delivery_spread,
-                pallas_lanes=_env_int("RAPID_TPU_BENCH_LANES_1M", 128),
+                pallas_lanes=lanes_xl,
             )
             vcx.assign_cohorts_roundrobin()
             vcx.crash(
@@ -267,11 +307,11 @@ def run_workload() -> None:
                 # Delivery-kernel tile width in effect for the main workload
                 # (autotune provenance); the 1M width only when the separate
                 # 1M point ran.
-                "pallas_lanes": _env_int("RAPID_TPU_BENCH_LANES", 128),
+                "pallas_lanes": lanes_main,
                 **(
                     {
                         "n1M_crash1pct_ms": round(xl_ms, 3),
-                        "lanes_1m": _env_int("RAPID_TPU_BENCH_LANES_1M", 128),
+                        "lanes_1m": lanes_xl,
                     }
                     if xl_ms is not None
                     else {}

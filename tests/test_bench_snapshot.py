@@ -100,3 +100,41 @@ def test_explicit_snapshot_env_overrides_discovery(tmp_path, monkeypatch, capsys
         env={"RAPID_TPU_BENCH_SNAPSHOT": str(chosen)},
     )
     assert ok and data["value"] == 88.8
+
+
+def test_autotuned_lanes_resolution(tmp_path, monkeypatch):
+    # Width resolution order: env override first; else newest committed
+    # autotune evidence, nearest measured shape; else the default. Garbage
+    # lines and non-TPU or insane widths never poison the choice.
+    for name in ("RAPID_TPU_BENCH_LANES", "RAPID_TPU_BENCH_LANES_1M"):
+        monkeypatch.delenv(name, raising=False)
+    evdir = tmp_path / "evidence" / "round9"
+    evdir.mkdir(parents=True)
+    (evdir / "autotune.jsonl").write_text(
+        json.dumps({"platform": "tpu", "best_width": 999}) + "\n"  # no shape: skipped
+        + json.dumps({"platform": "tpu", "shape": [64, 100_000], "best_width": 256}) + "\n"
+        + json.dumps({"platform": "tpu", "shape": [8, 1_000_000], "best_width": 512}) + "\n"
+        + json.dumps({"platform": "cpu", "shape": [64, 100_000], "best_width": 1024}) + "\n"
+        + json.dumps({"platform": "tpu", "shape": [8, 500_000], "best_width": 7}) + "\n"
+        + "not json{\n"
+    )
+    monkeypatch.setattr(
+        bench.glob, "glob", lambda pattern: [str(evdir / "autotune.jsonl")]
+    )
+    MAIN, XL = "RAPID_TPU_BENCH_LANES", "RAPID_TPU_BENCH_LANES_1M"
+    assert bench._autotuned_lanes(100_000, MAIN) == 256   # exact shape
+    assert bench._autotuned_lanes(90_000, MAIN) == 256    # nearest shape
+    assert bench._autotuned_lanes(1_000_000, XL) == 512
+    # The sweep plumbs per-point widths through the MAIN env at any N.
+    monkeypatch.setenv(MAIN, "1024")
+    assert bench._autotuned_lanes(100_000, MAIN) == 1024  # env wins
+    assert bench._autotuned_lanes(1_000_000, MAIN) == 1024
+    monkeypatch.setenv(XL, "128")
+    assert bench._autotuned_lanes(1_000_000, XL) == 128
+
+
+def test_autotuned_lanes_defaults_without_evidence(monkeypatch):
+    for name in ("RAPID_TPU_BENCH_LANES", "RAPID_TPU_BENCH_LANES_1M"):
+        monkeypatch.delenv(name, raising=False)
+    monkeypatch.setattr(bench.glob, "glob", lambda pattern: [])
+    assert bench._autotuned_lanes(100_000, "RAPID_TPU_BENCH_LANES") == 128
