@@ -16,8 +16,9 @@ the per-width slopes and the winner; run during a live TPU window:
 
     python examples/delivery_autotune.py [--widths 128,256,512,1024]
 
-Then set ``pallas_lanes=<winner>`` for that shape (bench.py reads
-RAPID_TPU_BENCH_LANES_100K / RAPID_TPU_BENCH_LANES_1M if set).
+bench.py picks the winners up automatically from the committed
+``evidence/*/autotune.jsonl`` (env overrides: RAPID_TPU_BENCH_LANES for
+the main workload, RAPID_TPU_BENCH_LANES_1M for the 1M point).
 """
 
 from __future__ import annotations
