@@ -85,10 +85,20 @@ def _autotuned_lanes(n: int, env_name: str, default: int = 128) -> int:
                     best[d["shape"][1]] = width
             except (json.JSONDecodeError, KeyError, IndexError, TypeError):
                 continue  # one bad line never poisons the rest
-    if not best:
+    # Only inherit a tuned width from a comparable shape: a 2K smoke run
+    # must not pick up the 100K-tuned width (1024 lanes on a 2K-slot array
+    # is pad-dominated). Within 4x of a measured N the tiling economics
+    # carry over; among eligible shapes the closest by RATIO wins (absolute
+    # distance would bias toward the largest measured shape).
+    eligible = {
+        shape_n: width
+        for shape_n, width in best.items()
+        if shape_n / 4 <= n <= shape_n * 4
+    }
+    if not eligible:
         return default
-    nearest = min(best, key=lambda shape_n: abs(shape_n - n))
-    return best[nearest]
+    nearest = min(eligible, key=lambda shape_n: max(n / shape_n, shape_n / n))
+    return eligible[nearest]
 
 
 def _mark(msg: str) -> None:
@@ -439,6 +449,46 @@ def _child_cpu_seconds(pid: int):
         return None
 
 
+def _git_head_rev(root: str):
+    """Short HEAD rev of the repo at `root`, or None when unavailable."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=root, capture_output=True, text=True, timeout=10,
+        ).stdout.strip()
+        return out or None
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+
+
+# Source paths whose content determines what bench.py measures; commits that
+# touch none of these (evidence captures, docs, tests) do not stale a
+# snapshot.
+_MEASUREMENT_PATHS = ("bench.py", "rapid_tpu", "native")
+
+
+def _snapshot_is_stale(root: str, snap_rev, head_rev) -> bool:
+    """True when the snapshot measured different CODE than HEAD. Bare rev
+    inequality is not enough: the evidence watcher commits its own capture
+    right after stamping it, advancing HEAD past the captured rev with a
+    byte-identical source tree — so when revs differ, the verdict comes from
+    diffing the measurement-relevant paths between them. Unknown revs (or a
+    snapshot rev no longer in the repo) are stale: provenance that cannot be
+    checked is never trusted."""
+    if snap_rev is None or head_rev is None:
+        return True
+    if snap_rev == head_rev:
+        return False
+    try:
+        rc = subprocess.run(
+            ["git", "diff", "--quiet", snap_rev, head_rev, "--", *_MEASUREMENT_PATHS],
+            cwd=root, timeout=10,
+        ).returncode
+    except (OSError, subprocess.TimeoutExpired):
+        return True
+    return rc != 0  # nonzero: paths differ, or a rev is unknown to git
+
+
 def _emit_tpu_snapshot() -> bool:
     """When the live accelerator attempt wedges, fall back to the most recent
     TPU measurement captured DURING a live tunnel window by
@@ -447,7 +497,15 @@ def _emit_tpu_snapshot() -> bool:
     for hours at a time, so the driver's capture window is often dead even
     though the hardware number exists; the snapshot is the same bench.py
     workload, same shapes, emitted with full provenance so a reader can tell
-    a replayed measurement from a live one. True iff a snapshot was emitted."""
+    a replayed measurement from a live one. True iff a snapshot was emitted.
+
+    Code provenance: the capture script stamps `git_rev` into each capture;
+    the replay diffs the measurement-relevant source paths between that rev
+    and HEAD (_snapshot_is_stale). When they differ — or provenance cannot be
+    checked — the snapshot measured DIFFERENT CODE: the emitted metric is
+    renamed with a `_snapshot` suffix, `stale_code: true` is set, and
+    `vs_baseline` is demoted to `vs_baseline_at_capture`, so no consumer can
+    mistake a historical number for a measurement of HEAD."""
     candidates = []
     explicit = os.environ.get("RAPID_TPU_BENCH_SNAPSHOT")
     root = os.path.dirname(os.path.abspath(__file__))
@@ -482,9 +540,24 @@ def _emit_tpu_snapshot() -> bool:
     data["capture"] = "session_snapshot"
     data["snapshot_path"] = os.path.relpath(path, root)
     data["live_attempt"] = "wedged"
+    head_rev = _git_head_rev(root)
+    snap_rev = data.get("git_rev")
+    if head_rev:
+        data["head_rev"] = head_rev
+    stale = _snapshot_is_stale(root, snap_rev, head_rev)
+    data["stale_code"] = stale
+    if stale:
+        # The snapshot measured a different commit than HEAD (or its commit
+        # is unrecorded): rename the metric and demote the baseline ratio so
+        # the replayed number can never pass as a measurement of current code.
+        data["metric"] = str(data["metric"]) + "_snapshot"
+        if "vs_baseline" in data:
+            data["vs_baseline_at_capture"] = data.pop("vs_baseline")
     print(
         f"bench: live accelerator wedged; replaying TPU snapshot {data['snapshot_path']} "
-        f"(captured_at {data['captured_at']})",
+        f"(captured_at {data['captured_at']}, git_rev {snap_rev or 'unknown'}"
+        + (f", STALE vs HEAD {head_rev}" if stale else ", matches HEAD")
+        + ")",
         file=sys.stderr,
         flush=True,
     )

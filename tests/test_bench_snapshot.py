@@ -57,6 +57,98 @@ def test_replays_newest_tpu_capture_with_provenance(tmp_path, monkeypatch, capsy
     assert data["captured_at"] == "2026-07-29T14:06:21Z"
 
 
+def test_stale_snapshot_is_self_describing(tmp_path, monkeypatch, capsys):
+    # A snapshot whose git_rev differs from HEAD (or is absent) measured
+    # different code: the replay must rename the metric, flag stale_code,
+    # and demote vs_baseline so nothing downstream reads it as current.
+    cap = _capture()
+    cap["git_rev"] = "0000000"  # never the current HEAD
+    cap["vs_baseline"] = 4.957
+    f = tmp_path / "bench.json"
+    f.write_text(json.dumps(cap))
+    ok, data = _emit(monkeypatch, capsys, [f])
+    assert ok
+    assert data["stale_code"] is True
+    assert data["metric"].endswith("_snapshot")
+    assert "vs_baseline" not in data
+    assert data["vs_baseline_at_capture"] == 4.957
+    assert data["git_rev"] == "0000000"
+    assert data["head_rev"] not in (None, "0000000")
+
+
+def test_unstamped_snapshot_counts_as_stale(tmp_path, monkeypatch, capsys):
+    # Round-2 captures predate the git_rev stamp: unknown provenance is
+    # treated as stale, never silently trusted.
+    cap = _capture()
+    cap["vs_baseline"] = 4.957
+    f = tmp_path / "bench.json"
+    f.write_text(json.dumps(cap))
+    ok, data = _emit(monkeypatch, capsys, [f])
+    assert ok
+    assert data["stale_code"] is True
+    assert data["metric"].endswith("_snapshot")
+    assert "vs_baseline" not in data
+
+
+def test_current_rev_snapshot_keeps_its_metric(tmp_path, monkeypatch, capsys):
+    # Same-commit replays (the watcher captured during THIS session) are
+    # real measurements of HEAD: metric and vs_baseline survive untouched.
+    import os
+
+    head = bench._git_head_rev(os.path.dirname(os.path.abspath(bench.__file__)))
+    cap = _capture()
+    cap["git_rev"] = head
+    cap["vs_baseline"] = 4.957
+    f = tmp_path / "bench.json"
+    f.write_text(json.dumps(cap))
+    ok, data = _emit(monkeypatch, capsys, [f])
+    assert ok
+    if head is None:  # no git in the environment: stale is the safe answer
+        assert data["stale_code"] is True
+    else:
+        assert data["stale_code"] is False
+        assert not data["metric"].endswith("_snapshot")
+        assert data["vs_baseline"] == 4.957
+
+
+def test_evidence_only_commits_do_not_stale_a_snapshot(tmp_path):
+    # The watcher commits its own capture right after stamping it, advancing
+    # HEAD past the captured rev with a byte-identical source tree. Staleness
+    # is decided by diffing the measurement paths, not by rev equality.
+    import subprocess
+
+    def git(*args):
+        return subprocess.run(
+            ["git", "-c", "user.name=t", "-c", "user.email=t@t", *args],
+            cwd=tmp_path, capture_output=True, text=True, check=True,
+        ).stdout.strip()
+
+    git("init", "-q")
+    (tmp_path / "bench.py").write_text("x = 1\n")
+    (tmp_path / "rapid_tpu").mkdir()
+    (tmp_path / "rapid_tpu" / "core.py").write_text("y = 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "code")
+    measured_rev = git("rev-parse", "--short", "HEAD")
+    (tmp_path / "evidence").mkdir()
+    (tmp_path / "evidence" / "bench.json").write_text("{}\n")
+    git("add", "-A")
+    git("commit", "-qm", "evidence only")
+    head_after_evidence = git("rev-parse", "--short", "HEAD")
+    root = str(tmp_path)
+    assert not bench._snapshot_is_stale(root, measured_rev, head_after_evidence)
+    # A code commit after the capture DOES stale it.
+    (tmp_path / "rapid_tpu" / "core.py").write_text("y = 2\n")
+    git("add", "-A")
+    git("commit", "-qm", "code change")
+    head_after_code = git("rev-parse", "--short", "HEAD")
+    assert bench._snapshot_is_stale(root, measured_rev, head_after_code)
+    # Unknown / unverifiable provenance is always stale.
+    assert bench._snapshot_is_stale(root, None, head_after_code)
+    assert bench._snapshot_is_stale(root, "fffffff", head_after_code)
+    assert bench._snapshot_is_stale(root, measured_rev, None)
+
+
 def test_never_replays_a_different_workload(tmp_path, monkeypatch, capsys):
     # A smoke run at N=2000 must not replay the 100K capture, and vice versa.
     f = tmp_path / "bench.json"
@@ -131,6 +223,50 @@ def test_autotuned_lanes_resolution(tmp_path, monkeypatch):
     assert bench._autotuned_lanes(1_000_000, MAIN) == 1024
     monkeypatch.setenv(XL, "128")
     assert bench._autotuned_lanes(1_000_000, XL) == 128
+
+
+def test_autotuned_lanes_shape_proximity_guard(tmp_path, monkeypatch):
+    # A tuned width only transfers to shapes within 4x of where it was
+    # measured: a 2K smoke run must not inherit the 100K-tuned width (the
+    # tiling economics don't carry), but 25K-400K legitimately may.
+    for name in ("RAPID_TPU_BENCH_LANES", "RAPID_TPU_BENCH_LANES_1M"):
+        monkeypatch.delenv(name, raising=False)
+    evdir = tmp_path / "evidence" / "round9"
+    evdir.mkdir(parents=True)
+    (evdir / "autotune.jsonl").write_text(
+        json.dumps({"platform": "tpu", "shape": [64, 100_000], "best_width": 512}) + "\n"
+    )
+    monkeypatch.setattr(
+        bench.glob, "glob", lambda pattern: [str(evdir / "autotune.jsonl")]
+    )
+    MAIN = "RAPID_TPU_BENCH_LANES"
+    assert bench._autotuned_lanes(2_000, MAIN) == 128       # far below: default
+    assert bench._autotuned_lanes(25_000, MAIN) == 512      # 4x boundary: applies
+    assert bench._autotuned_lanes(400_000, MAIN) == 512     # 4x boundary: applies
+    assert bench._autotuned_lanes(1_000_000, MAIN) == 128   # far above: default
+    monkeypatch.setenv(MAIN, "256")
+    assert bench._autotuned_lanes(2_000, MAIN) == 256       # env always wins
+
+
+def test_autotuned_lanes_eligibility_before_nearest(tmp_path, monkeypatch):
+    # Eligibility (4x window) filters BEFORE nearest-shape selection: at
+    # N=450K with 100K and 1M both tuned, 100K is nearer by absolute
+    # distance but out of window — the in-window 1M width must apply, not
+    # the default.
+    for name in ("RAPID_TPU_BENCH_LANES", "RAPID_TPU_BENCH_LANES_1M"):
+        monkeypatch.delenv(name, raising=False)
+    evdir = tmp_path / "evidence" / "round9"
+    evdir.mkdir(parents=True)
+    (evdir / "autotune.jsonl").write_text(
+        json.dumps({"platform": "tpu", "shape": [64, 100_000], "best_width": 512}) + "\n"
+        + json.dumps({"platform": "tpu", "shape": [8, 1_000_000], "best_width": 256}) + "\n"
+    )
+    monkeypatch.setattr(
+        bench.glob, "glob", lambda pattern: [str(evdir / "autotune.jsonl")]
+    )
+    MAIN = "RAPID_TPU_BENCH_LANES"
+    assert bench._autotuned_lanes(450_000, MAIN) == 256   # only 1M in window
+    assert bench._autotuned_lanes(200_000, MAIN) == 512   # both in window; 100K nearer by ratio
 
 
 def test_autotuned_lanes_defaults_without_evidence(monkeypatch):
