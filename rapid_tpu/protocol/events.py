@@ -19,6 +19,13 @@ class ClusterEvents(enum.Enum):
 
     VIEW_CHANGE_PROPOSAL = "VIEW_CHANGE_PROPOSAL"
     VIEW_CHANGE = "VIEW_CHANGE"
+    #: Payload contract: the accompanying ClusterStatusChange carries the
+    #: configuration id and membership of the view the fallback is deciding
+    #: IN, with EMPTY status_changes — at fallback engagement no view delta
+    #: has been decided yet (the fast round failed to pick one). Subscribers
+    #: must not assume every notification carries changes; deltas arrive with
+    #: the eventual VIEW_CHANGE. Deviation from the reference (which declares
+    #: this event but never fires it) documented in PARITY.md.
     VIEW_CHANGE_ONE_STEP_FAILED = "VIEW_CHANGE_ONE_STEP_FAILED"
     KICKED = "KICKED"
 

@@ -57,3 +57,19 @@ if os.environ.get("HYPOTHESIS_PROFILE") == "thorough":
                 fn._hypothesis_internal_use_settings = hypothesis.settings(
                     spec, max_examples=spec.max_examples * 5
                 )
+        # The attachment point is a hypothesis-private attribute: if an
+        # upgrade renames it, every spec lookup above returns None and the
+        # dial silently becomes a 1x no-op. Fail fast instead — unless the
+        # selected subset genuinely contains no property tests.
+        has_hypothesis_items = any(
+            getattr(item, "function", None) is not None
+            and getattr(item.function, "hypothesis", None) is not None
+            for item in items
+        )
+        if has_hypothesis_items and not scaled:
+            raise RuntimeError(
+                "HYPOTHESIS_PROFILE=thorough scaled zero tests although "
+                "hypothesis-driven items were collected: the "
+                "_hypothesis_internal_use_settings attachment point has "
+                "moved; update the dial in tests/conftest.py"
+            )
