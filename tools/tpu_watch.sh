@@ -1,0 +1,57 @@
+#!/bin/bash
+# Session-long TPU evidence watcher: probe the axon tunnel until it answers,
+# then atomically capture the full evidence set and commit it.
+#
+#   nohup bash tools/tpu_watch.sh round4 >> /tmp/tpu_watch_r4.log 2>&1 &
+#
+# The tunnel wedges for hours at a time (bench.py watchdog docstring) and
+# live windows are rare and short, so the moment a probe succeeds we go
+# straight into tools/capture_tpu_evidence.sh, sync every finished artifact
+# into evidence/$ROUND/ as it lands (a mid-capture wedge must not lose the
+# stages that DID finish), and commit. The sync loop runs alongside the
+# capture so even a killed session leaves committed evidence behind.
+set -u
+ROUND="${1:-round4}"
+OUT="/tmp/tpu_evidence_${ROUND}"
+cd "$(dirname "$0")/.."
+DEST="evidence/$ROUND"
+
+sync_evidence() {
+  mkdir -p "$DEST"
+  local changed=0
+  for f in bench.json bench_tuned.json microbench.json microbench_slope.json \
+           autotune.jsonl bootstrap.json sweep.jsonl pytest_tpu.log bench.log; do
+    if [ -s "$OUT/$f" ] && ! cmp -s "$OUT/$f" "$DEST/$f" 2>/dev/null; then
+      cp "$OUT/$f" "$DEST/$f" && changed=1
+    fi
+  done
+  return $((1 - changed))
+}
+
+commit_evidence() {
+  # Retry around a concurrent index lock from the interactive session.
+  for _ in 1 2 3 4 5; do
+    if git add "$DEST" 2>/dev/null && \
+       git -c user.name=distsys-graft -c user.email=graft@localhost \
+         commit -m "Capture live TPU evidence ($ROUND watcher)" -- "$DEST" 2>/dev/null; then
+      echo "$(date -u +%H:%M:%S) committed $DEST"
+      return 0
+    fi
+    sleep 23
+  done
+  echo "$(date -u +%H:%M:%S) commit failed; files staged in $DEST"
+}
+
+bash tools/tunnel_probe.sh 180 90 || exit 1
+
+echo "$(date -u +%H:%M:%S) tunnel alive; capturing to $OUT"
+OUT="$OUT" bash tools/capture_tpu_evidence.sh &
+CAP_PID=$!
+while kill -0 "$CAP_PID" 2>/dev/null; do
+  sleep 120
+  sync_evidence && commit_evidence
+done
+wait "$CAP_PID"
+CAP_RC=$?
+sync_evidence && commit_evidence
+echo "$(date -u +%H:%M:%S) CAPTURE DONE rc=$CAP_RC"
