@@ -2,17 +2,19 @@
 
 Implements the messaging SPI over the exact RPC the reference serves —
 ``remoting.MembershipService/sendRequest`` (rapid.proto:9-11) with
-protobuf-encoded ``RapidRequest``/``RapidResponse`` envelopes. Compatibility
-is at the RPC/wire layer only: mixed Java/Python clusters are a NON-GOAL,
-because the two implementations order rings differently (our ``ring_key``
-hashes the port as 8 bytes and sorts identifiers unsigned; the reference
-hashes 4-byte ints and uses a signed NodeId comparator,
-``MembershipView.java:579-587``), so configuration ids and observer sets
-would diverge immediately and each side would filter the other's alerts.
-What this transport buys is the reference's operational surface — gRPC
-tooling, interceptors, proxies — for homogeneous rapid_tpu clusters. Built
-on grpc.aio with a generic method handler (no generated stubs; the schema is
-materialized at runtime, rapid_tpu.interop.proto_schema).
+protobuf-encoded ``RapidRequest``/``RapidResponse`` envelopes. By default
+compatibility is at the RPC/wire layer only, because the tpu-native topology
+orders rings differently from Java (our ``ring_key`` hashes the port as
+8 bytes and sorts keys/identifiers unsigned; the reference hashes 4-byte
+ints and compares signed, ``MembershipView.java:579-587``), so configuration
+ids and observer sets would diverge. ``Settings(topology="java")`` closes
+that gap: it switches the ring ordering and configuration-id fold to
+reference-exact semantics (rapid_tpu.protocol.view.TOPOLOGY_JAVA, pinned in
+tests/test_view_java_compat.py), making mixed Java/rapid_tpu clusters over
+this transport possible in principle. Either way the transport buys the
+reference's operational surface — gRPC tooling, interceptors, proxies.
+Built on grpc.aio with a generic method handler (no generated stubs; the
+schema is materialized at runtime, rapid_tpu.interop.proto_schema).
 """
 
 from __future__ import annotations

@@ -25,6 +25,7 @@ from __future__ import annotations
 import asyncio
 import ipaddress
 import logging
+import random
 from typing import Dict, Optional
 
 from rapid_tpu.messaging.codec import decode_request, encode_request
@@ -162,19 +163,59 @@ class UdpHybridClient(TcpClient):
             if ip is not None:
                 payload = encode_request(request)
                 if len(payload) <= _MAX_DATAGRAM:
-                    try:
-                        transport = await self._udp(ip.version)
-                        transport.sendto(payload, (remote.hostname, remote.port))
-                        self.stats.tx(len(payload))
+                    if await self._send_datagram(ip.version, remote, payload):
                         return Response()  # fire-and-forget: no ack exists
-                    except Exception as exc:  # noqa: BLE001 — fall back to TCP
-                        LOG.debug(
-                            "udp send to %s failed (%r); falling back to tcp", remote, exc
-                        )
         return await super().send_best_effort(remote, request)
+
+    async def _send_datagram(self, ip_version: int, remote: Endpoint, payload: bytes) -> bool:
+        """Put one datagram on the wire; False routes the caller to the TCP
+        fallback. The seam LossyDatagramClient injects network loss at."""
+        try:
+            transport = await self._udp(ip_version)
+            transport.sendto(payload, (remote.hostname, remote.port))
+            self.stats.tx(len(payload))
+            return True
+        except Exception as exc:  # noqa: BLE001 — fall back to TCP
+            LOG.debug("udp send to %s failed (%r); falling back to tcp", remote, exc)
+            return False
 
     async def shutdown(self) -> None:
         for transport in self._udp_transports.values():
             transport.close()
         self._udp_transports.clear()
         await super().shutdown()
+
+
+class LossyDatagramClient(UdpHybridClient):
+    """Fault-injection client: a seeded fraction of outbound datagrams is
+    dropped AFTER the sender commits to the datagram path — exactly where
+    network loss strikes (the sender believes it sent; no TCP fallback
+    engages). This is the instrument that quantifies the hybrid transport's
+    admitted tradeoff (module docstring above): datagram loss costs
+    convergence latency (lost votes ride out the fallback timer) and, in the
+    limit, forced rejoins (a decision naming a joiner whose every UP alert
+    was lost). tests/test_udp_loss.py pins the rejoin-free envelope;
+    examples/udp_loss_curve.py measures the latency curve."""
+
+    def __init__(
+        self,
+        my_addr: Endpoint,
+        settings: Optional[Settings] = None,
+        loss_rate: float = 0.0,
+        rng: Optional[random.Random] = None,
+    ) -> None:
+        if not 0.0 <= loss_rate <= 1.0:
+            raise ValueError(f"loss_rate must be in [0, 1], got {loss_rate}")
+        super().__init__(my_addr, settings)
+        self.loss_rate = loss_rate
+        self._rng = rng if rng is not None else random.Random(0)
+        self.datagrams_dropped = 0
+        self.datagrams_delivered = 0
+
+    async def _send_datagram(self, ip_version: int, remote: Endpoint, payload: bytes) -> bool:
+        if self._rng.random() < self.loss_rate:
+            self.datagrams_dropped += 1
+            self.stats.tx(len(payload))  # the sender transmitted; the network ate it
+            return True
+        self.datagrams_delivered += 1
+        return await super()._send_datagram(ip_version, remote, payload)

@@ -5,7 +5,15 @@ from rapid_tpu.protocol.events import ClusterEvents, ClusterStatusChange, NodeSt
 from rapid_tpu.protocol.fast_paxos import FastPaxos, fast_paxos_quorum
 from rapid_tpu.protocol.metadata import MetadataManager
 from rapid_tpu.protocol.paxos import Paxos, select_proposal_using_coordinator_rule
-from rapid_tpu.protocol.view import Configuration, MembershipView, configuration_id_of, ring_key
+from rapid_tpu.protocol.view import (
+    TOPOLOGY_JAVA,
+    TOPOLOGY_NATIVE,
+    Configuration,
+    MembershipView,
+    configuration_id_of,
+    ring_key,
+    ring_key_java,
+)
 
 __all__ = [
     "Cluster",
@@ -23,4 +31,7 @@ __all__ = [
     "MembershipView",
     "configuration_id_of",
     "ring_key",
+    "ring_key_java",
+    "TOPOLOGY_JAVA",
+    "TOPOLOGY_NATIVE",
 ]

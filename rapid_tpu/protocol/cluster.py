@@ -123,7 +123,12 @@ class Cluster:
         client, server = cls._make_transport(listen_address, settings, network, client, server)
         fd_factory = fd_factory or PingPongFailureDetectorFactory(listen_address, client)
         node_id = NodeId.from_uuid()
-        view = MembershipView(settings.k, node_ids=[node_id], endpoints=[listen_address])
+        view = MembershipView(
+            settings.k,
+            node_ids=[node_id],
+            endpoints=[listen_address],
+            topology=settings.topology,
+        )
         detector_factory = cut_detector_factory or MultiNodeCutDetector
         cut_detector = detector_factory(settings.k, settings.h, settings.l)
         metadata_map = {listen_address: metadata} if metadata else {}
@@ -308,7 +313,10 @@ class Cluster:
         """Build the node from a streamed configuration (Cluster.java:442-474)."""
         assert response.endpoints and response.identifiers
         view = MembershipView(
-            settings.k, node_ids=response.identifiers, endpoints=response.endpoints
+            settings.k,
+            node_ids=response.identifiers,
+            endpoints=response.endpoints,
+            topology=settings.topology,
         )
         metadata_map = dict(zip(response.metadata_keys, response.metadata_values))
         detector_factory = cut_detector_factory or MultiNodeCutDetector

@@ -25,45 +25,92 @@ from rapid_tpu.errors import (
     UUIDAlreadySeenError,
 )
 from rapid_tpu.types import Endpoint, JoinStatusCode, NodeId
-from rapid_tpu.utils.xxhash import to_signed64, xxh64, xxh64_int
+from rapid_tpu.utils.xxhash import to_signed64, xxh64, xxh64_int, xxh64_int4
 
 _MASK64 = (1 << 64) - 1
 
+#: Topology modes. NATIVE is the tpu-first default: ports hashed as 8 bytes,
+#: keys and identifiers ordered unsigned — one uniform u64 keyspace shared
+#: with the device kernels (rapid_tpu.ops.rings ships u32 hi/lo words).
+#: JAVA_COMPAT reproduces the reference's exact semantics — ports hashed as
+#: 4-byte Java ints (``LongHashFunction.hashInt``), ring keys compared as
+#: SIGNED longs (``Long.compare``, MembershipView.java:573-577), identifiers
+#: ordered by the signed (high, low) NodeIdComparator
+#: (MembershipView.java:474-499) — so a compat-mode cluster computes the same
+#: ring orders, observer/subject sets, and configuration ids a Java cluster
+#: would, making mixed clusters over the interop transport possible in
+#: principle.
+TOPOLOGY_NATIVE = "native"
+TOPOLOGY_JAVA = "java"
+TOPOLOGIES = (TOPOLOGY_NATIVE, TOPOLOGY_JAVA)
+
 
 def ring_key(endpoint: Endpoint, seed: int) -> int:
-    """The seeded ordering key for one ring (semantics of
-    ``MembershipView.AddressComparator``, MembershipView.java:562-587)."""
+    """The seeded ordering key for one ring, native mode (semantics of
+    ``MembershipView.AddressComparator``, MembershipView.java:562-587, with
+    the port hashed as 8 bytes and the key compared unsigned)."""
     h = xxh64(endpoint.hostname.encode("utf-8"), seed)
     return (h * 31 + xxh64_int(endpoint.port, seed)) & _MASK64
 
 
-def configuration_id_of(node_ids: Sequence[NodeId], endpoints: Sequence[Endpoint]) -> int:
+def ring_key_java(endpoint: Endpoint, seed: int) -> int:
+    """Reference-exact ring key, returned SIGNED so Python's natural int
+    ordering reproduces Java's ``Long.compare``: ``xx(seed).hashBytes(
+    hostname_utf8) * 31 + xx(seed).hashInt(port)`` in wrapping 64-bit
+    arithmetic (MembershipView.java:579-587)."""
+    h = xxh64(endpoint.hostname.encode("utf-8"), seed)
+    return to_signed64((h * 31 + xxh64_int4(endpoint.port, seed)) & _MASK64)
+
+
+def _ring_key_for(topology: str):
+    return ring_key_java if topology == TOPOLOGY_JAVA else ring_key
+
+
+def node_id_sort_key(node_id: NodeId, topology: str = TOPOLOGY_NATIVE):
+    """Identifier ordering for the configuration fold: unsigned (high, low)
+    natively; Java's signed NodeIdComparator (MembershipView.java:474-499)
+    in compat mode."""
+    if topology == TOPOLOGY_JAVA:
+        return (to_signed64(node_id.high), to_signed64(node_id.low))
+    return (node_id.high, node_id.low)
+
+
+def configuration_id_of(
+    node_ids: Sequence[NodeId],
+    endpoints: Sequence[Endpoint],
+    topology: str = TOPOLOGY_NATIVE,
+) -> int:
     """Deterministic 64-bit fold over identifiers-seen and membership
     (semantics of ``MembershipView.Configuration.getConfigurationId``,
-    MembershipView.java:544-556). ``node_ids`` must be in sorted order and
-    ``endpoints`` in ring-0 order for all members to agree.
+    MembershipView.java:544-556). ``node_ids`` must be in the topology's
+    sorted order and ``endpoints`` in the topology's ring-0 order for all
+    members to agree. In JAVA mode the fold is reference-exact (seed-0 xxHash,
+    ports as 4-byte ints), so a compat cluster computes the ids a Java
+    cluster would.
 
     Returned as *signed* 64-bit (Java-long convention, and the wire codec's
     i64): every host-path config-id comparison uses this signed canonical
     form. (The device engine's config identity is a separate unsigned
     set-hash space, never compared against this fold.)"""
-    from rapid_tpu.utils._native import native_configuration_id
+    if topology != TOPOLOGY_JAVA:
+        from rapid_tpu.utils._native import native_configuration_id
 
-    native = native_configuration_id(
-        [nid.high for nid in node_ids],
-        [nid.low for nid in node_ids],
-        [ep.hostname.encode("utf-8") for ep in endpoints],
-        [ep.port for ep in endpoints],
-    )
-    if native is not None:
-        return to_signed64(native)
+        native = native_configuration_id(
+            [nid.high for nid in node_ids],
+            [nid.low for nid in node_ids],
+            [ep.hostname.encode("utf-8") for ep in endpoints],
+            [ep.port for ep in endpoints],
+        )
+        if native is not None:
+            return to_signed64(native)
+    hash_port = xxh64_int4 if topology == TOPOLOGY_JAVA else xxh64_int
     h = 1
     for nid in node_ids:
         h = (h * 37 + xxh64_int(nid.high)) & _MASK64
         h = (h * 37 + xxh64_int(nid.low)) & _MASK64
     for ep in endpoints:
         h = (h * 37 + xxh64(ep.hostname.encode("utf-8"))) & _MASK64
-        h = (h * 37 + xxh64_int(ep.port)) & _MASK64
+        h = (h * 37 + hash_port(ep.port)) & _MASK64
     return to_signed64(h)
 
 
@@ -72,17 +119,25 @@ class Configuration:
     list). Sufficient to reconstruct an identical view — this is also the
     checkpoint format (MembershipView.java:521-533)."""
 
-    __slots__ = ("node_ids", "endpoints", "_config_id")
+    __slots__ = ("node_ids", "endpoints", "topology", "_config_id")
 
-    def __init__(self, node_ids: Sequence[NodeId], endpoints: Sequence[Endpoint]):
+    def __init__(
+        self,
+        node_ids: Sequence[NodeId],
+        endpoints: Sequence[Endpoint],
+        topology: str = TOPOLOGY_NATIVE,
+    ):
         self.node_ids: Tuple[NodeId, ...] = tuple(node_ids)
         self.endpoints: Tuple[Endpoint, ...] = tuple(endpoints)
+        self.topology = topology
         self._config_id: Optional[int] = None
 
     @property
     def configuration_id(self) -> int:
         if self._config_id is None:
-            self._config_id = configuration_id_of(self.node_ids, self.endpoints)
+            self._config_id = configuration_id_of(
+                self.node_ids, self.endpoints, self.topology
+            )
         return self._config_id
 
 
@@ -95,10 +150,15 @@ class MembershipView:
         k: int,
         node_ids: Sequence[NodeId] = (),
         endpoints: Sequence[Endpoint] = (),
+        topology: str = TOPOLOGY_NATIVE,
     ) -> None:
         if k <= 0:
             raise ValueError("K must be > 0")
+        if topology not in TOPOLOGIES:
+            raise ValueError(f"topology must be one of {TOPOLOGIES}, got {topology!r}")
         self.k = k
+        self.topology = topology
+        self._ring_key = _ring_key_for(topology)
         # Per ring: parallel sorted lists of keys and endpoints.
         self._ring_keys: List[List[int]] = [[] for _ in range(k)]
         self._rings: List[List[Endpoint]] = [[] for _ in range(k)]
@@ -117,7 +177,7 @@ class MembershipView:
     def _keys_of(self, endpoint: Endpoint) -> Tuple[int, ...]:
         keys = self._key_cache.get(endpoint)
         if keys is None:
-            keys = tuple(ring_key(endpoint, seed) for seed in range(self.k))
+            keys = tuple(self._ring_key(endpoint, seed) for seed in range(self.k))
             self._key_cache[endpoint] = keys
         return keys
 
@@ -247,7 +307,12 @@ class MembershipView:
     def configuration(self) -> Configuration:
         if self._config_dirty or self._cached_configuration is None:
             self._cached_configuration = Configuration(
-                sorted(self._identifiers_seen), self._rings[0]
+                sorted(
+                    self._identifiers_seen,
+                    key=lambda nid: node_id_sort_key(nid, self.topology),
+                ),
+                self._rings[0],
+                topology=self.topology,
             )
             self._config_dirty = False
         return self._cached_configuration
@@ -259,4 +324,4 @@ class MembershipView:
     def ring_zero_sorted(self, endpoints) -> List[Endpoint]:
         """Canonical proposal order: ring-0 comparator
         (MembershipService.java:346-348)."""
-        return sorted(endpoints, key=lambda ep: (ring_key(ep, 0), ep))
+        return sorted(endpoints, key=lambda ep: (self._ring_key(ep, 0), ep))

@@ -34,8 +34,22 @@ class Settings:
     # Leave (MembershipService.java:78).
     leave_message_timeout_ms: int = 1500
 
+    # Topology mode: "native" (tpu-first default: 8-byte port hashing,
+    # unsigned key/identifier ordering) or "java" (reference-exact ring
+    # ordering and configuration-id fold, MembershipView.java:544-587 —
+    # required for mixed clusters with the Java implementation over the
+    # interop transport). Cluster-wide: every member must use the same mode
+    # or configuration ids diverge immediately.
+    topology: str = "native"
+
     def validate(self) -> None:
         if not (self.k >= 3 and self.k >= self.h >= self.l >= 1):
             raise ValueError(
                 f"K/H/L must satisfy K>=3 and K>=H>=L>=1, got K={self.k} H={self.h} L={self.l}"
+            )
+        from rapid_tpu.protocol.view import TOPOLOGIES
+
+        if self.topology not in TOPOLOGIES:
+            raise ValueError(
+                f"topology must be one of {TOPOLOGIES}, got {self.topology!r}"
             )

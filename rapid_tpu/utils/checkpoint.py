@@ -39,13 +39,22 @@ from rapid_tpu.messaging.codec import (
     write_endpoint,
     write_node_id,
 )
-from rapid_tpu.protocol.view import Configuration, MembershipView
+from rapid_tpu.protocol.view import (
+    TOPOLOGY_JAVA,
+    TOPOLOGY_NATIVE,
+    Configuration,
+    MembershipView,
+)
 
 if TYPE_CHECKING:
     from rapid_tpu.models.state import EngineConfig, EngineState
 
 _MAGIC = b"RTCF"
-_VERSION = 1
+# v2 appends a topology-mode byte; v1 checkpoints (which predate the
+# java-compat mode and were always native) still load.
+_VERSION = 2
+_TOPOLOGY_CODES = {TOPOLOGY_NATIVE: 0, TOPOLOGY_JAVA: 1}
+_TOPOLOGY_NAMES = {code: name for name, code in _TOPOLOGY_CODES.items()}
 
 
 def configuration_to_bytes(config: Configuration) -> bytes:
@@ -58,6 +67,7 @@ def configuration_to_bytes(config: Configuration) -> bytes:
     w.u32(len(config.endpoints))
     for ep in config.endpoints:
         write_endpoint(w, ep)
+    w.u8(_TOPOLOGY_CODES[config.topology])
     return w.getvalue()
 
 
@@ -66,16 +76,30 @@ def configuration_from_bytes(data: bytes) -> Configuration:
         raise ValueError("not a rapid_tpu configuration checkpoint")
     r = Reader(data[4:])
     version = r.u8()
-    if version != _VERSION:
+    if version not in (1, _VERSION):
         raise ValueError(f"unsupported checkpoint version {version}")
     node_ids = tuple(read_node_id(r) for _ in range(r.u32()))
     endpoints = tuple(read_endpoint(r) for _ in range(r.u32()))
-    return Configuration(node_ids, endpoints)
+    if version == 1:
+        topology = TOPOLOGY_NATIVE
+    else:
+        code = r.u8()
+        if code not in _TOPOLOGY_NAMES:
+            raise ValueError(f"unknown topology code {code} in checkpoint")
+        topology = _TOPOLOGY_NAMES[code]
+    return Configuration(node_ids, endpoints, topology=topology)
 
 
 def view_from_configuration(config: Configuration, k: int) -> MembershipView:
-    """Resume: rebuild the K rings from a configuration snapshot."""
-    return MembershipView(k, node_ids=config.node_ids, endpoints=config.endpoints)
+    """Resume: rebuild the K rings from a configuration snapshot (the
+    snapshot's topology mode rides along, so a java-compat cluster resumes
+    java-compat)."""
+    return MembershipView(
+        k,
+        node_ids=config.node_ids,
+        endpoints=config.endpoints,
+        topology=config.topology,
+    )
 
 
 def save_engine_state(path, cfg: "EngineConfig", state: "EngineState") -> None:

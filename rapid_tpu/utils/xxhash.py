@@ -103,6 +103,14 @@ def xxh64_int(value: int, seed: int = 0) -> int:
     return xxh64(struct.pack("<q", _to_signed64(value)), seed)
 
 
+def xxh64_int4(value: int, seed: int = 0) -> int:
+    """Hash an integer by its little-endian 4-byte encoding — the reference's
+    ``LongHashFunction.hashInt`` (a Java ``int`` is 4 bytes), used by the
+    Java-compatible topology mode for port hashing. The tpu-native default
+    hashes ports as 8 bytes (xxh64_int)."""
+    return xxh64(struct.pack("<i", value - (1 << 32) if value >= (1 << 31) else value), seed)
+
+
 def _to_signed64(value: int) -> int:
     value &= _MASK64
     return value - (1 << 64) if value >= (1 << 63) else value

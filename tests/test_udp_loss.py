@@ -1,0 +1,179 @@
+"""Quantifying the hybrid transport's datagram-loss tradeoff.
+
+The hybrid TCP+UDP transport's admitted failure mode (messaging/udp.py
+docstring) is a forced rejoin: a consensus decision names a joiner whose
+every UP alert datagram was lost, so the receiver lacks the joiner's UUID
+and signals KICKED (service._recover_from_unknown_joiners) instead of
+corrupting its view. These tests pin the ENVELOPE of that mode: a receiver
+misses a joiner's UUID only if it loses the alert batches of ALL the
+joiner's distinct observers — probability ~p^O at loss rate p — so at
+operationally plausible loss the cost of datagrams lost is CONVERGENCE
+LATENCY (votes riding out the fallback timer), not rejoins.
+
+The full latency curve is measured by examples/udp_loss_curve.py; its
+committed results live in EVALUATION.md.
+"""
+
+import asyncio
+import functools
+import random
+import socket
+
+from rapid_tpu.messaging.udp import LossyDatagramClient, UdpHybridServer
+from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
+from rapid_tpu.protocol.cluster import Cluster
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import Endpoint
+
+from helpers import wait_until
+
+
+def free_endpoints(count: int) -> list:
+    """Kernel-assigned free ports (reserved briefly, then released): these
+    tests must never collide with a concurrently running suite."""
+    socks = []
+    for _ in range(count):
+        sk = socket.socket()
+        sk.bind(("127.0.0.1", 0))
+        socks.append(sk)
+    eps = [Endpoint("127.0.0.1", sk.getsockname()[1]) for sk in socks]
+    for sk in socks:
+        sk.close()
+    return eps
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        async def with_timeout():
+            await asyncio.wait_for(fn(*args, **kwargs), timeout=120)
+
+        asyncio.run(with_timeout())
+
+    return wrapper
+
+
+def fast_settings() -> Settings:
+    s = Settings()
+    s.batching_window_ms = 20
+    s.failure_detector_interval_ms = 50
+    s.rpc_timeout_ms = 500
+    s.rpc_join_timeout_ms = 2000
+    s.rpc_probe_timeout_ms = 200
+    s.consensus_fallback_base_delay_ms = 1000
+    return s
+
+
+async def run_lossy_churn(loss_rate: float, seed: int):
+    """5-node bring-up -> 3-node join wave -> 1 crash, every datagram lane
+    subject to seeded loss. Returns (clusters, forced_rejoins, kicked)."""
+    settings = fast_settings()
+    fd = StaticFailureDetectorFactory()
+    rng = random.Random(seed)
+    eps = free_endpoints(8)
+
+    def ep(i: int) -> Endpoint:
+        return eps[i]
+
+    def client(i: int) -> LossyDatagramClient:
+        return LossyDatagramClient(
+            ep(i), settings, loss_rate=loss_rate,
+            rng=random.Random(rng.randrange(1 << 30)),
+        )
+
+    clusters = [
+        await Cluster.start(ep(0), settings=settings, client=client(0),
+                            server=UdpHybridServer(ep(0)), fd_factory=fd,
+                            rng=random.Random(seed))
+    ]
+    for i in range(1, 5):
+        clusters.append(
+            await Cluster.join(ep(0), ep(i), settings=settings, client=client(i),
+                               server=UdpHybridServer(ep(i)), fd_factory=fd,
+                               rng=random.Random(seed + i))
+        )
+    assert await wait_until(lambda: all(c.membership_size == 5 for c in clusters))
+
+    # Concurrent join wave: UP alerts and votes ride lossy datagrams.
+    joiners = await asyncio.gather(*(
+        Cluster.join(ep(0), ep(i), settings=settings, client=client(i),
+                     server=UdpHybridServer(ep(i)), fd_factory=fd,
+                     rng=random.Random(seed + i))
+        for i in range(5, 8)
+    ))
+    clusters.extend(joiners)
+    assert await wait_until(
+        lambda: all(c.membership_size == 8 for c in clusters), timeout_s=60
+    )
+
+    # Crash: DOWN alerts ride lossy datagrams too.
+    victim = clusters[3]
+    await victim.shutdown()
+    fd.add_failed_nodes([victim.listen_address])
+    survivors = [c for c in clusters if c is not victim]
+    assert await wait_until(
+        lambda: all(c.membership_size == 7 for c in survivors), timeout_s=60
+    )
+
+    forced_rejoins = sum(
+        c.service.metrics.counters["decision_missing_joiner_uuid"] for c in survivors
+    )
+    kicked = sum(c.service.metrics.counters["kicked"] for c in survivors)
+    return survivors, forced_rejoins, kicked
+
+
+@async_test
+async def test_no_forced_rejoin_at_10pct_loss():
+    # The pin: with the default alert fan-out (every distinct observer of a
+    # joiner broadcasts its own UP batch) and FD-cadence redelivery, 10%
+    # datagram loss never forces a rejoin — the loss envelope for missing a
+    # UUID entirely is ~0.1^observers. Convergence still completes.
+    survivors, forced_rejoins, kicked = await run_lossy_churn(loss_rate=0.10, seed=42)
+    try:
+        assert forced_rejoins == 0
+        assert kicked == 0
+        assert len({tuple(c.membership) for c in survivors}) == 1
+    finally:
+        await asyncio.gather(*(c.shutdown() for c in survivors), return_exceptions=True)
+
+
+@async_test
+async def test_converges_under_heavy_loss():
+    # 30% loss: convergence must still complete (lost votes ride out the
+    # classic-fallback timer; lost alerts are re-sent on later FD ticks).
+    # No zero-rejoin guarantee is claimed at this rate.
+    survivors, forced_rejoins, _ = await run_lossy_churn(loss_rate=0.30, seed=7)
+    try:
+        assert len({tuple(c.membership) for c in survivors}) == 1
+        assert all(c.membership_size == 7 for c in survivors)
+    finally:
+        await asyncio.gather(*(c.shutdown() for c in survivors), return_exceptions=True)
+
+
+@async_test
+async def test_loss_actually_drops_datagrams():
+    # The instrument itself: loss strikes AFTER the sender commits to the
+    # datagram path — no TCP fallback engages (a fallback would defeat the
+    # injection). The joiner at 100% loss still joins (joins ride TCP), but
+    # its leave broadcast is eaten and counted; the clean seed drops nothing.
+    settings = fast_settings()
+    a, b = free_endpoints(2)
+    fd = StaticFailureDetectorFactory()
+    clean = LossyDatagramClient(a, settings, loss_rate=0.0, rng=random.Random(1))
+    lossy = LossyDatagramClient(b, settings, loss_rate=1.0, rng=random.Random(2))
+    c0 = await Cluster.start(a, settings=settings, client=clean,
+                             server=UdpHybridServer(a), fd_factory=fd,
+                             rng=random.Random(0))
+    c1 = await Cluster.join(a, b, settings=settings, client=lossy,
+                            server=UdpHybridServer(b), fd_factory=fd,
+                            rng=random.Random(1))
+    try:
+        assert await wait_until(lambda: c0.membership_size == 2 and c1.membership_size == 2)
+        # Force one-way traffic through the lossy client: a leave broadcast.
+        await c1.leave_gracefully()
+        assert clean.datagrams_dropped == 0
+        assert lossy.datagrams_dropped > 0
+        # The seed never heard the leave: the datagram genuinely vanished.
+        assert c0.membership_size == 2
+    finally:
+        await asyncio.gather(c0.shutdown(), c1.shutdown(), return_exceptions=True)

@@ -1,0 +1,276 @@
+"""Java-compatible topology mode: reference-exact ring ordering and
+configuration-id fold (MembershipView.java:544-587).
+
+The tpu-native topology deliberately diverges from the reference (8-byte
+port hashing, unsigned orderings) because one uniform u64 keyspace is what
+the device kernels ship. ``topology="java"`` switches the host path to the
+reference's exact semantics so a compat cluster computes the same ring
+orders, observer/subject sets, and configuration ids a Java cluster would.
+
+No JVM exists in this environment, so compatibility is pinned two ways:
+every composition rule is RE-DERIVED here step by step from the XXH64
+primitives (themselves pinned against the published xxHash test vectors in
+tests/test_xxhash.py) exactly as MembershipView.java composes them; and a
+committed golden fixture (tests/fixtures/java_topology.json) freezes the
+resulting keys/ids so the semantics cannot drift silently.
+"""
+
+import asyncio
+import functools
+import json
+import os
+import random
+import struct
+
+import pytest
+
+from rapid_tpu.messaging.inprocess import InProcessNetwork
+from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
+from rapid_tpu.protocol.cluster import Cluster
+from rapid_tpu.protocol.view import (
+    TOPOLOGY_JAVA,
+    TOPOLOGY_NATIVE,
+    Configuration,
+    MembershipView,
+    configuration_id_of,
+    node_id_sort_key,
+    ring_key,
+    ring_key_java,
+)
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import Endpoint, NodeId
+from rapid_tpu.utils.xxhash import to_signed64, xxh64
+
+from helpers import wait_until
+
+_MASK64 = (1 << 64) - 1
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "java_topology.json")
+
+
+# ---------------------------------------------------------------------------
+# Composition rules, re-derived from the XXH64 primitives.
+# ---------------------------------------------------------------------------
+
+
+def test_java_ring_key_composition():
+    # AddressComparator.computeHash (MembershipView.java:579-587):
+    #   xx(seed).hashBytes(hostname_utf8) * 31 + xx(seed).hashInt(port)
+    # hashInt hashes the FOUR little-endian bytes of the Java int; the result
+    # is a signed long compared via Long.compare.
+    ep = Endpoint("192.168.1.20", 5002)
+    for seed in (0, 1, 7):
+        host_h = xxh64(b"192.168.1.20", seed)
+        port_h = xxh64(struct.pack("<i", 5002), seed)
+        expected = to_signed64((host_h * 31 + port_h) & _MASK64)
+        assert ring_key_java(ep, seed) == expected
+
+
+def test_java_vs_native_key_differs_only_in_port_hash_width():
+    # Same hostname hash; the native key hashes the port as 8 bytes and
+    # stays unsigned, the java key hashes 4 bytes and goes signed.
+    ep = Endpoint("host-a", 80)
+    host_h = xxh64(b"host-a", 3)
+    port8 = xxh64(struct.pack("<q", 80), 3)
+    port4 = xxh64(struct.pack("<i", 80), 3)
+    assert port8 != port4  # widths genuinely diverge
+    assert ring_key(ep, 3) == (host_h * 31 + port8) & _MASK64
+    assert ring_key_java(ep, 3) == to_signed64((host_h * 31 + port4) & _MASK64)
+
+
+def test_java_configuration_id_fold():
+    # Configuration.getConfigurationId (MembershipView.java:544-556):
+    #   hash = 1
+    #   for id in identifiersSeen (signed NodeIdComparator order):
+    #       hash = hash*37 + xx(0).hashLong(high); hash = hash*37 + xx(0).hashLong(low)
+    #   for ep in ring-0 order:
+    #       hash = hash*37 + xx(0).hashBytes(hostname); hash = hash*37 + xx(0).hashInt(port)
+    ids = [NodeId(high=5, low=9), NodeId(high=(1 << 63) + 1, low=2)]
+    eps = [Endpoint("n1", 1), Endpoint("n2", 2)]
+    h = 1
+    for nid in ids:
+        for word in (nid.high, nid.low):
+            signed = word - (1 << 64) if word >= (1 << 63) else word
+            h = (h * 37 + xxh64(struct.pack("<q", signed), 0)) & _MASK64
+        # hashLong hashes the 8 LE bytes of the signed long — identical bytes
+        # either way; the signed conversion above is belt-and-braces.
+    for ep in eps:
+        h = (h * 37 + xxh64(ep.hostname.encode(), 0)) & _MASK64
+        h = (h * 37 + xxh64(struct.pack("<i", ep.port), 0)) & _MASK64
+    assert configuration_id_of(ids, eps, TOPOLOGY_JAVA) == to_signed64(h)
+
+
+def test_signed_identifier_ordering():
+    # NodeIdComparator (MembershipView.java:474-499) compares high then low
+    # as SIGNED longs: a NodeId with the high bit set sorts FIRST in java
+    # mode (negative) but LAST natively (unsigned).
+    neg = NodeId(high=(1 << 63) + 5, low=0)  # signed: negative high
+    pos = NodeId(high=3, low=0)
+    assert sorted([pos, neg], key=lambda n: node_id_sort_key(n, TOPOLOGY_JAVA)) == [neg, pos]
+    assert sorted([pos, neg], key=lambda n: node_id_sort_key(n, TOPOLOGY_NATIVE)) == [pos, neg]
+
+
+def _endpoints_with_divergent_order(seed: int, count: int = 12):
+    """A set of endpoints whose signed and unsigned ring orders differ
+    (guaranteed once keys straddle the sign bit, which random hashes do)."""
+    eps = [Endpoint(f"node-{i}.example", 4000 + i) for i in range(count)]
+    unsigned = sorted(eps, key=lambda e: ring_key_java(e, seed) & _MASK64)
+    signed = sorted(eps, key=lambda e: ring_key_java(e, seed))
+    assert unsigned != signed  # the sign bit genuinely reorders this set
+    return eps, signed
+
+
+def test_ring_order_is_signed():
+    eps, signed = _endpoints_with_divergent_order(seed=0)
+    view = MembershipView(3, endpoints=eps, topology=TOPOLOGY_JAVA)
+    assert view.ring(0) == signed
+    # Every ring is ordered by its own seed's signed key.
+    for ring_idx in range(3):
+        keys = [ring_key_java(e, ring_idx) for e in view.ring(ring_idx)]
+        assert keys == sorted(keys)
+
+
+def test_observers_subjects_follow_java_order():
+    eps, signed = _endpoints_with_divergent_order(seed=0)
+    view = MembershipView(3, endpoints=eps, topology=TOPOLOGY_JAVA)
+    node = signed[0]
+    # Ring-0 observer is the signed-order successor, subject the predecessor.
+    assert view.observers_of(node)[0] == signed[1]
+    assert view.subjects_of(node)[0] == signed[-1]
+
+
+def test_view_configuration_id_matches_fold():
+    ids = [NodeId.from_uuid() for _ in range(5)]
+    eps = [Endpoint(f"m{i}", 9000 + i) for i in range(5)]
+    view = MembershipView(4, node_ids=ids, endpoints=eps, topology=TOPOLOGY_JAVA)
+    expected = configuration_id_of(
+        sorted(ids, key=lambda n: node_id_sort_key(n, TOPOLOGY_JAVA)),
+        view.ring(0),
+        TOPOLOGY_JAVA,
+    )
+    assert view.configuration_id == expected
+    # And it differs from the native id for the same membership.
+    native_view = MembershipView(4, node_ids=ids, endpoints=eps)
+    assert native_view.configuration_id != view.configuration_id
+
+
+def test_invalid_topology_rejected():
+    with pytest.raises(ValueError):
+        MembershipView(3, topology="jvm")
+    s = Settings()
+    s.topology = "jvm"
+    with pytest.raises(ValueError):
+        s.validate()
+
+
+# ---------------------------------------------------------------------------
+# Golden fixture: freeze the compat keyspace against silent drift.
+# ---------------------------------------------------------------------------
+
+
+def _golden_case():
+    ids = [NodeId(high=h, low=l) for h, l in
+           [(1, 2), ((1 << 63) + 7, 3), (42, (1 << 63) + 1)]]
+    eps = [Endpoint("alpha.rapid", 50001), Endpoint("beta.rapid", 50002),
+           Endpoint("gamma.rapid", 50003)]
+    return ids, eps
+
+
+def test_golden_fixture():
+    ids, eps = _golden_case()
+    with open(FIXTURE) as f:
+        golden = json.load(f)
+    for ep, expect in zip(eps, golden["ring_keys"]):
+        assert [ring_key_java(ep, seed) for seed in range(3)] == expect
+    view = MembershipView(3, node_ids=ids, endpoints=eps, topology=TOPOLOGY_JAVA)
+    assert [f"{e.hostname}:{e.port}" for e in view.ring(0)] == golden["ring0_order"]
+    assert view.configuration_id == golden["configuration_id"]
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint + cluster integration.
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_roundtrip_preserves_topology():
+    from rapid_tpu.utils.checkpoint import (
+        configuration_from_bytes,
+        configuration_to_bytes,
+        view_from_configuration,
+    )
+
+    ids, eps = _golden_case()
+    config = Configuration(ids, eps, topology=TOPOLOGY_JAVA)
+    restored = configuration_from_bytes(configuration_to_bytes(config))
+    assert restored.topology == TOPOLOGY_JAVA
+    assert restored.configuration_id == config.configuration_id
+    assert view_from_configuration(restored, 3).topology == TOPOLOGY_JAVA
+    # Native configs still round-trip native.
+    native = Configuration(ids, eps)
+    assert configuration_from_bytes(configuration_to_bytes(native)).topology == TOPOLOGY_NATIVE
+
+
+def test_v1_checkpoint_loads_as_native():
+    # Pre-topology checkpoints (version byte 1, no trailing topology byte)
+    # were always native mode; they must keep loading.
+    from rapid_tpu.utils.checkpoint import configuration_from_bytes, configuration_to_bytes
+
+    ids, eps = _golden_case()
+    v2 = bytearray(configuration_to_bytes(Configuration(ids, eps)))
+    v1 = bytes(v2[:4]) + bytes([1]) + bytes(v2[5:-1])  # rewrite version, drop topology byte
+    restored = configuration_from_bytes(v1)
+    assert restored.topology == TOPOLOGY_NATIVE
+    assert restored.endpoints == tuple(eps)
+
+
+def _async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        async def with_timeout():
+            await asyncio.wait_for(fn(*args, **kwargs), timeout=60)
+
+        asyncio.run(with_timeout())
+
+    return wrapper
+
+
+@_async_test
+async def test_java_mode_cluster_converges():
+    # A compat-mode cluster runs the full protocol (join handshake streams
+    # the config; every member folds the same java-semantics id).
+    settings = Settings()
+    settings.batching_window_ms = 20
+    settings.failure_detector_interval_ms = 50
+    settings.rpc_timeout_ms = 500
+    settings.rpc_join_timeout_ms = 2000
+    settings.topology = TOPOLOGY_JAVA
+    network = InProcessNetwork()
+    eps = [Endpoint("127.0.0.1", 21000 + i) for i in range(4)]
+    clusters = [
+        await Cluster.start(eps[0], settings=settings, network=network,
+                            fd_factory=StaticFailureDetectorFactory(),
+                            rng=random.Random(0))
+    ]
+    try:
+        for i in range(1, 4):
+            clusters.append(
+                await Cluster.join(eps[0], eps[i], settings=settings, network=network,
+                                   fd_factory=StaticFailureDetectorFactory(),
+                                   rng=random.Random(i))
+            )
+        assert await wait_until(
+            lambda: all(c.membership_size == 4 for c in clusters)
+        )
+        ids = {c.service.view.configuration_id for c in clusters}
+        assert len(ids) == 1
+        # The agreed id is the JAVA fold of the membership, not the native one.
+        view = clusters[0].service.view
+        assert view.topology == TOPOLOGY_JAVA
+        expected = configuration_id_of(
+            sorted(view.configuration.node_ids,
+                   key=lambda n: node_id_sort_key(n, TOPOLOGY_JAVA)),
+            view.ring(0),
+            TOPOLOGY_JAVA,
+        )
+        assert ids == {expected}
+    finally:
+        await asyncio.gather(*(c.shutdown() for c in clusters), return_exceptions=True)
