@@ -33,6 +33,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -475,7 +476,12 @@ def _snapshot_is_stale(root: str, snap_rev, head_rev) -> bool:
     diffing the measurement-relevant paths between them. Unknown revs (or a
     snapshot rev no longer in the repo) are stale: provenance that cannot be
     checked is never trusted."""
-    if snap_rev is None or head_rev is None:
+    # snap_rev comes from an evidence JSON file: only a hex-looking string is
+    # allowed into the git argv (a non-string would raise past the except
+    # clause below; a leading-dash string would parse as a git option).
+    if not isinstance(snap_rev, str) or not re.fullmatch(r"[0-9a-fA-F]{7,40}", snap_rev):
+        return True
+    if head_rev is None:
         return True
     if snap_rev == head_rev:
         return False

@@ -8,16 +8,19 @@ as single datagrams — no connection setup, no response path — while
 request/response traffic (joins, probes, coordinator-bound phase1b) stays on
 the reliable TCP path. Both listeners share the endpoint's port.
 
-Protocol safety: everything sent over UDP is already best-effort in the
-protocol (alert redelivery via further FD ticks; consensus tolerates lost
-votes via the fallback), so datagram loss normally costs latency, not
-correctness. The known exception is a lost UP alert whose decision still
-arrives via consensus: the receiver then lacks the joiner's UUID and cannot
-apply the view. The membership service detects that case before mutating
-anything and recovers by rejoining (``service._recover_from_unknown_joiners``)
-rather than corrupting its view — so the failure mode is a forced rejoin,
-not an inconsistency, but it is a real availability cost this transport
-widens relative to TCP-only alert delivery.
+Protocol safety and liveness: the protocol treats everything routed over UDP
+as best-effort and replaces the reference transport's delivery guarantee at
+the protocol level (see settings.py): alert batches are re-broadcast while
+their cut is unresolved, undecided consensus re-arms (vote re-offer plus
+escalating classic rounds, ``fast_paxos.py``), and a node that misses a
+decision entirely pulls the configuration from a peer over the reliable TCP
+path (``service._config_sync_loop``). Datagram loss therefore costs
+convergence latency, never liveness or correctness. Even the historically
+worst case — a decision naming a joiner whose every UP alert datagram was
+lost — now resolves by pulling the decided configuration (identifiers
+included) from a peer instead of forcing a rejoin
+(``service._recover_from_unknown_joiners``). tests/test_udp_loss.py pins the
+envelope; tests/test_delivery_liveness.py pins each mechanism.
 """
 
 from __future__ import annotations
@@ -192,9 +195,9 @@ class LossyDatagramClient(UdpHybridClient):
     network loss strikes (the sender believes it sent; no TCP fallback
     engages). This is the instrument that quantifies the hybrid transport's
     admitted tradeoff (module docstring above): datagram loss costs
-    convergence latency (lost votes ride out the fallback timer) and, in the
-    limit, forced rejoins (a decision naming a joiner whose every UP alert
-    was lost). tests/test_udp_loss.py pins the rejoin-free envelope;
+    convergence latency — lost votes and alerts ride out the redelivery and
+    fallback timers, and in the limit a node catches up by config pull.
+    tests/test_udp_loss.py pins the rejoin-free envelope;
     examples/udp_loss_curve.py measures the latency curve."""
 
     def __init__(

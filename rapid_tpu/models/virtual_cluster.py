@@ -860,6 +860,7 @@ class VirtualCluster:
         delivery_prob_permille: int = 1000,
         pallas_lanes: int = 128,
         n_members: Optional[int] = None,
+        topology: str = "native",
     ) -> "VirtualCluster":
         """Build from real endpoints with the host view's exact ring keys, so
         the engine's topology matches a host MembershipView bit-for-bit.
@@ -868,7 +869,15 @@ class VirtualCluster:
         live members; the rest become keyed-but-dead slots reserved for a
         later ``inject_join_wave`` — their ring keys are already the host
         view's keys for those endpoints, so a join admits them at exactly the
-        ring positions the host stack would."""
+        ring positions the host stack would.
+
+        Callers pairing the engine with a host ``MembershipView`` must thread
+        ``topology=view.topology``: the engine's u64 keyspace cannot
+        represent the java-compat signed ring order, so java mode is
+        rejected (``endpoint_ring_keys``). The parameter defaults to native —
+        the only mode the engine supports — so a caller that omits it while
+        holding a java view still diverges; threading the view's mode is
+        what turns that into a loud failure."""
         if n_members is None:
             n_members = len(endpoints)
         if not 0 < n_members <= len(endpoints):
@@ -888,7 +897,7 @@ class VirtualCluster:
             delivery_prob_permille=delivery_prob_permille,
             pallas_lanes=pallas_lanes,
         )
-        key_hi0, key_lo0 = endpoint_ring_keys(endpoints, k)
+        key_hi0, key_lo0 = endpoint_ring_keys(endpoints, k, topology=topology)
         key_hi = np.zeros((k, n), dtype=np.uint32)
         key_lo = np.zeros((k, n), dtype=np.uint32)
         key_hi[:, : len(endpoints)] = np.asarray(key_hi0)

@@ -38,12 +38,22 @@ class RingTopology(NamedTuple):
     order: jnp.ndarray
 
 
-def endpoint_ring_keys(endpoints, k: int):
+def endpoint_ring_keys(endpoints, k: int, topology: str = "native"):
     """Host-side: K seeded 64-bit ring keys per endpoint, split into uint32
     lanes of shape [K, N]. Uses the exact key function of the host view so
     device and host topologies agree bit-for-bit. The native C library (when
     built) computes the whole batch at memory bandwidth; the Python fallback
-    is bit-identical."""
+    is bit-identical.
+
+    Native topology only: the u64 keyspace and unsigned ring order are what
+    the device kernels assume. ``TOPOLOGY_JAVA`` views order rings by SIGNED
+    4-byte-port hashes (``view.ring_key_java``); feeding those through this
+    seam would silently compute divergent ring orders, so it is rejected."""
+    if topology != "native":
+        raise ValueError(
+            f"the device/engine path requires the native topology; got {topology!r} "
+            "(java-compat ring order is host-path only)"
+        )
     from rapid_tpu.utils._native import native_ring_keys_batch
 
     keys = native_ring_keys_batch(

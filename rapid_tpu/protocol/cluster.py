@@ -148,6 +148,7 @@ class Cluster:
             rng=rng,
             vote_tally_factory=vote_tally_factory,
             broadcaster=broadcaster,
+            node_id=node_id,
         )
         server.set_membership_service(cls._server_handler(broadcaster, service))
         await server.start()
@@ -300,7 +301,7 @@ class Cluster:
                 return cls._from_join_response(
                     response, listen_address, settings, client, server,
                     fd_factory, subscriptions, clock, rng, cut_detector_factory,
-                    vote_tally_factory, broadcaster_factory,
+                    vote_tally_factory, broadcaster_factory, node_id=node_id,
                 )
         raise JoinPhaseTwoError()
 
@@ -308,7 +309,7 @@ class Cluster:
     def _from_join_response(
         cls, response: JoinResponse, listen_address, settings, client, server,
         fd_factory, subscriptions, clock, rng, cut_detector_factory=None,
-        vote_tally_factory=None, broadcaster_factory=None,
+        vote_tally_factory=None, broadcaster_factory=None, node_id=None,
     ) -> "Cluster":
         """Build the node from a streamed configuration (Cluster.java:442-474)."""
         assert response.endpoints and response.identifiers
@@ -337,6 +338,7 @@ class Cluster:
             rng=rng,
             vote_tally_factory=vote_tally_factory,
             broadcaster=broadcaster,
+            node_id=node_id,
         )
         server.set_membership_service(cls._server_handler(broadcaster, service))
         cluster = cls(listen_address, service, server, client)

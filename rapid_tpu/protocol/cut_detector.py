@@ -42,6 +42,12 @@ class MultiNodeCutDetector:
     def num_proposals(self) -> int:
         return self._proposal_count
 
+    def has_pending_reports(self) -> bool:
+        """True while any edge report is held for the current configuration —
+        the service's alert-redelivery and config-sync loops use this as the
+        'a cut may be stuck below H somewhere' suspicion signal."""
+        return bool(self._reports_per_host)
+
     def aggregate(self, msg: AlertMessage) -> List[Endpoint]:
         """Apply one alert (all its ring numbers); returns the released
         proposal if this alert completed one, else [] (MultiNodeCutDetector.java:76-82)."""

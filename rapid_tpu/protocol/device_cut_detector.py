@@ -56,6 +56,11 @@ class DeviceCutDetector:
     def num_proposals(self) -> int:
         return self._proposal_count
 
+    def has_pending_reports(self) -> bool:
+        """True while any subject occupies a report slot this configuration —
+        same suspicion signal as MultiNodeCutDetector.has_pending_reports."""
+        return bool(self._slot_of)
+
     def _slot(self, endpoint: Endpoint) -> Optional[int]:
         """Slot for an endpoint, or None when capacity is exhausted. Alerts
         for unslottable endpoints are dropped — always protocol-safe (alert

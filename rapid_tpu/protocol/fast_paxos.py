@@ -75,6 +75,12 @@ class FastPaxos:
         self._votes_received: Set[Endpoint] = set()
         self.decided = False
         self._fallback_task: Optional[CancelHandle] = None
+        self._cancelled = False
+        self._my_proposal: Optional[Tuple[Endpoint, ...]] = None
+        # Classic rounds escalate 2, 3, 4, ... on every liveness tick until a
+        # decision lands — the host-side twin of the engine's per-epoch
+        # classic-attempt rotation (models/virtual_cluster.py classic_epoch).
+        self._next_classic_round = 2
 
         def on_decide_wrapped(hosts: Tuple[Endpoint, ...]) -> None:
             if self.decided:
@@ -93,8 +99,16 @@ class FastPaxos:
         self, proposal: Sequence[Endpoint], recovery_delay_ms: Optional[float] = None
     ) -> None:
         """Vote for ``proposal`` in the fast round and arm the classic-round
-        fallback (FastPaxos.java:94-108)."""
+        fallback (FastPaxos.java:94-108).
+
+        Unlike the reference — whose transport guarantees delivery, so one
+        broadcast and one single-shot fallback suffice — the fallback here is
+        a recurring liveness tick: every firing re-broadcasts the fast-round
+        vote (receivers dedup by sender) and escalates one classic round,
+        re-armed with fresh jitter until the decision lands. One lost
+        datagram therefore costs one fallback period, never liveness."""
         proposal = tuple(proposal)
+        self._my_proposal = proposal
         self.paxos.register_fast_round_vote(proposal)
         self._broadcast(
             FastRoundPhase2bMessage(
@@ -103,11 +117,30 @@ class FastPaxos:
                 endpoints=proposal,
             )
         )
-        if recovery_delay_ms is None:
-            recovery_delay_ms = self._random_delay_ms()
-        self._fallback_task = self._clock.call_later_ms(
-            recovery_delay_ms, self.start_classic_paxos_round
-        )
+        self._arm_liveness(recovery_delay_ms)
+
+    def _arm_liveness(self, delay_ms: Optional[float] = None) -> None:
+        if self._cancelled or self.decided:
+            return
+        if delay_ms is None:
+            delay_ms = self._random_delay_ms()
+        self._fallback_task = self._clock.call_later_ms(delay_ms, self._liveness_tick)
+
+    def _liveness_tick(self) -> None:
+        if self._cancelled or self.decided:
+            return
+        if self._my_proposal is not None:
+            # Re-offer our fast-round vote: a late quorum can still decide in
+            # round 1, and it re-seeds vval for any classic coordinator.
+            self._broadcast(
+                FastRoundPhase2bMessage(
+                    sender=self.my_addr,
+                    configuration_id=self.configuration_id,
+                    endpoints=self._my_proposal,
+                )
+            )
+        self.start_classic_paxos_round()
+        self._arm_liveness()
 
     def handle_message(self, request: RapidRequest) -> RapidResponse:
         """Route the five consensus message types (FastPaxos.java:163-184)."""
@@ -147,14 +180,21 @@ class FastPaxos:
             self._on_decide(proposal)
 
     def start_classic_paxos_round(self) -> None:
-        """Fallback entry: classic rounds always start at round 2
-        (FastPaxos.java:189-195)."""
+        """Fallback entry: classic rounds start at round 2 and escalate by
+        one on each re-entry (FastPaxos.java:189-195 starts round 2 exactly
+        once; the escalation is this implementation's liveness replacement
+        for the reference's reliable transport)."""
         if not self.decided:
             if self._on_classic_round is not None:
+                # Fires per classic round started (the metric's meaning);
+                # the service gates the once-per-configuration
+                # VIEW_CHANGE_ONE_STEP_FAILED event itself.
                 self._on_classic_round()
-            self.paxos.start_phase1a(2)
+            self.paxos.start_phase1a(self._next_classic_round)
+            self._next_classic_round += 1
 
     def cancel_fallback(self) -> None:
+        self._cancelled = True
         if self._fallback_task is not None:
             self._fallback_task.cancel()
 

@@ -66,10 +66,22 @@ class Paxos:
     # -- coordinator ------------------------------------------------------
 
     def start_phase1a(self, round_number: int) -> None:
-        """Become coordinator for ``round_number`` (Paxos.java:98-111)."""
+        """Become coordinator for ``round_number`` (Paxos.java:98-111).
+
+        Re-entrant: the fallback re-arms with escalating rounds until a
+        decision lands (lossy transports can eat an entire round's messages).
+        Advancing to a higher rank discards the previous round's coordinator
+        state — promises collected at an older crnd must never satisfy the
+        new round's majority, and ``cval`` must be re-picked from the new
+        round's phase1b quorum (the reference never re-enters,
+        FastPaxos.java:189-195, because its transport never drops)."""
         if self.crnd.round > round_number:
             return
-        self.crnd = Rank(round_number, node_index_of(self.my_addr))
+        rank = Rank(round_number, node_index_of(self.my_addr))
+        if rank.as_tuple() > self.crnd.as_tuple():
+            self.crnd = rank
+            self._phase1b_messages = {}
+            self.cval = ()
         self._broadcast(
             Phase1aMessage(
                 sender=self.my_addr, configuration_id=self.configuration_id, rank=self.crnd
@@ -140,6 +152,13 @@ class Paxos:
                 )
             )
 
+    # Classic rounds escalate while undecided (fast_paxos liveness tick), so
+    # a long-lived partition would otherwise accumulate one accept-tally per
+    # rank forever. Only the highest few ranks can still plausibly complete a
+    # majority; pruning below them affects memory, never safety (a pruned
+    # rank merely loses the ability to decide at that stale rank).
+    _MAX_TRACKED_ACCEPT_RANKS = 8
+
     def handle_phase2b(self, msg: Phase2bMessage) -> None:
         """Learner: decide on a majority of identical-rank accepts
         (Paxos.java:223-238)."""
@@ -147,6 +166,9 @@ class Paxos:
             return
         in_rnd = self._accept_responses.setdefault(msg.rnd, {})
         in_rnd[msg.sender] = msg
+        if len(self._accept_responses) > self._MAX_TRACKED_ACCEPT_RANKS:
+            oldest = min(self._accept_responses, key=lambda r: r.as_tuple())
+            del self._accept_responses[oldest]
         if len(in_rnd) > self.n // 2 and not self.decided:
             self.decided = True
             self._on_decide(msg.endpoints)

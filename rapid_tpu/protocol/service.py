@@ -17,7 +17,8 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
-from typing import Dict, List, Optional, Tuple
+from collections import OrderedDict
+from typing import Dict, List, Optional, Set, Tuple
 
 from rapid_tpu.messaging.base import Broadcaster, MessagingClient, UnicastToAllBroadcaster
 from rapid_tpu.monitoring.base import EdgeFailureDetectorFactory
@@ -62,6 +63,28 @@ CONSENSUS_TYPES = (
     Phase2bMessage,
 )
 
+#: Sentinel configuration id for a member-initiated config pull: guaranteed to
+#: mismatch the receiver's current configuration, routing the request into the
+#: config-stream branch of the join phase-2 handler (the same -1 trick the
+#: joiner uses after HOSTNAME_ALREADY_IN_RING, Cluster.java:374-381).
+CATCH_UP_CONFIG_ID = -1
+
+#: Alert batches are re-broadcast unconditionally this many times (our own
+#: copy of the original broadcast may itself have been lost, leaving us with
+#: no local evidence that a cut is pending), then only while the cut detector
+#: or consensus still shows the cut unresolved, capped at _MAX_REDELIVERIES so
+#: a permanently sub-L straggler report cannot generate traffic forever.
+_UNCONDITIONAL_REDELIVERIES = 5
+_MAX_REDELIVERIES = 30
+
+#: Config-sync pulls per configuration when the only suspicion is an
+#: unresolved cut report (a permanently sub-L straggler would otherwise pull
+#: a full membership snapshot every interval forever — same rationale as
+#: _MAX_REDELIVERIES). The stronger suspicions — an undecided proposal, or a
+#: decision we could not apply — stay uncapped: those states MUST resolve and
+#: the traffic stops the moment they do.
+_MAX_REPORT_ONLY_SYNC_PULLS = 30
+
 
 class MembershipService:
     def __init__(
@@ -78,8 +101,14 @@ class MembershipService:
         broadcaster: Optional[Broadcaster] = None,
         rng: Optional[random.Random] = None,
         vote_tally_factory=None,
+        node_id: Optional[NodeId] = None,
     ) -> None:
         self.my_addr = my_addr
+        # This node's own identifier. Required for the config catch-up path
+        # (the pull rides the join phase-2 config-stream branch, which
+        # authenticates membership by endpoint + identifier); without it the
+        # service falls back to reference-style KICKED recovery.
+        self.node_id = node_id
         self.settings = settings
         self.view = view
         self.cut_detector = cut_detector
@@ -114,6 +143,22 @@ class MembershipService:
         self._fd_tasks: List[asyncio.Task] = []
         self._fd_generation = 0
         self._stopped = False
+        # Delivery-liveness state (droppable transports; settings.py):
+        # alerts broadcast for the current configuration (redelivery buffer),
+        # catch-up bookkeeping, and the config-id history used to tell
+        # straggler traffic from evidence of an unknown configuration.
+        self._alerts_sent: List[AlertMessage] = []
+        self._redeliveries_this_config = 0
+        self._catch_up_inflight = False
+        self._catch_up_tasks: Set[asyncio.Task] = set()
+        self._last_catch_up_ms = float("-inf")
+        self._decision_pending_catch_up = False
+        self._kicked_signalled = False
+        self._report_only_sync_pulls = 0
+        self._undecided_suspicion_ticks = 0
+        self._one_step_failed_notified = False
+        self._known_config_ids: "OrderedDict[int, bool]" = OrderedDict()
+        self._remember_config_id(self.view.configuration_id)
 
         self.broadcaster.set_membership(self.view.ring(0))
         self._fast_paxos = self._new_fast_paxos()
@@ -127,18 +172,27 @@ class MembershipService:
     # ------------------------------------------------------------------
 
     async def start(self) -> None:
-        """Arm the alert batcher and failure detectors."""
+        """Arm the alert batcher, delivery-liveness loops, and failure
+        detectors."""
         self._background_tasks.append(asyncio.ensure_future(self._alert_batcher_loop()))
+        self._background_tasks.append(asyncio.ensure_future(self._alert_redelivery_loop()))
+        self._background_tasks.append(asyncio.ensure_future(self._config_sync_loop()))
         self._create_failure_detectors()
 
     async def shutdown(self) -> None:
         self._stopped = True
+        self._fast_paxos.cancel_fallback()
         fd_tasks = self._cancel_failure_detectors()
         for task in self._background_tasks:
             task.cancel()
+        catch_up_tasks = list(self._catch_up_tasks)
+        for task in catch_up_tasks:
+            task.cancel()
         # Await detectors too: a mid-tick probe must finish (or unwind) before
         # the client underneath it is shut down.
-        await asyncio.gather(*self._background_tasks, *fd_tasks, return_exceptions=True)
+        await asyncio.gather(
+            *self._background_tasks, *fd_tasks, *catch_up_tasks, return_exceptions=True
+        )
         self._background_tasks.clear()
         await self.client.shutdown()
 
@@ -178,9 +232,11 @@ class MembershipService:
                 return await future
             return future
         if isinstance(request, BatchedAlertMessage):
+            self._note_config_evidence(request)
             async with self._lock:
                 return self._handle_batched_alerts(request)
         if isinstance(request, CONSENSUS_TYPES):
+            self._note_config_evidence(request)
             async with self._lock:
                 return self._fast_paxos.handle_message(request)
         if isinstance(request, LeaveMessage):
@@ -246,6 +302,21 @@ class MembershipService:
                 identifiers=config.node_ids,
                 metadata_keys=tuple(metadata.keys()),
                 metadata_values=tuple(metadata.values()),
+            )
+        if self.view.is_identifier_present(msg.node_id):
+            # Known identifier, absent host: identifier history is
+            # append-only, so this view is at-or-past the sender's EVICTION —
+            # a pre-join stale view would never have seen its identifier.
+            # Return the configuration as verifiable eviction proof (the
+            # sender checks identifiers ⊇ its own ∧ itself ∉ endpoints); a
+            # plain joiner retrying phase 2 ignores the payload and retries
+            # phase 1 on the status code as before.
+            return JoinResponse(
+                sender=self.my_addr,
+                status_code=JoinStatusCode.CONFIG_CHANGED,
+                configuration_id=config.configuration_id,
+                endpoints=config.endpoints,
+                identifiers=config.node_ids,
             )
         return JoinResponse(
             sender=self.my_addr,
@@ -355,12 +426,18 @@ class MembershipService:
                     self.metadata_manager.add_metadata({node: metadata})
                 status_changes.append(NodeStatusChange(node, EdgeStatus.UP, metadata))
 
-        config_id = self.view.configuration_id
         change = ClusterStatusChange(
-            configuration_id=config_id,
+            configuration_id=self.view.configuration_id,
             membership=tuple(self.view.ring(0)),
             status_changes=tuple(status_changes),
         )
+        self._commit_view_change(change, respond_to=proposal)
+
+    def _commit_view_change(self, change: ClusterStatusChange, respond_to) -> None:
+        """The apply/notify tail every view change shares — consensus
+        decision and config catch-up alike: metrics, VIEW_CHANGE notify,
+        per-configuration reset, failure-detector re-arm (or KICKED), and
+        joiner responses."""
         self.metrics.inc("view_changes")
         if self._convergence_timing:
             self.metrics.record_ms(
@@ -369,28 +446,75 @@ class MembershipService:
             )
             self._convergence_timing = False
         self._notify(ClusterEvents.VIEW_CHANGE, change)
-
-        # Reset for the next configuration.
-        self.cut_detector.clear()
-        self._announced_proposal = False
-        self._fast_paxos = self._new_fast_paxos()
-        self.broadcaster.set_membership(self.view.ring(0))
+        self._reset_for_new_configuration()
 
         if self.view.is_host_present(self.my_addr):
             self._create_failure_detectors()
-        else:
+        elif not self._kicked_signalled:
             LOG.info("%s was kicked out", self.my_addr)
+            self._kicked_signalled = True
             self.metrics.inc("kicked")
             self._notify(ClusterEvents.KICKED, change)
 
-        self._respond_to_joiners(proposal)
+        self._respond_to_joiners(respond_to)
+
+    def _reset_for_new_configuration(self) -> None:
+        """Per-configuration protocol state reset, shared by the consensus
+        decision path and the config catch-up path."""
+        self.cut_detector.clear()
+        self._announced_proposal = False
+        self._alerts_sent.clear()
+        self._redeliveries_this_config = 0
+        # Joiner bookkeeping is per-configuration: a live joiner re-alerts in
+        # the new configuration on its next attempt, and an identifier
+        # recorded under an older configuration must never satisfy a later
+        # decision's missing-identifier check — installing a stale identifier
+        # would silently fork this node's configuration id from the cluster's.
+        self._joiner_uuid.clear()
+        self._joiner_metadata.clear()
+        self._report_only_sync_pulls = 0
+        self._undecided_suspicion_ticks = 0
+        self._one_step_failed_notified = False
+        self._decision_pending_catch_up = False
+        self._remember_config_id(self.view.configuration_id)
+        self._fast_paxos.cancel_fallback()
+        self._fast_paxos = self._new_fast_paxos()
+        self.broadcaster.set_membership(self.view.ring(0))
+
+    def _remember_config_id(self, config_id: int) -> None:
+        """Bounded history of configuration ids this node has inhabited or
+        verified (via a pull) as not ahead of it: distinguishes straggler
+        traffic from a configuration we genuinely missed (ids are hash
+        folds, not ordered — history is the only way to tell)."""
+        self._known_config_ids[config_id] = True
+        self._known_config_ids.move_to_end(config_id)
+        while len(self._known_config_ids) > 64:
+            self._known_config_ids.popitem(last=False)
 
     def _recover_from_unknown_joiners(self, missing: List[Endpoint]) -> None:
-        """The cluster decided a view containing joiners we know nothing
-        about; the rest of the cluster will apply it, so our configuration is
-        now permanently stale. Stop participating and signal ``KICKED`` so the
-        application layer performs the standard stale-node recovery: rejoin
-        with a fresh identity (same path as an eviction)."""
+        """The cluster decided a view containing joiners whose identifiers we
+        never received (their UP alerts were lost in transit). The decided
+        configuration — identifiers included — exists in full at every peer
+        that applied it, so the primary recovery is a config catch-up pull
+        over the reliable path; the config-sync loop keeps retrying random
+        peers until one has applied the decision. Only a service that cannot
+        pull (no identity plumbed / sync disabled) falls back to the
+        reference-style recovery: stop participating and signal ``KICKED`` so
+        the application rejoins with a fresh identity."""
+        self.metrics.inc("decision_missing_joiner_uuid")
+        if self.node_id is not None and self.settings.config_sync_interval_ms > 0:
+            LOG.warning(
+                "%s cannot apply view change in config %d: no UUID recorded "
+                "for joiner(s) %s; pulling the decided configuration",
+                self.my_addr,
+                self.view.configuration_id,
+                [str(n) for n in missing],
+            )
+            self._decision_pending_catch_up = True
+            peer = self._random_peer()
+            if peer is not None:
+                self._spawn_catch_up(peer)
+            return
         LOG.error(
             "%s cannot apply view change in config %d: no UUID recorded for "
             "joiner(s) %s; signalling KICKED for rejoin",
@@ -398,7 +522,6 @@ class MembershipService:
             self.view.configuration_id,
             [str(n) for n in missing],
         )
-        self.metrics.inc("decision_missing_joiner_uuid")
         self._cancel_failure_detectors()
         self._notify(
             ClusterEvents.KICKED,
@@ -430,12 +553,16 @@ class MembershipService:
         )
 
     def _on_fast_round_failed(self) -> None:
-        """The jittered fallback fired before a fast-round quorum formed:
-        classic Paxos is engaging. The reference DECLARES this event but
-        never fires it (ClusterEvents.java:19-23); here the declared API is
-        completed — subscribers learn exactly when one-step consensus failed
-        and the metrics record how often the slow path runs."""
+        """The fallback fired before a fast-round quorum formed: classic
+        Paxos is engaging. The metric counts every classic round started
+        (rounds escalate while undecided); the VIEW_CHANGE_ONE_STEP_FAILED
+        event — which the reference DECLARES but never fires
+        (ClusterEvents.java:19-23) — fires once per configuration, the
+        moment one-step consensus is first abandoned for the slow path."""
         self.metrics.inc("classic_rounds_started")
+        if self._one_step_failed_notified:
+            return
+        self._one_step_failed_notified = True
         self._notify(
             ClusterEvents.VIEW_CHANGE_ONE_STEP_FAILED,
             ClusterStatusChange(
@@ -561,9 +688,310 @@ class MembershipService:
             ):
                 messages, self._send_queue = self._send_queue, []
                 self.metrics.inc("alert_batches_sent")
+                self._alerts_sent.extend(messages)
                 self.broadcaster.broadcast(
                     BatchedAlertMessage(sender=self.my_addr, messages=tuple(messages))
                 )
+
+    # ------------------------------------------------------------------
+    # delivery liveness (droppable transports; settings.py rationale)
+    #
+    # The reference's protocol fires every broadcast exactly once and stays
+    # live because its transport guarantees delivery (Retries.java:43-90,
+    # GrpcClient.java:106-115). Here transports may drop (the UDP hybrid
+    # ships one-way traffic as datagrams), so the delivery guarantee is
+    # re-established at the protocol level: alert batches are re-broadcast
+    # while their cut is unresolved, undecided consensus re-arms (fast_paxos
+    # re-offers votes and escalates classic rounds), and a node with
+    # evidence or suspicion of staleness pulls the current configuration
+    # from a peer over the reliable request/response path.
+    # ------------------------------------------------------------------
+
+    async def _alert_redelivery_loop(self) -> None:
+        """Re-broadcast this configuration's alert batches while the cut they
+        announce is unresolved. Receivers are idempotent — the cut detector
+        dedups per (subject, ring) and vote tallies dedup per sender — so
+        redelivery is always safe. The first few rounds are unconditional
+        (our own copy of the original broadcast may itself have been lost,
+        leaving no local evidence of a pending cut); afterwards only while
+        local state shows the cut in flight, capped at _MAX_REDELIVERIES."""
+        interval = self.settings.alert_redelivery_interval_ms
+        if interval <= 0:
+            return
+        while not self._stopped:
+            await self.clock.sleep_ms(interval)
+            try:
+                async with self._lock:
+                    if self._stopped:
+                        return
+                    config_id = self.view.configuration_id
+                    pending = tuple(
+                        m for m in self._alerts_sent if m.configuration_id == config_id
+                    )
+                    if not pending or self._redeliveries_this_config >= _MAX_REDELIVERIES:
+                        continue
+                    unresolved = (
+                        self._announced_proposal and not self._fast_paxos.decided
+                    ) or (
+                        not self._announced_proposal
+                        and self.cut_detector.has_pending_reports()
+                    )
+                    if (
+                        not unresolved
+                        and self._redeliveries_this_config >= _UNCONDITIONAL_REDELIVERIES
+                    ):
+                        continue
+                    self._redeliveries_this_config += 1
+                    self.metrics.inc("alert_batches_redelivered")
+                    self.broadcaster.broadcast(
+                        BatchedAlertMessage(sender=self.my_addr, messages=pending)
+                    )
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — the liveness loop must survive
+                LOG.exception("%s alert redelivery tick failed; continuing", self.my_addr)
+
+    async def _config_sync_loop(self) -> None:
+        """Anti-entropy for the configuration itself: while this node has
+        reason to believe it is stuck — an undecided proposal, an unresolved
+        cut, or a decision it could not apply — pull the current
+        configuration from a random peer each interval. The pull rides the
+        reliable path, so unlike every broadcast above it cannot be lost."""
+        interval = self.settings.config_sync_interval_ms
+        if interval <= 0 or self.node_id is None:
+            return
+        while not self._stopped:
+            await self.clock.sleep_ms(interval)
+            try:
+                async with self._lock:
+                    if self._stopped:
+                        return
+                    # An undecided proposal is normal for the first couple of
+                    # intervals of any slow classic decision; only a
+                    # PERSISTENTLY undecided one warrants pulling snapshots.
+                    if self._announced_proposal and not self._fast_paxos.decided:
+                        self._undecided_suspicion_ticks += 1
+                    else:
+                        self._undecided_suspicion_ticks = 0
+                    strong = self._decision_pending_catch_up or (
+                        self._undecided_suspicion_ticks >= 2
+                    )
+                    report_only = (
+                        not self._announced_proposal
+                        and self.cut_detector.has_pending_reports()
+                        and self._report_only_sync_pulls < _MAX_REPORT_ONLY_SYNC_PULLS
+                    )
+                    suspicious = (
+                        not self._kicked_signalled
+                        and not self._catch_up_inflight
+                        and (strong or report_only)
+                    )
+                    if suspicious and not strong:
+                        # Budget counts pulls actually issued, not skipped ticks.
+                        self._report_only_sync_pulls += 1
+                    peer = self._random_peer() if suspicious else None
+                if peer is not None:
+                    await self._catch_up(peer)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — anti-entropy must survive, e.g.
+                # a raising application subscriber inside a catch-up install.
+                LOG.exception("%s config sync tick failed; continuing", self.my_addr)
+
+    def _note_config_evidence(self, request: RapidRequest) -> None:
+        """Traffic stamped with a configuration id this node has never
+        inhabited is evidence that the cluster moved somewhere we missed:
+        pull from the sender (who, having stamped it, holds that config).
+        Ids are hash folds, not ordered, so the known-id history — not a
+        comparison — tells stragglers from the future."""
+        if self.node_id is None or self.settings.config_sync_interval_ms <= 0:
+            return
+        if self._stopped or self._catch_up_inflight:
+            return
+        if isinstance(request, BatchedAlertMessage):
+            config_ids = {m.configuration_id for m in request.messages}
+        else:
+            config_ids = {request.configuration_id}
+        unknown = frozenset(
+            cid for cid in config_ids if cid not in self._known_config_ids
+        )
+        if unknown:
+            sender = request.sender
+            if sender != self.my_addr:
+                now = self.clock.now_ms()
+                if now - self._last_catch_up_ms >= self.settings.config_sync_interval_ms:
+                    self._last_catch_up_ms = now
+                    self._spawn_catch_up(sender, trigger_ids=unknown)
+
+    def _random_peer(self) -> Optional[Endpoint]:
+        members = [m for m in self.view.ring(0) if m != self.my_addr]
+        if not members:
+            return None
+        return self.rng.choice(members)
+
+    def _spawn_catch_up(self, peer: Endpoint, trigger_ids: frozenset = frozenset()) -> None:
+        task = asyncio.ensure_future(self._catch_up(peer, trigger_ids))
+        self._catch_up_tasks.add(task)
+        task.add_done_callback(self._catch_up_task_done)
+
+    def _catch_up_task_done(self, task: asyncio.Task) -> None:
+        self._catch_up_tasks.discard(task)
+        if not task.cancelled() and task.exception() is not None:
+            LOG.error(
+                "%s config catch-up task failed: %r", self.my_addr, task.exception()
+            )
+
+    async def _catch_up(self, peer: Endpoint, trigger_ids: frozenset = frozenset()) -> None:
+        """Pull ``peer``'s current configuration via the join phase-2
+        config-stream branch (JoinMessage with the -1 config sentinel,
+        authenticated by our endpoint + identifier) and adopt it if it is
+        ahead of ours. ``trigger_ids`` are the unknown config ids whose
+        traffic triggered this pull: on a futile outcome they are remembered
+        as not-ahead (any id the sender stamped lies on its chain at or
+        behind the not-ahead config it just answered with), so the same
+        straggler traffic cannot re-trigger pulls forever."""
+        if self._catch_up_inflight or self._stopped or self.node_id is None:
+            return
+        self._catch_up_inflight = True
+        try:
+            request = JoinMessage(
+                sender=self.my_addr,
+                node_id=self.node_id,
+                ring_numbers=(),
+                configuration_id=CATCH_UP_CONFIG_ID,
+                metadata=(),
+            )
+            try:
+                response = await self.client.send(peer, request)
+            except Exception as exc:  # noqa: BLE001 — any transport failure: retry later
+                LOG.debug("%s config pull from %s failed: %r", self.my_addr, peer, exc)
+                return
+            if not isinstance(response, JoinResponse):
+                return
+            async with self._lock:
+                if not self._stopped:
+                    self._apply_catch_up_response(peer, response, trigger_ids)
+        finally:
+            self._catch_up_inflight = False
+
+    def _apply_catch_up_response(
+        self,
+        peer: Endpoint,
+        response: JoinResponse,
+        trigger_ids: frozenset = frozenset(),
+    ) -> None:
+        if self._kicked_signalled:
+            return
+        if response.status_code == JoinStatusCode.CONFIG_CHANGED:
+            # The peer's view does not contain us. That alone is ambiguous —
+            # the peer may be stuck in a configuration predating our join —
+            # so eviction is concluded ONLY from verifiable proof: the peer's
+            # identifier history contains everything ours does (it is at or
+            # past every configuration we inhabited; histories are
+            # append-only) yet its endpoints lack us. A stale pre-join peer
+            # cannot fabricate this — it has never seen our identifier — so
+            # no count of ambiguous answers is needed, and no count of
+            # ambiguous answers can falsely convict.
+            theirs_ids = frozenset(response.identifiers)
+            proven = (
+                bool(response.endpoints)
+                and theirs_ids >= self.view.identifiers_seen()
+                and self.my_addr not in set(response.endpoints)
+            )
+            if proven:
+                LOG.warning(
+                    "%s: peer %s proved a configuration past our eviction "
+                    "(identifier superset, endpoints exclude us); signalling KICKED",
+                    self.my_addr, peer,
+                )
+                # Latch: KICKED fires once; the application owns the rejoin.
+                # Also silence our consensus liveness tick — an evicted node
+                # must not keep broadcasting stale votes/rounds at the living.
+                self._kicked_signalled = True
+                self._fast_paxos.cancel_fallback()
+                self.metrics.inc("kicked")
+                self._cancel_failure_detectors()
+                self._notify(
+                    ClusterEvents.KICKED,
+                    ClusterStatusChange(
+                        configuration_id=self.view.configuration_id,
+                        membership=tuple(self.view.ring(0)),
+                        status_changes=(),
+                    ),
+                )
+            else:
+                # Learned nothing actionable: remember the peer's config id
+                # AND the trigger ids so this straggler traffic stops
+                # re-triggering evidence pulls (ids are hash-unique; a config
+                # verified not-ahead of us can never become ahead).
+                self._remember_config_id(response.configuration_id)
+                for cid in trigger_ids:
+                    self._remember_config_id(cid)
+            return
+        if response.status_code != JoinStatusCode.SAFE_TO_JOIN or not response.endpoints:
+            return
+        theirs_ids = frozenset(response.identifiers)
+        mine_ids = self.view.identifiers_seen()
+        theirs_eps = set(response.endpoints)
+        mine_eps = set(self.view.ring(0))
+        # Identifier history is append-only along the decided chain
+        # (view.identifiers_seen docstring), which orders configurations
+        # without a version counter.
+        newer = theirs_ids > mine_ids or (
+            theirs_ids == mine_ids and theirs_eps < mine_eps
+        )
+        if not newer:
+            # Futile pull: mark the peer's config and the trigger ids as
+            # known-not-ahead so this straggler traffic stops re-triggering
+            # evidence pulls.
+            self._remember_config_id(response.configuration_id)
+            for cid in trigger_ids:
+                self._remember_config_id(cid)
+            return
+        self.metrics.inc("config_catch_ups")
+        self._install_fetched_configuration(response)
+
+    def _install_fetched_configuration(self, response: JoinResponse) -> None:
+        """Adopt a configuration pulled from a peer: the catch-up twin of
+        ``_decide_view_change``'s apply path, with status changes computed as
+        the membership diff."""
+        old_members = set(self.view.ring(0))
+        old_metadata = self.metadata_manager.get_all_metadata()
+        self._cancel_failure_detectors()
+        self.view = MembershipView(
+            self.settings.k,
+            node_ids=response.identifiers,
+            endpoints=response.endpoints,
+            topology=self.settings.topology,
+        )
+        self.metadata_manager = MetadataManager()
+        if response.metadata_keys:
+            self.metadata_manager.add_metadata(
+                dict(zip(response.metadata_keys, response.metadata_values))
+            )
+        new_members = set(self.view.ring(0))
+        status_changes = tuple(
+            NodeStatusChange(node, EdgeStatus.UP, self.metadata_manager.get(node))
+            for node in self.view.ring_zero_sorted(new_members - old_members)
+        ) + tuple(
+            NodeStatusChange(node, EdgeStatus.DOWN, old_metadata.get(node, ()))
+            for node in sorted(old_members - new_members)
+        )
+        change = ClusterStatusChange(
+            configuration_id=self.view.configuration_id,
+            membership=tuple(self.view.ring(0)),
+            status_changes=status_changes,
+        )
+        LOG.info(
+            "%s caught up to config %d (%d nodes) via peer pull",
+            self.my_addr, self.view.configuration_id, self.view.membership_size,
+        )
+        # Joiners pending through us that the fetched configuration admitted
+        # get it streamed; the rest keep waiting (decide-path contract).
+        pending_members = tuple(
+            joiner for joiner in self._joiners_to_respond_to if joiner in new_members
+        )
+        self._commit_view_change(change, respond_to=pending_members)
 
     # ------------------------------------------------------------------
     # leave (MembershipService.java:545-565)
@@ -589,8 +1017,18 @@ class MembershipService:
     # ------------------------------------------------------------------
 
     def _notify(self, event: ClusterEvents, change: ClusterStatusChange) -> None:
+        # Subscriber isolation: callbacks are application code, and several
+        # call sites sit mid-transition (view replaced, per-config state not
+        # yet reset). A raising subscriber must not abort the transition —
+        # that would strand the service half-migrated (new view, old
+        # consensus/broadcaster state) with no repair path.
         for callback in self.subscriptions[event]:
-            callback(change)
+            try:
+                callback(change)
+            except Exception:  # noqa: BLE001 — app callback, not protocol state
+                LOG.exception(
+                    "%s subscriber for %s raised; continuing", self.my_addr, event
+                )
 
     def _status_changes_for(self, proposal) -> List[NodeStatusChange]:
         return [

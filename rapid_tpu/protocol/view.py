@@ -221,6 +221,16 @@ class MembershipView:
     def is_identifier_present(self, node_id: NodeId) -> bool:
         return node_id in self._identifiers_seen
 
+    def identifiers_seen(self) -> frozenset:
+        """The append-only identifier history. ``ring_delete`` never removes
+        identifiers (MembershipView.java:167-201 semantics), so along the
+        decided configuration chain this set only grows — which makes two
+        configurations comparable without a version counter: the newer one
+        has a strict superset of identifiers, or an equal identifier set and
+        a strict subset of endpoints (equal-identifier chains are
+        remove-only). The config catch-up path relies on this ordering."""
+        return frozenset(self._identifiers_seen)
+
     @property
     def membership_size(self) -> int:
         return len(self._all_nodes)
@@ -229,7 +239,12 @@ class MembershipView:
         return list(self._rings[ring_idx])
 
     def ring_keys(self, ring_idx: int) -> List[int]:
-        """Raw sorted hash keys of one ring (device-kernel interchange)."""
+        """Raw sorted hash keys of one ring. In ``TOPOLOGY_NATIVE`` these are
+        u64 values interchangeable with the device kernels
+        (``ops.rings.endpoint_ring_keys`` computes the identical function).
+        In ``TOPOLOGY_JAVA`` they are SIGNED 64-bit values in signed ring
+        order — reference-compatible, but NOT device interchange: the engine
+        path is native-topology only (``endpoint_ring_keys`` enforces it)."""
         return list(self._ring_keys[ring_idx])
 
     def observers_of(self, node: Endpoint) -> List[Endpoint]:

@@ -34,6 +34,21 @@ class Settings:
     # Leave (MembershipService.java:78).
     leave_message_timeout_ms: int = 1500
 
+    # Protocol-level delivery liveness. The reference guarantees message
+    # delivery inside the transport (bounded retries, Retries.java:43-90;
+    # channel retry wrapper GrpcClient.java:106-115), so its protocol can
+    # fire every broadcast exactly once. Transports here may be lossy (the
+    # UDP hybrid ships one-way traffic as droppable datagrams), so the
+    # equivalent guarantee lives at the protocol level instead:
+    # - alert batches for the current configuration are re-broadcast on this
+    #   cadence while the cut they announce is still unresolved (0 = off);
+    # - a node that suspects it is stale (undecided proposal, unresolved cut,
+    #   or traffic stamped with a configuration id it does not know) pulls
+    #   the current configuration from a peer over the reliable path on this
+    #   cadence (0 = off).
+    alert_redelivery_interval_ms: int = 1000
+    config_sync_interval_ms: int = 2000
+
     # Topology mode: "native" (tpu-first default: 8-byte port hashing,
     # unsigned key/identifier ordering) or "java" (reference-exact ring
     # ordering and configuration-id fold, MembershipView.java:544-587 —

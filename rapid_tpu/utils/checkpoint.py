@@ -51,7 +51,11 @@ if TYPE_CHECKING:
 
 _MAGIC = b"RTCF"
 # v2 appends a topology-mode byte; v1 checkpoints (which predate the
-# java-compat mode and were always native) still load.
+# java-compat mode and were always native) still load. Native configs are
+# WRITTEN as v1: the trailing byte buys nothing in the default case, and
+# emitting v2 would make every checkpoint unreadable to older readers that
+# only accept v1 — forward incompatibility reserved for java-mode configs,
+# which older readers could not resume correctly anyway.
 _VERSION = 2
 _TOPOLOGY_CODES = {TOPOLOGY_NATIVE: 0, TOPOLOGY_JAVA: 1}
 _TOPOLOGY_NAMES = {code: name for name, code in _TOPOLOGY_CODES.items()}
@@ -60,14 +64,16 @@ _TOPOLOGY_NAMES = {code: name for name, code in _TOPOLOGY_CODES.items()}
 def configuration_to_bytes(config: Configuration) -> bytes:
     w = Writer()
     w.raw(_MAGIC)
-    w.u8(_VERSION)
+    version = 1 if config.topology == TOPOLOGY_NATIVE else _VERSION
+    w.u8(version)
     w.u32(len(config.node_ids))
     for nid in config.node_ids:
         write_node_id(w, nid)
     w.u32(len(config.endpoints))
     for ep in config.endpoints:
         write_endpoint(w, ep)
-    w.u8(_TOPOLOGY_CODES[config.topology])
+    if version >= 2:
+        w.u8(_TOPOLOGY_CODES[config.topology])
     return w.getvalue()
 
 

@@ -39,6 +39,28 @@ def test_configuration_rejects_garbage():
         configuration_from_bytes(b"not a checkpoint")
 
 
+def test_native_configs_write_v1_java_configs_write_v2():
+    # Backward compatibility: the default (native) topology emits the v1
+    # layout older readers accept; only java-mode configs — which old readers
+    # could not resume correctly anyway — pay the v2 trailing topology byte.
+    from rapid_tpu.protocol.view import TOPOLOGY_JAVA
+
+    native = MembershipView(K)
+    native.ring_add(Endpoint("10.3.0.1", 4000), NodeId(1, 7))
+    native_blob = configuration_to_bytes(native.configuration)
+    assert native_blob[4] == 1  # version byte after the 4-byte magic
+
+    java = MembershipView(K, topology=TOPOLOGY_JAVA)
+    java.ring_add(Endpoint("10.3.0.1", 4000), NodeId(1, 7))
+    java_blob = configuration_to_bytes(java.configuration)
+    assert java_blob[4] == 2
+    assert len(java_blob) == len(native_blob) + 1  # the trailing topology byte
+
+    for blob, topology in ((native_blob, "native"), (java_blob, TOPOLOGY_JAVA)):
+        restored = configuration_from_bytes(blob)
+        assert restored.topology == topology
+
+
 def test_engine_state_roundtrip(tmp_path):
     from rapid_tpu.models.virtual_cluster import VirtualCluster
 

@@ -1,0 +1,489 @@
+"""Protocol-level delivery liveness under droppable transports.
+
+The reference protocol fires every broadcast exactly once and stays live
+because its transport guarantees delivery (``Retries.java:43-90``; channel
+retry wrapper ``GrpcClient.java:106-115``). The transports here may drop
+(the UDP hybrid ships one-way traffic as datagrams), so the same guarantee
+is re-established at the protocol level instead:
+
+- undecided consensus re-arms: the fallback timer re-offers the fast-round
+  vote and escalates one classic round per tick (``fast_paxos.py``), with
+  coordinator state reset between rounds (``paxos.py``);
+- alert batches are re-broadcast while their cut is unresolved;
+- a node with evidence (traffic stamped with a configuration id it never
+  inhabited) or suspicion (stuck proposal / unresolved cut / unappliable
+  decision) of staleness pulls the current configuration from a peer over
+  the reliable request/response path and adopts it if ahead.
+
+These tests pin each mechanism in isolation; ``tests/test_udp_loss.py``
+pins the end-to-end envelope under seeded datagram loss.
+"""
+
+import asyncio
+import functools
+import random
+
+from rapid_tpu.messaging.inprocess import (
+    InProcessClient,
+    InProcessNetwork,
+    InProcessServer,
+)
+from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
+from rapid_tpu.protocol.cut_detector import MultiNodeCutDetector
+from rapid_tpu.protocol.events import ClusterEvents
+from rapid_tpu.protocol.fast_paxos import FastPaxos, fast_paxos_quorum
+from rapid_tpu.protocol.paxos import Paxos, node_index_of
+from rapid_tpu.protocol.service import MembershipService
+from rapid_tpu.protocol.view import MembershipView
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import (
+    Endpoint,
+    FastRoundPhase2bMessage,
+    NodeId,
+    Phase1aMessage,
+    Phase1bMessage,
+    Phase2aMessage,
+    Rank,
+)
+from rapid_tpu.utils.clock import ManualClock
+
+from helpers import wait_until
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        async def with_timeout():
+            await asyncio.wait_for(fn(*args, **kwargs), timeout=30)
+
+        asyncio.run(with_timeout())
+
+    return wrapper
+
+
+def ep(i: int) -> Endpoint:
+    return Endpoint("127.0.0.1", 42000 + i)
+
+
+# ---------------------------------------------------------------------------
+# paxos.py: coordinator state must reset between escalating rounds
+# ---------------------------------------------------------------------------
+
+
+def test_stale_promises_do_not_satisfy_a_later_round():
+    # n=5: majority is 3. Two promises collected at round 2 plus one at
+    # round 4 must NOT look like a majority for round 4.
+    broadcasts = []
+    paxos = Paxos(ep(0), 7, 5, broadcasts.append, lambda r, m: None, lambda v: None)
+
+    paxos.start_phase1a(2)
+    rank2 = Rank(2, node_index_of(ep(0)))
+    value = (ep(9),)
+    for sender in (ep(1), ep(2)):
+        paxos.handle_phase1b(
+            Phase1bMessage(sender=sender, configuration_id=7, rnd=rank2,
+                           vrnd=Rank(1, 1), vval=value)
+        )
+    assert not any(isinstance(b, Phase2aMessage) for b in broadcasts)
+
+    paxos.start_phase1a(4)  # escalation discards round-2 promises
+    rank4 = Rank(4, node_index_of(ep(0)))
+    paxos.handle_phase1b(
+        Phase1bMessage(sender=ep(3), configuration_id=7, rnd=rank4,
+                       vrnd=Rank(1, 1), vval=value)
+    )
+    assert not any(isinstance(b, Phase2aMessage) for b in broadcasts), (
+        "2 stale round-2 promises + 1 round-4 promise must not reach the "
+        "round-4 majority"
+    )
+    for sender in (ep(1), ep(2)):
+        paxos.handle_phase1b(
+            Phase1bMessage(sender=sender, configuration_id=7, rnd=rank4,
+                           vrnd=Rank(1, 1), vval=value)
+        )
+    phase2a = [b for b in broadcasts if isinstance(b, Phase2aMessage)]
+    assert len(phase2a) == 1 and phase2a[0].vval == value
+
+
+def test_escalated_round_repicks_value():
+    # cval resets on escalation: the round-4 quorum's vvals decide the pick,
+    # not a leftover from round 2.
+    broadcasts = []
+    paxos = Paxos(ep(0), 7, 3, broadcasts.append, lambda r, m: None, lambda v: None)
+    paxos.start_phase1a(2)
+    rank2 = Rank(2, node_index_of(ep(0)))
+    for sender in (ep(1), ep(2)):
+        paxos.handle_phase1b(
+            Phase1bMessage(sender=sender, configuration_id=7, rnd=rank2,
+                           vrnd=Rank(1, 1), vval=(ep(8),))
+        )
+    assert paxos.cval == (ep(8),)
+    paxos.start_phase1a(3)
+    assert paxos.cval == ()
+    rank3 = Rank(3, node_index_of(ep(0)))
+    for sender in (ep(1), ep(2)):
+        paxos.handle_phase1b(
+            Phase1bMessage(sender=sender, configuration_id=7, rnd=rank3,
+                           vrnd=Rank(2, 2), vval=(ep(9),))
+        )
+    assert paxos.cval == (ep(9),)
+
+
+# ---------------------------------------------------------------------------
+# fast_paxos.py: the fallback is a recurring liveness tick
+# ---------------------------------------------------------------------------
+
+
+def test_fallback_rearms_and_escalates_until_decided():
+    clock = ManualClock()
+    broadcasts = []
+    decided = []
+    fp = FastPaxos(
+        my_addr=ep(0), configuration_id=7, membership_size=3,
+        broadcast_fn=broadcasts.append, send_fn=lambda r, m: None,
+        on_decide=decided.append, clock=clock,
+        consensus_fallback_base_delay_ms=100, rng=random.Random(0),
+    )
+    fp.propose((ep(2),), recovery_delay_ms=100)
+    votes = [b for b in broadcasts if isinstance(b, FastRoundPhase2bMessage)]
+    assert len(votes) == 1
+
+    clock.advance_ms(150)  # first tick: re-offer vote, classic round 2
+    # Re-arm delays are expovariate with mean ~N*1000ms over the base delay;
+    # 30 s of simulated time yields several more ticks.
+    clock.advance_ms(30_000)
+    votes = [b for b in broadcasts if isinstance(b, FastRoundPhase2bMessage)]
+    phase1a = [b for b in broadcasts if isinstance(b, Phase1aMessage)]
+    assert len(votes) >= 3, "undecided vote must be re-broadcast every tick"
+    rounds = [m.rank.round for m in phase1a]
+    assert rounds[0] == 2 and rounds == sorted(rounds) and len(set(rounds)) >= 2, (
+        f"classic rounds must escalate from 2, got {rounds}"
+    )
+
+    # Decision cancels the re-arm: no further traffic.
+    quorum = fast_paxos_quorum(3)
+    for i in range(quorum):
+        fp.handle_message(
+            FastRoundPhase2bMessage(sender=ep(i), configuration_id=7, endpoints=(ep(2),))
+        )
+    assert decided == [(ep(2),)]
+    n_before = len(broadcasts)
+    clock.advance_ms(60_000)
+    assert len(broadcasts) == n_before
+
+
+def test_cancel_fallback_stops_rearming():
+    clock = ManualClock()
+    broadcasts = []
+    fp = FastPaxos(
+        my_addr=ep(0), configuration_id=7, membership_size=3,
+        broadcast_fn=broadcasts.append, send_fn=lambda r, m: None,
+        on_decide=lambda v: None, clock=clock,
+        consensus_fallback_base_delay_ms=100, rng=random.Random(0),
+    )
+    fp.propose((ep(2),), recovery_delay_ms=100)
+    fp.cancel_fallback()
+    n_before = len(broadcasts)
+    clock.advance_ms(60_000)
+    assert len(broadcasts) == n_before
+
+
+# ---------------------------------------------------------------------------
+# service.py: config catch-up
+# ---------------------------------------------------------------------------
+
+
+def build_service(network, my_index, endpoints, node_ids, settings=None, metadata=None):
+    """A MembershipService over InProcessNetwork with its server registered,
+    identity plumbed (node_id enables the catch-up path)."""
+    settings = settings or Settings()
+    settings.batching_window_ms = 20
+    my_addr = endpoints[my_index]
+    view = MembershipView(settings.k, node_ids=node_ids, endpoints=endpoints)
+    service = MembershipService(
+        my_addr=my_addr,
+        cut_detector=MultiNodeCutDetector(settings.k, settings.h, settings.l),
+        view=view,
+        settings=settings,
+        client=InProcessClient(network, my_addr, settings),
+        fd_factory=StaticFailureDetectorFactory(),
+        metadata_map=metadata,
+        rng=random.Random(my_index),
+        node_id=node_ids[my_index],
+    )
+    server = InProcessServer(network, my_addr)
+    server.set_membership_service(service)
+    return service, server
+
+
+@async_test
+async def test_evidence_of_unknown_config_triggers_catch_up():
+    # A (5-member view) receives a consensus vote stamped with a config id it
+    # never inhabited, from peer e1 whose view is one join ahead. A pulls
+    # from e1 over the reliable path and installs the newer configuration.
+    network = InProcessNetwork()
+    ids = [NodeId(0, i) for i in range(6)]
+    old_eps, new_eps = [ep(i) for i in range(5)], [ep(i) for i in range(6)]
+
+    stale, stale_server = build_service(network, 0, old_eps, ids[:5])
+    ahead, ahead_server = build_service(network, 1, new_eps, ids)
+    await stale_server.start()
+    await ahead_server.start()
+    await stale.start()
+    try:
+        assert stale.membership_size == 5
+        evidence = FastRoundPhase2bMessage(
+            sender=ahead.my_addr,
+            configuration_id=ahead.view.configuration_id,
+            endpoints=(ep(9),),
+        )
+        await stale.handle_message(evidence)
+        assert await wait_until(lambda: stale.membership_size == 6)
+        assert stale.view.configuration_id == ahead.view.configuration_id
+        assert stale.metrics.counters["config_catch_ups"] == 1
+        assert ep(5) in stale.membership
+    finally:
+        await stale_server.shutdown()
+        await ahead_server.shutdown()
+        await stale.shutdown()
+        await ahead.shutdown()
+
+
+@async_test
+async def test_catch_up_never_adopts_an_older_configuration():
+    # The pull target may itself be stale: a fetched config whose identifier
+    # history is NOT a strict superset (nor an equal-id endpoint subset)
+    # must be ignored — config ids are hashes and carry no order.
+    network = InProcessNetwork()
+    ids = [NodeId(0, i) for i in range(6)]
+    new_eps, old_eps = [ep(i) for i in range(6)], [ep(i) for i in range(5)]
+
+    current, current_server = build_service(network, 0, new_eps, ids)
+    behind, behind_server = build_service(network, 1, old_eps, ids[:5])
+    await current_server.start()
+    await behind_server.start()
+    await current.start()
+    try:
+        config_before = current.view.configuration_id
+        evidence = FastRoundPhase2bMessage(
+            sender=behind.my_addr,
+            configuration_id=behind.view.configuration_id,
+            endpoints=(ep(9),),
+        )
+        await current.handle_message(evidence)
+        # Give the catch-up task time to complete (and be ignored).
+        await wait_until(
+            lambda: not current._catch_up_inflight and not current._catch_up_tasks,
+            timeout_s=5,
+        )
+        assert current.membership_size == 6
+        assert current.view.configuration_id == config_before
+        assert current.metrics.counters["config_catch_ups"] == 0
+    finally:
+        await current_server.shutdown()
+        await behind_server.shutdown()
+        await current.shutdown()
+        await behind.shutdown()
+
+
+@async_test
+async def test_eviction_requires_proof_not_ambiguous_answers():
+    # "You are not in my view" alone is ambiguous (the peer may be stuck in
+    # a configuration predating our join) and must NEVER convict — no matter
+    # how many peers say it. Eviction is concluded only from verifiable
+    # proof: a view whose identifier history covers ours (it can only have
+    # seen our identifier if it inhabited a configuration we were in) yet
+    # whose endpoints exclude us.
+    network = InProcessNetwork()
+    my_ids = [NodeId(0, i) for i in range(3)]
+    my_eps = [ep(i) for i in range(3)]
+    node, node_server = build_service(network, 0, my_eps, my_ids)
+    node.settings.config_sync_interval_ms = 1  # allow rapid re-pulls
+    # Three stale peers whose views never contained this node or its id.
+    stale_peers = []
+    for i in (1, 2, 3):
+        peer_ids = [NodeId(9, 100 * i + j) for j in range(2)]
+        peer_eps = [ep(100 + i), ep(200 + i)]
+        service, server = build_service(network, 0, peer_eps, peer_ids)
+        stale_peers.append((service, server))
+        await server.start()
+    await node_server.start()
+    await node.start()
+    kicked = []
+    node.register_subscription(ClusterEvents.KICKED, kicked.append)
+    try:
+        for peer, _ in stale_peers:
+            await node.handle_message(
+                FastRoundPhase2bMessage(
+                    sender=peer.my_addr,
+                    configuration_id=peer.view.configuration_id,
+                    endpoints=(ep(9),),
+                )
+            )
+            assert await wait_until(
+                lambda: not node._catch_up_inflight and not node._catch_up_tasks,
+                timeout_s=5,
+            )
+            await asyncio.sleep(0.01)
+        assert not kicked, "ambiguous absent-from-view answers must not convict"
+        assert node.metrics.counters["kicked"] == 0
+
+        # A peer whose view DID remove us (it holds our identifier in its
+        # append-only history, endpoints exclude us) proves eviction: one
+        # answer convicts, immediately.
+        prover, prover_server = build_service(
+            network, 0, [ep(1), ep(2)], my_ids  # our id n0 seen; e0 removed
+        )
+        await prover_server.start()
+        try:
+            await node.handle_message(
+                FastRoundPhase2bMessage(
+                    sender=prover.my_addr,
+                    configuration_id=prover.view.configuration_id,
+                    endpoints=(ep(9),),
+                )
+            )
+            assert await wait_until(lambda: len(kicked) == 1)
+            assert node.metrics.counters["kicked"] == 1
+        finally:
+            await prover_server.shutdown()
+            await prover.shutdown()
+    finally:
+        await node_server.shutdown()
+        await node.shutdown()
+        for service, server in stale_peers:
+            await server.shutdown()
+            await service.shutdown()
+
+
+@async_test
+async def test_eviction_proof_rules():
+    # Direct pin of the proof check: payload-less CONFIG_CHANGED and
+    # non-superset payloads never convict; a superset-without-us payload
+    # convicts exactly once (latched).
+    from rapid_tpu.types import JoinResponse, JoinStatusCode
+
+    network = InProcessNetwork()
+    ids = [NodeId(0, i) for i in range(4)]
+    eps = [ep(i) for i in range(4)]
+    service, server = build_service(network, 0, eps, ids)
+    kicked = []
+    service.register_subscription(ClusterEvents.KICKED, kicked.append)
+    try:
+        plain = JoinResponse(
+            sender=eps[1], status_code=JoinStatusCode.CONFIG_CHANGED,
+            configuration_id=123,
+        )
+        not_superset = JoinResponse(  # stale id space: never saw our ids
+            sender=eps[1], status_code=JoinStatusCode.CONFIG_CHANGED,
+            configuration_id=124, endpoints=(eps[1], eps[2]),
+            identifiers=(NodeId(9, 9),),
+        )
+        proof = JoinResponse(  # full history, endpoints exclude us
+            sender=eps[1], status_code=JoinStatusCode.CONFIG_CHANGED,
+            configuration_id=125, endpoints=(eps[1], eps[2], eps[3]),
+            identifiers=tuple(ids) + (NodeId(7, 7),),
+        )
+        service._apply_catch_up_response(eps[1], plain)
+        service._apply_catch_up_response(eps[2], plain)
+        service._apply_catch_up_response(eps[3], plain)
+        service._apply_catch_up_response(eps[1], not_superset)
+        assert not kicked, "ambiguous/unverifiable answers must not convict"
+        service._apply_catch_up_response(eps[1], proof)
+        assert len(kicked) == 1
+        # Latched: further proof answers must not re-fire KICKED.
+        service._apply_catch_up_response(eps[2], proof)
+        assert len(kicked) == 1
+        assert service.metrics.counters["kicked"] == 1
+    finally:
+        await server.shutdown()
+        await service.shutdown()
+
+
+def test_engine_rejects_java_topology_at_the_key_seam():
+    # The engine's u64 keyspace cannot represent java-compat signed ring
+    # order; pairing them must fail loudly at from_endpoints, not diverge.
+    import pytest
+
+    from rapid_tpu.models.virtual_cluster import VirtualCluster
+
+    with pytest.raises(ValueError, match="native topology"):
+        VirtualCluster.from_endpoints([ep(0), ep(1), ep(2)], topology="java")
+
+
+@async_test
+async def test_decision_missing_uuid_recovers_by_pull_not_rejoin():
+    # A consensus decision names a joiner whose every UP alert this node
+    # lost. Round-4 behavior: apply nothing, signal KICKED, force a rejoin.
+    # Now: apply nothing and pull the decided configuration — identifiers
+    # included — from a peer that applied it. No KICKED, no rejoin.
+    network = InProcessNetwork()
+    ids = [NodeId(0, i) for i in range(5)]
+    eps = [ep(i) for i in range(5)]
+    joiner, joiner_id = ep(5), NodeId(0, 5)
+
+    settings = Settings()
+    settings.config_sync_interval_ms = 50  # fast retry until a peer has it
+    node, node_server = build_service(network, 0, eps, ids, settings=settings)
+    # Peer at e1 already applied the decision: view includes the joiner.
+    applied, applied_server = build_service(network, 1, eps + [joiner], ids + [joiner_id])
+    await node_server.start()
+    await applied_server.start()
+    await node.start()
+    kicked = []
+    node.register_subscription(ClusterEvents.KICKED, kicked.append)
+    try:
+        config_id = node.view.configuration_id
+        quorum = fast_paxos_quorum(5)
+        for i in range(quorum):
+            await node.handle_message(
+                FastRoundPhase2bMessage(
+                    sender=eps[i], configuration_id=config_id, endpoints=(joiner,)
+                )
+            )
+        # The decision could not be applied locally...
+        assert node.metrics.counters["decision_missing_joiner_uuid"] == 1
+        # ...but the sync loop pulls it from a peer instead of rejoining.
+        assert await wait_until(lambda: node.membership_size == 6, timeout_s=10)
+        assert joiner in node.membership
+        assert node.view.configuration_id == applied.view.configuration_id
+        assert not kicked
+        assert node.metrics.counters["kicked"] == 0
+    finally:
+        await node_server.shutdown()
+        await applied_server.shutdown()
+        await node.shutdown()
+        await applied.shutdown()
+
+
+@async_test
+async def test_alert_redelivery_heals_a_lost_batch():
+    # An observer's single alert-batch broadcast is lost toward one receiver
+    # (dropped before reaching it); the redelivery loop re-broadcasts the
+    # batch and the receiver's cut completes. Modeled at the service level:
+    # the receiver simply misses the first batch, then gets the redelivery.
+    network = InProcessNetwork()
+    ids = [NodeId(0, i) for i in range(4)]
+    eps = [ep(i) for i in range(4)]
+    settings = Settings()
+    settings.k, settings.h, settings.l = 4, 3, 2
+    settings.alert_redelivery_interval_ms = 50
+    sender_svc, sender_server = build_service(
+        network, 0, eps, ids, settings=settings
+    )
+    await sender_server.start()
+    await sender_svc.start()
+    try:
+        # Enqueue a DOWN alert; the batcher broadcasts it once; with nobody
+        # at H yet and reports pending, the loop must re-broadcast.
+        async with sender_svc._lock:
+            sender_svc._edge_failure_notification(
+                eps[3], sender_svc.view.configuration_id
+            )
+        assert await wait_until(
+            lambda: sender_svc.metrics.counters["alert_batches_redelivered"] >= 2,
+            timeout_s=10,
+        )
+    finally:
+        await sender_server.shutdown()
+        await sender_svc.shutdown()

@@ -426,6 +426,142 @@ async def test_random_schedules_agree_across_stacks(seed):
     )
 
 
+async def _run_host_fallback_scenario(endpoints, n0, victim_slot, n_blocked):
+    """Fallback-forcing host run: ingress-block ``n_blocked`` of the victim's
+    observers so fewer than the fast quorum can vote, forcing the decision
+    through classic Paxos. Blocked nodes are chosen among the victim's
+    OBSERVERS deliberately: each then holds local evidence (its own ring
+    report, stuck below L) that a cut is unresolved — the suspicion signal
+    that drives the config-sync pull by which they re-join the new
+    configuration THROUGH the partition (requests out, responses back).
+    Returns (cuts, final_membership, blocked_slots, classic_rounds_started,
+    one_step_failed_events)."""
+    h = _HostHarness(endpoints)
+    await h.bootstrap(n0)
+    victim = endpoints[victim_slot]
+    view = h.clusters[0].service.view
+    blocked = []
+    for obs in view.observers_of(victim):
+        if obs not in (endpoints[0], victim) and obs not in blocked:
+            blocked.append(obs)
+        if len(blocked) == n_blocked:
+            break
+    assert len(blocked) == n_blocked
+    one_step_failed = []
+    for cluster in h.clusters.values():
+        cluster.register_subscription(
+            ClusterEvents.VIEW_CHANGE_ONE_STEP_FAILED, one_step_failed.append
+        )
+
+    for b in blocked:
+        for other in endpoints[:n0]:
+            if other != b:
+                h.network.blackholed_links.add((other, b))
+    h.crash([victim_slot])
+    # Generous budget: the classic fallback fires on the jittered timer and
+    # blocked nodes then need config-sync pulls to adopt the decision.
+    await h.converge_members(n0 - 1, budget_ms=60_000)
+
+    # Heal and confirm the agreement is stable (nothing pending re-fires).
+    h.network.blackholed_links.clear()
+    await h.converge_members(n0 - 1)
+
+    classic_started = sum(
+        h.clusters[i].service.metrics.counters["classic_rounds_started"]
+        for i in h.live_ids
+    )
+    blocked_slots = [endpoints.index(b) for b in blocked]
+    final = await h.shutdown()
+    return h.cuts, final, blocked_slots, classic_started, one_step_failed
+
+
+def _run_engine_fallback_scenario(endpoints, n0, victim_slot, blocked_slots):
+    """The same fallback-forcing schedule through the engine: each blocked
+    node gets a dedicated cohort whose ingress is rx-blocked (own alerts
+    still arrive, matching the host's open self-delivery), so its detector
+    never crosses H and it never votes — the fast round sits below quorum
+    and the decision must come from the classic attempt
+    (models/virtual_cluster.py classic_attempt ≙ host paxos.py).
+    Returns (cut, final_membership, fast_decided)."""
+    from rapid_tpu.models.virtual_cluster import VirtualCluster
+
+    c = len(blocked_slots) + 1
+    vc = VirtualCluster.from_endpoints(
+        endpoints[:n0], n_slots=n0, n_members=n0, k=10, h=9, l=4,
+        cohorts=c, fd_threshold=1, delivery_spread=0,
+        fallback_rounds=4, concurrent_coordinators=2,
+    )
+    cohort_of = np.zeros(n0, dtype=np.int32)
+    for idx, s in enumerate(blocked_slots):
+        cohort_of[s] = idx + 1
+    vc.assign_cohorts(cohort_of)
+    rx = np.zeros((c, n0), dtype=bool)
+    for idx, s in enumerate(blocked_slots):
+        rx[idx + 1, :] = True
+        rx[idx + 1, s] = False  # own alerts still arrive (host parity)
+    vc.set_rx_block(rx)
+
+    vc.crash([victim_slot])
+    was_alive = np.asarray(vc.state.alive)
+    for _ in range(64):
+        events = vc.step()
+        if bool(events.decided):
+            fast = bool(events.fast_decided)
+            mask = np.asarray(events.winner_mask)
+            break
+    else:
+        raise AssertionError("engine did not decide under the vote partition")
+    cut = frozenset(
+        (endpoints[s], EdgeStatus.DOWN if was_alive[s] else EdgeStatus.UP)
+        for s in np.nonzero(mask)[0].tolist()
+    )
+    # Heal and step: stale alerts from previously-blocked cohorts re-open
+    # (set_rx_block re-stamps fired edges) and must not flip membership.
+    vc.set_rx_block(np.zeros((c, n0), dtype=bool))
+    for _ in range(8):
+        events = vc.step()
+        assert not bool(events.decided), "heal must not re-fire a decision"
+    alive = np.asarray(vc.state.alive)
+    final = {endpoints[s] for s in np.nonzero(alive)[0].tolist()}
+    return cut, final, fast
+
+
+@pytest.mark.parametrize("seed", [11, 12])
+@async_test
+async def test_forced_classic_fallback_agrees_across_stacks(seed):
+    # VERDICT item: the cross-stack differential never forced a classic
+    # fallback. Here the fast round is partitioned below quorum in BOTH
+    # stacks — n_blocked observers cannot hear alerts, so only
+    # n0-1-n_blocked nodes vote, under the N - floor((N-1)/4) fast quorum —
+    # and both stacks must (a) decide via the classic path, (b) decide the
+    # IDENTICAL value, (c) reach the identical final membership. Rank
+    # identity is deliberately not compared: host ranks are (round,
+    # endpoint-hash) while engine ranks are (round, slot) — the portable
+    # contract is path + value + membership. Reference bar: the
+    # drop-the-fast-round recovery tests, PaxosTests.java:72-191,424-446.
+    n0 = 16
+    rng = random.Random(seed)
+    victim_slot = rng.randrange(1, n0)
+    n_blocked = 4  # floor((N-1)/4) < 4 voters lost <= N/2 - majority margin
+    endpoints = [Endpoint(f"10.7.{seed}.{i}", 7400 + i) for i in range(n0)]
+
+    host_cuts, host_final, blocked_slots, classic_started, one_step_failed = (
+        await _run_host_fallback_scenario(endpoints, n0, victim_slot, n_blocked)
+    )
+    engine_cut, engine_final, engine_fast = _run_engine_fallback_scenario(
+        endpoints, n0, victim_slot, blocked_slots
+    )
+
+    expected_cut = frozenset({(endpoints[victim_slot], EdgeStatus.DOWN)})
+    assert host_cuts == [expected_cut]
+    assert engine_cut == expected_cut
+    assert host_final == engine_final == set(endpoints) - {endpoints[victim_slot]}
+    # Both stacks took the slow path.
+    assert not engine_fast, "engine must have decided via the classic attempt"
+    assert classic_started >= 1, "host must have engaged the classic fallback"
+    assert one_step_failed, "VIEW_CHANGE_ONE_STEP_FAILED must fire somewhere"
+
+
 @async_test
 async def test_host_and_engine_agree_on_cut_sequence_and_membership():
     host_cuts, host_final = await _run_host_scenario()

@@ -1,14 +1,15 @@
 """Quantifying the hybrid transport's datagram-loss tradeoff.
 
-The hybrid TCP+UDP transport's admitted failure mode (messaging/udp.py
-docstring) is a forced rejoin: a consensus decision names a joiner whose
-every UP alert datagram was lost, so the receiver lacks the joiner's UUID
-and signals KICKED (service._recover_from_unknown_joiners) instead of
-corrupting its view. These tests pin the ENVELOPE of that mode: a receiver
-misses a joiner's UUID only if it loses the alert batches of ALL the
-joiner's distinct observers — probability ~p^O at loss rate p — so at
-operationally plausible loss the cost of datagrams lost is CONVERGENCE
-LATENCY (votes riding out the fallback timer), not rejoins.
+Datagram loss costs the hybrid TCP+UDP transport convergence LATENCY, never
+liveness: the protocol's delivery-liveness mechanisms (settings.py; pinned
+individually in tests/test_delivery_liveness.py) re-broadcast unresolved
+alert batches, re-offer undecided fast-round votes, escalate classic rounds
+until a decision lands, and let a node that missed a decision pull the
+configuration from a peer over the reliable TCP path. Even a decision
+naming a joiner whose every UP alert datagram was lost — probability ~p^O
+at loss rate p with O distinct observers — resolves by config pull rather
+than a forced rejoin. These tests pin that envelope end-to-end under
+seeded loss: churn converges, nobody rejoins, nobody is kicked.
 
 The full latency curve is measured by examples/udp_loss_curve.py; its
 committed results live in EVALUATION.md.
@@ -125,9 +126,10 @@ async def run_lossy_churn(loss_rate: float, seed: int):
 @async_test
 async def test_no_forced_rejoin_at_10pct_loss():
     # The pin: with the default alert fan-out (every distinct observer of a
-    # joiner broadcasts its own UP batch) and FD-cadence redelivery, 10%
-    # datagram loss never forces a rejoin — the loss envelope for missing a
-    # UUID entirely is ~0.1^observers. Convergence still completes.
+    # joiner broadcasts its own UP batch) and timer-based batch redelivery,
+    # 10% datagram loss never forces a rejoin — the loss envelope for missing
+    # a UUID entirely is ~0.1^(observers × redeliveries), and even that case
+    # would resolve by config pull. Convergence still completes.
     survivors, forced_rejoins, kicked = await run_lossy_churn(loss_rate=0.10, seed=42)
     try:
         assert forced_rejoins == 0
@@ -139,9 +141,11 @@ async def test_no_forced_rejoin_at_10pct_loss():
 
 @async_test
 async def test_converges_under_heavy_loss():
-    # 30% loss: convergence must still complete (lost votes ride out the
-    # classic-fallback timer; lost alerts are re-sent on later FD ticks).
-    # No zero-rejoin guarantee is claimed at this rate.
+    # 30% loss: convergence must still complete — lost votes are re-offered
+    # and classic rounds escalate on every fallback tick, lost alert batches
+    # are re-broadcast on the redelivery timer, and any node that misses the
+    # decision itself catches up by config pull. No zero-rejoin guarantee is
+    # claimed at this rate.
     survivors, forced_rejoins, _ = await run_lossy_churn(loss_rate=0.30, seed=7)
     try:
         assert len({tuple(c.membership) for c in survivors}) == 1

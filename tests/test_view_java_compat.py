@@ -211,15 +211,23 @@ def test_checkpoint_roundtrip_preserves_topology():
 
 def test_v1_checkpoint_loads_as_native():
     # Pre-topology checkpoints (version byte 1, no trailing topology byte)
-    # were always native mode; they must keep loading.
+    # were always native mode; they must keep loading — and native configs
+    # still WRITE that v1 layout, so old readers keep working (ADVICE r4).
     from rapid_tpu.utils.checkpoint import configuration_from_bytes, configuration_to_bytes
 
     ids, eps = _golden_case()
-    v2 = bytearray(configuration_to_bytes(Configuration(ids, eps)))
-    v1 = bytes(v2[:4]) + bytes([1]) + bytes(v2[5:-1])  # rewrite version, drop topology byte
+    v1 = configuration_to_bytes(Configuration(ids, eps))
+    assert v1[4] == 1  # native emits the v1 layout, not a gratuitous v2
     restored = configuration_from_bytes(v1)
     assert restored.topology == TOPOLOGY_NATIVE
     assert restored.endpoints == tuple(eps)
+
+    # A java-mode blob rewritten to v1 (version byte, trailing topology byte
+    # dropped) is exactly the legacy layout; it must load as native.
+    v2 = bytearray(configuration_to_bytes(Configuration(ids, eps, topology=TOPOLOGY_JAVA)))
+    assert v2[4] == 2
+    legacy = bytes(v2[:4]) + bytes([1]) + bytes(v2[5:-1])
+    assert configuration_from_bytes(legacy).topology == TOPOLOGY_NATIVE
 
 
 def _async_test(fn):
