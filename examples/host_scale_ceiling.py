@@ -1,0 +1,155 @@
+"""Locate the host protocol path's scale ceiling vs the device engine.
+
+The framework ships two implementations of the same protocol: the asyncio
+host path (one ``MembershipService`` per node — the reference architecture,
+``ClusterTest.java``'s 50-node in-JVM regime) and the fused device engine
+(``models/virtual_cluster.py``, one program for all N). The host path's cost
+per view change is dominated by the O(N²) vote fan-out (every member
+broadcasts its fast-round vote to every member) plus asyncio scheduling
+overhead per message; the engine turns the same work into a handful of
+batched array ops. This instrument measures WHERE the curves cross.
+
+Method: for each N, wire N ``MembershipService`` instances directly on one
+``InProcessNetwork`` (identical pre-built views — the convergence hot path,
+without conflating O(N²)-per-join bootstrap cost), crash one member, and
+pump a ``ManualClock`` until every service applies the view change. Wall
+time measured around the pumping loop is pure host CPU cost (simulated time
+never sleeps). The engine column runs the identical crash on a
+``VirtualCluster`` of the same size and membership.
+
+One JSON line per N:
+
+    {"n": 200, "host_crash_wall_ms": ..., "host_msgs": ...,
+     "engine_crash_wall_ms": ..., "sim_ms": ...}
+
+Committed results live in EVALUATION.md ("Host-path scale ceiling").
+
+    python examples/host_scale_ceiling.py [--sizes 50,100,200,350,500]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from rapid_tpu.utils.platform import force_platform
+
+force_platform("cpu")
+
+from rapid_tpu.messaging.inprocess import InProcessClient, InProcessNetwork, InProcessServer
+from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
+from rapid_tpu.protocol.cut_detector import MultiNodeCutDetector
+from rapid_tpu.protocol.service import MembershipService
+from rapid_tpu.protocol.view import MembershipView
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import Endpoint, NodeId
+from rapid_tpu.utils.clock import ManualClock
+
+
+async def host_crash_convergence(n: int, seed: int = 0):
+    """Wall-clock cost of one crash view-change across n host services."""
+    settings = Settings()  # reference defaults: 1 s FD, 100 ms batching
+    endpoints = [Endpoint(f"10.20.{i // 250}.{i % 250}", 6000 + i) for i in range(n)]
+    node_ids = [NodeId(0, i) for i in range(n)]
+    network = InProcessNetwork()
+    clock = ManualClock()
+    fd = StaticFailureDetectorFactory()
+
+    services = []
+    servers = []
+    for i in range(n):
+        view = MembershipView(settings.k, node_ids=node_ids, endpoints=endpoints)
+        service = MembershipService(
+            my_addr=endpoints[i],
+            cut_detector=MultiNodeCutDetector(settings.k, settings.h, settings.l),
+            view=view,
+            settings=settings,
+            client=InProcessClient(network, endpoints[i], settings),
+            fd_factory=fd,
+            clock=clock,
+            rng=random.Random(seed + i),
+            node_id=node_ids[i],
+        )
+        server = InProcessServer(network, endpoints[i])
+        server.set_membership_service(service)
+        await server.start()
+        await service.start()
+        services.append(service)
+        servers.append(server)
+
+    victim = endpoints[n // 2]
+    fd.add_failed_nodes([victim])
+    network.blackholed.add(victim)
+    live = [s for s in services if s.my_addr != victim]
+
+    async def drain(rounds=40):
+        for _ in range(rounds):
+            await asyncio.sleep(0)
+
+    t0 = time.perf_counter()
+    sim_ms = 0.0
+    while not all(s.membership_size == n - 1 for s in live):
+        clock.advance_ms(50)
+        sim_ms += 50
+        await drain()
+        if sim_ms > 120_000:
+            raise TimeoutError(f"host n={n} did not converge in 120 s sim")
+    wall_ms = (time.perf_counter() - t0) * 1000.0
+
+    msgs = sum(s.metrics.counters.get("alerts_received", 0) for s in live)
+    for server in servers:
+        await server.shutdown()
+    for service in services:
+        await service.shutdown()
+    return wall_ms, sim_ms, msgs
+
+
+def engine_crash_convergence(n: int):
+    """The same crash on the fused engine (current backend; CPU here)."""
+    from rapid_tpu.models.virtual_cluster import VirtualCluster
+
+    endpoints = [Endpoint(f"10.20.{i // 250}.{i % 250}", 6000 + i) for i in range(n)]
+    vc = VirtualCluster.from_endpoints(
+        endpoints, n_slots=n, fd_threshold=1, delivery_spread=0
+    )
+    vc.crash([n // 2])
+    vc.run_to_decision(max_steps=64)  # warm-up compile on first shape
+    # Re-create for the measured run (state was consumed by the decision).
+    vc = VirtualCluster.from_endpoints(
+        endpoints, n_slots=n, fd_threshold=1, delivery_spread=0
+    )
+    vc.crash([n // 2])
+    t0 = time.perf_counter()
+    _, decided, _, _ = vc.run_to_decision(max_steps=64)
+    wall_ms = (time.perf_counter() - t0) * 1000.0
+    assert decided
+    return wall_ms
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--sizes", default="50,100,200,350,500")
+    parser.add_argument("--skip-engine", action="store_true")
+    args = parser.parse_args()
+    for n in (int(s) for s in args.sizes.split(",")):
+        wall_ms, sim_ms, msgs = asyncio.run(host_crash_convergence(n))
+        row = {
+            "n": n,
+            "host_crash_wall_ms": round(wall_ms, 1),
+            "host_msgs": msgs,
+            "sim_ms": sim_ms,
+        }
+        if not args.skip_engine:
+            row["engine_crash_wall_ms"] = round(engine_crash_convergence(n), 1)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

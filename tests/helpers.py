@@ -1,6 +1,24 @@
 """Shared test helpers (imported by the async test suites)."""
 
 import asyncio
+import socket
+
+
+def free_endpoints(count: int, hostname: str = "127.0.0.1"):
+    """Kernel-assigned free ports (reserved briefly, then released), returned
+    as Endpoints. One definition — per-file copies of the bind-then-close
+    idiom would drift (e.g. on SO_REUSEADDR handling)."""
+    from rapid_tpu.types import Endpoint
+
+    socks = []
+    for _ in range(count):
+        sk = socket.socket()
+        sk.bind((hostname, 0))
+        socks.append(sk)
+    endpoints = [Endpoint(hostname, sk.getsockname()[1]) for sk in socks]
+    for sk in socks:
+        sk.close()
+    return endpoints
 
 
 async def wait_until(predicate, timeout_s=20.0, interval_s=0.02):

@@ -18,7 +18,6 @@ committed results live in EVALUATION.md.
 import asyncio
 import functools
 import random
-import socket
 
 from rapid_tpu.messaging.udp import LossyDatagramClient, UdpHybridServer
 from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
@@ -26,21 +25,7 @@ from rapid_tpu.protocol.cluster import Cluster
 from rapid_tpu.settings import Settings
 from rapid_tpu.types import Endpoint
 
-from helpers import wait_until
-
-
-def free_endpoints(count: int) -> list:
-    """Kernel-assigned free ports (reserved briefly, then released): these
-    tests must never collide with a concurrently running suite."""
-    socks = []
-    for _ in range(count):
-        sk = socket.socket()
-        sk.bind(("127.0.0.1", 0))
-        socks.append(sk)
-    eps = [Endpoint("127.0.0.1", sk.getsockname()[1]) for sk in socks]
-    for sk in socks:
-        sk.close()
-    return eps
+from helpers import free_endpoints, wait_until
 
 
 def async_test(fn):

@@ -45,6 +45,9 @@ from helpers import wait_until
 
 _MASK64 = (1 << 64) - 1
 FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "java_topology.json")
+FIXTURE_WIDE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "java_topology_wide.json"
+)
 
 
 # ---------------------------------------------------------------------------
@@ -186,6 +189,42 @@ def test_golden_fixture():
     assert view.configuration_id == golden["configuration_id"]
 
 
+def _golden_case_wide():
+    """Boundary/hostile inputs: non-ASCII UTF-8 hostnames (umlaut, Cyrillic,
+    CJK), single-byte and very long hostnames, boundary ports (1, 65535,
+    32768), and boundary identifiers (zero, all-ones, the signed-long
+    sign-flip points) — the inputs where a composition misreading (byte
+    order of ``hashInt``, sign handling in the fold or comparators) would
+    actually diverge."""
+    m = 1 << 64
+    ids = [NodeId(0, 0), NodeId(m - 1, m - 1), NodeId(1 << 63, (1 << 63) - 1),
+           NodeId(1, 1), NodeId(5, (1 << 63) + 5), NodeId(1 << 32, 1 << 32)]
+    eps = [Endpoint("köln-node.example", 1), Endpoint("рапид.бг", 65535),
+           Endpoint("节点七", 7), Endpoint("a", 80),
+           Endpoint("delta.rapid", 50004),
+           Endpoint("z-very-long-hostname-segment-z-very-long-hostname-segment", 32768)]
+    return ids, eps
+
+
+def test_golden_fixture_wide():
+    ids, eps = _golden_case_wide()
+    with open(FIXTURE_WIDE) as f:
+        golden = json.load(f)
+    k = golden["k"]
+    for ep, expect in zip(eps, golden["ring_keys"]):
+        assert [ring_key_java(ep, seed) for seed in range(k)] == expect
+    view = MembershipView(k, node_ids=ids, endpoints=eps, topology=TOPOLOGY_JAVA)
+    for ring_idx in range(k):
+        assert [
+            f"{e.hostname}:{e.port}" for e in view.ring(ring_idx)
+        ] == golden["ring_orders"][ring_idx]
+    assert view.configuration_id == golden["configuration_id"]
+    # The native keyspace genuinely diverges on every one of these inputs —
+    # the fixture would catch a silent fall-through to native hashing.
+    for ep in eps:
+        assert ring_key(ep, 0) != (ring_key_java(ep, 0) & _MASK64)
+
+
 # ---------------------------------------------------------------------------
 # Checkpoint + cluster integration.
 # ---------------------------------------------------------------------------
@@ -280,5 +319,75 @@ async def test_java_mode_cluster_converges():
             TOPOLOGY_JAVA,
         )
         assert ids == {expected}
+    finally:
+        await asyncio.gather(*(c.shutdown() for c in clusters), return_exceptions=True)
+
+
+@_async_test
+async def test_java_mode_cluster_over_grpc_transport():
+    # Compat mode exists for ONE transport: the interop gRPC path that can
+    # face a Java cluster (rapid.proto wire format). Run a java-topology
+    # cluster end to end over real grpc.aio sockets — join handshake,
+    # convergence, crash, re-convergence — and check every member agrees on
+    # the JAVA configuration-id fold throughout.
+    from helpers import free_endpoints
+
+    from rapid_tpu.interop.grpc_transport import GrpcClient, GrpcServer
+
+    settings = Settings()
+    settings.batching_window_ms = 20
+    settings.failure_detector_interval_ms = 50
+    settings.rpc_timeout_ms = 500
+    settings.rpc_join_timeout_ms = 2000
+    settings.rpc_probe_timeout_ms = 200
+    settings.topology = TOPOLOGY_JAVA
+    fd = StaticFailureDetectorFactory()
+
+    eps = free_endpoints(5)
+
+    clusters = [
+        await Cluster.start(eps[0], settings=settings,
+                            client=GrpcClient(eps[0], settings),
+                            server=GrpcServer(eps[0]), fd_factory=fd,
+                            rng=random.Random(0))
+    ]
+    try:
+        for i in range(1, 5):
+            clusters.append(
+                await Cluster.join(eps[0], eps[i], settings=settings,
+                                   client=GrpcClient(eps[i], settings),
+                                   server=GrpcServer(eps[i]), fd_factory=fd,
+                                   rng=random.Random(i))
+            )
+        assert await wait_until(
+            lambda: all(c.membership_size == 5 for c in clusters)
+            and len({c.service.view.configuration_id for c in clusters}) == 1
+        )
+
+        def java_fold(view):
+            return configuration_id_of(
+                sorted(view.configuration.node_ids,
+                       key=lambda n: node_id_sort_key(n, TOPOLOGY_JAVA)),
+                view.ring(0),
+                TOPOLOGY_JAVA,
+            )
+
+        view = clusters[0].service.view
+        assert view.topology == TOPOLOGY_JAVA
+        assert view.configuration_id == java_fold(view)
+
+        # Crash: DOWN alerts + consensus ride the gRPC wire; the new
+        # configuration id must again be the java fold.
+        victim = clusters[2]
+        await victim.shutdown()
+        fd.add_failed_nodes([victim.listen_address])
+        survivors = [c for c in clusters if c is not victim]
+        assert await wait_until(
+            lambda: all(c.membership_size == 4 for c in survivors)
+            and len({c.service.view.configuration_id for c in survivors}) == 1,
+            timeout_s=30,
+        )
+        view = survivors[0].service.view
+        assert view.configuration_id == java_fold(view)
     finally:
         await asyncio.gather(*(c.shutdown() for c in clusters), return_exceptions=True)
