@@ -1,0 +1,146 @@
+"""Adversarial message-interleaving safety fuzzer for classic Paxos.
+
+The engine resolves a classic attempt inside one round
+(``models/virtual_cluster.py`` ``classic_attempt``: phase1a→1b→2a→2b with
+in-attempt rank ordering), so cross-attempt interleavings — a phase2a from
+round r arriving while acceptors are already promising round r+2, a stale
+phase1b resurfacing after three escalations, duplicated deliveries — can
+occur only on the host stack (``protocol/paxos.py``), and they occur MORE
+now that the fallback escalates rounds until decided (``fast_paxos.py``).
+The scenario oracle (test_oracle_parity.py) compares outcomes of full
+schedules; this fuzzer attacks the message layer directly: a seeded
+adversarial scheduler that reorders, delays, duplicates, and drops
+individual consensus messages across many escalating rounds, checking the
+one invariant no interleaving may break — agreement: two nodes never decide
+different values. (Liveness under the adversary is not asserted: an
+adversary that drops everything trivially prevents decisions; seeds that do
+decide must decide consistently, and the chosen value must be one that was
+actually proposed. Validity + agreement ≙ PaxosTests.java:72-191's
+drop-the-fast-round recovery family, generalized over delivery schedules.)
+"""
+
+import random
+
+import pytest
+
+from rapid_tpu.protocol.fast_paxos import FastPaxos, fast_paxos_quorum
+from rapid_tpu.types import Endpoint, RapidRequest
+from rapid_tpu.utils.clock import ManualClock
+
+
+def ep(i: int) -> Endpoint:
+    return Endpoint("10.5.0.1", 9000 + i)
+
+
+class AdversarialNetwork:
+    """Central message pool with a seeded adversarial scheduler: every
+    broadcast/send enqueues (target, message) pairs; delivery order is a
+    random permutation draw, messages may be duplicated (redelivery) or
+    dropped, and the pool persists across liveness ticks so stale-round
+    traffic interleaves with escalated rounds."""
+
+    def __init__(self, rng: random.Random, n: int, drop_p: float, dup_p: float):
+        self.rng = rng
+        self.n = n
+        self.pool = []  # list of (target_index, message)
+        self.nodes = []  # FastPaxos instances, filled by the test
+        self.drop_p = drop_p
+        self.dup_p = dup_p
+
+    def broadcast_from(self, message: RapidRequest) -> None:
+        for target in range(self.n):
+            self._enqueue(target, message)
+
+    def send(self, remote: Endpoint, message: RapidRequest) -> None:
+        self._enqueue(remote.port - 9000, message)
+
+    def _enqueue(self, target: int, message: RapidRequest) -> None:
+        if self.rng.random() < self.drop_p:
+            return
+        self.pool.append((target, message))
+        if self.rng.random() < self.dup_p:
+            self.pool.append((target, message))
+
+    def deliver_some(self, max_messages: int) -> int:
+        """Deliver up to max_messages pool entries in adversarial order."""
+        delivered = 0
+        while self.pool and delivered < max_messages:
+            idx = self.rng.randrange(len(self.pool))
+            target, message = self.pool.pop(idx)
+            self.nodes[target].handle_message(message)
+            delivered += 1
+        return delivered
+
+
+def run_adversarial_schedule(seed: int, n: int = 5, drop_p: float = 0.15,
+                             dup_p: float = 0.2):
+    """One fuzzed run; returns (decisions per node, proposals)."""
+    rng = random.Random(seed)
+    clock = ManualClock()
+    net = AdversarialNetwork(rng, n, drop_p, dup_p)
+    decisions = {}
+
+    def on_decide_for(i):
+        def on_decide(value):
+            decisions[i] = tuple(value)
+        return on_decide
+
+    nodes = []
+    for i in range(n):
+        fp = FastPaxos(
+            my_addr=ep(i), configuration_id=77, membership_size=n,
+            broadcast_fn=net.broadcast_from, send_fn=net.send,
+            on_decide=on_decide_for(i), clock=clock,
+            consensus_fallback_base_delay_ms=100, rng=random.Random(seed + i),
+        )
+        nodes.append(fp)
+    net.nodes = nodes
+
+    # Contested fast round: nodes vote for one of two proposals, split so
+    # that neither reaches the fast quorum — every decision must come from
+    # classic rounds racing under the adversary.
+    proposals = [(ep(100),), (ep(100), ep(101))]
+    quorum = fast_paxos_quorum(n)
+    split = min(quorum - 1, n - 1)
+    for i, fp in enumerate(nodes):
+        fp.propose(proposals[0 if i < split else 1],
+                   recovery_delay_ms=50 + rng.random() * 200)
+
+    # Interleave clock ticks (escalating rounds at every undecided node)
+    # with adversarial deliveries; the pool carries stale-round messages
+    # forward into later rounds.
+    for _ in range(400):
+        clock.advance_ms(rng.choice([0, 10, 40, 150]))
+        net.deliver_some(rng.randrange(1, 12))
+        if len(decisions) == n:
+            break
+    # Final drain: deliver everything still pooled (dup/reorder included).
+    while net.pool:
+        net.deliver_some(len(net.pool))
+    return decisions, proposals
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_agreement_under_adversarial_interleavings(seed):
+    decisions, proposals = run_adversarial_schedule(seed)
+    decided_values = set(decisions.values())
+    # Agreement: no two nodes decide differently — regardless of how many
+    # rounds raced, how stale the resurfacing messages were, or what got
+    # duplicated or dropped.
+    assert len(decided_values) <= 1, (
+        f"seed {seed}: divergent decisions {decisions}"
+    )
+    # Validity: a decided value must be one of the actually-proposed cuts.
+    if decided_values:
+        assert decided_values <= set(map(tuple, proposals))
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_lossless_adversary_decides_and_agrees(seed):
+    # With no drops the adversary can only reorder/duplicate/delay: every
+    # node must eventually decide (the escalating fallback guarantees a
+    # round completes once its messages all deliver), and identically.
+    decisions, proposals = run_adversarial_schedule(seed, drop_p=0.0)
+    assert len(decisions) == 5, f"seed {seed}: only {sorted(decisions)} decided"
+    assert len(set(decisions.values())) == 1
+    assert set(decisions.values()) <= set(map(tuple, proposals))
