@@ -725,9 +725,12 @@ class MembershipService:
                     if self._stopped:
                         return
                     config_id = self.view.configuration_id
-                    pending = tuple(
+                    # De-duplicate, order-preserving: join retries enqueue
+                    # identical UP alerts; receivers dedup anyway (per
+                    # subject+ring), so repeats only waste payload.
+                    pending = tuple(dict.fromkeys(
                         m for m in self._alerts_sent if m.configuration_id == config_id
-                    )
+                    ))
                     if not pending or self._redeliveries_this_config >= _MAX_REDELIVERIES:
                         continue
                     unresolved = (

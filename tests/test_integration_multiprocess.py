@@ -15,7 +15,13 @@ import pytest
 
 REPO = Path(__file__).resolve().parent.parent
 AGENT = REPO / "examples" / "standalone_agent.py"
-BASE_PORT = 34100
+def free_ports(count: int):
+    """Kernel-assigned ports for agent subprocesses: fixed ranges collide
+    with whatever else runs on the host (a concurrent suite run flaked
+    exactly that way). Reserve-then-release via the shared helper."""
+    from helpers import free_endpoints
+
+    return [ep.port for ep in free_endpoints(count)]
 
 
 class AgentRunner:
@@ -78,15 +84,21 @@ def runner(tmp_path):
 
 
 def test_single_agent_starts(runner):
-    runner.spawn(BASE_PORT, BASE_PORT)
-    assert runner.wait_for_size([BASE_PORT], 1, timeout_s=30)
-    assert runner.procs[BASE_PORT].poll() is None  # still alive
+    (port,) = free_ports(1)
+    runner.spawn(port, port)
+    assert runner.wait_for_size([port], 1, timeout_s=30)
+    assert runner.procs[port].poll() is None  # still alive
 
 
 def test_five_agents_converge_and_survive_a_kill(runner):
-    ports = [BASE_PORT + 10 + i for i in range(5)]
-    runner.spawn(ports[0], ports[0])
-    assert runner.wait_for_size([ports[0]], 1, timeout_s=30)
+    # Ports are allocated immediately before their spawns: reserving the
+    # whole set up-front would widen the reserve-then-release race (the
+    # running seed's outbound ephemeral connections draw from the same
+    # kernel range the reserved ports were released back into).
+    (seed_port,) = free_ports(1)
+    runner.spawn(seed_port, seed_port)
+    assert runner.wait_for_size([seed_port], 1, timeout_s=30)
+    ports = [seed_port] + free_ports(4)
     for port in ports[1:]:
         runner.spawn(port, ports[0])
     assert runner.wait_for_size(ports, 5, timeout_s=90)
@@ -103,9 +115,10 @@ def test_ten_agents_converge(runner):
     # RapidNodeRunnerTest's 10-JVM bring-up (RapidNodeRunnerTest.java:28-57):
     # ten real OS processes join through one seed and all converge on the
     # same membership size.
-    ports = [BASE_PORT + 40 + i for i in range(10)]
-    runner.spawn(ports[0], ports[0])
-    assert runner.wait_for_size([ports[0]], 1, timeout_s=30)
+    (seed_port,) = free_ports(1)
+    runner.spawn(seed_port, seed_port)
+    assert runner.wait_for_size([seed_port], 1, timeout_s=30)
+    ports = [seed_port] + free_ports(9)
     for port in ports[1:]:
         runner.spawn(port, ports[0])
     assert runner.wait_for_size(ports, 10, timeout_s=90)
@@ -116,9 +129,10 @@ def test_ten_agents_converge(runner):
 def test_windowed_fd_agents_detect_kill(runner):
     # Real processes on the PAPER's failure-detection policy (--fd windowed):
     # a SIGKILLed member is detected and evicted by the survivors.
-    ports = [BASE_PORT + 60 + i for i in range(3)]
-    runner.spawn(ports[0], ports[0], extra=["--fd", "windowed"])
-    assert runner.wait_for_size([ports[0]], 1, timeout_s=30)
+    (seed_port,) = free_ports(1)
+    runner.spawn(seed_port, seed_port, extra=["--fd", "windowed"])
+    assert runner.wait_for_size([seed_port], 1, timeout_s=30)
+    ports = [seed_port] + free_ports(2)
     for port in ports[1:]:
         runner.spawn(port, ports[0], extra=["--fd", "windowed"])
     assert runner.wait_for_size(ports, 3, timeout_s=60)
