@@ -152,6 +152,10 @@ class MembershipService:
         self._catch_up_inflight = False
         self._catch_up_tasks: Set[asyncio.Task] = set()
         self._last_catch_up_ms = float("-inf")
+        self._last_beacon_ms = float("-inf")
+        # Idle-heartbeat timer starts at construction: a fresh node is
+        # current by definition and owes no immediate anti-entropy pull.
+        self._last_idle_sync_ms = self.clock.now_ms()
         self._decision_pending_catch_up = False
         self._kicked_signalled = False
         self._report_only_sync_pulls = 0
@@ -481,12 +485,17 @@ class MembershipService:
         self._fast_paxos = self._new_fast_paxos()
         self.broadcaster.set_membership(self.view.ring(0))
 
-    def _remember_config_id(self, config_id: int) -> None:
-        """Bounded history of configuration ids this node has inhabited or
-        verified (via a pull) as not ahead of it: distinguishes straggler
-        traffic from a configuration we genuinely missed (ids are hash
-        folds, not ordered — history is the only way to tell)."""
-        self._known_config_ids[config_id] = True
+    def _remember_config_id(self, config_id: int, inhabited: bool = True) -> None:
+        """Bounded history of configuration ids this node has inhabited
+        (value True) or merely verified via a futile pull as not ahead of it
+        (value False): both suppress further evidence pulls, but only
+        genuinely-inhabited ids qualify a sender for a config beacon — a
+        futile-learned id belongs to a chain we never walked, and beaconing
+        on it would let two diverged chains beacon each other forever. Ids
+        are hash folds, not ordered; history is the only way to tell
+        stragglers from configurations we genuinely missed."""
+        if inhabited or not self._known_config_ids.get(config_id, False):
+            self._known_config_ids[config_id] = inhabited
         self._known_config_ids.move_to_end(config_id)
         while len(self._known_config_ids) > 64:
             self._known_config_ids.popitem(last=False)
@@ -784,14 +793,25 @@ class MembershipService:
                         and self.cut_detector.has_pending_reports()
                         and self._report_only_sync_pulls < _MAX_REPORT_ONLY_SYNC_PULLS
                     )
+                    # Anti-entropy heartbeat (settings rationale): with no
+                    # suspicion at all, still pull on the slow idle cadence —
+                    # the only channel to a member that missed a decision
+                    # with zero local evidence and zero inbound traffic.
+                    idle_ms = self.settings.config_sync_idle_interval_ms
+                    now = self.clock.now_ms()
+                    idle_due = (
+                        idle_ms > 0 and now - self._last_idle_sync_ms >= idle_ms
+                    )
                     suspicious = (
                         not self._kicked_signalled
                         and not self._catch_up_inflight
-                        and (strong or report_only)
+                        and (strong or report_only or idle_due)
                     )
-                    if suspicious and not strong:
+                    if suspicious and not strong and not idle_due:
                         # Budget counts pulls actually issued, not skipped ticks.
                         self._report_only_sync_pulls += 1
+                    if suspicious:
+                        self._last_idle_sync_ms = now
                     peer = self._random_peer() if suspicious else None
                 if peer is not None:
                     await self._catch_up(peer)
@@ -809,7 +829,7 @@ class MembershipService:
         comparison — tells stragglers from the future."""
         if self.node_id is None or self.settings.config_sync_interval_ms <= 0:
             return
-        if self._stopped or self._catch_up_inflight:
+        if self._stopped or self._kicked_signalled or self._catch_up_inflight:
             return
         if isinstance(request, BatchedAlertMessage):
             config_ids = {m.configuration_id for m in request.messages}
@@ -818,13 +838,48 @@ class MembershipService:
         unknown = frozenset(
             cid for cid in config_ids if cid not in self._known_config_ids
         )
+        sender = request.sender
+        if sender == self.my_addr:
+            return
+        now = self.clock.now_ms()
         if unknown:
-            sender = request.sender
-            if sender != self.my_addr:
-                now = self.clock.now_ms()
-                if now - self._last_catch_up_ms >= self.settings.config_sync_interval_ms:
-                    self._last_catch_up_ms = now
-                    self._spawn_catch_up(sender, trigger_ids=unknown)
+            if now - self._last_catch_up_ms >= self.settings.config_sync_interval_ms:
+                self._last_catch_up_ms = now
+                self._last_idle_sync_ms = now  # a pull IS the heartbeat
+                self._spawn_catch_up(sender, trigger_ids=unknown)
+        elif (
+            config_ids
+            and self.view.configuration_id not in config_ids
+            and all(self._known_config_ids.get(cid, False) for cid in config_ids)
+        ):
+            # Every id is one WE have inhabited (futile-learned ids do NOT
+            # qualify — see _remember_config_id) but none is current: the
+            # sender is demonstrably behind us (e.g. it missed a decision
+            # and its liveness tick keeps re-offering old-config votes).
+            # Answer with a config BEACON — a semantically inert alert
+            # batch (a self-UP alert is filtered by every receiver) whose
+            # config stamp is, to the stale sender, evidence of an unknown
+            # configuration: its own evidence pull does the rest. Keeps
+            # post-decision staleness recovery prompt without new wire
+            # types; the idle-cadence pull remains the no-signal fallback.
+            if now - self._last_beacon_ms >= self.settings.config_sync_interval_ms:
+                self._last_beacon_ms = now
+                self.metrics.inc("config_beacons_sent")
+                self.client.send_nowait(
+                    sender,
+                    BatchedAlertMessage(
+                        sender=self.my_addr,
+                        messages=(
+                            AlertMessage(
+                                edge_src=self.my_addr,
+                                edge_dst=self.my_addr,
+                                edge_status=EdgeStatus.UP,
+                                configuration_id=self.view.configuration_id,
+                                ring_numbers=(),
+                            ),
+                        ),
+                    ),
+                )
 
     def _random_peer(self) -> Optional[Endpoint]:
         members = [m for m in self.view.ring(0) if m != self.my_addr]
@@ -927,9 +982,9 @@ class MembershipService:
                 # AND the trigger ids so this straggler traffic stops
                 # re-triggering evidence pulls (ids are hash-unique; a config
                 # verified not-ahead of us can never become ahead).
-                self._remember_config_id(response.configuration_id)
+                self._remember_config_id(response.configuration_id, inhabited=False)
                 for cid in trigger_ids:
-                    self._remember_config_id(cid)
+                    self._remember_config_id(cid, inhabited=False)
             return
         if response.status_code != JoinStatusCode.SAFE_TO_JOIN or not response.endpoints:
             return
@@ -947,9 +1002,9 @@ class MembershipService:
             # Futile pull: mark the peer's config and the trigger ids as
             # known-not-ahead so this straggler traffic stops re-triggering
             # evidence pulls.
-            self._remember_config_id(response.configuration_id)
+            self._remember_config_id(response.configuration_id, inhabited=False)
             for cid in trigger_ids:
-                self._remember_config_id(cid)
+                self._remember_config_id(cid, inhabited=False)
             return
         self.metrics.inc("config_catch_ups")
         self._install_fetched_configuration(response)

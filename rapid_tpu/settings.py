@@ -48,6 +48,15 @@ class Settings:
     #   cadence (0 = off).
     alert_redelivery_interval_ms: int = 1000
     config_sync_interval_ms: int = 2000
+    # Anti-entropy heartbeat: even with NO local suspicion a member pulls a
+    # peer's configuration this often (0 = off). This is the only mechanism
+    # that reaches a member which missed a decision AND has no local
+    # evidence of it AND receives no traffic at all afterwards (e.g. its
+    # ingress was partitioned through the decision and the cluster went
+    # quiescent) — suspicion-based sync and evidence pulls both need some
+    # signal; this needs none. Deliberately slow: one small request/response
+    # per member per interval, a no-op whenever nothing changed.
+    config_sync_idle_interval_ms: int = 30_000
 
     # Topology mode: "native" (tpu-first default: 8-byte port hashing,
     # unsigned key/identifier ordering) or "java" (reference-exact ring

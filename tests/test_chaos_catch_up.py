@@ -1,0 +1,112 @@
+"""Multi-cycle partition/heal chaos soak for the config catch-up path.
+
+The fallback-forcing oracle case (test_oracle_parity.py) proves ONE cycle:
+ingress-blocked observers miss a decision and pull their way back. This
+soak generalizes it: over several cycles with seeded random blocked sets,
+the cluster keeps deciding membership changes (each forced through the
+classic fallback — the blocked set is sized to hold the fast round below
+quorum), and the blocked members keep re-joining the new configuration
+through the partition via reliable-path config pulls. Invariants per
+cycle: every live node (blocked included) reaches the identical view, no
+node is ever kicked, and the configuration chain advances monotonically
+(identifier history grows on joins) across MULTIPLE missed decisions per
+node — exercising the known-config-id history, the futile-pull memory, and
+repeated catch-up installs on the same service instance.
+"""
+
+import asyncio
+import functools
+import random
+
+import pytest
+
+from rapid_tpu.types import Endpoint
+
+from test_oracle_parity import _HostHarness
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        async def with_timeout():
+            await asyncio.wait_for(fn(*args, **kwargs), timeout=300)
+
+        asyncio.run(with_timeout())
+
+    return wrapper
+
+
+N0 = 12
+CYCLES = 4
+BLOCKED_PER_CYCLE = 3  # voters 12-1-3 < fast quorum 10: classic every cycle
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+@async_test
+async def test_repeated_partitions_heal_by_catch_up(seed):
+    rng = random.Random(seed)
+    endpoints = [
+        Endpoint(f"10.6.{seed}.{i}", 7600 + i) for i in range(N0 + CYCLES)
+    ]
+    h = _HostHarness(endpoints)
+    # Fast idle heartbeat: a blocked member that is NOT an observer of the
+    # change has zero local evidence and zero inbound traffic — the
+    # unconditional anti-entropy pull is the only channel that reaches it
+    # through a one-way partition (settings.py rationale).
+    h.settings.config_sync_idle_interval_ms = 2_000
+    await h.bootstrap(N0)
+    kicked = []
+    for cluster in h.clusters.values():
+        from rapid_tpu.protocol.events import ClusterEvents
+
+        cluster.register_subscription(ClusterEvents.KICKED, kicked.append)
+
+    members = N0
+    next_join = N0
+    total_catch_ups_before = 0
+    for cycle in range(CYCLES):
+        # Random blocked set: live members, never the seed, never this
+        # cycle's crash victim.
+        live = sorted(h.live_ids - {0})
+        blocked = rng.sample(live, BLOCKED_PER_CYCLE)
+        victim = rng.choice([s for s in live if s not in blocked])
+        for b in blocked:
+            for other in h.clusters:
+                if other != b:
+                    h.network.blackholed_links.add(
+                        (h.endpoints[other], h.endpoints[b])
+                    )
+
+        # Alternate crash and join cycles so identifier history both grows
+        # and the endpoint set both shrinks and grows across the chain.
+        if cycle % 2 == 0:
+            h.crash([victim])
+            members -= 1
+        else:
+            await h.join_one(next_join)
+            next_join += 1
+            members += 1
+
+        # Blocked members must reach the new configuration THROUGH the
+        # partition (their pulls ride request/response; ingress of pushed
+        # traffic stays dead until the heal below).
+        await h.converge_members(members, budget_ms=90_000)
+
+        h.network.blackholed_links.clear()
+        await h.converge_members(members)
+        assert not kicked, f"cycle {cycle}: healthy member kicked: {kicked}"
+
+        total_catch_ups = sum(
+            h.clusters[i].service.metrics.counters["config_catch_ups"]
+            for i in h.live_ids
+        )
+        assert total_catch_ups >= total_catch_ups_before
+        total_catch_ups_before = total_catch_ups
+
+    # The soak must have exercised the catch-up path, not converged by luck.
+    assert total_catch_ups_before >= CYCLES - 1, (
+        f"expected repeated catch-ups across {CYCLES} cycles, "
+        f"saw {total_catch_ups_before}"
+    )
+    final = await h.shutdown()
+    assert len(final) == members

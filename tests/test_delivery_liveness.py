@@ -457,6 +457,59 @@ async def test_decision_missing_uuid_recovers_by_pull_not_rejoin():
 
 
 @async_test
+async def test_stale_sender_traffic_draws_a_config_beacon():
+    # A member that missed a decision keeps emitting old-config traffic
+    # (its liveness tick re-offers votes). An up-to-date receiver — for whom
+    # those config ids are all known history — answers with a config
+    # BEACON: a semantically inert self-UP alert batch stamped with the
+    # current config id, which the stale sender treats as evidence of an
+    # unknown configuration and pulls. End state: the stale member catches
+    # up without anyone pushing configuration state over best-effort lanes.
+    network = InProcessNetwork()
+    ids = [NodeId(0, i) for i in range(5)]
+    eps = [ep(i) for i in range(5)]
+    current, current_server = build_service(network, 1, eps, ids)
+    stale, stale_server = build_service(network, 0, eps, ids)
+    await current_server.start()
+    await stale_server.start()
+    await stale.start()
+    try:
+        old_config = current.view.configuration_id
+        assert old_config == stale.view.configuration_id
+        # Drive a real crash decision at `current` only: quorum fast-round
+        # votes naming an existing member (no joiner UUID needed).
+        victim = eps[4]
+        quorum = fast_paxos_quorum(5)
+        for i in range(quorum):
+            await current.handle_message(
+                FastRoundPhase2bMessage(
+                    sender=eps[i], configuration_id=old_config, endpoints=(victim,)
+                )
+            )
+        assert current.membership_size == 4
+        assert stale.membership_size == 5  # genuinely stale
+
+        # The stale member's old-config vote reaches `current`: known-stale
+        # traffic, so `current` beacons instead of pulling.
+        await current.handle_message(
+            FastRoundPhase2bMessage(
+                sender=stale.my_addr, configuration_id=old_config, endpoints=(victim,)
+            )
+        )
+        assert current.metrics.counters["config_beacons_sent"] == 1
+        # The beacon lands at `stale` (in-process broadcast is direct), whose
+        # evidence pull brings it into the decided configuration.
+        assert await wait_until(lambda: stale.membership_size == 4)
+        assert stale.view.configuration_id == current.view.configuration_id
+        assert stale.metrics.counters["config_catch_ups"] == 1
+    finally:
+        await current_server.shutdown()
+        await stale_server.shutdown()
+        await current.shutdown()
+        await stale.shutdown()
+
+
+@async_test
 async def test_alert_redelivery_heals_a_lost_batch():
     # An observer's single alert-batch broadcast is lost toward one receiver
     # (dropped before reaching it); the redelivery loop re-broadcasts the
