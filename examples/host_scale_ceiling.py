@@ -19,12 +19,16 @@ never sleeps). The engine column runs the identical crash on a
 
 One JSON line per N:
 
-    {"n": 200, "host_crash_wall_ms": ..., "host_msgs": ...,
+    {"n": 200, "broadcast": "unicast", "host_crash_wall_ms": ...,
+     "host_msgs": ..., "gossip_relays": ...,  # gossip mode only
      "engine_crash_wall_ms": ..., "sim_ms": ...}
 
-Committed results live in EVALUATION.md ("Host-path scale ceiling").
+Committed results live in EVALUATION.md ("Host-path scale ceiling"),
+including the measured NEGATIVE result for --broadcast gossip (relay
+duplication multiplies total deliveries for all-origin vote fan-outs).
 
     python examples/host_scale_ceiling.py [--sizes 50,100,200,350,500]
+                                          [--broadcast unicast|gossip]
 """
 
 from __future__ import annotations
@@ -53,8 +57,13 @@ from rapid_tpu.types import Endpoint, NodeId
 from rapid_tpu.utils.clock import ManualClock
 
 
-async def host_crash_convergence(n: int, seed: int = 0):
-    """Wall-clock cost of one crash view-change across n host services."""
+async def host_crash_convergence(n: int, seed: int = 0, broadcast: str = "unicast"):
+    """Wall-clock cost of one crash view-change across n host services.
+    ``broadcast="gossip"`` swaps the O(N) unicast-to-all fan-out for the
+    epidemic relay (ln-N fanout) at every node — same protocol, different
+    egress shape."""
+    if broadcast not in ("unicast", "gossip"):
+        raise ValueError(f"broadcast must be 'unicast' or 'gossip', got {broadcast!r}")
     settings = Settings()  # reference defaults: 1 s FD, 100 ms batching
     endpoints = [Endpoint(f"10.20.{i // 250}.{i % 250}", 6000 + i) for i in range(n)]
     node_ids = [NodeId(0, i) for i in range(n)]
@@ -66,19 +75,28 @@ async def host_crash_convergence(n: int, seed: int = 0):
     servers = []
     for i in range(n):
         view = MembershipView(settings.k, node_ids=node_ids, endpoints=endpoints)
+        client = InProcessClient(network, endpoints[i], settings)
+        broadcaster = None
+        if broadcast == "gossip":
+            from rapid_tpu.messaging.gossip import GossipBroadcaster
+
+            broadcaster = GossipBroadcaster(client, endpoints[i], rng=random.Random(seed + i))
         service = MembershipService(
             my_addr=endpoints[i],
             cut_detector=MultiNodeCutDetector(settings.k, settings.h, settings.l),
             view=view,
             settings=settings,
-            client=InProcessClient(network, endpoints[i], settings),
+            client=client,
             fd_factory=fd,
             clock=clock,
             rng=random.Random(seed + i),
             node_id=node_ids[i],
+            broadcaster=broadcaster,
         )
         server = InProcessServer(network, endpoints[i])
-        server.set_membership_service(service)
+        server.set_membership_service(
+            broadcaster.router(service) if broadcaster is not None else service
+        )
         await server.start()
         await service.start()
         services.append(service)
@@ -104,11 +122,14 @@ async def host_crash_convergence(n: int, seed: int = 0):
     wall_ms = (time.perf_counter() - t0) * 1000.0
 
     msgs = sum(s.metrics.counters.get("alerts_received", 0) for s in live)
+    relays = sum(
+        getattr(s.broadcaster, "relays_sent", 0) for s in services
+    )
     for server in servers:
         await server.shutdown()
     for service in services:
         await service.shutdown()
-    return wall_ms, sim_ms, msgs
+    return wall_ms, sim_ms, msgs, relays
 
 
 def engine_crash_convergence(n: int):
@@ -137,15 +158,21 @@ def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--sizes", default="50,100,200,350,500")
     parser.add_argument("--skip-engine", action="store_true")
+    parser.add_argument("--broadcast", default="unicast", choices=["unicast", "gossip"])
     args = parser.parse_args()
     for n in (int(s) for s in args.sizes.split(",")):
-        wall_ms, sim_ms, msgs = asyncio.run(host_crash_convergence(n))
+        wall_ms, sim_ms, msgs, relays = asyncio.run(
+            host_crash_convergence(n, broadcast=args.broadcast)
+        )
         row = {
             "n": n,
+            "broadcast": args.broadcast,
             "host_crash_wall_ms": round(wall_ms, 1),
             "host_msgs": msgs,
             "sim_ms": sim_ms,
         }
+        if args.broadcast == "gossip":
+            row["gossip_relays"] = relays
         if not args.skip_engine:
             row["engine_crash_wall_ms"] = round(engine_crash_convergence(n), 1)
         print(json.dumps(row), flush=True)
