@@ -193,7 +193,8 @@ def test_cancel_fallback_stops_rearming():
 # ---------------------------------------------------------------------------
 
 
-def build_service(network, my_index, endpoints, node_ids, settings=None, metadata=None):
+def build_service(network, my_index, endpoints, node_ids, settings=None,
+                  metadata=None, clock=None):
     """A MembershipService over InProcessNetwork with its server registered,
     identity plumbed (node_id enables the catch-up path)."""
     settings = settings or Settings()
@@ -210,6 +211,7 @@ def build_service(network, my_index, endpoints, node_ids, settings=None, metadat
         metadata_map=metadata,
         rng=random.Random(my_index),
         node_id=node_ids[my_index],
+        clock=clock,
     )
     server = InProcessServer(network, my_addr)
     server.set_membership_service(service)
@@ -507,6 +509,54 @@ async def test_stale_sender_traffic_draws_a_config_beacon():
         await stale_server.shutdown()
         await current.shutdown()
         await stale.shutdown()
+
+
+@async_test
+async def test_quiescent_cluster_traffic_is_bounded_to_the_idle_heartbeat():
+    # The flip side of the liveness guarantees: a converged, healthy,
+    # change-free cluster must generate NO redeliveries, NO beacons, NO
+    # suspicion pulls — only the slow idle anti-entropy heartbeat — over an
+    # hour of simulated time. Runaway background traffic would be a
+    # liveness mechanism misfiring.
+    network = InProcessNetwork()
+    ids = [NodeId(0, i) for i in range(4)]
+    eps = [ep(i) for i in range(4)]
+    clock = ManualClock()
+    settings = Settings()
+    services, servers = [], []
+    for i in range(4):
+        service, server = build_service(
+            network, i, eps, ids, settings=settings, clock=clock
+        )
+        await server.start()
+        await service.start()
+        services.append(service)
+        servers.append(server)
+    try:
+        sim_hour_ms = 3_600_000
+        step = 5_000
+        for _ in range(sim_hour_ms // step):
+            clock.advance_ms(step)
+            for _ in range(20):
+                await asyncio.sleep(0)
+        expected_idle_pulls = sim_hour_ms // settings.config_sync_idle_interval_ms
+        for service in services:
+            c = service.metrics.counters
+            assert c["alert_batches_redelivered"] == 0
+            assert c["config_beacons_sent"] == 0
+            assert c["kicked"] == 0
+            # Only idle-heartbeat pulls, roughly one per idle interval (the
+            # loop tick quantization allows a little slack, never runaway).
+            assert c["config_catch_ups"] == 0  # same-config pulls adopt nothing
+            snap = service.client.stats.snapshot()
+            assert snap["msgs_tx"] <= expected_idle_pulls + 2, snap
+            # ...and the heartbeat is genuinely alive, not silently dead.
+            assert snap["msgs_tx"] >= expected_idle_pulls // 2, snap
+    finally:
+        for server in servers:
+            await server.shutdown()
+        for service in services:
+            await service.shutdown()
 
 
 @async_test
