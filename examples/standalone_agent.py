@@ -20,6 +20,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import os
 import signal
 import sys
 from pathlib import Path
@@ -33,6 +34,7 @@ from rapid_tpu.protocol.cluster import Cluster
 from rapid_tpu.protocol.events import ClusterEvents
 from rapid_tpu.settings import Settings
 from rapid_tpu.types import Endpoint
+from rapid_tpu.utils import exposition
 
 LOG = logging.getLogger("standalone_agent")
 
@@ -100,15 +102,31 @@ async def run(args) -> None:
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
 
+    def dump_metrics() -> None:
+        # The unified telemetry snapshot (utils/exposition.py schema):
+        # metrics + transport accounting + the full flight recording — one
+        # file per node, the exact input tools/traceview.py merges. Written
+        # atomically so a concurrently-running traceview never reads a
+        # torn JSON.
+        tmp = args.metrics_dump + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(exposition.snapshot_json(cluster.telemetry_snapshot(), indent=2))
+            f.write("\n")
+        os.replace(tmp, args.metrics_dump)
+
     async def reporter():
         while not stop.is_set():
             LOG.info("membership size: %d (config %d)",
                      cluster.membership_size, cluster.service.view.configuration_id)
+            if args.metrics_dump:
+                dump_metrics()
             await asyncio.sleep(args.report_interval)
 
     reporter_task = asyncio.ensure_future(reporter())
     await stop.wait()
     reporter_task.cancel()
+    if args.metrics_dump:
+        dump_metrics()  # final recording survives the shutdown
     LOG.info("leaving gracefully")
     await cluster.leave_gracefully()
 
@@ -130,6 +148,12 @@ def main() -> None:
                         "default) or epidemic gossip relay (the alternate "
                         "IBroadcaster impl its docs name)")
     parser.add_argument("--report-interval", type=float, default=1.0)
+    parser.add_argument("--metrics-dump", default="", metavar="PATH",
+                        help="write the node's unified telemetry snapshot "
+                        "(metrics, transport stats, flight recording) to PATH "
+                        "as JSON every report interval and on shutdown; feed "
+                        "one file per node to tools/traceview.py to merge a "
+                        "cluster-wide timeline")
     args = parser.parse_args()
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s"

@@ -19,6 +19,7 @@ schema is materialized at runtime, rapid_tpu.interop.proto_schema).
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 from typing import Dict, Optional
 
@@ -35,6 +36,7 @@ from rapid_tpu.interop.convert import (
 from rapid_tpu.interop.proto_schema import GRPC_METHOD, proto_class
 from rapid_tpu.messaging.base import MessagingClient, MessagingServer
 from rapid_tpu.messaging.retries import call_with_retries
+from rapid_tpu.messaging.stats import TransportStats
 from rapid_tpu.settings import Settings
 from rapid_tpu.types import (
     Endpoint,
@@ -51,6 +53,32 @@ LOG = logging.getLogger(__name__)
 
 _SERVICE = "remoting.MembershipService"
 _METHOD = "sendRequest"
+
+#: Trace-context header. rapid.proto has no trace field and its descriptors
+#: are frozen by the golden wire fixtures (tests/test_wire_fixtures.py), so
+#: the correlation key travels as gRPC metadata — invisible to the message
+#: schema, ignored by a reference peer, re-attached to the native request on
+#: our side.
+TRACE_METADATA_KEY = "rapid-trace-id"
+
+
+def _trace_metadata(request: RapidRequest):
+    trace_id = getattr(request, "trace_id", None)
+    if trace_id is None:
+        return None
+    return ((TRACE_METADATA_KEY, str(trace_id)),)
+
+
+def _attach_trace(request: RapidRequest, invocation_metadata) -> RapidRequest:
+    if not hasattr(request, "trace_id") or invocation_metadata is None:
+        return request
+    for key, value in invocation_metadata:
+        if key == TRACE_METADATA_KEY:
+            try:
+                return dataclasses.replace(request, trace_id=int(value))
+            except (ValueError, TypeError):
+                return request
+    return request
 
 
 def _serialize_response(response_proto) -> bytes:
@@ -70,6 +98,7 @@ class GrpcServer(MessagingServer):
         self.listen_address = listen_address
         self._service = None
         self._server: Optional[grpc.aio.Server] = None
+        self.stats = TransportStats()  # paper Table 2 accounting
 
     def set_membership_service(self, service) -> None:
         self._service = service
@@ -78,15 +107,22 @@ class GrpcServer(MessagingServer):
         server = grpc.aio.server()
 
         async def send_request(request_proto, context):
-            request = request_from_proto(request_proto)
+            self.stats.rx(request_proto.ByteSize())
+            request = _attach_trace(
+                request_from_proto(request_proto), context.invocation_metadata()
+            )
             if self._service is None:
                 if isinstance(request, ProbeMessage):
                     # BOOTSTRAPPING probes before the service exists
                     # (GrpcServer.java:77-96).
-                    return response_to_proto(ProbeResponse(status=NodeStatus.BOOTSTRAPPING))
+                    out = response_to_proto(ProbeResponse(status=NodeStatus.BOOTSTRAPPING))
+                    self.stats.tx(out.ByteSize())
+                    return out
                 await context.abort(grpc.StatusCode.UNAVAILABLE, "bootstrapping")
             response = await self._service.handle_message(request)
-            return response_to_proto(response)
+            out = response_to_proto(response)
+            self.stats.tx(out.ByteSize())
+            return out
 
         handler = grpc.unary_unary_rpc_method_handler(
             send_request,
@@ -124,6 +160,7 @@ class GrpcClient(MessagingClient):
         self._settings = settings if settings is not None else Settings()
         self._channels: Dict[Endpoint, grpc.aio.Channel] = {}
         self._shut_down = False
+        self.stats = TransportStats()  # paper Table 2 accounting
 
     def _timeout_s_for(self, request: RapidRequest) -> float:
         if isinstance(request, (JoinMessage, PreJoinMessage)):
@@ -148,9 +185,14 @@ class GrpcClient(MessagingClient):
             request_serializer=lambda r: r.SerializeToString(),
             response_deserializer=lambda data: _parse_response(data),
         )
+        request_proto = request_to_proto(request)
+        self.stats.tx(request_proto.ByteSize())
         response_proto = await call(
-            request_to_proto(request), timeout=self._timeout_s_for(request)
+            request_proto,
+            timeout=self._timeout_s_for(request),
+            metadata=_trace_metadata(request),
         )
+        self.stats.rx(response_proto.ByteSize())
         return response_from_proto(response_proto)
 
     async def send(self, remote: Endpoint, request: RapidRequest) -> RapidResponse:

@@ -186,6 +186,22 @@ def _r_rings(r: _Reader) -> Tuple[int, ...]:
     return tuple(r.u32() for _ in range(r.u32()))
 
 
+# Optional trailing trace-context field (alert batches + consensus messages):
+# written ONLY when present, so a frame without a trace id is byte-identical
+# to the pre-trace layout — old recordings and golden fixtures stay valid,
+# and a peer that never stamps traces interoperates unchanged. On decode the
+# message body consumes an exact prefix, so any remainder IS the extension.
+
+
+def _w_opt_trace(w: _Writer, trace_id) -> None:
+    if trace_id is not None:
+        w.u64(trace_id)
+
+
+def _r_opt_trace(r: _Reader):
+    return None if r.done() else r.u64()
+
+
 def _w_alert(w: _Writer, a: AlertMessage) -> None:
     _w_endpoint(w, a.edge_src)
     _w_endpoint(w, a.edge_dst)
@@ -239,7 +255,11 @@ def encode_request(request: RapidRequest) -> bytes:
     them is safe. A request built with unhashable sequence fields (e.g.
     lists) still encodes, just uncached."""
     try:
-        return _encode_request_cached(request)
+        # trace_id is compare=False (types.py): two protocol-equal requests
+        # with different trace stamps hash alike, so the stamp must join the
+        # cache key explicitly or one message's bytes would carry the other's
+        # trace id.
+        return _encode_request_cached(request, getattr(request, "trace_id", None))
     except TypeError:  # unhashable field values — encode without the cache
         return _encode_request_impl(request)
 
@@ -248,7 +268,7 @@ def encode_request(request: RapidRequest) -> bytes:
 # whose fan-out futures are interleaved on the loop at once, and a small LRU
 # avoids pinning dead request batches for the process lifetime.
 @functools.lru_cache(maxsize=8)
-def _encode_request_cached(request: RapidRequest) -> bytes:
+def _encode_request_cached(request: RapidRequest, _trace_id) -> bytes:
     return _encode_request_impl(request)
 
 
@@ -272,32 +292,38 @@ def _encode_request_impl(request: RapidRequest) -> bytes:
         w.u32(len(request.messages))
         for alert in request.messages:
             _w_alert(w, alert)
+        _w_opt_trace(w, request.trace_id)
     elif isinstance(request, ProbeMessage):
         _w_endpoint(w, request.sender)
     elif isinstance(request, FastRoundPhase2bMessage):
         _w_endpoint(w, request.sender)
         w.i64(request.configuration_id)
         _w_endpoints(w, request.endpoints)
+        _w_opt_trace(w, request.trace_id)
     elif isinstance(request, Phase1aMessage):
         _w_endpoint(w, request.sender)
         w.i64(request.configuration_id)
         _w_rank(w, request.rank)
+        _w_opt_trace(w, request.trace_id)
     elif isinstance(request, Phase1bMessage):
         _w_endpoint(w, request.sender)
         w.i64(request.configuration_id)
         _w_rank(w, request.rnd)
         _w_rank(w, request.vrnd)
         _w_endpoints(w, request.vval)
+        _w_opt_trace(w, request.trace_id)
     elif isinstance(request, Phase2aMessage):
         _w_endpoint(w, request.sender)
         w.i64(request.configuration_id)
         _w_rank(w, request.rnd)
         _w_endpoints(w, request.vval)
+        _w_opt_trace(w, request.trace_id)
     elif isinstance(request, Phase2bMessage):
         _w_endpoint(w, request.sender)
         w.i64(request.configuration_id)
         _w_rank(w, request.rnd)
         _w_endpoints(w, request.endpoints)
+        _w_opt_trace(w, request.trace_id)
     elif isinstance(request, LeaveMessage):
         _w_endpoint(w, request.sender)
     elif isinstance(request, GossipMessage):
@@ -328,19 +354,29 @@ def decode_request(data: bytes) -> RapidRequest:
         )
     elif tag == 3:
         sender = _r_endpoint(r)
-        out = BatchedAlertMessage(sender, tuple(_r_alert(r) for _ in range(r.u32())))
+        messages = tuple(_r_alert(r) for _ in range(r.u32()))
+        out = BatchedAlertMessage(sender, messages, trace_id=_r_opt_trace(r))
     elif tag == 4:
         out = ProbeMessage(_r_endpoint(r))
     elif tag == 5:
-        out = FastRoundPhase2bMessage(_r_endpoint(r), r.i64(), _r_endpoints(r))
+        out = FastRoundPhase2bMessage(
+            _r_endpoint(r), r.i64(), _r_endpoints(r), trace_id=_r_opt_trace(r)
+        )
     elif tag == 6:
-        out = Phase1aMessage(_r_endpoint(r), r.i64(), _r_rank(r))
+        out = Phase1aMessage(_r_endpoint(r), r.i64(), _r_rank(r), trace_id=_r_opt_trace(r))
     elif tag == 7:
-        out = Phase1bMessage(_r_endpoint(r), r.i64(), _r_rank(r), _r_rank(r), _r_endpoints(r))
+        out = Phase1bMessage(
+            _r_endpoint(r), r.i64(), _r_rank(r), _r_rank(r), _r_endpoints(r),
+            trace_id=_r_opt_trace(r),
+        )
     elif tag == 8:
-        out = Phase2aMessage(_r_endpoint(r), r.i64(), _r_rank(r), _r_endpoints(r))
+        out = Phase2aMessage(
+            _r_endpoint(r), r.i64(), _r_rank(r), _r_endpoints(r), trace_id=_r_opt_trace(r)
+        )
     elif tag == 9:
-        out = Phase2bMessage(_r_endpoint(r), r.i64(), _r_rank(r), _r_endpoints(r))
+        out = Phase2bMessage(
+            _r_endpoint(r), r.i64(), _r_rank(r), _r_endpoints(r), trace_id=_r_opt_trace(r)
+        )
     elif tag == 10:
         out = LeaveMessage(_r_endpoint(r))
     elif tag == 11:

@@ -43,6 +43,8 @@ from rapid_tpu.ops.rings import (
     predecessor_of_keys,
     ring_topology_from_perm,
 )
+from rapid_tpu.utils import exposition
+from rapid_tpu.utils.metrics import Metrics
 
 
 def cohort_words(c: int) -> int:
@@ -794,6 +796,10 @@ class VirtualCluster:
         self.state = state
         self.faults = FaultInputs.none(cfg)
         self._rng = np.random.default_rng(0)
+        # Engine-level telemetry: host-side counters over device dispatches
+        # (the per-node flight recorder has no device analog — the engine's
+        # observability grain is the dispatch, not the message).
+        self.metrics = Metrics()
 
     # -- construction ---------------------------------------------------
 
@@ -1067,6 +1073,8 @@ class VirtualCluster:
     # -- execution ------------------------------------------------------
 
     def step(self) -> StepEvents:
+        self.metrics.inc("engine_steps")
+        self.metrics.inc("engine_dispatches")
         self.state, events = engine_step(self.cfg, self.state, self.faults)
         return events
 
@@ -1107,6 +1115,7 @@ class VirtualCluster:
         RTT per view change."""
         if max_steps > 255:  # not an assert: python -O must not skip this
             raise ValueError(f"max_steps packs into 8 bits, got {max_steps}")
+        self.metrics.inc("engine_dispatches")
         self.state, steps, decided, winner = run_to_decision(
             self.cfg, self.state, self.faults, jnp.int32(max_steps)
         )
@@ -1144,6 +1153,7 @@ class VirtualCluster:
         if not 0 <= target <= self.cfg.n:
             # Not an assert: python -O must not skip this.
             raise ValueError(f"target must be in [0, {self.cfg.n}]: {target}")
+        self.metrics.inc("engine_dispatches")
         self.state, steps, cuts, resolved, sizes = run_until_membership(
             self.cfg, self.state, self.faults,
             jnp.int32(target), jnp.int32(max_steps), int(max_cuts),
@@ -1185,3 +1195,24 @@ class VirtualCluster:
     @property
     def config_id(self) -> int:
         return (int(self.state.config_hi) << 32) | int(self.state.config_lo)
+
+    # -- observability (utils/exposition.py schema) ---------------------
+
+    def telemetry_snapshot(self) -> dict:
+        """The engine's unified telemetry snapshot — same schema as
+        ``MembershipService.telemetry_snapshot`` minus the per-message
+        instruments (transport stats, flight recorder) that have no device
+        analog, so one scrape pipeline serves host nodes and the engine
+        alike."""
+        return {
+            "node": f"virtual-cluster/{self.cfg.n}",
+            "configuration_id": self.config_id,
+            "membership_size": self.membership_size,
+            "config_epoch": self.config_epoch,
+            "metrics": self.metrics.summary(),
+            "transport": {},
+            "recorder": None,
+        }
+
+    def prometheus_text(self) -> str:
+        return exposition.prometheus_text(self.telemetry_snapshot())

@@ -75,6 +75,24 @@ class Cluster:
         # north-star metric (SURVEY §5.1).
         return self.service.metrics.summary()
 
+    def telemetry_snapshot(self, recorder_tail=None):
+        """The node's unified telemetry (utils/exposition.py schema): the
+        service snapshot plus the server side of the transport accounting,
+        which only this layer holds."""
+        snapshot = self.service.telemetry_snapshot(recorder_tail=recorder_tail)
+        server_stats = getattr(self._server, "stats", None)
+        snapshot["transport"]["server"] = (
+            server_stats.snapshot() if server_stats is not None else None
+        )
+        return snapshot
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition for this node (stable names pinned by
+        tests/test_observability.py) — the string to serve on /metrics."""
+        from rapid_tpu.utils import exposition
+
+        return exposition.prometheus_text(self.telemetry_snapshot(recorder_tail=0))
+
     # -- lifecycle ------------------------------------------------------
 
     async def leave_gracefully(self) -> None:

@@ -14,9 +14,10 @@ with the same per-batch semantics.
 
 from __future__ import annotations
 
-from typing import Dict, List, Set, TYPE_CHECKING
+from typing import Callable, Dict, List, Optional, Set, TYPE_CHECKING
 
 from rapid_tpu.types import AlertMessage, EdgeStatus, Endpoint
+from rapid_tpu.utils.flight_recorder import EventName, FlightRecorder
 
 if TYPE_CHECKING:
     from rapid_tpu.protocol.view import MembershipView
@@ -37,6 +38,22 @@ class MultiNodeCutDetector:
         self._proposal: Set[Endpoint] = set()
         self._pre_proposal: Set[Endpoint] = set()
         self._seen_down_events = False
+        # Observability seam (bind_recorder): the owning service threads its
+        # flight recorder + trace-context supplier in so watermark crossings
+        # land in the same correlated event stream as the alert/consensus
+        # events around them. None (standalone detector) = no recording.
+        self._recorder: Optional[FlightRecorder] = None
+        self._trace: Callable[[], Optional[int]] = lambda: None
+
+    def bind_recorder(
+        self, recorder: FlightRecorder, trace_supplier: Callable[[], Optional[int]]
+    ) -> None:
+        self._recorder = recorder
+        self._trace = trace_supplier
+
+    def _record(self, name: EventName, **fields) -> None:
+        if self._recorder is not None:
+            self._recorder.record(name, trace_id=self._trace(), **fields)
 
     @property
     def num_proposals(self) -> int:
@@ -73,16 +90,26 @@ class MultiNodeCutDetector:
         if num_reports == self.l:
             self._updates_in_progress += 1
             self._pre_proposal.add(link_dst)
+            self._record(
+                EventName.CUT_L_CROSSED, subject=str(link_dst), reports=num_reports
+            )
 
         if num_reports == self.h:
             self._pre_proposal.discard(link_dst)
             self._proposal.add(link_dst)
             self._updates_in_progress -= 1
+            self._record(
+                EventName.CUT_H_CROSSED, subject=str(link_dst), reports=num_reports
+            )
             if self._updates_in_progress == 0:
                 # Every subject past H and none in (L, H): release the cut.
                 self._proposal_count += 1
                 ret = list(self._proposal)
                 self._proposal.clear()
+                self._record(
+                    EventName.CUT_RELEASED,
+                    subjects=[str(node) for node in ret],
+                )
                 return ret
         return []
 

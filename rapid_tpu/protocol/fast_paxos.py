@@ -16,9 +16,10 @@ from __future__ import annotations
 
 import math
 import random
-from typing import Dict, Optional, Sequence, Set, Tuple
+from typing import Callable, Dict, Optional, Sequence, Set, Tuple
 
 from rapid_tpu.protocol.paxos import BroadcastFn, OnDecideFn, Paxos, SendFn
+from rapid_tpu.utils.flight_recorder import EventName, FlightRecorder
 from rapid_tpu.types import (
     ConsensusResponse,
     Endpoint,
@@ -54,6 +55,8 @@ class FastPaxos:
         rng: Optional[random.Random] = None,
         vote_tally=None,
         on_classic_round=None,
+        recorder: Optional[FlightRecorder] = None,
+        trace_supplier: Optional[Callable[[], Optional[int]]] = None,
     ) -> None:
         self.my_addr = my_addr
         self.configuration_id = configuration_id
@@ -82,17 +85,31 @@ class FastPaxos:
         # classic-attempt rotation (models/virtual_cluster.py classic_epoch).
         self._next_classic_round = 2
 
+        # Observability: the service's flight recorder + trace-context
+        # supplier; every outgoing vote and the decision event carry the
+        # membership change's correlation key.
+        self._recorder = recorder
+        self._trace = trace_supplier if trace_supplier is not None else (lambda: None)
+
         def on_decide_wrapped(hosts: Tuple[Endpoint, ...]) -> None:
             if self.decided:
                 return
             self.decided = True
             if self._fallback_task is not None:
                 self._fallback_task.cancel()
+            if self._recorder is not None:
+                self._recorder.record(
+                    EventName.CONSENSUS_DECIDED,
+                    config_id=self.configuration_id,
+                    trace_id=self._trace(),
+                    proposal=[str(node) for node in hosts],
+                )
             on_decide(hosts)
 
         self._on_decide = on_decide_wrapped
         self.paxos = Paxos(
-            my_addr, configuration_id, membership_size, broadcast_fn, send_fn, on_decide_wrapped
+            my_addr, configuration_id, membership_size, broadcast_fn, send_fn,
+            on_decide_wrapped, recorder=recorder, trace_supplier=trace_supplier,
         )
 
     def propose(
@@ -115,6 +132,7 @@ class FastPaxos:
                 sender=self.my_addr,
                 configuration_id=self.configuration_id,
                 endpoints=proposal,
+                trace_id=self._trace(),
             )
         )
         self._arm_liveness(recovery_delay_ms)
@@ -137,6 +155,7 @@ class FastPaxos:
                     sender=self.my_addr,
                     configuration_id=self.configuration_id,
                     endpoints=self._my_proposal,
+                    trace_id=self._trace(),
                 )
             )
         self.start_classic_paxos_round()
@@ -165,6 +184,13 @@ class FastPaxos:
         if self.decided:
             return
         proposal = tuple(msg.endpoints)
+        if self._recorder is not None:
+            self._recorder.record(
+                EventName.FAST_ROUND_VOTE_RX,
+                config_id=self.configuration_id,
+                trace_id=msg.trace_id if msg.trace_id is not None else self._trace(),
+                voter=str(msg.sender),
+            )
         if self._vote_tally is not None:
             winner = self._vote_tally.add_vote(msg.sender, proposal)
             if winner is not None:
@@ -190,6 +216,15 @@ class FastPaxos:
                 # the service gates the once-per-configuration
                 # VIEW_CHANGE_ONE_STEP_FAILED event itself.
                 self._on_classic_round()
+            if self._recorder is not None:
+                # One event per engagement AND per escalation: the round
+                # number distinguishes them in the merged timeline.
+                self._recorder.record(
+                    EventName.CLASSIC_ROUND_START,
+                    config_id=self.configuration_id,
+                    trace_id=self._trace(),
+                    round=self._next_classic_round,
+                )
             self.paxos.start_phase1a(self._next_classic_round)
             self._next_classic_round += 1
 

@@ -13,7 +13,7 @@ reference's IBroadcaster / IMessagingClient seam.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from rapid_tpu.types import (
     Endpoint,
@@ -24,6 +24,7 @@ from rapid_tpu.types import (
     Rank,
     RapidRequest,
 )
+from rapid_tpu.utils.flight_recorder import EventName, FlightRecorder
 from rapid_tpu.utils.xxhash import xxh64
 
 BroadcastFn = Callable[[RapidRequest], None]
@@ -46,6 +47,8 @@ class Paxos:
         broadcast_fn: BroadcastFn,
         send_fn: SendFn,
         on_decide: OnDecideFn,
+        recorder: Optional[FlightRecorder] = None,
+        trace_supplier: Optional[Callable[[], Optional[int]]] = None,
     ) -> None:
         self.my_addr = my_addr
         self.configuration_id = configuration_id
@@ -53,6 +56,11 @@ class Paxos:
         self._broadcast = broadcast_fn
         self._send = send_fn
         self._on_decide = on_decide
+        # Observability: the owning FastPaxos threads the service's flight
+        # recorder and trace-context supplier through, so every classic
+        # message this engine emits carries the view change's trace id.
+        self._recorder = recorder
+        self._trace = trace_supplier if trace_supplier is not None else (lambda: None)
 
         self.rnd = Rank(0, 0)
         self.vrnd = Rank(0, 0)
@@ -84,7 +92,10 @@ class Paxos:
             self.cval = ()
         self._broadcast(
             Phase1aMessage(
-                sender=self.my_addr, configuration_id=self.configuration_id, rank=self.crnd
+                sender=self.my_addr,
+                configuration_id=self.configuration_id,
+                rank=self.crnd,
+                trace_id=self._trace(),
             )
         )
 
@@ -104,6 +115,7 @@ class Paxos:
                 rnd=self.rnd,
                 vrnd=self.vrnd,
                 vval=self.vval,
+                trace_id=msg.trace_id if msg.trace_id is not None else self._trace(),
             ),
         )
 
@@ -124,12 +136,21 @@ class Paxos:
             )
             if msg.rnd == self.crnd and not self.cval and chosen:
                 self.cval = chosen
+                if self._recorder is not None:
+                    self._recorder.record(
+                        EventName.CLASSIC_PHASE2A_TX,
+                        config_id=self.configuration_id,
+                        trace_id=self._trace(),
+                        round=self.crnd.round,
+                        proposal=[str(node) for node in chosen],
+                    )
                 self._broadcast(
                     Phase2aMessage(
                         sender=self.my_addr,
                         configuration_id=self.configuration_id,
                         rnd=self.crnd,
                         vval=chosen,
+                        trace_id=self._trace(),
                     )
                 )
 
@@ -149,6 +170,7 @@ class Paxos:
                     configuration_id=self.configuration_id,
                     rnd=msg.rnd,
                     endpoints=msg.vval,
+                    trace_id=msg.trace_id if msg.trace_id is not None else self._trace(),
                 )
             )
 

@@ -50,7 +50,9 @@ from rapid_tpu.types import (
     RapidResponse,
     Response,
 )
+from rapid_tpu.utils import exposition
 from rapid_tpu.utils.clock import AsyncioClock, Clock
+from rapid_tpu.utils.flight_recorder import EventName, FlightRecorder, mint_trace_id
 from rapid_tpu.utils.metrics import Metrics
 
 LOG = logging.getLogger(__name__)
@@ -63,10 +65,25 @@ CONSENSUS_TYPES = (
     Phase2bMessage,
 )
 
-#: Sentinel configuration id for a member-initiated config pull: guaranteed to
-#: mismatch the receiver's current configuration, routing the request into the
-#: config-stream branch of the join phase-2 handler (the same -1 trick the
-#: joiner uses after HOSTNAME_ALREADY_IN_RING, Cluster.java:374-381).
+#: Member-initiated config pulls ride the join phase-2 handler stamped with
+#: the requester's CURRENT configuration id: an up-to-date peer recognizes a
+#: member asking about the configuration it already inhabits and answers with
+#: a compact "unchanged" response instead of streaming the full O(N)
+#: configuration (the idle heartbeat fires every 30 s on every member — at
+#: production N the difference is the whole cost of the heartbeat). A peer on
+#: a DIFFERENT configuration takes the mismatch branch and streams, exactly
+#: as it does for the joiner's -1 sentinel after HOSTNAME_ALREADY_IN_RING
+#: (Cluster.java:374-381).
+#:
+#: Java-topology clusters keep the -1 sentinel instead: that mode exists so a
+#: reference JVM peer can share the ring (PARITY.md), and the reference's
+#: phase-2 handler has no unchanged fast path — a config-id MATCH there parks
+#: the response future behind a (filtered, never-decided) UP alert until RPC
+#: timeout and pollutes the peer's alert stream every heartbeat. The sentinel
+#: is guaranteed to mismatch, so any implementation answers immediately.
+#: Native-topology clusters cannot contain reference peers (ring orders and
+#: configuration ids diverge at the first hash), so the optimization is safe
+#: exactly where it is enabled.
 CATCH_UP_CONFIG_ID = -1
 
 #: Alert batches are re-broadcast unconditionally this many times (our own
@@ -84,6 +101,15 @@ _MAX_REDELIVERIES = 30
 #: decision we could not apply — stay uncapped: those states MUST resolve and
 #: the traffic stops the moment they do.
 _MAX_REPORT_ONLY_SYNC_PULLS = 30
+
+#: Futile decided-config catch-up pulls before the wedge is escalated to an
+#: ERROR log + flight-recorder event + metrics counter. The pulls themselves
+#: stay uncapped (a decision we could not apply MUST eventually resolve, and
+#: the only path is a pull), but a cluster that crashed between deciding and
+#: answering leaves this node retrying forever — after this many futile
+#: attempts that retry loop becomes an observable incident instead of a
+#: silent one.
+_WEDGED_PULLS_ERROR_THRESHOLD = 100
 
 
 class MembershipService:
@@ -160,12 +186,33 @@ class MembershipService:
         self._kicked_signalled = False
         self._report_only_sync_pulls = 0
         self._undecided_suspicion_ticks = 0
+        self._wedged_pulls = 0
         self._one_step_failed_notified = False
         self._known_config_ids: "OrderedDict[int, bool]" = OrderedDict()
         self._remember_config_id(self.view.configuration_id)
 
+        # Observability: per-node flight recorder (utils/flight_recorder.py)
+        # and the trace-context key for the membership change currently in
+        # flight — minted at the first local alert, adopted from the first
+        # traced inbound message, cleared when the view change commits.
+        self.recorder = FlightRecorder(node=str(my_addr), clock=self.clock)
+        self._trace_id: Optional[int] = None
+        if hasattr(self.cut_detector, "bind_recorder"):
+            self.cut_detector.bind_recorder(self.recorder, lambda: self._trace_id)
+
         self.broadcaster.set_membership(self.view.ring(0))
         self._fast_paxos = self._new_fast_paxos()
+
+        # The recording opens with the configuration this node entered
+        # (bootstrap or join): a merged timeline then shows every node, even
+        # one that crashes before it ever witnesses a membership change.
+        self.recorder.record(
+            EventName.VIEW_CHANGE,
+            config_id=self.view.configuration_id,
+            membership_size=self.view.membership_size,
+            changes=0,
+            origin="startup",
+        )
 
         # Inform the application that the start/join completed
         # (MembershipService.java:162-168).
@@ -219,6 +266,33 @@ class MembershipService:
         self.subscriptions[event].append(callback)
 
     # ------------------------------------------------------------------
+    # observability surface (utils/exposition.py)
+    # ------------------------------------------------------------------
+
+    def telemetry_snapshot(self, recorder_tail: Optional[int] = None) -> Dict[str, object]:
+        """One unified telemetry snapshot: protocol metrics, transport
+        accounting (when the client keeps ``TransportStats``), and the
+        flight recording. ``recorder_tail`` bounds the events included
+        (None = the whole ring). This dict is the artifact the standalone
+        agent's ``--metrics-dump`` writes and ``tools/traceview.py``
+        merges."""
+        stats = getattr(self.client, "stats", None)
+        return {
+            "node": str(self.my_addr),
+            "configuration_id": self.view.configuration_id,
+            "membership_size": self.view.membership_size,
+            "trace_id": self._trace_id,
+            "metrics": self.metrics.summary(),
+            "transport": {"client": stats.snapshot() if stats is not None else None},
+            "recorder": self.recorder.snapshot(tail=recorder_tail),
+        }
+
+    def prometheus_text(self) -> str:
+        """The node's telemetry in Prometheus text exposition format, under
+        the stable metric names pinned by tests/test_observability.py."""
+        return exposition.prometheus_text(self.telemetry_snapshot(recorder_tail=0))
+
+    # ------------------------------------------------------------------
     # message entry point (MembershipService.java:174-196)
     # ------------------------------------------------------------------
 
@@ -242,6 +316,7 @@ class MembershipService:
         if isinstance(request, CONSENSUS_TYPES):
             self._note_config_evidence(request)
             async with self._lock:
+                self._adopt_trace(request.trace_id)
                 return self._fast_paxos.handle_message(request)
         if isinstance(request, LeaveMessage):
             async with self._lock:
@@ -254,6 +329,14 @@ class MembershipService:
     # ------------------------------------------------------------------
     # join protocol, server side
     # ------------------------------------------------------------------
+
+    def _adopt_trace(self, trace_id: Optional[int]) -> None:
+        """Dapper-style context propagation, receive side: the first traced
+        message about the in-flight membership change donates its trace id,
+        so every node's recording of that change shares one correlation key
+        even when the local node never saw the originating alert."""
+        if self._trace_id is None and trace_id is not None:
+            self._trace_id = trace_id
 
     def _handle_pre_join(self, msg: PreJoinMessage) -> JoinResponse:
         """Phase 1 at the seed (MembershipService.java:203-224)."""
@@ -278,6 +361,20 @@ class MembershipService:
         either an immediate JoinResponse or a future resolved after consensus."""
         current_config = self.view.configuration_id
         if current_config == msg.configuration_id:
+            if self.view.is_host_present(msg.sender) and self.view.is_identifier_present(
+                msg.node_id
+            ):
+                # Not a joiner: an existing member's config-sync pull stamped
+                # with the configuration we both inhabit (configuration ids
+                # are content hashes — equal id means identical view).
+                # Answer compactly instead of enqueueing a to-be-filtered UP
+                # alert or streaming the full O(N) configuration.
+                self.metrics.inc("config_pull_unchanged_served")
+                return JoinResponse(
+                    sender=self.my_addr,
+                    status_code=JoinStatusCode.SAFE_TO_JOIN,
+                    configuration_id=current_config,
+                )
             future: asyncio.Future = asyncio.get_event_loop().create_future()
             self._joiners_to_respond_to.setdefault(msg.sender, []).append(future)
             alert = AlertMessage(
@@ -335,6 +432,14 @@ class MembershipService:
     def _handle_batched_alerts(self, batch: BatchedAlertMessage) -> Response:
         self.metrics.inc("alerts_received", len(batch.messages))
         config_id = self.view.configuration_id
+        self._adopt_trace(batch.trace_id)
+        self.recorder.record(
+            EventName.ALERT_BATCH_RX,
+            config_id=config_id,
+            trace_id=batch.trace_id if batch.trace_id is not None else self._trace_id,
+            sender=str(batch.sender),
+            alerts=len(batch.messages),
+        )
         valid = [
             self._extract_joiner_details(msg)
             for msg in batch.messages
@@ -351,6 +456,12 @@ class MembershipService:
         if proposal:
             LOG.info("%s proposing membership change of size %d", self.my_addr, len(proposal))
             self.metrics.inc("proposals_announced")
+            self.recorder.record(
+                EventName.FAST_ROUND_PROPOSAL,
+                config_id=config_id,
+                trace_id=self._trace_id,
+                proposal=[str(node) for node in proposal],
+            )
             self._announced_proposal = True
             if not self._convergence_timing:
                 self._convergence_timing = True
@@ -449,6 +560,15 @@ class MembershipService:
                 self.metrics.elapsed_since_ms("view_change_convergence", self.clock.now_ms()),
             )
             self._convergence_timing = False
+        # Recorded with the OLD configuration's trace id (the correlation key
+        # of the change that produced this view) before the reset clears it.
+        self.recorder.record(
+            EventName.VIEW_CHANGE,
+            config_id=change.configuration_id,
+            trace_id=self._trace_id,
+            membership_size=len(change.membership),
+            changes=len(change.status_changes),
+        )
         self._notify(ClusterEvents.VIEW_CHANGE, change)
         self._reset_for_new_configuration()
 
@@ -458,6 +578,9 @@ class MembershipService:
             LOG.info("%s was kicked out", self.my_addr)
             self._kicked_signalled = True
             self.metrics.inc("kicked")
+            self.recorder.record(
+                EventName.KICKED, config_id=change.configuration_id
+            )
             self._notify(ClusterEvents.KICKED, change)
 
         self._respond_to_joiners(respond_to)
@@ -478,8 +601,12 @@ class MembershipService:
         self._joiner_metadata.clear()
         self._report_only_sync_pulls = 0
         self._undecided_suspicion_ticks = 0
+        self._wedged_pulls = 0
         self._one_step_failed_notified = False
         self._decision_pending_catch_up = False
+        # Trace context is per membership change: the next change mints or
+        # adopts a fresh correlation key.
+        self._trace_id = None
         self._remember_config_id(self.view.configuration_id)
         self._fast_paxos.cancel_fallback()
         self._fast_paxos = self._new_fast_paxos()
@@ -498,7 +625,27 @@ class MembershipService:
             self._known_config_ids[config_id] = inhabited
         self._known_config_ids.move_to_end(config_id)
         while len(self._known_config_ids) > 64:
-            self._known_config_ids.popitem(last=False)
+            # Prefer evicting futile-learned (inhabited=False) entries:
+            # straggler ids are unbounded in principle (any peer can stamp
+            # any stale id), and letting them push out genuinely-inhabited
+            # history would make OUR OWN old configurations look unknown
+            # again — re-triggering spurious evidence pulls for traffic we
+            # have already verified as behind us. Inhabited history is
+            # bounded by real view changes, so it only rotates against
+            # itself. The just-remembered id is exempt (evicting what we
+            # came to learn would be a no-op cache).
+            victim = next(
+                (
+                    cid
+                    for cid, inh in self._known_config_ids.items()
+                    if not inh and cid != config_id
+                ),
+                None,
+            )
+            if victim is not None:
+                del self._known_config_ids[victim]
+            else:
+                self._known_config_ids.popitem(last=False)
 
     def _recover_from_unknown_joiners(self, missing: List[Endpoint]) -> None:
         """The cluster decided a view containing joiners whose identifiers we
@@ -559,6 +706,8 @@ class MembershipService:
             rng=self.rng,
             vote_tally=vote_tally,
             on_classic_round=self._on_fast_round_failed,
+            recorder=self.recorder,
+            trace_supplier=lambda: self._trace_id,
         )
 
     def _on_fast_round_failed(self) -> None:
@@ -666,6 +815,19 @@ class MembershipService:
         self._last_enqueue_ms = now
         self._send_queue.append(msg)
         self.metrics.inc("alerts_enqueued")
+        if self._trace_id is None:
+            # First local evidence of this membership change: mint the
+            # trace id every node's recording of it will share.
+            self._trace_id = mint_trace_id(
+                str(self.my_addr), msg.configuration_id, now
+            )
+        self.recorder.record(
+            EventName.ALERT_ENQUEUED,
+            config_id=msg.configuration_id,
+            trace_id=self._trace_id,
+            subject=str(msg.edge_dst),
+            status=msg.edge_status.name,
+        )
         # North-star timer: first local evidence of a membership change until
         # the view change commits. A mark left by evidence that never led to
         # a proposal (e.g. one spurious FD firing, tally below L) would
@@ -698,8 +860,18 @@ class MembershipService:
                 messages, self._send_queue = self._send_queue, []
                 self.metrics.inc("alert_batches_sent")
                 self._alerts_sent.extend(messages)
+                self.recorder.record(
+                    EventName.ALERT_BATCH_TX,
+                    config_id=self.view.configuration_id,
+                    trace_id=self._trace_id,
+                    alerts=len(messages),
+                )
                 self.broadcaster.broadcast(
-                    BatchedAlertMessage(sender=self.my_addr, messages=tuple(messages))
+                    BatchedAlertMessage(
+                        sender=self.my_addr,
+                        messages=tuple(messages),
+                        trace_id=self._trace_id,
+                    )
                 )
 
     # ------------------------------------------------------------------
@@ -755,8 +927,19 @@ class MembershipService:
                         continue
                     self._redeliveries_this_config += 1
                     self.metrics.inc("alert_batches_redelivered")
+                    self.recorder.record(
+                        EventName.ALERT_REDELIVERY,
+                        config_id=config_id,
+                        trace_id=self._trace_id,
+                        alerts=len(pending),
+                        redelivery=self._redeliveries_this_config,
+                    )
                     self.broadcaster.broadcast(
-                        BatchedAlertMessage(sender=self.my_addr, messages=pending)
+                        BatchedAlertMessage(
+                            sender=self.my_addr,
+                            messages=pending,
+                            trace_id=self._trace_id,
+                        )
                     )
             except asyncio.CancelledError:
                 raise
@@ -807,12 +990,41 @@ class MembershipService:
                         and not self._catch_up_inflight
                         and (strong or report_only or idle_due)
                     )
-                    if suspicious and not strong and not idle_due:
-                        # Budget counts pulls actually issued, not skipped ticks.
-                        self._report_only_sync_pulls += 1
                     if suspicious:
                         self._last_idle_sync_ms = now
                     peer = self._random_peer() if suspicious else None
+                    if peer is not None and not strong and not idle_due:
+                        # Budget counts pulls actually ISSUED: a single-member
+                        # view has no peer to pull from, and charging its
+                        # no-op ticks would exhaust the report-only budget
+                        # before a partner ever appears (advisor round 5).
+                        self._report_only_sync_pulls += 1
+                    if peer is not None and self._decision_pending_catch_up:
+                        # A decision we could not apply keeps pulling
+                        # uncapped — but if the peers that decided it all
+                        # crashed before answering, this node is wedged on a
+                        # configuration nobody can serve. Escalate once so
+                        # the wedge is an observable incident, not an
+                        # indefinite silent retry loop.
+                        self._wedged_pulls += 1
+                        if self._wedged_pulls == _WEDGED_PULLS_ERROR_THRESHOLD:
+                            self.metrics.inc("catch_up_wedged")
+                            self.recorder.record(
+                                EventName.UNKNOWN_JOINER_WEDGE,
+                                config_id=self.view.configuration_id,
+                                trace_id=self._trace_id,
+                                futile_pulls=self._wedged_pulls,
+                            )
+                            LOG.error(
+                                "%s wedged: %d futile pulls for a decided "
+                                "configuration we could not apply (config %d); "
+                                "the deciding peers are likely gone — still "
+                                "retrying, operator intervention (restart/"
+                                "rejoin) may be required",
+                                self.my_addr,
+                                self._wedged_pulls,
+                                self.view.configuration_id,
+                            )
                 if peer is not None:
                     await self._catch_up(peer)
             except asyncio.CancelledError:
@@ -865,6 +1077,12 @@ class MembershipService:
             if now - self._last_beacon_ms >= self.settings.config_sync_interval_ms:
                 self._last_beacon_ms = now
                 self.metrics.inc("config_beacons_sent")
+                self.recorder.record(
+                    EventName.CONFIG_BEACON_TX,
+                    config_id=self.view.configuration_id,
+                    trace_id=self._trace_id,
+                    peer=str(sender),
+                )
                 self.client.send_nowait(
                     sender,
                     BatchedAlertMessage(
@@ -901,9 +1119,10 @@ class MembershipService:
 
     async def _catch_up(self, peer: Endpoint, trigger_ids: frozenset = frozenset()) -> None:
         """Pull ``peer``'s current configuration via the join phase-2
-        config-stream branch (JoinMessage with the -1 config sentinel,
-        authenticated by our endpoint + identifier) and adopt it if it is
-        ahead of ours. ``trigger_ids`` are the unknown config ids whose
+        handler (a JoinMessage authenticated by our endpoint + identifier,
+        stamped with our current config id — or the -1 sentinel on
+        java-topology clusters, see CATCH_UP_CONFIG_ID) and adopt it if it
+        is ahead of ours. ``trigger_ids`` are the unknown config ids whose
         traffic triggered this pull: on a futile outcome they are remembered
         as not-ahead (any id the sender stamped lies on its chain at or
         behind the not-ahead config it just answered with), so the same
@@ -912,11 +1131,32 @@ class MembershipService:
             return
         self._catch_up_inflight = True
         try:
+            # Stamped with OUR current configuration id (not the joiner's -1
+            # sentinel): a peer inhabiting the same configuration answers
+            # with a compact "unchanged" response instead of streaming the
+            # full O(N) configuration — which turns the 30 s idle heartbeat
+            # into a true no-op when nothing changed. A peer on any other
+            # configuration takes the mismatch branch and streams, exactly
+            # as before. Java-topology clusters (which may contain reference
+            # JVM peers without the unchanged branch) keep the sentinel —
+            # see CATCH_UP_CONFIG_ID.
+            self.recorder.record(
+                EventName.CATCH_UP_PULL,
+                config_id=self.view.configuration_id,
+                trace_id=self._trace_id,
+                peer=str(peer),
+                triggers=len(trigger_ids),
+            )
+            pull_config_id = (
+                CATCH_UP_CONFIG_ID
+                if self.settings.topology == "java"
+                else self.view.configuration_id
+            )
             request = JoinMessage(
                 sender=self.my_addr,
                 node_id=self.node_id,
                 ring_numbers=(),
-                configuration_id=CATCH_UP_CONFIG_ID,
+                configuration_id=pull_config_id,
                 metadata=(),
             )
             try:
@@ -968,6 +1208,11 @@ class MembershipService:
                 self._kicked_signalled = True
                 self._fast_paxos.cancel_fallback()
                 self.metrics.inc("kicked")
+                self.recorder.record(
+                    EventName.KICKED,
+                    config_id=self.view.configuration_id,
+                    peer=str(peer),
+                )
                 self._cancel_failure_detectors()
                 self._notify(
                     ClusterEvents.KICKED,
@@ -985,8 +1230,34 @@ class MembershipService:
                 self._remember_config_id(response.configuration_id, inhabited=False)
                 for cid in trigger_ids:
                     self._remember_config_id(cid, inhabited=False)
+                self.recorder.record(
+                    EventName.CATCH_UP_RESULT,
+                    config_id=self.view.configuration_id,
+                    trace_id=self._trace_id,
+                    peer=str(peer),
+                    outcome="futile_config_changed",
+                )
             return
         if response.status_code != JoinStatusCode.SAFE_TO_JOIN or not response.endpoints:
+            if (
+                response.status_code == JoinStatusCode.SAFE_TO_JOIN
+                and response.configuration_id == self.view.configuration_id
+            ):
+                # Compact "unchanged" answer: the peer inhabits the same
+                # configuration we do. The trigger ids (if any) are thereby
+                # verified not-ahead — remember them so the same straggler
+                # traffic cannot re-trigger pulls, exactly as a futile full
+                # stream used to.
+                self.metrics.inc("config_sync_unchanged")
+                for cid in trigger_ids:
+                    self._remember_config_id(cid, inhabited=False)
+                self.recorder.record(
+                    EventName.CATCH_UP_RESULT,
+                    config_id=self.view.configuration_id,
+                    trace_id=self._trace_id,
+                    peer=str(peer),
+                    outcome="unchanged",
+                )
             return
         theirs_ids = frozenset(response.identifiers)
         mine_ids = self.view.identifiers_seen()
@@ -1005,8 +1276,23 @@ class MembershipService:
             self._remember_config_id(response.configuration_id, inhabited=False)
             for cid in trigger_ids:
                 self._remember_config_id(cid, inhabited=False)
+            self.recorder.record(
+                EventName.CATCH_UP_RESULT,
+                config_id=self.view.configuration_id,
+                trace_id=self._trace_id,
+                peer=str(peer),
+                outcome="futile_not_newer",
+            )
             return
         self.metrics.inc("config_catch_ups")
+        self.recorder.record(
+            EventName.CATCH_UP_RESULT,
+            config_id=self.view.configuration_id,
+            trace_id=self._trace_id,
+            peer=str(peer),
+            outcome="installed",
+            new_config_id=response.configuration_id,
+        )
         self._install_fetched_configuration(response)
 
     def _install_fetched_configuration(self, response: JoinResponse) -> None:

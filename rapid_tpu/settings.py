@@ -54,8 +54,12 @@ class Settings:
     # evidence of it AND receives no traffic at all afterwards (e.g. its
     # ingress was partitioned through the decision and the cluster went
     # quiescent) — suspicion-based sync and evidence pulls both need some
-    # signal; this needs none. Deliberately slow: one small request/response
-    # per member per interval, a no-op whenever nothing changed.
+    # signal; this needs none. Deliberately slow, and cheap when current:
+    # the pull carries the requester's configuration id, so an up-to-date
+    # peer answers with a compact "unchanged" response instead of streaming
+    # the full O(N) configuration (protocol/service.py::_catch_up; native
+    # topology only — java-topology clusters keep the joiner's -1 sentinel
+    # because a reference JVM peer has no unchanged fast path).
     config_sync_idle_interval_ms: int = 30_000
 
     # Topology mode: "native" (tpu-first default: 8-byte port hashing,

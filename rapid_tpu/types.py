@@ -13,8 +13,17 @@ from __future__ import annotations
 
 import enum
 import uuid as _uuid
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional, Tuple, Union
+
+# Trace-context note: ``BatchedAlertMessage`` and the five consensus messages
+# carry an optional ``trace_id`` — the correlation key minted at the first
+# alert of a membership change (protocol/service.py) and propagated on the
+# wire (messaging/codec.py appends it as an optional trailing field, so
+# frames without it are byte-identical to the pre-trace layout). The field is
+# declared ``compare=False``: equality/hash stay keyed on protocol content
+# exactly as the reference keys vote tallies, so two identical votes with
+# different trace stamps still dedup as one vote.
 
 
 @dataclass(frozen=True, order=True)
@@ -117,6 +126,7 @@ class BatchedAlertMessage:
 
     sender: Endpoint
     messages: Tuple[AlertMessage, ...]
+    trace_id: Optional[int] = field(default=None, compare=False)
 
 
 @dataclass(frozen=True)
@@ -144,6 +154,7 @@ class FastRoundPhase2bMessage:
     sender: Endpoint
     configuration_id: int
     endpoints: Tuple[Endpoint, ...]
+    trace_id: Optional[int] = field(default=None, compare=False)
 
 
 @dataclass(frozen=True)
@@ -151,6 +162,7 @@ class Phase1aMessage:
     sender: Endpoint
     configuration_id: int
     rank: Rank
+    trace_id: Optional[int] = field(default=None, compare=False)
 
 
 @dataclass(frozen=True)
@@ -160,6 +172,7 @@ class Phase1bMessage:
     rnd: Rank
     vrnd: Rank
     vval: Tuple[Endpoint, ...]
+    trace_id: Optional[int] = field(default=None, compare=False)
 
 
 @dataclass(frozen=True)
@@ -168,6 +181,7 @@ class Phase2aMessage:
     configuration_id: int
     rnd: Rank
     vval: Tuple[Endpoint, ...]
+    trace_id: Optional[int] = field(default=None, compare=False)
 
 
 @dataclass(frozen=True)
@@ -176,6 +190,7 @@ class Phase2bMessage:
     configuration_id: int
     rnd: Rank
     endpoints: Tuple[Endpoint, ...]
+    trace_id: Optional[int] = field(default=None, compare=False)
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,175 @@
+"""Metrics exposition: one unified telemetry snapshot per node, rendered as
+Prometheus text or JSON.
+
+The reference has no runtime telemetry surface at all (SURVEY §5.1/5.5); the
+paper's Table 2 network numbers came from external OS tooling. This module
+unifies the three in-tree instruments — the ``Metrics`` registry
+(utils/metrics.py), per-transport ``TransportStats`` (messaging/stats.py),
+and the flight recorder (utils/flight_recorder.py) — into a single snapshot
+dict with a stable shape, and renders it in the Prometheus text exposition
+format under stable metric names (pinned by tests/test_observability.py).
+
+Snapshot shape (``MembershipService.telemetry_snapshot`` /
+``Cluster.telemetry_snapshot`` produce it; ``tools/traceview.py`` and the
+standalone agent's ``--metrics-dump`` consume it)::
+
+    {
+      "node": "host:port",
+      "configuration_id": int,
+      "membership_size": int,
+      "metrics": {<counter>: int, ..., "<timer>_ms": {count,last,p50,max}},
+      "transport": {"client": TransportStats.snapshot()|None, "server": ...},
+      "recorder": FlightRecorder.snapshot(),
+    }
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+_PREFIX = "rapid"
+
+#: Counters every membership-service scrape exposes even before the first
+#: increment. Prometheus series that appear only once an event has happened
+#: break rate()/absent() alerting; zero-filling the known vocabulary keeps
+#: the series set stable from the first scrape. (``Metrics`` counters are a
+#: defaultdict — there is no registry to enumerate, so the vocabulary lives
+#: here and the golden test pins it.)
+KNOWN_COUNTERS = (
+    "alerts_enqueued",
+    "alerts_received",
+    "alert_batches_sent",
+    "alert_batches_redelivered",
+    "proposals_announced",
+    "classic_rounds_started",
+    "view_changes",
+    "kicked",
+    "config_beacons_sent",
+    "config_catch_ups",
+    "config_sync_unchanged",
+    "config_pull_unchanged_served",
+    "catch_up_wedged",
+    "decision_missing_joiner_uuid",
+)
+
+_TRANSPORT_COUNTERS = ("msgs_tx", "bytes_tx", "msgs_rx", "bytes_rx")
+_TRANSPORT_GAUGES = ("kbps_tx", "kbps_rx")
+
+
+def _esc(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _labels(**labels: str) -> str:
+    inner = ",".join(f'{k}="{_esc(v)}"' for k, v in labels.items() if v is not None)
+    return "{" + inner + "}" if inner else ""
+
+
+def _num(value: Any) -> str:
+    # Prometheus floats; integers render without a trailing .0 for
+    # readability (both parse identically).
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, int):
+        return str(value)
+    return repr(float(value))
+
+
+class _Renderer:
+    def __init__(self) -> None:
+        self._lines: List[str] = []
+        self._typed: set = set()
+
+    def sample(
+        self, name: str, kind: str, value: Any, **labels: str
+    ) -> None:
+        if name not in self._typed:
+            self._typed.add(name)
+            self._lines.append(f"# TYPE {name} {kind}")
+        self._lines.append(f"{name}{_labels(**labels)} {_num(value)}")
+
+    def text(self) -> str:
+        return "\n".join(self._lines) + "\n"
+
+
+def prometheus_text(snapshot: Dict[str, Any]) -> str:
+    """Render one unified telemetry snapshot as Prometheus text exposition.
+
+    Metric names are a stable API (tests/test_observability.py pins them):
+    - ``rapid_membership_size`` / ``rapid_configuration_id`` gauges;
+    - every ``Metrics`` counter as ``rapid_<name>_total`` (the
+      KNOWN_COUNTERS vocabulary is zero-filled);
+    - every ``Metrics`` timer as ``rapid_<name>_ms{stat=...}``;
+    - transport counters as ``rapid_transport_<dir>_total{side=...}``;
+    - flight-recorder depth/capacity/total/dropped gauges.
+    """
+    node = snapshot.get("node")
+    out = _Renderer()
+    if "membership_size" in snapshot:
+        out.sample(f"{_PREFIX}_membership_size", "gauge",
+                   snapshot["membership_size"], node=node)
+    if "configuration_id" in snapshot:
+        out.sample(f"{_PREFIX}_configuration_id", "gauge",
+                   snapshot["configuration_id"], node=node)
+
+    metrics: Dict[str, Any] = dict(snapshot.get("metrics", {}))
+    counters = {name: 0 for name in KNOWN_COUNTERS}
+    timers: Dict[str, Dict[str, Any]] = {}
+    for name, value in metrics.items():
+        if isinstance(value, dict):
+            timers[name] = value
+        else:
+            counters[name] = value
+    for name in sorted(counters):
+        out.sample(f"{_PREFIX}_{name}_total", "counter", counters[name], node=node)
+    for name in sorted(timers):
+        for stat, value in sorted(timers[name].items()):
+            out.sample(f"{_PREFIX}_{name}", "summary", value, node=node, stat=stat)
+
+    transport = snapshot.get("transport") or {}
+    for side in sorted(transport):
+        stats = transport[side]
+        if not stats:
+            continue
+        for key in _TRANSPORT_COUNTERS:
+            if key in stats:
+                out.sample(f"{_PREFIX}_transport_{key}_total", "counter",
+                           stats[key], node=node, side=side)
+        for key in _TRANSPORT_GAUGES:
+            if key in stats:
+                out.sample(f"{_PREFIX}_transport_{key}", "gauge",
+                           stats[key], node=node, side=side)
+
+    recorder = snapshot.get("recorder")
+    if recorder:
+        # Derived from the ring counters, not len(events): a snapshot taken
+        # with a truncated tail still reports the true ring depth.
+        depth = recorder.get("recorded_total", 0) - recorder.get("dropped", 0)
+        out.sample(f"{_PREFIX}_flight_recorder_depth", "gauge", depth, node=node)
+        out.sample(f"{_PREFIX}_flight_recorder_capacity", "gauge",
+                   recorder.get("capacity", 0), node=node)
+        out.sample(f"{_PREFIX}_flight_recorder_recorded_total", "counter",
+                   recorder.get("recorded_total", 0), node=node)
+        out.sample(f"{_PREFIX}_flight_recorder_dropped_total", "counter",
+                   recorder.get("dropped", 0), node=node)
+    return out.text()
+
+
+def metric_names(text: str) -> List[str]:
+    """The sorted set of metric names in a Prometheus text exposition —
+    what the golden-name test pins."""
+    names = set()
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name = line.split("{", 1)[0].split(" ", 1)[0]
+        if name:
+            names.add(name)
+    return sorted(names)
+
+
+def snapshot_json(snapshot: Dict[str, Any], indent: Optional[int] = None) -> str:
+    """The JSON twin of the Prometheus rendering — the artifact
+    ``--metrics-dump`` writes and ``tools/traceview.py`` merges."""
+    return json.dumps(snapshot, indent=indent, sort_keys=False)

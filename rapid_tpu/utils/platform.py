@@ -13,9 +13,11 @@ One helper, one behavior — used by ``tests/conftest.py``, ``bench.py``, and
 
 from __future__ import annotations
 
+import logging
 import os
 import re
-import sys
+
+LOG = logging.getLogger(__name__)
 
 _COUNT_FLAG = "xla_force_host_platform_device_count"
 
@@ -26,7 +28,7 @@ def force_platform(platform: str, n_host_devices: int | None = None) -> bool:
     ``n_host_devices`` (CPU only) requests that many virtual host devices via
     ``XLA_FLAGS``; the flag is read lazily at first backend initialization, so
     setting it post-import still works. Returns True when the config update
-    succeeded; on failure (a backend is already live) a warning is printed and
+    succeeded; on failure (a backend is already live) a warning is logged and
     the caller should verify ``jax.devices()[0].platform`` before trusting the
     process.
     """
@@ -46,8 +48,5 @@ def force_platform(platform: str, n_host_devices: int | None = None) -> bool:
         jax.config.update("jax_platforms", platform)
         return True
     except Exception as exc:  # pragma: no cover - only with a live backend
-        print(
-            f"rapid_tpu: could not force jax platform {platform!r}: {exc}",
-            file=sys.stderr,
-        )
+        LOG.warning("could not force jax platform %r: %s", platform, exc)
         return False

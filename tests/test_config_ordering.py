@@ -18,6 +18,9 @@ applying only "newer" configurations can therefore never be rolled back by
 a stale peer, no matter which snapshots it is offered in which order.
 """
 
+import pytest
+
+pytest.importorskip("hypothesis")  # property tests need it; the rest of the suite doesn't
 from hypothesis import given, settings, strategies as st
 
 from rapid_tpu.protocol.view import MembershipView

@@ -16,6 +16,9 @@ compiled executable; only data varies.
 """
 
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")  # property tests need it; the rest of the suite doesn't
 from hypothesis import given, settings, strategies as st
 
 from rapid_tpu.models.virtual_cluster import VirtualCluster

@@ -2,6 +2,9 @@
 message contents, watermark-kernel equivalence, and XXH64 native parity."""
 
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")  # property tests need it; the rest of the suite doesn't
 from hypothesis import given, settings, strategies as st
 
 import jax.numpy as jnp

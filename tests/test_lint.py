@@ -6,11 +6,17 @@ no ruff/mypy, so the equivalent gate is enforced here with stdlib ``ast``
 checks over the whole source tree, run as part of the ordinary test session:
 a violation fails the build the same way checkstyle fails the reference's.
 
-Checks: unused module imports, bare ``except:`` clauses, and mutable default
-arguments. The resolution tier — undefined names, call-signature
-conformance — lives in tools/staticcheck.py, gated by
-tests/test_staticcheck.py (the error-prone analog; this file is the
-checkstyle analog).
+Checks: unused module imports, bare ``except:`` clauses, mutable default
+arguments, and two observability-discipline rules over ``rapid_tpu/`` only:
+no bare ``print()`` for runtime diagnostics (the library speaks through
+``logging``, ``Metrics``, and the flight recorder — exposition that a
+production deployment can route; stdout it cannot), and every
+flight-recorder ``record()`` call site names its event via the registered
+``EventName`` enum (free-form strings would silently fork the event
+vocabulary and break traceview's causal phase ordering). The resolution
+tier — undefined names, call-signature conformance — lives in
+tools/staticcheck.py, gated by tests/test_staticcheck.py (the error-prone
+analog; this file is the checkstyle analog).
 """
 
 from __future__ import annotations
@@ -69,6 +75,64 @@ def test_no_bare_except():
         for node in ast.walk(_parse(path)):
             if isinstance(node, ast.ExceptHandler) and node.type is None:
                 offenders.append(f"{path.relative_to(REPO)}:{node.lineno}: bare except")
+    assert not offenders, "\n".join(offenders)
+
+
+def test_library_has_no_bare_print():
+    """rapid_tpu/ must not print() runtime diagnostics: the structured
+    channels (logging, Metrics, FlightRecorder, the exposition snapshot) are
+    scrapeable and mergeable; stdout is neither. Examples/tools/tests are
+    exempt — a CLI's job is to print."""
+    offenders = []
+    for path in _py_files(("rapid_tpu",)):
+        for node in ast.walk(_parse(path)):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                offenders.append(
+                    f"{path.relative_to(REPO)}:{node.lineno}: bare print() — "
+                    "use logging / Metrics / FlightRecorder"
+                )
+    assert not offenders, "\n".join(offenders)
+
+
+def test_recorder_events_come_from_registered_enum():
+    """Every flight-recorder record() call site in rapid_tpu/ must name its
+    event as ``EventName.<member>`` — the registered vocabulary traceview's
+    causal phase ranking is defined over. (Matched: any ``*.record(...)`` or
+    ``self._record(...)`` call; ``Metrics.record_ms`` has a different
+    attribute name and is not caught.)"""
+    from rapid_tpu.utils.flight_recorder import EventName
+
+    offenders = []
+    for path in _py_files(("rapid_tpu",)):
+        for node in ast.walk(_parse(path)):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("record", "_record")
+            ):
+                continue
+            args = list(node.args)
+            name_arg = args[0] if args else next(
+                (kw.value for kw in node.keywords if kw.arg == "name"), None
+            )
+            ok = (
+                isinstance(name_arg, ast.Attribute)
+                and isinstance(name_arg.value, ast.Name)
+                and name_arg.value.id == "EventName"
+                and name_arg.attr in EventName.__members__
+            )
+            # A record() call forwarding an already-checked EventName
+            # parameter (the cut detector's _record helper body) is fine.
+            forwards = isinstance(name_arg, ast.Name) and name_arg.id == "name"
+            if not (ok or forwards):
+                offenders.append(
+                    f"{path.relative_to(REPO)}:{node.lineno}: record() event "
+                    "must be an EventName member"
+                )
     assert not offenders, "\n".join(offenders)
 
 

@@ -13,7 +13,14 @@ O(fanout) where unicast-to-all pays O(N) at the sender.
 import asyncio
 import random
 
-from tests.test_cluster import async_test, ep, fast_settings, shutdown_all
+from tests.test_cluster import (
+    all_converged,
+    async_test,
+    ep,
+    fast_settings,
+    shutdown_all,
+    start_cluster,
+)
 
 from rapid_tpu.messaging.inprocess import InProcessNetwork
 from rapid_tpu.messaging.tcp import TcpClient, TcpServer
@@ -62,6 +69,38 @@ async def test_tcp_transport_counts_real_wire_bytes():
     finally:
         await client.shutdown()
         await server.shutdown()
+
+
+@async_test
+async def test_unified_snapshot_exposes_transport_byte_counters():
+    """The exposition layer (utils/exposition.py) must surface this module's
+    accounting: a node's unified telemetry snapshot carries both transport
+    sides' byte/message counters, and the Prometheus rendering exposes them
+    under the stable rapid_transport_* names."""
+    from rapid_tpu.utils import exposition
+
+    network = InProcessNetwork(count_wire_bytes=True)
+    clusters = await start_cluster(3, network)
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 3))
+        for c in clusters:
+            snap = c.telemetry_snapshot()
+            client, server = snap["transport"]["client"], snap["transport"]["server"]
+            # Three nodes converged: every node sent traffic, and
+            # wire-equivalent byte accounting is on. (A node's SERVER can be
+            # legitimately silent — with static FDs nothing probes the last
+            # joiner — so the rx law is asserted on the seed, which every
+            # join traversed.)
+            assert client["msgs_tx"] > 0 and client["bytes_tx"] > 0
+            assert server["msgs_rx"] >= 0 and server["bytes_rx"] >= 0
+            text = c.prometheus_text()
+            names = exposition.metric_names(text)
+            for key in ("msgs_tx", "bytes_tx", "msgs_rx", "bytes_rx"):
+                assert f"rapid_transport_{key}_total" in names
+        seed_server = clusters[0].telemetry_snapshot()["transport"]["server"]
+        assert seed_server["msgs_rx"] > 0 and seed_server["bytes_rx"] > 0
+    finally:
+        await shutdown_all(clusters)
 
 
 @async_test

@@ -1,0 +1,475 @@
+"""Observability subsystem tests: flight recorder, trace-context propagation,
+Prometheus/JSON exposition, and the traceview timeline merger.
+
+The acceptance surface of the observability layer is pinned here: the
+recorder's ring semantics under simulated time, the trace id's optional
+wire encoding (byte-identical frames when absent — the golden proto fixtures
+in tests/test_wire_fixtures.py stay valid), the stable Prometheus metric
+names (a golden list: renaming a metric is an API break for every scrape
+config), and the end-to-end claim — a 3-node in-process cluster's
+crash-and-converge run merges into one causally-ordered timeline
+(alert → proposal → decision → delivery on every surviving node) that
+renders as valid Chrome trace-event JSON.
+"""
+
+import dataclasses
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import traceview  # noqa: E402  — tools/traceview.py, the timeline merger
+
+from rapid_tpu.messaging.codec import decode_request, encode_request  # noqa: E402
+from rapid_tpu.messaging.inprocess import InProcessNetwork  # noqa: E402
+from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory  # noqa: E402
+from rapid_tpu.types import (  # noqa: E402
+    AlertMessage,
+    BatchedAlertMessage,
+    EdgeStatus,
+    Endpoint,
+    FastRoundPhase2bMessage,
+    Phase1aMessage,
+    Phase1bMessage,
+    Phase2aMessage,
+    Phase2bMessage,
+    Rank,
+)
+from rapid_tpu.utils import exposition  # noqa: E402
+from rapid_tpu.utils.clock import ManualClock  # noqa: E402
+from rapid_tpu.utils.flight_recorder import (  # noqa: E402
+    EventName,
+    FlightRecorder,
+    mint_trace_id,
+)
+
+from tests.test_cluster import (  # noqa: E402
+    all_converged,
+    async_test,
+    ep,
+    start_cluster,
+    shutdown_all,
+)
+from tests.test_wire_fixtures import canonical_requests  # noqa: E402
+from tests.helpers import wait_until  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ring semantics under simulated time
+# ---------------------------------------------------------------------------
+
+
+def test_ring_buffer_wraparound():
+    clock = ManualClock()
+    rec = FlightRecorder(node="n1", clock=clock, capacity=4)
+    for i in range(10):
+        rec.record(EventName.ALERT_ENQUEUED, config_id=i)
+    assert len(rec) == 4
+    assert rec.recorded_total == 10
+    assert rec.dropped == 6
+    # Oldest-first, and only the last `capacity` events survive.
+    assert [e.seq for e in rec.events()] == [6, 7, 8, 9]
+    assert [e.fields for e in rec.tail(2)] == [{}, {}]
+    assert [e.config_id for e in rec.tail(2)] == [8, 9]
+
+
+def test_ring_buffer_below_capacity():
+    rec = FlightRecorder(node="n1", clock=ManualClock(), capacity=8)
+    rec.record(EventName.VIEW_CHANGE, config_id=1)
+    rec.record(EventName.KICKED, config_id=1)
+    assert len(rec) == 2 and rec.dropped == 0
+    assert [e.name for e in rec.events()] == [EventName.VIEW_CHANGE, EventName.KICKED]
+
+
+def test_recorder_uses_simulated_clock():
+    clock = ManualClock()
+    rec = FlightRecorder(node="n1", clock=clock, capacity=8)
+    rec.record(EventName.ALERT_ENQUEUED)
+    clock.advance_ms(250.0)
+    rec.record(EventName.FAST_ROUND_PROPOSAL)
+    clock.advance_ms(4750.0)
+    rec.record(EventName.CONSENSUS_DECIDED)
+    assert [e.t_ms for e in rec.events()] == [0.0, 250.0, 5000.0]
+
+
+def test_snapshot_tail_and_shape():
+    rec = FlightRecorder(node="n9", clock=ManualClock(), capacity=16)
+    for i in range(5):
+        rec.record(EventName.ALERT_BATCH_RX, config_id=7, trace_id=0xAB, alerts=i)
+    snap = rec.snapshot(tail=2)
+    assert snap["node"] == "n9"
+    assert snap["capacity"] == 16
+    assert snap["recorded_total"] == 5 and snap["dropped"] == 0
+    assert [e["fields"]["alerts"] for e in snap["events"]] == [3, 4]
+    assert snap["events"][0]["name"] == "alert_batch_rx"
+    # The snapshot is the JSON artifact --metrics-dump writes: it must be
+    # serializable as-is.
+    json.dumps(snap)
+
+
+def test_mint_trace_id_deterministic_and_nonzero():
+    a = mint_trace_id("10.0.0.1:9001", 42, 1000.0)
+    assert a == mint_trace_id("10.0.0.1:9001", 42, 1000.0)
+    assert a != mint_trace_id("10.0.0.2:9001", 42, 1000.0)
+    assert a != mint_trace_id("10.0.0.1:9001", 43, 1000.0)
+    assert 0 < a < 2**64
+
+
+def test_every_event_name_has_a_phase_rank():
+    # traceview's tie-breaking is total over the registered vocabulary: a
+    # new EventName member without a rank would KeyError at merge time.
+    for name in EventName:
+        assert isinstance(name.phase_rank, int)
+
+
+# ---------------------------------------------------------------------------
+# trace-context wire encoding: optional, trailing, byte-identical when absent
+# ---------------------------------------------------------------------------
+
+_EP1 = Endpoint("10.0.0.1", 5000)
+_EP2 = Endpoint("10.0.0.2", 5001)
+_ALERT = AlertMessage(
+    edge_src=_EP1, edge_dst=_EP2, edge_status=EdgeStatus.DOWN,
+    configuration_id=-12345, ring_numbers=(0, 1),
+)
+_TRACEABLE = (
+    BatchedAlertMessage(sender=_EP1, messages=(_ALERT,)),
+    FastRoundPhase2bMessage(sender=_EP1, configuration_id=7, endpoints=(_EP1, _EP2)),
+    Phase1aMessage(sender=_EP1, configuration_id=7, rank=Rank(1, 2)),
+    Phase1bMessage(sender=_EP1, configuration_id=7, rnd=Rank(1, 2),
+                   vrnd=Rank(0, 0), vval=(_EP2,)),
+    Phase2aMessage(sender=_EP1, configuration_id=7, rnd=Rank(1, 2), vval=(_EP2,)),
+    Phase2bMessage(sender=_EP1, configuration_id=7, rnd=Rank(1, 2), endpoints=(_EP2,)),
+)
+
+
+def test_codec_trace_id_round_trip_and_absent_is_byte_identical():
+    for bare in _TRACEABLE:
+        traced = dataclasses.replace(bare, trace_id=0x1122334455667788)
+        bare_bytes = encode_request(bare)
+        traced_bytes = encode_request(traced)
+        # Optional trailing field: absent = the pre-trace frame, present =
+        # exactly 8 extra bytes appended.
+        assert traced_bytes[: len(bare_bytes)] == bare_bytes, type(bare).__name__
+        assert len(traced_bytes) == len(bare_bytes) + 8, type(bare).__name__
+        assert decode_request(bare_bytes).trace_id is None
+        out = decode_request(traced_bytes)
+        assert out == bare  # trace_id is compare=False: protocol equality
+        assert out.trace_id == 0x1122334455667788, type(bare).__name__
+
+
+def test_codec_cache_distinguishes_trace_ids():
+    # trace_id is excluded from dataclass equality/hash, so the encode LRU
+    # must key on it explicitly — otherwise one message's cached bytes would
+    # be replayed for an equal message carrying a different trace.
+    base = _TRACEABLE[1]
+    m1 = dataclasses.replace(base, trace_id=1)
+    m2 = dataclasses.replace(base, trace_id=2)
+    assert m1 == m2  # equal as protocol content...
+    b1, b2 = encode_request(m1), encode_request(m2)
+    assert b1 != b2  # ...but distinct on the wire
+    assert decode_request(b1).trace_id == 1
+    assert decode_request(b2).trace_id == 2
+
+
+def test_proto_interop_drops_trace_id_without_changing_bytes():
+    """The gRPC interop path: rapid.proto has no trace field (the golden
+    fixtures freeze its descriptors), so a stamped trace id must not alter
+    the proto frame — it travels as gRPC metadata instead and simply
+    vanishes when talking to a reference peer."""
+    from rapid_tpu.interop.convert import request_from_proto, request_to_proto
+
+    for name, msg in canonical_requests().items():
+        if not hasattr(msg, "trace_id"):
+            continue
+        traced = dataclasses.replace(msg, trace_id=0xBEEF)
+        bare_frame = request_to_proto(msg).SerializeToString(deterministic=True)
+        traced_frame = request_to_proto(traced).SerializeToString(deterministic=True)
+        assert traced_frame == bare_frame, name
+        assert request_from_proto(request_to_proto(traced)).trace_id is None, name
+
+
+# ---------------------------------------------------------------------------
+# exposition: stable Prometheus names (golden) and snapshot shape
+# ---------------------------------------------------------------------------
+
+#: The complete metric-name vocabulary of one node's scrape. This list is an
+#: API: renaming or dropping an entry breaks every dashboard and alert rule
+#: pointed at a rapid_tpu deployment, so any diff here must be deliberate.
+GOLDEN_METRIC_NAMES = [
+    "rapid_alert_batches_redelivered_total",
+    "rapid_alert_batches_sent_total",
+    "rapid_alerts_enqueued_total",
+    "rapid_alerts_received_total",
+    "rapid_catch_up_wedged_total",
+    "rapid_classic_rounds_started_total",
+    "rapid_config_beacons_sent_total",
+    "rapid_config_catch_ups_total",
+    "rapid_config_pull_unchanged_served_total",
+    "rapid_config_sync_unchanged_total",
+    "rapid_configuration_id",
+    "rapid_decision_missing_joiner_uuid_total",
+    "rapid_flight_recorder_capacity",
+    "rapid_flight_recorder_depth",
+    "rapid_flight_recorder_dropped_total",
+    "rapid_flight_recorder_recorded_total",
+    "rapid_kicked_total",
+    "rapid_membership_size",
+    "rapid_proposals_announced_total",
+    "rapid_transport_bytes_rx_total",
+    "rapid_transport_bytes_tx_total",
+    "rapid_transport_kbps_rx",
+    "rapid_transport_kbps_tx",
+    "rapid_transport_msgs_rx_total",
+    "rapid_transport_msgs_tx_total",
+    "rapid_view_change_convergence_ms",
+    "rapid_view_changes_total",
+]
+
+
+def _full_synthetic_snapshot():
+    transport_side = {
+        "msgs_tx": 10, "bytes_tx": 1024, "msgs_rx": 9, "bytes_rx": 900,
+        "elapsed_s": 2.0, "kbps_tx": 0.5, "kbps_rx": 0.44,
+    }
+    return {
+        "node": "10.0.0.1:9001",
+        "configuration_id": 42,
+        "membership_size": 3,
+        "metrics": {
+            "view_changes": 2,
+            "view_change_convergence_ms": {
+                "count": 1, "last": 12.0, "p50": 12.0, "max": 12.0,
+            },
+        },
+        "transport": {"client": transport_side, "server": dict(transport_side)},
+        "recorder": {
+            "node": "10.0.0.1:9001", "capacity": 512,
+            "recorded_total": 10, "dropped": 0, "events": [],
+        },
+    }
+
+
+def test_prometheus_metric_names_are_golden():
+    text = exposition.prometheus_text(_full_synthetic_snapshot())
+    assert exposition.metric_names(text) == GOLDEN_METRIC_NAMES
+
+
+def test_prometheus_text_values_and_labels():
+    text = exposition.prometheus_text(_full_synthetic_snapshot())
+    lines = text.splitlines()
+    assert 'rapid_membership_size{node="10.0.0.1:9001"} 3' in lines
+    assert 'rapid_view_changes_total{node="10.0.0.1:9001"} 2' in lines
+    # Zero-filled vocabulary: series exist before their first increment.
+    assert 'rapid_kicked_total{node="10.0.0.1:9001"} 0' in lines
+    assert 'rapid_transport_bytes_tx_total{node="10.0.0.1:9001",side="client"} 1024' in lines
+    assert 'rapid_transport_bytes_rx_total{node="10.0.0.1:9001",side="server"} 900' in lines
+    assert 'rapid_view_change_convergence_ms{node="10.0.0.1:9001",stat="p50"} 12.0' in lines
+    assert 'rapid_flight_recorder_depth{node="10.0.0.1:9001"} 10' in lines
+    # Every metric is TYPE-declared exactly once.
+    assert sum(1 for l in lines if l.startswith("# TYPE rapid_membership_size ")) == 1
+
+
+@async_test
+async def test_live_cluster_snapshot_shape_and_prometheus():
+    network = InProcessNetwork()
+    clusters = await start_cluster(2, network)
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 2))
+        snap = clusters[0].telemetry_snapshot()
+        assert snap["node"] == str(ep(0))
+        assert snap["membership_size"] == 2
+        assert set(snap["transport"]) == {"client", "server"}
+        assert snap["recorder"]["recorded_total"] > 0
+        # The full snapshot (events included) is the --metrics-dump artifact.
+        json.loads(exposition.snapshot_json(snap))
+
+        text = clusters[0].prometheus_text()
+        names = exposition.metric_names(text)
+        # Live scrape exposes at least the golden vocabulary (extra counters
+        # may appear as the node does more protocol work).
+        assert set(GOLDEN_METRIC_NAMES) - {"rapid_view_change_convergence_ms"} <= set(names)
+        assert f'rapid_membership_size{{node="{ep(0)}"}} 2' in text.splitlines()
+    finally:
+        await shutdown_all(clusters)
+
+
+# ---------------------------------------------------------------------------
+# config-sync pull stamping: compact "unchanged" vs reference compatibility
+# ---------------------------------------------------------------------------
+
+
+@async_test
+async def test_catch_up_pull_config_id_depends_on_topology():
+    """Native-topology pulls carry the requester's current config id (so an
+    up-to-date in-tree peer answers with the compact "unchanged" response);
+    java-topology pulls keep the joiner's -1 sentinel, because a reference
+    JVM peer has no unchanged fast path — a config-id match there would park
+    the response behind a never-decided UP alert instead of answering."""
+    import random
+
+    from rapid_tpu.messaging.inprocess import InProcessClient, InProcessServer
+    from rapid_tpu.protocol.cut_detector import MultiNodeCutDetector
+    from rapid_tpu.protocol.service import CATCH_UP_CONFIG_ID, MembershipService
+    from rapid_tpu.protocol.view import MembershipView
+    from rapid_tpu.settings import Settings
+    from rapid_tpu.types import JoinMessage, JoinResponse, JoinStatusCode, NodeId
+
+    async def pulled_config_id(topology):
+        network = InProcessNetwork()
+        settings = Settings()
+        settings.topology = topology
+        my, peer = Endpoint("127.0.0.1", 41000), Endpoint("127.0.0.1", 41001)
+        view = MembershipView(
+            settings.k, node_ids=[NodeId(0, 1), NodeId(0, 2)],
+            endpoints=[my, peer], topology=topology,
+        )
+        service = MembershipService(
+            my_addr=my,
+            cut_detector=MultiNodeCutDetector(settings.k, settings.h, settings.l),
+            view=view,
+            settings=settings,
+            client=InProcessClient(network, my, settings),
+            fd_factory=StaticFailureDetectorFactory(),
+            rng=random.Random(0),
+            node_id=NodeId(0, 1),  # catch-up authenticates by endpoint + id
+        )
+        seen = []
+
+        class _Peer:
+            async def handle_message(self, request):
+                seen.append(request)
+                return JoinResponse(
+                    sender=peer,
+                    status_code=JoinStatusCode.CONFIG_CHANGED,
+                    configuration_id=request.configuration_id,
+                )
+
+        server = InProcessServer(network, peer)
+        server.set_membership_service(_Peer())
+        await server.start()
+        try:
+            await service._catch_up(peer)
+        finally:
+            await server.shutdown()
+            await service.shutdown()
+        [msg] = [m for m in seen if isinstance(m, JoinMessage)]
+        return msg.configuration_id, service.view.configuration_id
+
+    sent, current = await pulled_config_id("native")
+    assert sent == current
+    sent, current = await pulled_config_id("java")
+    assert sent == CATCH_UP_CONFIG_ID != current
+
+
+# ---------------------------------------------------------------------------
+# traceview: merge order and Chrome trace output
+# ---------------------------------------------------------------------------
+
+
+def test_merge_orders_timestamp_ties_by_protocol_phase():
+    clock = ManualClock()  # both nodes on one simulated instant
+    rec_a = FlightRecorder(node="a", clock=clock, capacity=8)
+    rec_b = FlightRecorder(node="b", clock=clock, capacity=8)
+    rec_a.record(EventName.CONSENSUS_DECIDED, config_id=1, trace_id=9)
+    rec_b.record(EventName.ALERT_ENQUEUED, config_id=1, trace_id=9)
+    rec_b.record(EventName.FAST_ROUND_PROPOSAL, config_id=1, trace_id=9)
+    merged = traceview.merge_events([rec_a.snapshot(), rec_b.snapshot()])
+    assert [e["name"] for e in merged] == [
+        "alert_enqueued", "fast_round_proposal", "consensus_decided",
+    ]
+
+
+def test_merge_filters_by_trace_id():
+    clock = ManualClock()
+    rec = FlightRecorder(node="a", clock=clock, capacity=8)
+    rec.record(EventName.ALERT_ENQUEUED, trace_id=1)
+    rec.record(EventName.ALERT_ENQUEUED, trace_id=2)
+    merged = traceview.merge_events([rec.snapshot()], trace_id=2)
+    assert len(merged) == 1 and merged[0]["trace_id"] == 2
+
+
+def _first_index(events, node, names):
+    for i, e in enumerate(events):
+        if e["node"] == node and e["name"] in names:
+            return i
+    raise AssertionError(f"no {names} event for {node}")
+
+
+@async_test
+async def test_traceview_merges_three_node_crash_and_converge():
+    """The tentpole's end-to-end criterion: a 3-node cluster crashes one
+    member, converges, and the per-node flight recordings merge into one
+    causally-ordered timeline — alert → proposal → decision → delivery on
+    every surviving node, all three nodes present — that renders as valid
+    Chrome trace-event JSON."""
+    network = InProcessNetwork()
+    fd = StaticFailureDetectorFactory()
+    clusters = await start_cluster(3, network, fd_factory=fd)
+    victim, survivors = clusters[2], clusters[:2]
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 3))
+        network.blackholed.add(victim.listen_address)
+        fd.add_failed_nodes([victim.listen_address])
+        assert await wait_until(lambda: all_converged(survivors, 2))
+
+        with tempfile.TemporaryDirectory() as tmp:
+            paths = []
+            for i, c in enumerate(clusters):
+                path = str(Path(tmp) / f"node{i}.json")
+                with open(path, "w") as f:
+                    f.write(exposition.snapshot_json(c.telemetry_snapshot()))
+                paths.append(path)
+            chrome_path = str(Path(tmp) / "chrome.json")
+            assert traceview.main([*paths, "--chrome", chrome_path]) == 0
+            merged = traceview.merge_events(traceview.load_snapshots(paths))
+            with open(chrome_path) as f:
+                chrome = json.load(f)
+
+        # Every node of the cluster contributes to the merged timeline (the
+        # victim's recording covers the pre-crash join epochs).
+        assert {e["node"] for e in merged} == {str(c.listen_address) for c in clusters}
+
+        for c in survivors:
+            node = str(c.listen_address)
+            # The final view change on this node is the victim's eviction;
+            # its trace id correlates that change's events across phases.
+            view_changes = [
+                e for e in merged
+                if e["node"] == node and e["name"] == "view_change"
+            ]
+            assert view_changes, node
+            trace = view_changes[-1]["trace_id"]
+            assert trace is not None, node
+            chain = [e for e in merged if e["node"] == node and e["trace_id"] == trace]
+            alert = _first_index(chain, node, ("alert_enqueued", "alert_batch_rx"))
+            proposal = _first_index(chain, node, ("fast_round_proposal",))
+            decided = _first_index(chain, node, ("consensus_decided",))
+            delivered = _first_index(chain, node, ("view_change",))
+            assert alert < proposal < decided < delivered, (
+                node, [(e["name"], e["t_ms"]) for e in chain],
+            )
+
+        # Chrome trace-event validity: the envelope Perfetto/chrome://tracing
+        # load, instant events with µs timestamps, metadata naming each node.
+        assert set(chrome) == {"traceEvents", "displayTimeUnit"}
+        assert chrome["displayTimeUnit"] == "ms"
+        process_names = set()
+        instants = 0
+        for ev in chrome["traceEvents"]:
+            assert {"name", "ph", "pid", "tid"} <= set(ev)
+            assert ev["ph"] in ("M", "i")
+            if ev["ph"] == "M":
+                if ev["name"] == "process_name":
+                    process_names.add(ev["args"]["name"])
+            else:
+                instants += 1
+                assert ev["s"] == "t"
+                assert isinstance(ev["ts"], (int, float))
+        assert process_names == {str(c.listen_address) for c in clusters}
+        assert instants == len(merged)
+    finally:
+        await shutdown_all(clusters)

@@ -282,7 +282,18 @@ def check_call_signatures(
         return []
     try:
         module = importlib.import_module(mod_name)
-    except Exception as exc:  # noqa: BLE001 — any import failure is a finding
+    except BaseException as exc:  # noqa: BLE001 — any import failure is a finding
+        # BaseException, not Exception: pytest.importorskip raises Skipped,
+        # which subclasses BaseException so that test code can't swallow it
+        # by accident — but here it must not propagate and skip/abort the
+        # whole gate.
+        if type(exc).__name__ == "Skipped":
+            # Module-level importorskip: the module declares an optional
+            # dependency this environment lacks (e.g. hypothesis).
+            # Un-analyzable here, not broken — pytest skips it the same way.
+            return []
+        if not isinstance(exc, Exception):
+            raise  # KeyboardInterrupt / SystemExit stay fatal
         return [Finding(rel, 1, "import-error", f"cannot import {mod_name}: {exc}")]
 
     findings: List[Finding] = []

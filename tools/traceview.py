@@ -1,0 +1,211 @@
+"""Merge per-node flight recordings into one causally-ordered timeline.
+
+Each node's flight recorder (rapid_tpu/utils/flight_recorder.py) holds that
+node's view of a membership change; the cluster-wide story only exists once
+the recordings are merged. This tool takes one telemetry-snapshot JSON per
+node (what ``MembershipService.telemetry_snapshot`` returns and the
+standalone agent's ``--metrics-dump`` writes — a bare
+``FlightRecorder.snapshot()`` dict works too) and merges them into a single
+timeline ordered by (timestamp, causal phase rank, node, per-node sequence).
+The phase rank breaks timestamp ties the way the protocol actually flows —
+alert before proposal before decision before delivery — which matters under
+simulated clocks that tick coarsely, and under real clocks when one batch of
+events lands within scheduler jitter.
+
+Events that share a ``trace_id`` are one membership change seen from every
+node: ``--trace`` filters to a single change, and the Chrome trace output
+(``--chrome out.json``, the trace-event format Perfetto and chrome://tracing
+read) lanes events by node so the cross-node cascade is visible at a glance.
+
+Usage:
+
+    python tools/traceview.py node1.json node2.json node3.json
+    python tools/traceview.py dumps/*.json --trace 0x1b3 --chrome view.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from rapid_tpu.utils.flight_recorder import EventName  # noqa: E402
+
+#: Rank for event names outside the registered vocabulary (a newer recording
+#: read by an older traceview): sorts after every known phase at the same
+#: timestamp instead of crashing the merge.
+_UNKNOWN_RANK = max(n.phase_rank for n in EventName) + 1
+
+
+def _phase_rank(name: str) -> int:
+    try:
+        return EventName(name).phase_rank
+    except ValueError:
+        return _UNKNOWN_RANK
+
+
+def load_snapshots(paths: Iterable[str]) -> List[Dict[str, Any]]:
+    """Read telemetry-snapshot (or bare recorder-snapshot) JSON files. A file
+    holding a list is a convenience for single-file dumps of many nodes."""
+    snapshots: List[Dict[str, Any]] = []
+    for path in paths:
+        with open(path) as f:
+            data = json.load(f)
+        snapshots.extend(data if isinstance(data, list) else [data])
+    return snapshots
+
+
+def _recorder_of(snapshot: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    if "events" in snapshot:  # bare FlightRecorder.snapshot()
+        return snapshot
+    return snapshot.get("recorder")
+
+
+def merge_events(
+    snapshots: Iterable[Dict[str, Any]], trace_id: Optional[int] = None
+) -> List[Dict[str, Any]]:
+    """One causally-ordered timeline from many per-node recordings.
+
+    Sort key: (t_ms, phase rank, node, per-node seq). Timestamps order
+    events whose clocks are comparable (one simulated clock, or one host's
+    loop clock); the phase rank arbitrates ties so the merged order reads
+    as the protocol executes even when a whole view change lands on one
+    simulated-clock tick. ``trace_id`` filters to one membership change.
+    """
+    merged: List[Dict[str, Any]] = []
+    for snapshot in snapshots:
+        recorder = _recorder_of(snapshot)
+        if not recorder:
+            continue
+        for event in recorder.get("events", ()):
+            if trace_id is not None and event.get("trace_id") != trace_id:
+                continue
+            merged.append(event)
+    merged.sort(
+        key=lambda e: (
+            e.get("t_ms", 0.0),
+            _phase_rank(e.get("name", "")),
+            str(e.get("node", "")),
+            e.get("seq", 0),
+        )
+    )
+    return merged
+
+
+def render_text(events: List[Dict[str, Any]]) -> str:
+    """The human-facing timeline: one line per event, time-left-aligned to
+    the first event so a convergence run reads as elapsed milliseconds."""
+    if not events:
+        return "(no events)\n"
+    t0 = events[0].get("t_ms", 0.0)
+    width = max(len(str(e.get("node", ""))) for e in events)
+    lines = []
+    for e in events:
+        fields = " ".join(f"{k}={v}" for k, v in (e.get("fields") or {}).items())
+        trace = e.get("trace_id")
+        lines.append(
+            f"{e.get('t_ms', 0.0) - t0:>10.3f}ms  {str(e.get('node', '')):<{width}}  "
+            f"{e.get('name', '?'):<22}"
+            f" cfg={e.get('config_id')}"
+            + (f" trace={trace:#x}" if trace is not None else "")
+            + (f"  {fields}" if fields else "")
+        )
+    return "\n".join(lines) + "\n"
+
+
+def chrome_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Chrome trace-event JSON (the format chrome://tracing and Perfetto
+    load): every flight event becomes a thread-scoped instant event, laned
+    by node (pid) with the trace id as the thread so concurrent membership
+    changes render as separate rows under each node."""
+    pids: Dict[str, int] = {}
+    tids: Dict[Any, int] = {}
+    named_lanes: set = set()  # (pid, tid) pairs with thread_name emitted
+    trace_events: List[Dict[str, Any]] = []
+    for e in events:
+        node = str(e.get("node", "?"))
+        if node not in pids:
+            pids[node] = len(pids) + 1
+            trace_events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": pids[node],
+                    "tid": 0,
+                    "args": {"name": node},
+                }
+            )
+        lane = e.get("trace_id")
+        if lane not in tids:
+            tids[lane] = len(tids) + 1
+        if (pids[node], tids[lane]) not in named_lanes:
+            # thread_name metadata is scoped per (pid, tid): a trace shared
+            # across nodes needs its lane named under EVERY node's pid.
+            named_lanes.add((pids[node], tids[lane]))
+            trace_events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pids[node],
+                    "tid": tids[lane],
+                    "args": {
+                        "name": "untraced" if lane is None else f"trace {lane:#x}"
+                    },
+                }
+            )
+        args = dict(e.get("fields") or {})
+        args["config_id"] = e.get("config_id")
+        if lane is not None:
+            args["trace_id"] = f"{lane:#x}"
+        trace_events.append(
+            {
+                "name": e.get("name", "?"),
+                "ph": "i",
+                "s": "t",  # thread-scoped instant
+                "ts": e.get("t_ms", 0.0) * 1000.0,  # trace-event ts is µs
+                "pid": pids[node],
+                "tid": tids[lane],
+                "args": args,
+            }
+        )
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def _parse_trace_id(value: str) -> int:
+    return int(value, 0)  # accepts decimal and the 0x-prefixed form we print
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="merge per-node flight recordings into one timeline"
+    )
+    parser.add_argument(
+        "snapshots", nargs="+",
+        help="telemetry-snapshot JSON files, one per node (--metrics-dump output)",
+    )
+    parser.add_argument(
+        "--trace", type=_parse_trace_id, default=None, metavar="ID",
+        help="only events of this trace id (one membership change)",
+    )
+    parser.add_argument(
+        "--chrome", metavar="OUT.json", default=None,
+        help="also write Chrome trace-event JSON (open in Perfetto)",
+    )
+    args = parser.parse_args(argv)
+
+    events = merge_events(load_snapshots(args.snapshots), trace_id=args.trace)
+    sys.stdout.write(render_text(events))
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump(chrome_trace(events), f, indent=1)
+            f.write("\n")
+        sys.stdout.write(f"wrote {args.chrome} ({len(events)} events)\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
