@@ -293,6 +293,16 @@ def run_workload() -> None:
         _mark(f"1M point: {xl_ms:.1f} ms")
 
     value = min(samples)
+    # Bounded log-bucketed histogram of the timed samples (the same
+    # fixed-schedule instrument the membership service uses for its phase
+    # SLOs, utils/histogram.py): the bench trajectory records quantiles —
+    # p50/p90/p99/max plus mergeable bucket counts — not just the min/mean,
+    # so cross-round comparisons can see tail behavior.
+    from rapid_tpu.utils.histogram import LogHistogram
+
+    sample_hist = LogHistogram()
+    for s in samples:
+        sample_hist.observe(s)
     print(
         json.dumps(
             {
@@ -302,6 +312,7 @@ def run_workload() -> None:
                 "vs_baseline": round(baseline_target_ms / value, 3),
                 "platform": platform,
                 "samples_ms": [round(s, 3) for s in samples],
+                "churn_resolution_hist": sample_hist.summary(),
                 "view_changes": cuts_per_sample,
                 "n_members": n,
                 "joins": n_join,

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import logging
 import os
 import signal
@@ -114,12 +115,32 @@ async def run(args) -> None:
             f.write("\n")
         os.replace(tmp, args.metrics_dump)
 
+    def print_health() -> None:
+        # One JSON line per report interval: the node's health state
+        # (utils/health.py vocabulary) and its phase-decomposed convergence
+        # quantiles — the machine-readable heartbeat a wrapper script or CI
+        # probe consumes without parsing the full --metrics-dump snapshot.
+        snap = cluster.telemetry_snapshot(recorder_tail=0)
+        family = snap["metrics"].get("view_change_phase_ms") or {}
+        print(json.dumps({
+            "node": snap["node"],
+            "health": snap["health"],
+            "configuration_id": snap["configuration_id"],
+            "membership_size": snap["membership_size"],
+            "phases": {
+                phase: {k: hist[k] for k in ("count", "p50", "p90", "p99", "max")}
+                for phase, hist in family.items()
+            },
+        }), flush=True)
+
     async def reporter():
         while not stop.is_set():
             LOG.info("membership size: %d (config %d)",
                      cluster.membership_size, cluster.service.view.configuration_id)
             if args.metrics_dump:
                 dump_metrics()
+            if args.health:
+                print_health()
             await asyncio.sleep(args.report_interval)
 
     reporter_task = asyncio.ensure_future(reporter())
@@ -148,6 +169,11 @@ def main() -> None:
                         "default) or epidemic gossip relay (the alternate "
                         "IBroadcaster impl its docs name)")
     parser.add_argument("--report-interval", type=float, default=1.0)
+    parser.add_argument("--health", action="store_true",
+                        help="print the node's health state and phase-decomposed "
+                        "convergence quantiles as one JSON line per report "
+                        "interval (machine-readable; see utils/health.py for "
+                        "the state vocabulary)")
     parser.add_argument("--metrics-dump", default="", metavar="PATH",
                         help="write the node's unified telemetry snapshot "
                         "(metrics, transport stats, flight recording) to PATH "

@@ -44,6 +44,7 @@ from rapid_tpu.ops.rings import (
     ring_topology_from_perm,
 )
 from rapid_tpu.utils import exposition
+from rapid_tpu.utils.health import NodeHealth
 from rapid_tpu.utils.metrics import Metrics
 
 
@@ -1198,6 +1199,20 @@ class VirtualCluster:
 
     # -- observability (utils/exposition.py schema) ---------------------
 
+    def health(self) -> NodeHealth:
+        """Cluster-wide health of the N virtual members, in the same
+        vocabulary host nodes report (utils/health.py). The engine executes
+        every node's round in one fused program, so its aggregate IS the
+        cluster view: churn still in flight — a crashed slot not yet evicted
+        or a join wave not yet admitted — reads PROPOSING (alerts, cut
+        detection, and consensus all progress each round); otherwise STABLE.
+        One packed scalar fetch."""
+        pending = int(
+            jnp.sum(self.state.alive & self.faults.crashed, dtype=jnp.int32)
+            + jnp.sum(self.state.join_pending, dtype=jnp.int32)
+        )
+        return NodeHealth.PROPOSING if pending else NodeHealth.STABLE
+
     def telemetry_snapshot(self) -> dict:
         """The engine's unified telemetry snapshot — same schema as
         ``MembershipService.telemetry_snapshot`` minus the per-message
@@ -1208,6 +1223,7 @@ class VirtualCluster:
             "node": f"virtual-cluster/{self.cfg.n}",
             "configuration_id": self.config_id,
             "membership_size": self.membership_size,
+            "health": self.health().value,
             "config_epoch": self.config_epoch,
             "metrics": self.metrics.summary(),
             "transport": {},

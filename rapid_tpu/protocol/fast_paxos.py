@@ -77,6 +77,12 @@ class FastPaxos:
         self._votes_per_proposal: Dict[Tuple[Endpoint, ...], int] = {}
         self._votes_received: Set[Endpoint] = set()
         self.decided = False
+        #: Which path produced the decision: "fast" (round-1 quorum of
+        #: identical votes) or "classic" (the fallback's Paxos learner).
+        #: None until decided. The service labels the agreement-phase
+        #: histogram with it — the fast/classic split arXiv:1308.1358
+        #: identifies as the boundary worth measuring.
+        self.decided_path: Optional[str] = None
         self._fallback_task: Optional[CancelHandle] = None
         self._cancelled = False
         self._my_proposal: Optional[Tuple[Endpoint, ...]] = None
@@ -95,6 +101,10 @@ class FastPaxos:
             if self.decided:
                 return
             self.decided = True
+            # The classic learner (Paxos.handle_phase2b) latches its own
+            # decided flag before invoking us; the fast-round tally calls
+            # straight in — so the inner engine's flag tells the paths apart.
+            self.decided_path = "classic" if self.paxos.decided else "fast"
             if self._fallback_task is not None:
                 self._fallback_task.cancel()
             if self._recorder is not None:
@@ -103,6 +113,7 @@ class FastPaxos:
                     config_id=self.configuration_id,
                     trace_id=self._trace(),
                     proposal=[str(node) for node in hosts],
+                    path=self.decided_path,
                 )
             on_decide(hosts)
 

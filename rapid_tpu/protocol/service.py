@@ -53,6 +53,7 @@ from rapid_tpu.types import (
 from rapid_tpu.utils import exposition
 from rapid_tpu.utils.clock import AsyncioClock, Clock
 from rapid_tpu.utils.flight_recorder import EventName, FlightRecorder, mint_trace_id
+from rapid_tpu.utils.health import NodeHealth
 from rapid_tpu.utils.metrics import Metrics
 
 LOG = logging.getLogger(__name__)
@@ -111,6 +112,18 @@ _MAX_REPORT_ONLY_SYNC_PULLS = 30
 #: silent one.
 _WEDGED_PULLS_ERROR_THRESHOLD = 100
 
+#: Phase-decomposed convergence SLO timer (utils/metrics.py phase family):
+#: one membership change splits into detection (first alert evidence ->
+#: proposal release, i.e. the H-watermark crossing that frees the cut),
+#: agreement (proposal -> consensus decision, labeled fast/classic by which
+#: path decided — the boundary arXiv:1308.1358 measures), and delivery
+#: (decision -> view applied + subscribers notified). Rendered as
+#: ``rapid_view_change_phase_ms_bucket{phase=...}`` histograms.
+_PHASE_TIMER = "view_change_phase"
+_MARK_DETECTION = "vc_phase_detection"
+_MARK_AGREEMENT = "vc_phase_agreement"
+_MARK_DELIVERY = "vc_phase_delivery"
+
 
 class MembershipService:
     def __init__(
@@ -156,7 +169,10 @@ class MembershipService:
             for event, callbacks in subscriptions.items():
                 self.subscriptions[event].extend(callbacks)
 
-        self.metrics = Metrics()
+        # The protocol clock is the metrics clock: timers/marks measure
+        # simulated time correctly under ManualClock (wall clock would skew
+        # every phase SLO in simulated-time tests and engines).
+        self.metrics = Metrics(now_ms=self.clock.now_ms)
         self._convergence_timing = False
         self._lock = asyncio.Lock()  # the "protocol executor"
         self._joiners_to_respond_to: Dict[Endpoint, List[asyncio.Future]] = {}
@@ -269,18 +285,42 @@ class MembershipService:
     # observability surface (utils/exposition.py)
     # ------------------------------------------------------------------
 
+    def health(self) -> NodeHealth:
+        """This node's health state (utils/health.py vocabulary), derived
+        from the protocol's own suspicion machinery — worst condition wins:
+
+        - WEDGED: the decided-config catch-up escalated (futile pulls past
+          the error threshold), or the node was evicted (KICKED) — both need
+          the application/operator to rejoin or restart;
+        - CATCHING_UP: a decided configuration could not be applied locally
+          and is being pulled from peers;
+        - PROPOSING: a cut proposal announced, consensus undecided;
+        - DETECTING: edge reports held below H, or alerts queued to send;
+        - STABLE: none of the above.
+        """
+        if self._kicked_signalled or self._wedged_pulls >= _WEDGED_PULLS_ERROR_THRESHOLD:
+            return NodeHealth.WEDGED
+        if self._decision_pending_catch_up:
+            return NodeHealth.CATCHING_UP
+        if self._announced_proposal and not self._fast_paxos.decided:
+            return NodeHealth.PROPOSING
+        if self._send_queue or self.cut_detector.has_pending_reports():
+            return NodeHealth.DETECTING
+        return NodeHealth.STABLE
+
     def telemetry_snapshot(self, recorder_tail: Optional[int] = None) -> Dict[str, object]:
-        """One unified telemetry snapshot: protocol metrics, transport
-        accounting (when the client keeps ``TransportStats``), and the
-        flight recording. ``recorder_tail`` bounds the events included
+        """One unified telemetry snapshot: protocol metrics, health state,
+        transport accounting (when the client keeps ``TransportStats``), and
+        the flight recording. ``recorder_tail`` bounds the events included
         (None = the whole ring). This dict is the artifact the standalone
-        agent's ``--metrics-dump`` writes and ``tools/traceview.py``
-        merges."""
+        agent's ``--metrics-dump`` writes and ``tools/traceview.py`` /
+        ``tools/clustertop.py`` consume."""
         stats = getattr(self.client, "stats", None)
         return {
             "node": str(self.my_addr),
             "configuration_id": self.view.configuration_id,
             "membership_size": self.view.membership_size,
+            "health": self.health().value,
             "trace_id": self._trace_id,
             "metrics": self.metrics.summary(),
             "transport": {"client": stats.snapshot() if stats is not None else None},
@@ -445,6 +485,20 @@ class MembershipService:
             for msg in batch.messages
             if self._filter_alert(msg, config_id)
         ]
+        if valid and not self._announced_proposal:
+            # Detection phase opens at the first alert evidence of this
+            # change — received here, or enqueued locally (_enqueue_alert).
+            # Same staleness policy as the convergence timer: a mark left by
+            # evidence that never led to a proposal (one spurious FD blip,
+            # tally below L) would otherwise inflate a much later change's
+            # detection sample by hours.
+            now = self.clock.now_ms()
+            if (
+                not self.metrics.has_mark(_MARK_DETECTION)
+                or self.metrics.elapsed_since_ms(_MARK_DETECTION, now)
+                > self._stale_evidence_ms()
+            ):
+                self.metrics.mark(_MARK_DETECTION, now)
         if self._announced_proposal:
             # We already initiated consensus and cannot revise our proposal.
             return Response()
@@ -463,9 +517,21 @@ class MembershipService:
                 proposal=[str(node) for node in proposal],
             )
             self._announced_proposal = True
+            now = self.clock.now_ms()
             if not self._convergence_timing:
                 self._convergence_timing = True
-                self.metrics.mark("view_change_convergence", self.clock.now_ms())
+                self.metrics.mark("view_change_convergence", now)
+            # Detection phase closes at the H-threshold crossing that
+            # released this cut (the detector frees the proposal in the same
+            # synchronous pass); agreement opens with the proposal.
+            if self.metrics.has_mark(_MARK_DETECTION):
+                self.metrics.record_ms(
+                    _PHASE_TIMER,
+                    self.metrics.elapsed_since_ms(_MARK_DETECTION, now),
+                    phase="detection",
+                )
+                self.metrics.clear_mark(_MARK_DETECTION)
+            self.metrics.mark(_MARK_AGREEMENT, now)
             self._notify(
                 ClusterEvents.VIEW_CHANGE_PROPOSAL,
                 ClusterStatusChange(
@@ -502,6 +568,18 @@ class MembershipService:
     # ------------------------------------------------------------------
 
     def _decide_view_change(self, proposal: Tuple[Endpoint, ...]) -> None:
+        now = self.clock.now_ms()
+        if self.metrics.has_mark(_MARK_AGREEMENT):
+            # Agreement phase closes at the consensus decision, labeled by
+            # the path that decided it (fast quorum vs classic fallback) —
+            # the boundary where the fast path stops paying for itself.
+            path = self._fast_paxos.decided_path or "fast"
+            self.metrics.record_ms(
+                _PHASE_TIMER,
+                self.metrics.elapsed_since_ms(_MARK_AGREEMENT, now),
+                phase=f"agreement/{path}",
+            )
+            self.metrics.clear_mark(_MARK_AGREEMENT)
         LOG.info(
             "%s decide view change in config %d (%d nodes): %s",
             self.my_addr, self.view.configuration_id, self.view.membership_size,
@@ -523,6 +601,12 @@ class MembershipService:
         if missing:
             self._recover_from_unknown_joiners(missing)
             return
+        # Delivery phase: decision -> view applied + subscribers notified,
+        # recorded at the end of _commit_view_change. Armed only once the
+        # decision is validated as applicable: the missing-joiner recovery
+        # above never commits, and a mark left by it would charge the whole
+        # multi-second catch-up pull to "delivery" when the install lands.
+        self.metrics.mark(_MARK_DELIVERY, now)
         self._cancel_failure_detectors()
 
         status_changes: List[NodeStatusChange] = []
@@ -584,6 +668,15 @@ class MembershipService:
             self._notify(ClusterEvents.KICKED, change)
 
         self._respond_to_joiners(respond_to)
+        if self.metrics.has_mark(_MARK_DELIVERY):
+            # Consensus-decision commits only: a catch-up install never
+            # armed the mark (its "decision" happened on another node).
+            self.metrics.record_ms(
+                _PHASE_TIMER,
+                self.metrics.elapsed_since_ms(_MARK_DELIVERY, self.clock.now_ms()),
+                phase="delivery",
+            )
+            self.metrics.clear_mark(_MARK_DELIVERY)
 
     def _reset_for_new_configuration(self) -> None:
         """Per-configuration protocol state reset, shared by the consensus
@@ -605,7 +698,11 @@ class MembershipService:
         self._one_step_failed_notified = False
         self._decision_pending_catch_up = False
         # Trace context is per membership change: the next change mints or
-        # adopts a fresh correlation key.
+        # adopts a fresh correlation key. Phase marks likewise — a detection
+        # or agreement epoch left over from the superseded configuration
+        # must not leak into the next change's phase timings.
+        self.metrics.clear_mark(_MARK_DETECTION)
+        self.metrics.clear_mark(_MARK_AGREEMENT)
         self._trace_id = None
         self._remember_config_id(self.view.configuration_id)
         self._fast_paxos.cancel_fallback()
@@ -810,6 +907,16 @@ class MembershipService:
     # alert batching (MembershipService.java:572-581, 613-637)
     # ------------------------------------------------------------------
 
+    def _stale_evidence_ms(self) -> float:
+        """The window in which alerts related to the same membership change
+        can plausibly still arrive; evidence marks (the convergence timer
+        and the detection-phase mark) older than this belong to a change
+        that never happened and are expired rather than trusted."""
+        return 10 * (
+            self.settings.failure_detector_interval_ms
+            + self.settings.batching_window_ms
+        )
+
     def _enqueue_alert(self, msg: AlertMessage) -> None:
         now = self.clock.now_ms()
         self._last_enqueue_ms = now
@@ -833,20 +940,23 @@ class MembershipService:
         # a proposal (e.g. one spurious FD firing, tally below L) would
         # inflate a much later convergence; expire it after the window in
         # which related alerts could plausibly still arrive.
-        stale_ms = 10 * (
-            self.settings.failure_detector_interval_ms + self.settings.batching_window_ms
-        )
         if (
             self._convergence_timing
             and not self._announced_proposal
             # Once a proposal is announced, convergence is genuinely in
             # flight (possibly slow via the classic fallback) — never expire.
-            and self.metrics.elapsed_since_ms("view_change_convergence", now) > stale_ms
+            and self.metrics.elapsed_since_ms("view_change_convergence", now)
+            > self._stale_evidence_ms()
         ):
             self._convergence_timing = False
         if not self._convergence_timing:
             self._convergence_timing = True
             self.metrics.mark("view_change_convergence", now)
+            # Detection phase (re)opens with the convergence epoch: same
+            # staleness policy, same first-evidence semantics.
+            self.metrics.mark(_MARK_DETECTION, now)
+        elif not self._announced_proposal and not self.metrics.has_mark(_MARK_DETECTION):
+            self.metrics.mark(_MARK_DETECTION, now)
 
     async def _alert_batcher_loop(self) -> None:
         window = self.settings.batching_window_ms

@@ -3,30 +3,44 @@ Prometheus text or JSON.
 
 The reference has no runtime telemetry surface at all (SURVEY §5.1/5.5); the
 paper's Table 2 network numbers came from external OS tooling. This module
-unifies the three in-tree instruments — the ``Metrics`` registry
+unifies the in-tree instruments — the ``Metrics`` registry
 (utils/metrics.py), per-transport ``TransportStats`` (messaging/stats.py),
-and the flight recorder (utils/flight_recorder.py) — into a single snapshot
-dict with a stable shape, and renders it in the Prometheus text exposition
-format under stable metric names (pinned by tests/test_observability.py).
+the flight recorder (utils/flight_recorder.py), and the node health model
+(utils/health.py) — into a single snapshot dict with a stable shape, and
+renders it in the Prometheus text exposition format under stable metric
+names (pinned by tests/test_observability.py).
 
 Snapshot shape (``MembershipService.telemetry_snapshot`` /
-``Cluster.telemetry_snapshot`` produce it; ``tools/traceview.py`` and the
-standalone agent's ``--metrics-dump`` consume it)::
+``Cluster.telemetry_snapshot`` produce it; ``tools/traceview.py``,
+``tools/clustertop.py`` and the standalone agent's ``--metrics-dump``
+consume it)::
 
     {
       "node": "host:port",
       "configuration_id": int,
       "membership_size": int,
-      "metrics": {<counter>: int, ..., "<timer>_ms": {count,last,p50,max}},
+      "health": "stable" | "detecting" | "proposing" | "catching_up" | "wedged",
+      "metrics": {<counter>: int, ...,
+                  "<timer>_ms": {count,last,p50,p90,p99,max,sum,buckets},
+                  "<family>_ms": {<phase>: {count,...,buckets}, ...}},
       "transport": {"client": TransportStats.snapshot()|None, "server": ...},
       "recorder": FlightRecorder.snapshot(),
     }
+
+Timers render as real Prometheus histograms (``_bucket``/``_sum``/``_count``
+on the fixed schedule of utils/histogram.py); phase families additionally
+carry ``phase=`` (and, for "phase/path" keys, ``path=``) labels — the
+convergence SLO surface: ``rapid_view_change_phase_ms_bucket{phase="detection",...}``.
 """
 
 from __future__ import annotations
 
 import json
+import math
 from typing import Any, Dict, List, Optional
+
+from rapid_tpu.utils.health import NodeHealth
+from rapid_tpu.utils.histogram import cumulative_from_summary
 
 _PREFIX = "rapid"
 
@@ -68,12 +82,25 @@ def _labels(**labels: str) -> str:
 
 def _num(value: Any) -> str:
     # Prometheus floats; integers render without a trailing .0 for
-    # readability (both parse identically).
+    # readability (both parse identically). Non-finite floats use the
+    # exposition-format tokens — Python's repr ('nan', 'inf') is not
+    # parseable by Prometheus scrapers.
     if isinstance(value, bool):
         return "1" if value else "0"
     if isinstance(value, int):
         return str(value)
-    return repr(float(value))
+    value = float(value)
+    if math.isnan(value):
+        return "NaN"
+    if math.isinf(value):
+        return "+Inf" if value > 0 else "-Inf"
+    return repr(value)
+
+
+def _le(bound: Any) -> str:
+    """A histogram bucket's ``le`` label value: short float form for finite
+    bounds, the literal ``+Inf`` token for the overflow bucket."""
+    return bound if isinstance(bound, str) else format(bound, ".6g")
 
 
 class _Renderer:
@@ -81,16 +108,49 @@ class _Renderer:
         self._lines: List[str] = []
         self._typed: set = set()
 
-    def sample(
-        self, name: str, kind: str, value: Any, **labels: str
-    ) -> None:
+    def declare(self, name: str, kind: str) -> None:
         if name not in self._typed:
             self._typed.add(name)
             self._lines.append(f"# TYPE {name} {kind}")
+
+    def sample(
+        self, name: str, kind: str, value: Any, **labels: str
+    ) -> None:
+        self.declare(name, kind)
         self._lines.append(f"{name}{_labels(**labels)} {_num(value)}")
+
+    def histogram(self, name: str, summary: Dict[str, Any], **labels: str) -> None:
+        """One Prometheus histogram series set (``_bucket``/``_sum``/
+        ``_count``) from a LogHistogram summary dict. ``labels`` precede the
+        ``le`` label on every bucket line; the TYPE is declared once per
+        family name across label sets."""
+        buckets = cumulative_from_summary(summary)
+        if buckets is None:
+            # Legacy timer dict without bucket data (an old snapshot file):
+            # fall back to the stat-labeled summary rendering.
+            for stat, value in sorted(summary.items()):
+                self.sample(name, "summary", value, **labels, stat=stat)
+            return
+        self.declare(name, "histogram")
+        for bound, cumulative in buckets:
+            self._lines.append(
+                f"{name}_bucket{_labels(**labels, le=_le(bound))} {cumulative}"
+            )
+        self._lines.append(f"{name}_sum{_labels(**labels)} {_num(summary.get('sum', 0.0))}")
+        self._lines.append(f"{name}_count{_labels(**labels)} {summary.get('count', 0)}")
 
     def text(self) -> str:
         return "\n".join(self._lines) + "\n"
+
+
+def _phase_labels(phase_key: str) -> Dict[str, str]:
+    """'detection' -> {phase: detection}; 'agreement/fast' ->
+    {phase: agreement, path: fast} (the consensus-path split of the
+    agreement phase — arXiv:1308.1358's fast/classic boundary)."""
+    if "/" in phase_key:
+        phase, path = phase_key.split("/", 1)
+        return {"phase": phase, "path": path}
+    return {"phase": phase_key}
 
 
 def prometheus_text(snapshot: Dict[str, Any]) -> str:
@@ -98,9 +158,12 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
 
     Metric names are a stable API (tests/test_observability.py pins them):
     - ``rapid_membership_size`` / ``rapid_configuration_id`` gauges;
+    - ``rapid_node_health{state=...}`` one-hot over the health vocabulary;
     - every ``Metrics`` counter as ``rapid_<name>_total`` (the
       KNOWN_COUNTERS vocabulary is zero-filled);
-    - every ``Metrics`` timer as ``rapid_<name>_ms{stat=...}``;
+    - every ``Metrics`` timer as a ``rapid_<name>`` histogram
+      (``_bucket``/``_sum``/``_count``), phase families labeled
+      ``{phase=...}`` (and ``path=`` for the agreement split);
     - transport counters as ``rapid_transport_<dir>_total{side=...}``;
     - flight-recorder depth/capacity/total/dropped gauges.
     """
@@ -112,6 +175,14 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
     if "configuration_id" in snapshot:
         out.sample(f"{_PREFIX}_configuration_id", "gauge",
                    snapshot["configuration_id"], node=node)
+    if "health" in snapshot:
+        # One-hot over the full vocabulary: the series set is stable from
+        # the first scrape, so absent() alerting works per state.
+        current = str(snapshot["health"]).lower()
+        for state in NodeHealth:
+            out.sample(f"{_PREFIX}_node_health", "gauge",
+                       1 if state.value == current else 0,
+                       node=node, state=state.value)
 
     metrics: Dict[str, Any] = dict(snapshot.get("metrics", {}))
     counters = {name: 0 for name in KNOWN_COUNTERS}
@@ -124,8 +195,16 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
     for name in sorted(counters):
         out.sample(f"{_PREFIX}_{name}_total", "counter", counters[name], node=node)
     for name in sorted(timers):
-        for stat, value in sorted(timers[name].items()):
-            out.sample(f"{_PREFIX}_{name}", "summary", value, node=node, stat=stat)
+        value = timers[name]
+        if "count" in value:
+            out.histogram(f"{_PREFIX}_{name}", value, node=node)
+        else:
+            # Phase family: {phase_key: histogram summary}.
+            for phase_key in sorted(value):
+                out.histogram(
+                    f"{_PREFIX}_{name}", value[phase_key],
+                    **_phase_labels(phase_key), node=node,
+                )
 
     transport = snapshot.get("transport") or {}
     for side in sorted(transport):

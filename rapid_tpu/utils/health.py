@@ -1,0 +1,81 @@
+"""Per-node health model and its cluster-wide aggregation.
+
+The protocol's internal suspicion machinery (pending cut reports, undecided
+proposals, decision catch-up, the wedged-pull escalation) already encodes
+"how is this node doing" — this module names those conditions as a small
+ordered vocabulary so operators, ``telemetry_snapshot()``, the Prometheus
+exposition, and ``tools/clustertop.py`` all speak the same states:
+
+- ``STABLE``      — no membership change in flight; the steady state.
+- ``DETECTING``   — edge reports held below the H watermark (a cut is
+                    accumulating, or a straggler report is pending).
+- ``PROPOSING``   — a cut proposal is announced and consensus is undecided.
+- ``CATCHING_UP`` — a decided configuration could not be applied locally;
+                    the node is pulling it from peers.
+- ``WEDGED``      — the catch-up loop escalated (futile pulls past the
+                    threshold) or the node was evicted (KICKED): operator /
+                    application intervention is required.
+
+States are severity-ordered; a node in several conditions reports the worst.
+``aggregate_health`` folds many nodes' states into one cluster view — the
+header of clustertop and the summary a fleet scraper alerts on.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, Iterable, Union
+
+
+class NodeHealth(enum.Enum):
+    """Severity-ordered node health vocabulary (worst last)."""
+
+    STABLE = "stable"
+    DETECTING = "detecting"
+    PROPOSING = "proposing"
+    CATCHING_UP = "catching_up"
+    WEDGED = "wedged"
+
+    @property
+    def severity(self) -> int:
+        return _SEVERITY[self]
+
+
+_SEVERITY: Dict[NodeHealth, int] = {
+    NodeHealth.STABLE: 0,
+    NodeHealth.DETECTING: 1,
+    NodeHealth.PROPOSING: 2,
+    NodeHealth.CATCHING_UP: 3,
+    NodeHealth.WEDGED: 4,
+}
+
+
+def parse_health(value: Union[str, NodeHealth, None]) -> NodeHealth:
+    """Lenient parse for snapshot JSON: enum value ('stable') or member name
+    ('STABLE'); unknown/absent values read as STABLE (an old snapshot
+    predating the health model must not render a node as unhealthy)."""
+    if isinstance(value, NodeHealth):
+        return value
+    if isinstance(value, str):
+        try:
+            return NodeHealth(value.lower())
+        except ValueError:
+            pass
+    return NodeHealth.STABLE
+
+
+def aggregate_health(
+    states: Iterable[Union[str, NodeHealth, None]],
+) -> Dict[str, object]:
+    """Cluster-wide fold of per-node health states: the worst state present
+    (the cluster is only as healthy as its sickest member) plus per-state
+    counts — zero-filled over the full vocabulary so consumers see a stable
+    shape. An empty input aggregates to STABLE with all-zero counts."""
+    counts = {state.value: 0 for state in NodeHealth}
+    worst = NodeHealth.STABLE
+    for raw in states:
+        state = parse_health(raw)
+        counts[state.value] += 1
+        if state.severity > worst.severity:
+            worst = state
+    return {"overall": worst.value, "counts": counts}

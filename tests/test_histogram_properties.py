@@ -1,0 +1,100 @@
+"""Property-based invariants of the bounded log-bucketed histogram
+(utils/histogram.py) — the algebra the cluster-wide SLO story leans on:
+
+- **merge is associative and commutative** (over counts/sum/max/buckets —
+  the mergeable state; ``last`` is an explicitly order-dependent display
+  nicety), so folding per-node snapshots into one cluster histogram gives
+  the same answer in any order and any grouping (tools/clustertop.py);
+- **quantile rank bounds**: quantile(q) is never below the true order
+  statistic and never more than one bucket (GROWTH) above it — the error
+  contract every dashboard percentile inherits;
+- **conservation**: count and sum equal the recorded samples' count and sum
+  exactly, through merges and the summary/from_summary round trip.
+"""
+
+import math
+
+import pytest
+
+pytest.importorskip("hypothesis")  # property tests need it; the rest of the suite doesn't
+from hypothesis import given, settings, strategies as st
+
+from rapid_tpu.utils.histogram import (
+    FIRST_UPPER_MS,
+    GROWTH,
+    LogHistogram,
+)
+
+# Durations spanning the whole schedule: sub-first-bucket to past-overflow.
+_SAMPLES = st.lists(
+    st.floats(min_value=0.0, max_value=1e9, allow_nan=False, allow_infinity=False),
+    max_size=60,
+)
+
+
+def _hist(samples):
+    hist = LogHistogram()
+    for s in samples:
+        hist.observe(s)
+    return hist
+
+
+def _assert_same_mergeable_state(a, b):
+    """Equality over everything merge() is associative/commutative over
+    (excludes `last`, which is documented as order-dependent); sums compare
+    approximately — float addition itself only associates approximately."""
+    assert a._counts == b._counts
+    assert a.count == b.count
+    assert a.max == b.max
+    assert a.sum == pytest.approx(b.sum, rel=1e-9, abs=1e-9)
+
+
+@settings(max_examples=120, deadline=None)
+@given(_SAMPLES, _SAMPLES)
+def test_merge_commutes(xs, ys):
+    ab = _hist(xs).merge(_hist(ys))
+    ba = _hist(ys).merge(_hist(xs))
+    _assert_same_mergeable_state(ab, ba)
+
+
+@settings(max_examples=120, deadline=None)
+@given(_SAMPLES, _SAMPLES, _SAMPLES)
+def test_merge_associates(xs, ys, zs):
+    left = _hist(xs).merge(_hist(ys)).merge(_hist(zs))
+    right = _hist(xs).merge(_hist(ys).merge(_hist(zs)))
+    _assert_same_mergeable_state(left, right)
+
+
+@settings(max_examples=120, deadline=None)
+@given(_SAMPLES.filter(bool), st.floats(min_value=0.01, max_value=1.0))
+def test_quantile_rank_bounds(samples, q):
+    hist = _hist(samples)
+    ordered = sorted(samples)
+    rank = min(len(ordered), max(1, math.ceil(q * len(ordered))))
+    true_q = ordered[rank - 1]
+    got = hist.quantile(q)
+    # Never below the true order statistic; never more than one bucket above
+    # it (the first bucket's upper bound floors the error for tiny samples).
+    assert got >= true_q
+    assert got <= max(true_q * GROWTH, FIRST_UPPER_MS) * (1 + 1e-12)
+    assert got <= hist.max or hist.count == 0
+
+
+@settings(max_examples=120, deadline=None)
+@given(_SAMPLES, _SAMPLES)
+def test_count_and_sum_conserved_through_merge_and_round_trip(xs, ys):
+    merged = _hist(xs).merge(_hist(ys))
+    assert merged.count == len(xs) + len(ys)
+    assert merged.sum == pytest.approx(sum(xs) + sum(ys), rel=1e-9, abs=1e-9)
+    back = LogHistogram.from_summary(merged.summary())
+    assert back.count == merged.count
+    assert sum(back._counts) == merged.count  # every sample lands in a bucket
+    assert back.max == merged.max
+
+
+@settings(max_examples=60, deadline=None)
+@given(_SAMPLES.filter(bool))
+def test_quantiles_are_monotone_in_q(samples):
+    hist = _hist(samples)
+    values = [hist.quantile(q) for q in (0.1, 0.5, 0.9, 0.99, 1.0)]
+    assert values == sorted(values)

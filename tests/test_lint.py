@@ -136,6 +136,46 @@ def test_recorder_events_come_from_registered_enum():
     assert not offenders, "\n".join(offenders)
 
 
+def test_protocol_reads_no_wall_clock():
+    """rapid_tpu/protocol/ must not read wall clocks directly (time.time,
+    time.perf_counter, ...): the clock is injected (utils/clock.py, and the
+    Metrics registry's now_ms source), which is what keeps phase timings
+    correct under simulated time. The resolution-tier check lives in
+    tools/staticcheck.py (check_clock_injection) so the CLI gate catches it
+    too; this test runs it as part of the ordinary session. The tree is
+    currently clean — keep it that way."""
+    from staticcheck import check_clock_injection
+
+    offenders = []
+    for path in _py_files(("rapid_tpu/protocol",)):
+        offenders.extend(str(f) for f in check_clock_injection(path))
+    assert not offenders, "\n".join(offenders)
+
+
+def test_clock_injection_check_catches_both_spellings():
+    """The rule itself must fire on both the attribute and the from-import
+    spelling, and stay silent outside rapid_tpu/protocol/."""
+    import textwrap
+
+    from staticcheck import REPO as SC_REPO, check_clock_injection
+
+    offending = textwrap.dedent(
+        """
+        import time
+        from time import perf_counter
+
+        def now():
+            return time.time() + perf_counter()
+        """
+    )
+    inside = SC_REPO / "rapid_tpu" / "protocol" / "_lint_probe.py"
+    findings = check_clock_injection(inside, source=offending)
+    assert len(findings) == 2, findings
+    assert all(f.check == "clock-injection" for f in findings)
+    outside = SC_REPO / "rapid_tpu" / "utils" / "_lint_probe.py"
+    assert check_clock_injection(outside, source=offending) == []
+
+
 def test_no_mutable_default_arguments():
     offenders = []
     for path in _py_files():

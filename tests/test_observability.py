@@ -21,6 +21,7 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO / "tools"))
 
+import clustertop  # noqa: E402  — tools/clustertop.py, the live dashboard
 import traceview  # noqa: E402  — tools/traceview.py, the timeline merger
 
 from rapid_tpu.messaging.codec import decode_request, encode_request  # noqa: E402
@@ -45,6 +46,8 @@ from rapid_tpu.utils.flight_recorder import (  # noqa: E402
     FlightRecorder,
     mint_trace_id,
 )
+from rapid_tpu.utils.health import NodeHealth, aggregate_health  # noqa: E402
+from rapid_tpu.utils.histogram import LogHistogram  # noqa: E402
 
 from tests.test_cluster import (  # noqa: E402
     all_converged,
@@ -199,6 +202,11 @@ def test_proto_interop_drops_trace_id_without_changing_bytes():
 #: The complete metric-name vocabulary of one node's scrape. This list is an
 #: API: renaming or dropping an entry breaks every dashboard and alert rule
 #: pointed at a rapid_tpu deployment, so any diff here must be deliberate.
+#: (PR 2 deliberately re-shaped the timer surface: timers render as real
+#: Prometheus histograms — ``_bucket``/``_sum``/``_count`` — instead of
+#: stat-labeled summary gauges, and the phase-decomposed convergence SLO
+#: family ``rapid_view_change_phase_ms`` plus the ``rapid_node_health``
+#: one-hot joined the vocabulary.)
 GOLDEN_METRIC_NAMES = [
     "rapid_alert_batches_redelivered_total",
     "rapid_alert_batches_sent_total",
@@ -218,6 +226,7 @@ GOLDEN_METRIC_NAMES = [
     "rapid_flight_recorder_recorded_total",
     "rapid_kicked_total",
     "rapid_membership_size",
+    "rapid_node_health",
     "rapid_proposals_announced_total",
     "rapid_transport_bytes_rx_total",
     "rapid_transport_bytes_tx_total",
@@ -225,9 +234,21 @@ GOLDEN_METRIC_NAMES = [
     "rapid_transport_kbps_tx",
     "rapid_transport_msgs_rx_total",
     "rapid_transport_msgs_tx_total",
-    "rapid_view_change_convergence_ms",
+    "rapid_view_change_convergence_ms_bucket",
+    "rapid_view_change_convergence_ms_count",
+    "rapid_view_change_convergence_ms_sum",
+    "rapid_view_change_phase_ms_bucket",
+    "rapid_view_change_phase_ms_count",
+    "rapid_view_change_phase_ms_sum",
     "rapid_view_changes_total",
 ]
+
+
+def _hist_summary(*values_ms):
+    hist = LogHistogram()
+    for value in values_ms:
+        hist.observe(value)
+    return hist.summary()
 
 
 def _full_synthetic_snapshot():
@@ -239,10 +260,15 @@ def _full_synthetic_snapshot():
         "node": "10.0.0.1:9001",
         "configuration_id": 42,
         "membership_size": 3,
+        "health": "stable",
         "metrics": {
             "view_changes": 2,
-            "view_change_convergence_ms": {
-                "count": 1, "last": 12.0, "p50": 12.0, "max": 12.0,
+            "view_change_convergence_ms": _hist_summary(12.0),
+            "view_change_phase_ms": {
+                "detection": _hist_summary(5.0),
+                "agreement/fast": _hist_summary(4.0),
+                "agreement/classic": _hist_summary(250.0),
+                "delivery": _hist_summary(0.5),
             },
         },
         "transport": {"client": transport_side, "server": dict(transport_side)},
@@ -267,10 +293,62 @@ def test_prometheus_text_values_and_labels():
     assert 'rapid_kicked_total{node="10.0.0.1:9001"} 0' in lines
     assert 'rapid_transport_bytes_tx_total{node="10.0.0.1:9001",side="client"} 1024' in lines
     assert 'rapid_transport_bytes_rx_total{node="10.0.0.1:9001",side="server"} 900' in lines
-    assert 'rapid_view_change_convergence_ms{node="10.0.0.1:9001",stat="p50"} 12.0' in lines
+    # Health renders one-hot over the full vocabulary.
+    assert 'rapid_node_health{node="10.0.0.1:9001",state="stable"} 1' in lines
+    assert 'rapid_node_health{node="10.0.0.1:9001",state="wedged"} 0' in lines
+    # Timers are real Prometheus histograms: _bucket/_sum/_count.
+    assert 'rapid_view_change_convergence_ms_count{node="10.0.0.1:9001"} 1' in lines
+    assert 'rapid_view_change_convergence_ms_sum{node="10.0.0.1:9001"} 12.0' in lines
+    assert 'rapid_view_change_convergence_ms_bucket{node="10.0.0.1:9001",le="+Inf"} 1' in lines
+    # The phase SLO family carries phase= (and path= for the agreement
+    # split) labels — the tentpole's pinned series.
+    assert 'rapid_view_change_phase_ms_bucket{phase="detection",node="10.0.0.1:9001",le="+Inf"} 1' in lines
+    assert 'rapid_view_change_phase_ms_bucket{phase="agreement",path="fast",node="10.0.0.1:9001",le="+Inf"} 1' in lines
+    assert 'rapid_view_change_phase_ms_count{phase="delivery",node="10.0.0.1:9001"} 1' in lines
     assert 'rapid_flight_recorder_depth{node="10.0.0.1:9001"} 10' in lines
-    # Every metric is TYPE-declared exactly once.
+    # Every metric is TYPE-declared exactly once — including one histogram
+    # TYPE shared across the phase family's label sets.
     assert sum(1 for l in lines if l.startswith("# TYPE rapid_membership_size ")) == 1
+    assert sum(
+        1 for l in lines if l.startswith("# TYPE rapid_view_change_phase_ms ")
+    ) == 1
+    assert "# TYPE rapid_view_change_phase_ms histogram" in lines
+    # Bucket lines are cumulative and end at the total count.
+    detection = [
+        l for l in lines
+        if l.startswith('rapid_view_change_phase_ms_bucket{phase="detection"')
+    ]
+    counts = [int(l.rsplit(" ", 1)[1]) for l in detection]
+    assert counts == sorted(counts) and counts[-1] == 1
+
+
+def test_non_finite_values_render_spec_tokens():
+    """Prometheus exposition tokens for non-finite floats are NaN/+Inf/-Inf;
+    Python's repr ('nan', 'inf') is not scrapeable."""
+    assert exposition._num(float("nan")) == "NaN"
+    assert exposition._num(float("inf")) == "+Inf"
+    assert exposition._num(float("-inf")) == "-Inf"
+    assert exposition._num(1.5) == "1.5"
+    assert exposition._num(7) == "7"
+    snap = _full_synthetic_snapshot()
+    snap["transport"]["client"]["kbps_tx"] = float("inf")
+    snap["transport"]["client"]["kbps_rx"] = float("nan")
+    lines = exposition.prometheus_text(snap).splitlines()
+    assert 'rapid_transport_kbps_tx{node="10.0.0.1:9001",side="client"} +Inf' in lines
+    assert 'rapid_transport_kbps_rx{node="10.0.0.1:9001",side="client"} NaN' in lines
+    assert not any(l.endswith(" inf") or l.endswith(" nan") for l in lines)
+
+
+def test_legacy_timer_dict_without_buckets_still_renders():
+    # Old snapshot files (pre-histogram) carry {count,last,p50,max} only:
+    # they fall back to the stat-labeled summary rendering instead of
+    # crashing the scrape of an archived dump.
+    snap = _full_synthetic_snapshot()
+    snap["metrics"]["view_change_convergence_ms"] = {
+        "count": 1, "last": 12.0, "p50": 12.0, "max": 12.0,
+    }
+    lines = exposition.prometheus_text(snap).splitlines()
+    assert 'rapid_view_change_convergence_ms{node="10.0.0.1:9001",stat="p50"} 12.0' in lines
 
 
 @async_test
@@ -282,6 +360,7 @@ async def test_live_cluster_snapshot_shape_and_prometheus():
         snap = clusters[0].telemetry_snapshot()
         assert snap["node"] == str(ep(0))
         assert snap["membership_size"] == 2
+        assert snap["health"] in {s.value for s in NodeHealth}
         assert set(snap["transport"]) == {"client", "server"}
         assert snap["recorder"]["recorded_total"] > 0
         # The full snapshot (events included) is the --metrics-dump artifact.
@@ -291,8 +370,45 @@ async def test_live_cluster_snapshot_shape_and_prometheus():
         names = exposition.metric_names(text)
         # Live scrape exposes at least the golden vocabulary (extra counters
         # may appear as the node does more protocol work).
-        assert set(GOLDEN_METRIC_NAMES) - {"rapid_view_change_convergence_ms"} <= set(names)
+        assert set(GOLDEN_METRIC_NAMES) <= set(names)
         assert f'rapid_membership_size{{node="{ep(0)}"}} 2' in text.splitlines()
+        # The seed proposed/decided/applied the join, so all three phases of
+        # the convergence SLO surface are live — the tentpole's pinned claim.
+        assert 'rapid_view_change_phase_ms_bucket{phase="detection"' in text
+        assert 'rapid_view_change_phase_ms_bucket{phase="agreement"' in text
+        assert 'rapid_view_change_phase_ms_bucket{phase="delivery"' in text
+    finally:
+        await shutdown_all(clusters)
+
+
+@async_test
+async def test_live_cluster_phase_decomposition_and_health():
+    """A converged cluster's seed records all three convergence phases
+    (detection closed at proposal release, agreement labeled by the deciding
+    path, delivery closed at commit), and every node settles to STABLE
+    health once the change is applied."""
+    network = InProcessNetwork()
+    clusters = await start_cluster(3, network)
+    try:
+        assert await wait_until(lambda: all_converged(clusters, 3))
+        phases = clusters[0].metrics["view_change_phase_ms"]
+        assert "detection" in phases and "delivery" in phases
+        agreement = [k for k in phases if k.startswith("agreement/")]
+        assert agreement and set(agreement) <= {"agreement/fast", "agreement/classic"}
+        for summary in phases.values():
+            assert summary["count"] >= 1
+            assert summary["p50"] <= summary["p90"] <= summary["p99"]
+            # Bounded histogram, not a sample list.
+            assert sum(summary["buckets"].values()) == summary["count"]
+        # Phase durations are sub-phases of the north-star timer: detection
+        # through delivery on one change cannot exceed total convergence.
+        conv = clusters[0].metrics["view_change_convergence_ms"]
+        assert conv["count"] >= 1
+        assert await wait_until(
+            lambda: all(c.service.health() is NodeHealth.STABLE for c in clusters)
+        )
+        for c in clusters:
+            assert c.telemetry_snapshot()["health"] == "stable"
     finally:
         await shutdown_all(clusters)
 
@@ -473,3 +589,214 @@ async def test_traceview_merges_three_node_crash_and_converge():
         assert instants == len(merged)
     finally:
         await shutdown_all(clusters)
+
+
+# ---------------------------------------------------------------------------
+# traceview CLI error handling: clean nonzero exits, never tracebacks
+# ---------------------------------------------------------------------------
+
+
+def test_traceview_errors_cleanly_on_invalid_json(tmp_path, capsys):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{this is not json")
+    assert traceview.main([str(bad)]) == 2
+    err = capsys.readouterr().err
+    assert "traceview:" in err and str(bad) in err and "invalid JSON" in err
+
+
+def test_traceview_errors_cleanly_on_unreadable_file(tmp_path, capsys):
+    missing = tmp_path / "does_not_exist.json"
+    assert traceview.main([str(missing)]) == 2
+    err = capsys.readouterr().err
+    assert "traceview:" in err and str(missing) in err
+
+
+def test_traceview_errors_cleanly_on_non_snapshot_json(tmp_path, capsys):
+    scalar = tmp_path / "scalar.json"
+    scalar.write_text("42")
+    assert traceview.main([str(scalar)]) == 2
+    assert "not a telemetry snapshot" in capsys.readouterr().err
+
+
+def test_traceview_errors_cleanly_on_zero_events(tmp_path, capsys):
+    # A dump taken with recorder_tail=0 (e.g. a Prometheus-oriented scrape)
+    # holds no events: the merge has nothing to order, and the CLI must say
+    # so instead of printing an empty timeline and exiting 0.
+    rec = FlightRecorder(node="a", clock=ManualClock(), capacity=4)
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps(rec.snapshot()))
+    assert traceview.main([str(empty)]) == 2
+    assert "no recorder events" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# clustertop: the live cluster health/SLO dashboard
+# ---------------------------------------------------------------------------
+
+
+def _clustertop_snapshot(node, health="stable", detection_ms=(), config=7):
+    metrics = {"view_changes": 1}
+    if detection_ms:
+        hist = LogHistogram()
+        for v in detection_ms:
+            hist.observe(v)
+        metrics["view_change_phase_ms"] = {"detection": hist.summary()}
+    return {
+        "node": node,
+        "configuration_id": config,
+        "membership_size": 3,
+        "health": health,
+        "metrics": metrics,
+        "transport": {"client": {"kbps_tx": 1.25, "kbps_rx": 0.75}},
+        "recorder": None,
+    }
+
+
+def test_aggregate_health_worst_state_wins_with_stable_counts():
+    agg = aggregate_health(["stable", "detecting", "stable"])
+    assert agg["overall"] == "detecting"
+    assert agg["counts"]["stable"] == 2 and agg["counts"]["detecting"] == 1
+    assert set(agg["counts"]) == {s.value for s in NodeHealth}  # zero-filled
+    assert aggregate_health([])["overall"] == "stable"
+    # Unknown/legacy values read as stable, never as an invented state.
+    assert aggregate_health(["???", None])["overall"] == "stable"
+    assert aggregate_health(["stable", "WEDGED"])["overall"] == "wedged"
+
+
+def test_clustertop_renders_health_and_merged_phase_quantiles():
+    snapshots = [
+        _clustertop_snapshot("10.0.0.1:9001", "stable", detection_ms=(5.0, 6.0)),
+        _clustertop_snapshot("10.0.0.2:9001", "proposing", detection_ms=(50.0,)),
+        _clustertop_snapshot("10.0.0.3:9001", "wedged"),
+    ]
+    frame = clustertop.render_frame(snapshots)
+    assert "3 node(s)" in frame
+    assert "health: WEDGED" in frame  # worst state present wins the header
+    assert "1 wedged" in frame and "1 proposing" in frame and "1 stable" in frame
+    for node in ("10.0.0.1:9001", "10.0.0.2:9001", "10.0.0.3:9001"):
+        assert node in frame
+    # Cluster-wide SLO line comes from MERGED per-node histograms: three
+    # detection samples total, p99 in the bucket holding the 50 ms sample.
+    merged = LogHistogram()
+    for v in (5.0, 6.0, 50.0):
+        merged.observe(v)
+    assert f"detection p50={merged.quantile(0.5):.1f} p99={merged.quantile(0.99):.1f}" in frame
+    # A wedged node with no phase data renders dashes, not a crash.
+    assert "wedged" in frame
+
+
+def test_clustertop_renders_three_node_dump_files(tmp_path, capsys):
+    # The acceptance path: >=3 per-node snapshot dumps on disk -> one frame.
+    paths = []
+    for i in range(3):
+        path = tmp_path / f"node{i}.json"
+        path.write_text(json.dumps(_clustertop_snapshot(f"10.0.0.{i + 1}:9001")))
+        paths.append(str(path))
+    torn = tmp_path / "torn.json"
+    torn.write_text('{"node": "10.0.0.9:9001"')  # mid-rewrite file
+    assert clustertop.main([*paths, str(torn), "--once"]) == 0
+    out = capsys.readouterr().out
+    for i in range(3):
+        assert f"10.0.0.{i + 1}:9001" in out
+    assert "3 node(s)" in out
+    assert "torn.json" in out  # degraded to a footnote, not a crash
+    assert "configs: 1 (agreement)" in out
+
+
+def test_clustertop_once_with_nothing_renderable_exits_nonzero(tmp_path, capsys):
+    bad = tmp_path / "bad.json"
+    bad.write_text("nope")
+    assert clustertop.main([str(bad), "--once"]) == 2
+    assert "invalid JSON" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# phase-mark hygiene: stale evidence and uncommittable decisions must not
+# corrupt the phase histograms
+# ---------------------------------------------------------------------------
+
+
+def _direct_service(clock, n=3):
+    """A MembershipService wired directly (no started loops): the harness the
+    phase-mark regression tests drive synchronously."""
+    import random
+
+    from rapid_tpu.messaging.inprocess import InProcessClient
+    from rapid_tpu.protocol.cut_detector import MultiNodeCutDetector
+    from rapid_tpu.protocol.service import MembershipService
+    from rapid_tpu.protocol.view import MembershipView
+    from rapid_tpu.settings import Settings
+    from rapid_tpu.types import NodeId
+
+    settings = Settings()
+    endpoints = [Endpoint("127.0.0.1", 42000 + i) for i in range(n)]
+    node_ids = [NodeId(0, i + 1) for i in range(n)]
+    view = MembershipView(settings.k, node_ids=node_ids, endpoints=endpoints)
+    service = MembershipService(
+        my_addr=endpoints[0],
+        cut_detector=MultiNodeCutDetector(settings.k, settings.h, settings.l),
+        view=view,
+        settings=settings,
+        client=InProcessClient(InProcessNetwork(), endpoints[0], settings),
+        fd_factory=StaticFailureDetectorFactory(),
+        clock=clock,
+        rng=random.Random(0),
+        node_id=node_ids[0],
+    )
+    return service, endpoints, settings
+
+
+@async_test
+async def test_stale_detection_mark_does_not_inflate_phase_histogram():
+    """A spurious alert that never produces a view change leaves a detection
+    mark behind; a genuine change hours later must re-open the detection
+    epoch (same staleness policy as the convergence timer), not record the
+    hours-old mark into the phase histogram."""
+    clock = ManualClock()
+    service, endpoints, settings = _direct_service(clock)
+    try:
+        me, b, c = endpoints
+
+        def batch(rings):
+            return BatchedAlertMessage(
+                sender=b,
+                messages=(AlertMessage(
+                    edge_src=b, edge_dst=c, edge_status=EdgeStatus.DOWN,
+                    configuration_id=service.view.configuration_id,
+                    ring_numbers=tuple(rings),
+                ),),
+            )
+
+        # One below-L report: detection mark armed, no proposal follows.
+        service._handle_batched_alerts(batch([0]))
+        assert not service._announced_proposal
+        ten_hours_ms = 10 * 3600 * 1000.0
+        clock.advance_ms(ten_hours_ms)
+        # The genuine change: reports cross H in one batch -> proposal.
+        service._handle_batched_alerts(batch(range(settings.h)))
+        assert service._announced_proposal
+        detection = service.metrics.phase_timings["view_change_phase"]["detection"]
+        assert detection.count == 1
+        assert detection.max <= service._stale_evidence_ms(), detection.max
+    finally:
+        await service.shutdown()
+
+
+@async_test
+async def test_recovery_path_does_not_arm_delivery_mark():
+    """A decision naming a joiner whose UP alert was lost takes the
+    catch-up recovery path and never commits: the delivery mark must not be
+    armed, or the eventual catch-up install would charge the whole
+    multi-second recovery pull to the 'delivery' phase."""
+    clock = ManualClock()
+    service, endpoints, _ = _direct_service(clock)
+    try:
+        unknown_joiner = Endpoint("127.0.0.1", 42999)
+        service._decide_view_change((unknown_joiner,))
+        assert service._decision_pending_catch_up  # recovery engaged
+        assert not service.metrics.has_mark("vc_phase_delivery")
+        # And no delivery sample was recorded by the aborted decision.
+        family = service.metrics.phase_timings.get("view_change_phase", {})
+        assert "delivery" not in family
+    finally:
+        await service.shutdown()

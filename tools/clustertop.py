@@ -1,0 +1,220 @@
+"""Live top-like terminal dashboard over per-node telemetry snapshots.
+
+``tools/traceview.py`` answers "show me this one view change, end to end";
+this tool answers the operator's other question — "how is the cluster doing
+RIGHT NOW". It reads the same inputs (one telemetry-snapshot JSON per node,
+what ``--metrics-dump`` writes continuously) and renders a refreshing
+cluster view: per-node health states (utils/health.py), configuration
+agreement, message rates, and the phase-decomposed convergence quantiles
+(detection / agreement / delivery, utils/histogram.py) — both per node and
+merged cluster-wide, which is exactly what the histogram's associative
+``merge()`` exists for.
+
+Usage:
+
+    python tools/clustertop.py dumps/*.json              # refresh every 2 s
+    python tools/clustertop.py dumps/*.json --interval 1
+    python tools/clustertop.py dumps/*.json --once       # one frame, exit
+
+Unreadable or torn files (a node mid-rewrite, a crashed agent) degrade to a
+footnote, never a crash: a live dashboard that dies on one bad file is
+useless during the incident it exists for.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from rapid_tpu.utils.health import aggregate_health, parse_health  # noqa: E402
+from rapid_tpu.utils.histogram import LogHistogram  # noqa: E402
+
+#: Display order of the convergence phases (the protocol's causal order).
+PHASE_ORDER = ("detection", "agreement", "delivery")
+
+_CLEAR = "\x1b[2J\x1b[H"  # ANSI: clear screen + home cursor
+
+
+def load_snapshots_tolerant(
+    paths: List[str],
+) -> Tuple[List[Dict[str, Any]], List[str]]:
+    """(snapshots, error strings). A file holding a list contributes every
+    entry (single-file dumps of many nodes); malformed files become error
+    lines instead of exceptions."""
+    snapshots: List[Dict[str, Any]] = []
+    errors: List[str] = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except OSError as exc:
+            errors.append(f"{path}: unreadable ({exc})")
+            continue
+        except json.JSONDecodeError as exc:
+            errors.append(f"{path}: invalid JSON ({exc})")
+            continue
+        entries = data if isinstance(data, list) else [data]
+        for entry in entries:
+            if isinstance(entry, dict) and "node" in entry:
+                snapshots.append(entry)
+            else:
+                errors.append(f"{path}: not a telemetry snapshot entry")
+    return snapshots, errors
+
+
+def _phase_histograms(snapshot: Dict[str, Any]) -> Dict[str, LogHistogram]:
+    """Per-phase histograms of one node, agreement paths folded into their
+    phase (``agreement/fast`` + ``agreement/classic`` -> ``agreement``) —
+    merge is associative, so folding here and folding across nodes commute."""
+    family = (snapshot.get("metrics") or {}).get("view_change_phase_ms") or {}
+    out: Dict[str, LogHistogram] = {}
+    for key, summary in family.items():
+        if not isinstance(summary, dict) or "count" not in summary:
+            continue
+        phase = key.split("/", 1)[0]
+        hist = out.setdefault(phase, LogHistogram())
+        hist.merge(LogHistogram.from_summary(summary))
+    return out
+
+
+def _convergence_histogram(snapshot: Dict[str, Any]) -> Optional[LogHistogram]:
+    summary = (snapshot.get("metrics") or {}).get("view_change_convergence_ms")
+    if isinstance(summary, dict) and summary.get("count"):
+        return LogHistogram.from_summary(summary)
+    return None
+
+
+def _fmt_ms(value: Optional[float]) -> str:
+    if value is None:
+        return "-"
+    if value >= 1000.0:
+        return f"{value / 1000.0:.2f}s"
+    return f"{value:.1f}"
+
+
+def _fmt_rate(stats: Optional[Dict[str, Any]], key: str) -> str:
+    if not stats or key not in stats:
+        return "-"
+    return f"{float(stats[key]):.1f}"
+
+
+def _quantile_cell(hist: Optional[LogHistogram], q: float) -> str:
+    if hist is None or hist.count == 0:
+        return "-"
+    return _fmt_ms(hist.quantile(q))
+
+
+def render_frame(
+    snapshots: List[Dict[str, Any]], errors: Optional[List[str]] = None
+) -> str:
+    """One complete dashboard frame as a string (the testable core; the
+    refresh loop just clears the screen and prints it)."""
+    lines: List[str] = []
+    agg = aggregate_health(s.get("health") for s in snapshots)
+    configs = {s.get("configuration_id") for s in snapshots}
+    counts = ", ".join(f"{n} {state}" for state, n in agg["counts"].items() if n)
+    lines.append(
+        f"rapid clustertop — {len(snapshots)} node(s)"
+        f" | health: {str(agg['overall']).upper()}"
+        + (f" ({counts})" if counts else "")
+        + f" | configs: {len(configs) if snapshots else 0}"
+        + (" (agreement)" if len(configs) == 1 and snapshots else "")
+    )
+
+    # Cluster-wide phase SLOs: per-node bounded histograms merge exactly.
+    merged: Dict[str, LogHistogram] = {}
+    merged_conv = LogHistogram()
+    for snapshot in snapshots:
+        for phase, hist in _phase_histograms(snapshot).items():
+            merged.setdefault(phase, LogHistogram()).merge(hist)
+        conv = _convergence_histogram(snapshot)
+        if conv is not None:
+            merged_conv.merge(conv)
+    slo_cells = []
+    for phase in PHASE_ORDER:
+        hist = merged.get(phase)
+        slo_cells.append(
+            f"{phase} p50={_quantile_cell(hist, 0.5)} p99={_quantile_cell(hist, 0.99)}"
+        )
+    slo_cells.append(
+        f"convergence p50={_quantile_cell(merged_conv, 0.5)}"
+        f" p99={_quantile_cell(merged_conv, 0.99)}"
+    )
+    lines.append("cluster SLO (ms): " + " | ".join(slo_cells))
+    lines.append("")
+
+    header = (
+        "NODE", "HEALTH", "CONFIG", "SIZE", "VIEWS",
+        "TXKBPS", "RXKBPS", "DET99", "AGR99", "DLV99", "CONV99",
+    )
+    rows: List[Tuple[str, ...]] = []
+    for snapshot in sorted(snapshots, key=lambda s: str(s.get("node", ""))):
+        metrics = snapshot.get("metrics") or {}
+        phases = _phase_histograms(snapshot)
+        transport = snapshot.get("transport") or {}
+        client = transport.get("client")
+        rows.append((
+            str(snapshot.get("node", "?")),
+            parse_health(snapshot.get("health")).value,
+            str(snapshot.get("configuration_id", "-")),
+            str(snapshot.get("membership_size", "-")),
+            str(metrics.get("view_changes", 0)),
+            _fmt_rate(client, "kbps_tx"),
+            _fmt_rate(client, "kbps_rx"),
+            _quantile_cell(phases.get("detection"), 0.99),
+            _quantile_cell(phases.get("agreement"), 0.99),
+            _quantile_cell(phases.get("delivery"), 0.99),
+            _quantile_cell(_convergence_histogram(snapshot), 0.99),
+        ))
+    widths = [
+        max(len(header[i]), *(len(r[i]) for r in rows)) if rows else len(header[i])
+        for i in range(len(header))
+    ]
+    for row in (header, *rows):
+        lines.append("  ".join(cell.ljust(widths[i]) for i, cell in enumerate(row)))
+    for error in errors or ():
+        lines.append(f"! {error}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="live cluster health/SLO dashboard over telemetry snapshots"
+    )
+    parser.add_argument(
+        "snapshots", nargs="+",
+        help="telemetry-snapshot JSON files, one per node (--metrics-dump output)",
+    )
+    parser.add_argument(
+        "--interval", type=float, default=2.0, metavar="SECONDS",
+        help="refresh interval (re-reads the files each frame)",
+    )
+    parser.add_argument(
+        "--once", action="store_true",
+        help="render a single frame and exit (scripting/testing)",
+    )
+    args = parser.parse_args(argv)
+
+    while True:
+        snapshots, errors = load_snapshots_tolerant(args.snapshots)
+        frame = render_frame(snapshots, errors)
+        if args.once:
+            sys.stdout.write(frame)
+            # Nothing renderable at all is an error exit like traceview's.
+            return 0 if snapshots else 2
+        sys.stdout.write(_CLEAR + frame)
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

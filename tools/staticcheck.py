@@ -365,7 +365,64 @@ def check_call_signatures(
 
 
 # ---------------------------------------------------------------------------
-# Check 3: dead module-level definitions (tree-wide liveness)
+# Check 3: clock injection discipline in rapid_tpu/protocol/
+# ---------------------------------------------------------------------------
+
+#: Wall-clock readers banned inside the protocol package. Every timing
+#: consumer there must go through the injected Clock (utils/clock.py) /
+#: Metrics ``now_ms`` source, or simulated-time tests silently measure wall
+#: time (and phase SLO histograms record garbage under ManualClock).
+_BANNED_CLOCK_ATTRS = frozenset(
+    {"time", "perf_counter", "perf_counter_ns", "monotonic", "monotonic_ns"}
+)
+
+#: The tree this discipline applies to (posix-style relative prefix).
+CLOCK_DISCIPLINE_PREFIX = "rapid_tpu/protocol/"
+
+
+def check_clock_injection(
+    path: Path,
+    source: Optional[str] = None,
+    tree: "Optional[ast.AST]" = None,
+) -> List[Finding]:
+    """No direct wall-clock reads (``time.time``/``time.perf_counter``/...)
+    in rapid_tpu/protocol/: the clock is injected there, and this check
+    keeps it that way. Both spellings are caught — attribute access on the
+    ``time`` module and ``from time import perf_counter``."""
+    rel = _rel(path)
+    if not rel.replace("\\", "/").startswith(CLOCK_DISCIPLINE_PREFIX):
+        return []
+    src = source if source is not None else path.read_text()
+    if tree is None:
+        tree = ast.parse(src, filename=str(path))
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "time"
+            and node.attr in _BANNED_CLOCK_ATTRS
+        ):
+            findings.append(
+                Finding(rel, node.lineno, "clock-injection",
+                        f"direct wall-clock read time.{node.attr} in the "
+                        "protocol package — use the injected Clock / Metrics "
+                        "now_ms source")
+            )
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            banned = [a.name for a in node.names if a.name in _BANNED_CLOCK_ATTRS]
+            if banned:
+                findings.append(
+                    Finding(rel, node.lineno, "clock-injection",
+                            f"importing {', '.join(banned)} from time in the "
+                            "protocol package — use the injected Clock / "
+                            "Metrics now_ms source")
+                )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Check 4: dead module-level definitions (tree-wide liveness)
 # ---------------------------------------------------------------------------
 
 DEFAULT_ROOTS = (
@@ -514,6 +571,7 @@ def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
         trees.append((tree, _rel(path)))
         findings.extend(check_undefined_names(path, src, tree))
         findings.extend(check_call_signatures(path, src, tree))
+        findings.extend(check_clock_injection(path, src, tree))
     if tuple(roots) == DEFAULT_ROOTS:
         # Liveness is only meaningful over the FULL tree: with narrowed CLI
         # roots, code consumed from outside the subset would be reported as

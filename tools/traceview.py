@@ -48,14 +48,34 @@ def _phase_rank(name: str) -> int:
         return _UNKNOWN_RANK
 
 
+class SnapshotLoadError(RuntimeError):
+    """A snapshot file could not be read or is not telemetry-snapshot JSON.
+    Carries the offending path in its message; the CLI turns it into a clean
+    nonzero exit instead of a traceback."""
+
+
 def load_snapshots(paths: Iterable[str]) -> List[Dict[str, Any]]:
     """Read telemetry-snapshot (or bare recorder-snapshot) JSON files. A file
-    holding a list is a convenience for single-file dumps of many nodes."""
+    holding a list is a convenience for single-file dumps of many nodes.
+    Raises :class:`SnapshotLoadError` on unreadable files, invalid JSON, or
+    JSON that is not a snapshot object."""
     snapshots: List[Dict[str, Any]] = []
     for path in paths:
-        with open(path) as f:
-            data = json.load(f)
-        snapshots.extend(data if isinstance(data, list) else [data])
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except OSError as exc:
+            raise SnapshotLoadError(f"{path}: cannot read snapshot: {exc}") from exc
+        except json.JSONDecodeError as exc:
+            raise SnapshotLoadError(f"{path}: invalid JSON: {exc}") from exc
+        entries = data if isinstance(data, list) else [data]
+        for entry in entries:
+            if not isinstance(entry, dict):
+                raise SnapshotLoadError(
+                    f"{path}: not a telemetry snapshot (expected a JSON "
+                    f"object, got {type(entry).__name__})"
+                )
+        snapshots.extend(entries)
     return snapshots
 
 
@@ -197,7 +217,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     args = parser.parse_args(argv)
 
-    events = merge_events(load_snapshots(args.snapshots), trace_id=args.trace)
+    try:
+        snapshots = load_snapshots(args.snapshots)
+    except SnapshotLoadError as exc:
+        print(f"traceview: {exc}", file=sys.stderr)
+        return 2
+    recorded = sum(
+        len((_recorder_of(s) or {}).get("events", ())) for s in snapshots
+    )
+    if recorded == 0:
+        # Distinct from an empty --trace filter result: the inputs carry no
+        # recording at all (e.g. dumps taken with recorder_tail=0), so there
+        # is no timeline to merge — say so, nonzero.
+        print(
+            f"traceview: no recorder events in {len(args.snapshots)} "
+            "snapshot file(s) — dump with the full recorder tail "
+            "(--metrics-dump writes it by default)",
+            file=sys.stderr,
+        )
+        return 2
+    events = merge_events(snapshots, trace_id=args.trace)
     sys.stdout.write(render_text(events))
     if args.chrome:
         with open(args.chrome, "w") as f:
