@@ -71,8 +71,13 @@ class GossipBroadcaster(Broadcaster):
         self._fanout = fanout
         self._ttl = ttl
         self._rng = rng if rng is not None else random.Random()
-        self._members: List[Endpoint] = []
-        self._seen: "OrderedDict[Tuple[Endpoint, int], None]" = OrderedDict()
+        # Relay state is event-loop-confined (tools/analysis/concurrency.py):
+        # broadcast/accept/_relay are synchronous, so every dedup
+        # check-then-remember runs atomically under cooperative scheduling —
+        # the annotation keeps it that way (an await slipped between a _seen
+        # lookup and its _remember would re-relay duplicate envelopes).
+        self._members: List[Endpoint] = []  # guarded-by: event-loop
+        self._seen: "OrderedDict[Tuple[Endpoint, int], None]" = OrderedDict()  # guarded-by: event-loop
         self.relays_sent = 0  # observability: total envelope transmissions
 
     @classmethod

@@ -66,12 +66,15 @@ class TcpServer(MessagingServer):
         self.listen_address = listen_address
         self._service = None
         self._server: Optional[asyncio.AbstractServer] = None
-        self._connections: set = set()
+        # Event-loop-confined (tools/analysis/concurrency.py): mutated only
+        # in cooperative straight-line sections, no lock needed — but no
+        # read->await->write may straddle an await.
+        self._connections: set = set()  # guarded-by: event-loop
         self.stats = TransportStats()  # paper Table 2 accounting
         # Strong references to in-flight handlers: the event loop only holds
         # tasks weakly, so without this a handler can be garbage-collected
         # mid-flight and the request silently dropped.
-        self._handler_tasks: set = set()
+        self._handler_tasks: set = set()  # guarded-by: event-loop
 
     def set_membership_service(self, service) -> None:
         self._service = service
@@ -189,10 +192,14 @@ class TcpClient(MessagingClient):
     def __init__(self, my_addr: Endpoint, settings: Optional[Settings] = None) -> None:
         self.my_addr = my_addr
         self._settings = settings if settings is not None else Settings()
-        self._connections: Dict[Endpoint, _Connection] = {}
-        self._connect_locks: Dict[Endpoint, asyncio.Lock] = {}
+        # The check-then-connect in _connection_for is serialized by the
+        # PER-REMOTE locks below (a dict of locks is beyond what the
+        # guarded-by analysis can prove held, so the map itself carries the
+        # event-loop discipline: no read->await->write outside those locks).
+        self._connections: Dict[Endpoint, _Connection] = {}  # guarded-by: event-loop
+        self._connect_locks: Dict[Endpoint, asyncio.Lock] = {}  # guarded-by: event-loop
         self._correlation = itertools.count(1)
-        self._shut_down = False
+        self._shut_down = False  # guarded-by: event-loop
         self.stats = TransportStats()  # paper Table 2 accounting
 
     def _timeout_ms_for(self, request: RapidRequest) -> float:

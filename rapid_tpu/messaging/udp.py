@@ -130,7 +130,7 @@ class UdpHybridClient(TcpClient):
 
     def __init__(self, my_addr: Endpoint, settings: Optional[Settings] = None) -> None:
         super().__init__(my_addr, settings)
-        self._udp_transports: Dict[int, asyncio.DatagramTransport] = {}
+        self._udp_transports: Dict[int, asyncio.DatagramTransport] = {}  # guarded-by: _udp_lock
         self._udp_lock = asyncio.Lock()
 
     async def _udp(self, ip_version: int) -> asyncio.DatagramTransport:
@@ -183,9 +183,14 @@ class UdpHybridClient(TcpClient):
             return False
 
     async def shutdown(self) -> None:
-        for transport in self._udp_transports.values():
-            transport.close()
-        self._udp_transports.clear()
+        # Under the same lock _udp() creates through: a shutdown racing a
+        # concurrent first send could otherwise clear the map mid-create and
+        # leak the freshly-opened datagram transport past shutdown
+        # (surfaced by the unguarded-mutation analysis).
+        async with self._udp_lock:
+            for transport in self._udp_transports.values():
+                transport.close()
+            self._udp_transports.clear()
         await super().shutdown()
 
 

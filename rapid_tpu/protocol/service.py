@@ -149,13 +149,18 @@ class MembershipService:
         # service falls back to reference-style KICKED recovery.
         self.node_id = node_id
         self.settings = settings
-        self.view = view
+        # The `# guarded-by:` comments below are machine-checked annotations
+        # (tools/analysis/concurrency.py): a field marked `_lock` may only
+        # be MUTATED while the protocol executor is held; one marked
+        # `event-loop` is confined to cooperative scheduling (no lock
+        # required, but no read->await->write may straddle an await).
+        self.view = view  # guarded-by: _lock
         self.cut_detector = cut_detector
         self.client = client
         self.fd_factory = fd_factory
         self.clock = clock if clock is not None else AsyncioClock()
         self.rng = rng if rng is not None else random.Random()
-        self.metadata_manager = MetadataManager()
+        self.metadata_manager = MetadataManager()  # guarded-by: _lock
         if metadata_map:
             self.metadata_manager.add_metadata(metadata_map)
         self.broadcaster = (
@@ -173,38 +178,38 @@ class MembershipService:
         # simulated time correctly under ManualClock (wall clock would skew
         # every phase SLO in simulated-time tests and engines).
         self.metrics = Metrics(now_ms=self.clock.now_ms)
-        self._convergence_timing = False
+        self._convergence_timing = False  # guarded-by: _lock
         self._lock = asyncio.Lock()  # the "protocol executor"
-        self._joiners_to_respond_to: Dict[Endpoint, List[asyncio.Future]] = {}
-        self._joiner_uuid: Dict[Endpoint, NodeId] = {}
-        self._joiner_metadata: Dict[Endpoint, FrozenMetadata] = {}
-        self._announced_proposal = False
-        self._send_queue: List[AlertMessage] = []
-        self._last_enqueue_ms: float = -1.0
-        self._background_tasks: List[asyncio.Task] = []
-        self._fd_tasks: List[asyncio.Task] = []
-        self._fd_generation = 0
-        self._stopped = False
+        self._joiners_to_respond_to: Dict[Endpoint, List[asyncio.Future]] = {}  # guarded-by: _lock
+        self._joiner_uuid: Dict[Endpoint, NodeId] = {}  # guarded-by: _lock
+        self._joiner_metadata: Dict[Endpoint, FrozenMetadata] = {}  # guarded-by: _lock
+        self._announced_proposal = False  # guarded-by: _lock
+        self._send_queue: List[AlertMessage] = []  # guarded-by: _lock
+        self._last_enqueue_ms: float = -1.0  # guarded-by: _lock
+        self._background_tasks: List[asyncio.Task] = []  # guarded-by: event-loop
+        self._fd_tasks: List[asyncio.Task] = []  # guarded-by: event-loop
+        self._fd_generation = 0  # guarded-by: event-loop
+        self._stopped = False  # guarded-by: event-loop
         # Delivery-liveness state (droppable transports; settings.py):
         # alerts broadcast for the current configuration (redelivery buffer),
         # catch-up bookkeeping, and the config-id history used to tell
         # straggler traffic from evidence of an unknown configuration.
-        self._alerts_sent: List[AlertMessage] = []
-        self._redeliveries_this_config = 0
-        self._catch_up_inflight = False
-        self._catch_up_tasks: Set[asyncio.Task] = set()
-        self._last_catch_up_ms = float("-inf")
-        self._last_beacon_ms = float("-inf")
+        self._alerts_sent: List[AlertMessage] = []  # guarded-by: _lock
+        self._redeliveries_this_config = 0  # guarded-by: _lock
+        self._catch_up_inflight = False  # guarded-by: event-loop
+        self._catch_up_tasks: Set[asyncio.Task] = set()  # guarded-by: event-loop
+        self._last_catch_up_ms = float("-inf")  # guarded-by: event-loop
+        self._last_beacon_ms = float("-inf")  # guarded-by: event-loop
         # Idle-heartbeat timer starts at construction: a fresh node is
         # current by definition and owes no immediate anti-entropy pull.
-        self._last_idle_sync_ms = self.clock.now_ms()
-        self._decision_pending_catch_up = False
-        self._kicked_signalled = False
-        self._report_only_sync_pulls = 0
-        self._undecided_suspicion_ticks = 0
-        self._wedged_pulls = 0
-        self._one_step_failed_notified = False
-        self._known_config_ids: "OrderedDict[int, bool]" = OrderedDict()
+        self._last_idle_sync_ms = self.clock.now_ms()  # guarded-by: event-loop
+        self._decision_pending_catch_up = False  # guarded-by: _lock
+        self._kicked_signalled = False  # guarded-by: _lock
+        self._report_only_sync_pulls = 0  # guarded-by: _lock
+        self._undecided_suspicion_ticks = 0  # guarded-by: _lock
+        self._wedged_pulls = 0  # guarded-by: _lock
+        self._one_step_failed_notified = False  # guarded-by: _lock
+        self._known_config_ids: "OrderedDict[int, bool]" = OrderedDict()  # guarded-by: _lock
         self._remember_config_id(self.view.configuration_id)
 
         # Observability: per-node flight recorder (utils/flight_recorder.py)
@@ -212,12 +217,12 @@ class MembershipService:
         # flight — minted at the first local alert, adopted from the first
         # traced inbound message, cleared when the view change commits.
         self.recorder = FlightRecorder(node=str(my_addr), clock=self.clock)
-        self._trace_id: Optional[int] = None
+        self._trace_id: Optional[int] = None  # guarded-by: _lock
         if hasattr(self.cut_detector, "bind_recorder"):
             self.cut_detector.bind_recorder(self.recorder, lambda: self._trace_id)
 
         self.broadcaster.set_membership(self.view.ring(0))
-        self._fast_paxos = self._new_fast_paxos()
+        self._fast_paxos = self._new_fast_paxos()  # guarded-by: _lock
 
         # The recording opens with the configuration this node entered
         # (bootstrap or join): a merged timeline then shows every node, even
@@ -250,7 +255,11 @@ class MembershipService:
         self._stopped = True
         self._fast_paxos.cancel_fallback()
         fd_tasks = self._cancel_failure_detectors()
-        for task in self._background_tasks:
+        # Snapshot-and-clear BEFORE awaiting (the interleaving-hazard
+        # analysis caught the old shape — read into gather, clear() after
+        # it — which would silently drop any task appended mid-await).
+        background_tasks, self._background_tasks = self._background_tasks, []
+        for task in background_tasks:
             task.cancel()
         catch_up_tasks = list(self._catch_up_tasks)
         for task in catch_up_tasks:
@@ -258,9 +267,8 @@ class MembershipService:
         # Await detectors too: a mid-tick probe must finish (or unwind) before
         # the client underneath it is shut down.
         await asyncio.gather(
-            *self._background_tasks, *fd_tasks, *catch_up_tasks, return_exceptions=True
+            *background_tasks, *fd_tasks, *catch_up_tasks, return_exceptions=True
         )
-        self._background_tasks.clear()
         await self.client.shutdown()
 
     # ------------------------------------------------------------------
@@ -962,27 +970,35 @@ class MembershipService:
         window = self.settings.batching_window_ms
         while not self._stopped:
             await self.clock.sleep_ms(window)
-            if (
-                self._send_queue
-                and self._last_enqueue_ms > 0
-                and (self.clock.now_ms() - self._last_enqueue_ms) > window
-            ):
-                messages, self._send_queue = self._send_queue, []
-                self.metrics.inc("alert_batches_sent")
-                self._alerts_sent.extend(messages)
-                self.recorder.record(
-                    EventName.ALERT_BATCH_TX,
-                    config_id=self.view.configuration_id,
-                    trace_id=self._trace_id,
-                    alerts=len(messages),
-                )
-                self.broadcaster.broadcast(
-                    BatchedAlertMessage(
-                        sender=self.my_addr,
-                        messages=tuple(messages),
+            # Under the protocol executor, like the redelivery and
+            # config-sync loops: the queue swap, the redelivery-buffer
+            # append, and the trace-id read must not interleave with a
+            # handler mutating the same state while parked on an await
+            # (surfaced by the unguarded-mutation analysis; previously this
+            # loop touched _send_queue/_alerts_sent lock-free, safe only by
+            # the accident of having no await inside the tick body).
+            async with self._lock:
+                if (
+                    self._send_queue
+                    and self._last_enqueue_ms > 0
+                    and (self.clock.now_ms() - self._last_enqueue_ms) > window
+                ):
+                    messages, self._send_queue = self._send_queue, []
+                    self.metrics.inc("alert_batches_sent")
+                    self._alerts_sent.extend(messages)
+                    self.recorder.record(
+                        EventName.ALERT_BATCH_TX,
+                        config_id=self.view.configuration_id,
                         trace_id=self._trace_id,
+                        alerts=len(messages),
                     )
-                )
+                    self.broadcaster.broadcast(
+                        BatchedAlertMessage(
+                            sender=self.my_addr,
+                            messages=tuple(messages),
+                            trace_id=self._trace_id,
+                        )
+                    )
 
     # ------------------------------------------------------------------
     # delivery liveness (droppable transports; settings.py rationale)

@@ -1,0 +1,38 @@
+"""Clean counterpart for the concurrency analyzer: zero findings.
+
+Exercises the shapes the analysis must NOT convict: sync helpers whose
+lock-held context is proven through the intra-class call graph, atomic
+swap-then-return under one acquisition, and lock-free reads.
+"""
+
+import asyncio
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self._entries = []  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+
+    async def push(self, item):
+        async with self._lock:
+            self._record(item)
+
+    async def push_many(self, items):
+        async with self._lock:
+            for item in items:
+                self._record(item)
+
+    def _record(self, item):
+        # Sync helper called only with the lock held: the call-graph
+        # fixpoint proves the context, no annotation needed here.
+        self._seq += 1
+        self._entries.append((self._seq, item))
+
+    async def drain(self):
+        async with self._lock:
+            drained, self._entries = self._entries, []
+        return drained
+
+    def size(self):
+        return len(self._entries)  # reads need no lock

@@ -1,0 +1,39 @@
+"""Clean counterpart for the trace-safety analyzer: zero findings.
+
+Exercises the exemptions: ``is None`` pytree-structure tests, shape
+metadata branches, static-argument branches, traced-local container
+mutation, and unjitted helpers.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def masked_sum(x, mask=None):
+    if mask is None:  # pytree structure: resolved at trace time
+        return jnp.sum(x)
+    return jnp.sum(x * mask)
+
+
+@functools.partial(jax.jit, static_argnames=("axis",))
+def tail_mean(x, axis):
+    if x.shape[0] > 1:  # shape metadata is static under trace
+        x = x[1:]
+    if axis > 0:  # static argument
+        return jnp.mean(x, axis=axis)
+    return jnp.mean(x)
+
+
+@jax.jit
+def scratch_built(x):
+    rows = []
+    rows.append(x)  # traced-local container: fine
+    return jnp.stack(rows)
+
+
+def plain_helper(values):
+    values.append(1)  # not jitted: mutation is ordinary Python
+    return values

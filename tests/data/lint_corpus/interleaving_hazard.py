@@ -1,0 +1,53 @@
+"""Seeded defect: read -> await -> dependent write on guarded state.
+
+Both accesses hold the lock, but not ACROSS the await between them — the
+classic check-then-act lost update. The second case is the one-statement
+variant on an event-loop-confined field.
+"""
+
+import asyncio
+
+
+async def _refresh(value):
+    await asyncio.sleep(0)
+    return (value or 0) + 1
+
+
+class Counter:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self._total = 0  # guarded-by: _lock
+        self._cache = None  # guarded-by: event-loop
+
+    async def add(self, delta):
+        async with self._lock:
+            snapshot = self._total
+        await asyncio.sleep(0)
+        async with self._lock:
+            self._total = snapshot + delta  # expect: interleaving-hazard
+
+    async def add_atomic(self, delta):
+        async with self._lock:
+            self._total = self._total + delta  # lock held across: fine
+
+    async def refresh(self):
+        self._cache = await _refresh(self._cache)  # expect: interleaving-hazard
+
+    async def busy_guard(self):
+        # The canonical check-then-act: the read lives in the `if` TEST,
+        # straight-line with its siblings — two concurrent calls both pass
+        # the guard during the sleep and both proceed.
+        if self._cache:
+            return
+        await asyncio.sleep(0)
+        self._cache = 1  # expect: interleaving-hazard
+
+    async def wrong_shield(self, delta, gate):
+        # An unrelated context manager does not protect the field: its
+        # internal await yields to the event loop just the same.
+        async with self._lock:
+            snapshot = self._total
+        async with gate:
+            await asyncio.sleep(0)
+        async with self._lock:
+            self._total = snapshot + delta  # expect: interleaving-hazard

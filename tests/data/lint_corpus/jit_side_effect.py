@@ -1,0 +1,37 @@
+"""Seeded defect: Python side effects and impure reads under jax.jit.
+
+Each fires at TRACE time, not per call: the print happens once, the
+append records one tracer, and the wall-clock value is baked into the
+compiled program forever.
+"""
+
+import time
+
+import jax
+
+TRACE_LOG = []
+
+
+@jax.jit
+def leaky(x):
+    print("tracing", x)  # expect: jit-side-effect
+    TRACE_LOG.append(x)  # expect: jit-side-effect
+    return x * 2
+
+
+@jax.jit
+def stamped(x):
+    return x + time.time()  # expect: jit-side-effect
+
+
+@jax.jit
+def reordered(x):
+    TRACE_LOG.sort()  # expect: jit-side-effect
+    return x
+
+
+@jax.jit
+def tidy(x):
+    scratch = []
+    scratch.append(x)  # local container: traced-local, fine
+    return scratch[0]

@@ -1,0 +1,21 @@
+"""Seeded defect: Python control flow on traced values under jax.jit.
+
+``n`` is pinned by static_argnames, so branching on it is legitimate;
+branching on the traced ``x`` raises TracerBoolConversionError — but only
+on the first call that reaches the branch.
+"""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def stepper(x, n):
+    if n > 2:  # static argument: resolved at trace time, fine
+        x = x + 1
+    if x > 0:  # expect: jit-traced-branch
+        return x
+    while x < n:  # expect: jit-traced-branch
+        x = x + 1
+    return x

@@ -1,0 +1,34 @@
+"""Seeded defect: awaiting a lock-acquiring method while holding the lock.
+
+asyncio.Lock is not re-entrant, so both the direct and the one-hop
+transitive re-acquisition deadlock the holder forever.
+"""
+
+import asyncio
+
+
+class Service:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self._state = 0  # guarded-by: _lock
+
+    async def refresh(self):
+        async with self._lock:
+            await self._reload()  # expect: lock-reentrancy
+
+    async def poke(self):
+        async with self._lock:
+            await self._indirect()  # expect: lock-reentrancy
+
+    async def _indirect(self):
+        # Entry context is provably lock-held (only called from poke's
+        # critical section), so the hop itself is reported too, pointing
+        # one step closer to the re-acquisition.
+        await self._reload()  # expect: lock-reentrancy
+
+    async def _reload(self):
+        async with self._lock:
+            self._state += 1
+
+    async def safe(self):
+        await self._reload()  # lock not held here: fine

@@ -1,0 +1,5 @@
+"""Seeded defect: an unparseable file must become a finding, not a crash."""
+
+
+def broken(:  # expect: syntax-error
+    return 0

@@ -1,0 +1,33 @@
+"""Seeded defect: guarded-field mutation outside the guarding lock.
+
+``_events`` is explicitly annotated; ``_count`` is unannotated and its
+guard is majority-inferred (two locked mutation sites vs one lock-free).
+The ``# expect:`` markers drive tests/test_staticcheck.py's corpus gate.
+"""
+
+import asyncio
+
+
+class Tally:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self._events = []  # guarded-by: _lock
+        self._count = 0  # unannotated: guard inferred from majority usage
+
+    async def record(self, event):
+        async with self._lock:
+            self._events.append(event)
+            self._count += 1
+
+    async def bump(self):
+        async with self._lock:
+            self._count += 1
+
+    async def record_fast(self, event):
+        self._events.append(event)  # expect: unguarded-mutation
+
+    async def drop(self):
+        self._count -= 1  # expect: unguarded-mutation
+
+    def snapshot(self):
+        return list(self._events)  # reads need no lock

@@ -137,17 +137,19 @@ def test_recorder_events_come_from_registered_enum():
 
 
 def test_protocol_reads_no_wall_clock():
-    """rapid_tpu/protocol/ must not read wall clocks directly (time.time,
-    time.perf_counter, ...): the clock is injected (utils/clock.py, and the
+    """The clock-disciplined packages (rapid_tpu/protocol/ and
+    rapid_tpu/monitoring/ — failure detectors are timing consumers too)
+    must not read wall clocks directly (time.time, time.time_ns,
+    datetime.now, ...): the clock is injected (utils/clock.py, and the
     Metrics registry's now_ms source), which is what keeps phase timings
     correct under simulated time. The resolution-tier check lives in
-    tools/staticcheck.py (check_clock_injection) so the CLI gate catches it
-    too; this test runs it as part of the ordinary session. The tree is
-    currently clean — keep it that way."""
+    tools/analysis/clocks.py (check_clock_injection) so the CLI gate
+    catches it too; this test runs it as part of the ordinary session.
+    The tree is currently clean — keep it that way."""
     from staticcheck import check_clock_injection
 
     offenders = []
-    for path in _py_files(("rapid_tpu/protocol",)):
+    for path in _py_files(("rapid_tpu/protocol", "rapid_tpu/monitoring")):
         offenders.extend(str(f) for f in check_clock_injection(path))
     assert not offenders, "\n".join(offenders)
 

@@ -1,20 +1,32 @@
-"""Build gate for the resolution-tier static analysis (tools/staticcheck).
+"""Build gate for the resolution-tier static analysis (tools/staticcheck,
+backed by the tools/analysis/ package).
 
 Two halves, matching how the reference treats error-prone: the whole tree
 must be finding-free (the gate), and the analyzer itself must demonstrably
 catch the defect classes it claims — a gate that never bites is
-indistinguishable from no gate.
+indistinguishable from no gate. The seeded corpus under
+tests/data/lint_corpus/ (one file per defect class, expectations embedded
+as ``# expect: <check>`` markers) is the second half for the concurrency
+and trace-safety families.
 """
 
 from __future__ import annotations
 
+import json
+import os
+import re
+import subprocess
 import sys
 import textwrap
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
 
+import pytest  # noqa: E402
+
 import staticcheck  # noqa: E402
+
+CORPUS = Path(__file__).resolve().parent / "data" / "lint_corpus"
 
 
 def _undefined(src: str):
@@ -79,7 +91,7 @@ def _caller_findings(tmp_path, monkeypatch, name: str, callee_src: str, caller_s
     (tmp_path / f"{name}_callee.py").write_text(textwrap.dedent(callee_src))
     caller = tmp_path / f"{name}_caller.py"
     caller.write_text(textwrap.dedent(caller_src))
-    monkeypatch.setattr(staticcheck, "REPO", tmp_path)
+    monkeypatch.setattr(staticcheck.core, "REPO", tmp_path)
     monkeypatch.syspath_prepend(str(tmp_path))
     return staticcheck.check_call_signatures(caller)
 
@@ -184,8 +196,6 @@ def test_str_target_bindings_and_class_bodies_shadow(tmp_path, monkeypatch):
 
 def test_missing_root_fails_loudly():
     # A typo'd or renamed root must error, not shrink coverage to zero.
-    import pytest
-
     with pytest.raises(FileNotFoundError, match="no_such_root"):
         list(staticcheck.iter_files(["no_such_root"]))
 
@@ -266,7 +276,7 @@ def test_narrowed_roots_skip_liveness(tmp_path, monkeypatch):
     # A per-file/per-dir CLI run must not report cross-root consumers'
     # definitions as dead: liveness only runs on full-tree invocations.
     (tmp_path / "only.py").write_text("def consumed_elsewhere(): return 1\n")
-    monkeypatch.setattr(staticcheck, "REPO", tmp_path)
+    monkeypatch.setattr(staticcheck.core, "REPO", tmp_path)
     monkeypatch.syspath_prepend(str(tmp_path))
     findings = staticcheck.run([str(tmp_path / "only.py")])
     assert findings == []
@@ -274,6 +284,251 @@ def test_narrowed_roots_skip_liveness(tmp_path, monkeypatch):
 
 def test_whole_tree_is_finding_free():
     # The gate itself: resolution-tier findings fail the build exactly the
-    # way error-prone fails the reference's.
+    # way error-prone fails the reference's. All six check families run
+    # (names, signatures, clock, dead-defs, concurrency, trace-safety).
     findings = staticcheck.run()
     assert not findings, "\n".join(str(f) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# Driver robustness: syntax errors are findings, not crashes
+# ---------------------------------------------------------------------------
+
+
+def test_syntax_error_is_finding_not_crash(tmp_path, monkeypatch):
+    # One unparseable file must report itself and leave the rest of the
+    # tree analyzed (the old driver crashed the whole gate with a
+    # traceback on the first broken file).
+    (tmp_path / "broken.py").write_text("def f(:\n    return 0\n")
+    (tmp_path / "good.py").write_text("def g():\n    return mesage\n")
+    monkeypatch.setattr(staticcheck.core, "REPO", tmp_path)
+    monkeypatch.syspath_prepend(str(tmp_path))
+    findings = staticcheck.run([str(tmp_path)])
+    assert sorted(f.check for f in findings) == ["syntax-error", "undefined-name"]
+    syntax = next(f for f in findings if f.check == "syntax-error")
+    assert syntax.path.endswith("broken.py") and syntax.lineno == 1
+
+
+# ---------------------------------------------------------------------------
+# Seeded lint corpus: one file per defect class, expectations embedded as
+# `# expect: <check>` markers — exactly those findings and nothing else
+# ---------------------------------------------------------------------------
+
+_EXPECT_RE = re.compile(r"#\s*expect:\s*([a-z][a-z-]*)")
+
+#: corpus file -> (pretend repo path, check function name). The pretend
+#: path places the source inside the prefix each analyzer guards, the way
+#: the clock-injection tests in test_lint.py do.
+_CORPUS_CHECKERS = {
+    "unguarded_mutation.py": ("rapid_tpu/protocol/_corpus.py", "check_concurrency"),
+    "interleaving_hazard.py": ("rapid_tpu/protocol/_corpus.py", "check_concurrency"),
+    "lock_reentrancy.py": ("rapid_tpu/protocol/_corpus.py", "check_concurrency"),
+    "clean_concurrency.py": ("rapid_tpu/protocol/_corpus.py", "check_concurrency"),
+    "jit_side_effect.py": ("rapid_tpu/ops/_corpus.py", "check_trace_safety"),
+    "jit_traced_branch.py": ("rapid_tpu/ops/_corpus.py", "check_trace_safety"),
+    "clean_trace_safety.py": ("rapid_tpu/ops/_corpus.py", "check_trace_safety"),
+}
+
+
+def _expected_markers(path: Path):
+    return sorted(
+        (m.group(1), lineno)
+        for lineno, line in enumerate(path.read_text().splitlines(), 1)
+        if (m := _EXPECT_RE.search(line))
+    )
+
+
+def test_corpus_is_complete():
+    # Every corpus file is consumed by exactly one parametrized case below
+    # (a stray file would silently be a no-op fixture).
+    on_disk = {p.name for p in CORPUS.glob("*.py")}
+    assert on_disk == set(_CORPUS_CHECKERS) | {"syntax_error.py"}
+
+
+@pytest.mark.parametrize("name", sorted(_CORPUS_CHECKERS))
+def test_lint_corpus(name):
+    pretend_rel, checker_name = _CORPUS_CHECKERS[name]
+    checker = getattr(staticcheck, checker_name)
+    source = (CORPUS / name).read_text()
+    findings = checker(staticcheck.core.REPO / pretend_rel, source=source)
+    got = sorted((f.check, f.lineno) for f in findings)
+    assert got == _expected_markers(CORPUS / name), "\n".join(
+        str(f) for f in findings
+    )
+
+
+def test_lint_corpus_syntax_error():
+    # Fed through the real driver (an explicit file root bypasses the
+    # corpus exclusion): the parse failure becomes the file's one finding.
+    findings = staticcheck.run([str(CORPUS / "syntax_error.py")])
+    got = sorted((f.check, f.lineno) for f in findings)
+    assert got == _expected_markers(CORPUS / "syntax_error.py")
+
+
+def test_corpus_is_excluded_from_tree_sweeps():
+    # The corpus exists to be defective; directory walks must skip it or
+    # the whole-tree gate fails on purpose-built defects.
+    swept = {str(p) for p in staticcheck.iter_files(("tests",))}
+    assert not any("lint_corpus" in p for p in swept)
+
+
+# ---------------------------------------------------------------------------
+# Concurrency analyzer unit behaviors not covered by the corpus
+# ---------------------------------------------------------------------------
+
+
+def _concurrency(source: str, rel: str = "rapid_tpu/protocol/_probe.py"):
+    return staticcheck.check_concurrency(
+        staticcheck.core.REPO / rel, source=textwrap.dedent(source)
+    )
+
+
+def test_concurrency_checks_gate_on_package_prefix():
+    src = """
+    import asyncio
+
+    class C:
+        def __init__(self):
+            self._lock = asyncio.Lock()
+            self._x = 0  # guarded-by: _lock
+
+        async def poke(self):
+            self._x += 1
+    """
+    assert [f.check for f in _concurrency(src)] == ["unguarded-mutation"]
+    assert _concurrency(src, rel="rapid_tpu/utils/_probe.py") == []
+
+
+def test_guarded_by_annotation_typo_is_flagged():
+    # A typo'd lock name must fail the gate, not silently guard nothing.
+    src = """
+    import asyncio
+
+    class C:
+        def __init__(self):
+            self._lock = asyncio.Lock()
+            self._x = 0  # guarded-by: _lokc
+    """
+    findings = _concurrency(src)
+    assert [f.check for f in findings] == ["guarded-by-annotation"]
+    assert "_lokc" in findings[0].message
+
+
+def test_unguarded_ok_comment_allowlists_a_mutation():
+    src = """
+    import asyncio
+
+    class C:
+        def __init__(self):
+            self._lock = asyncio.Lock()
+            self._x = 0  # guarded-by: _lock
+
+        async def poke(self):
+            self._x += 1  # unguarded-ok: single-writer during bootstrap
+    """
+    assert _concurrency(src) == []
+
+
+def test_escaped_and_unknown_contexts_are_skipped():
+    # Methods registered as callbacks (or never called intra-class) have
+    # unknowable lock contexts: mutations there must not convict.
+    src = """
+    import asyncio
+
+    class C:
+        def __init__(self, bus):
+            self._lock = asyncio.Lock()
+            self._x = 0  # guarded-by: _lock
+            bus.subscribe(self._on_event)
+
+        def _on_event(self, _evt):
+            self._x += 1  # callback: context unknown, skip
+
+        def _never_called_here(self):
+            self._x += 1  # no intra-class call site: skip
+    """
+    assert _concurrency(src) == []
+
+
+# ---------------------------------------------------------------------------
+# Clock-injection extensions (time_ns, datetime spellings, monitoring/)
+# ---------------------------------------------------------------------------
+
+
+def test_clock_check_covers_new_spellings_and_monitoring():
+    src = textwrap.dedent(
+        """
+        import time
+        import datetime
+
+        def stamp():
+            return (
+                time.time_ns(),
+                datetime.datetime.now(),
+            )
+        """
+    )
+    for rel in ("rapid_tpu/protocol/_probe.py", "rapid_tpu/monitoring/_probe.py"):
+        findings = staticcheck.check_clock_injection(
+            staticcheck.core.REPO / rel, source=src
+        )
+        assert [f.check for f in findings] == ["clock-injection"] * 2, findings
+    outside = staticcheck.check_clock_injection(
+        staticcheck.core.REPO / "rapid_tpu" / "utils" / "_probe.py", source=src
+    )
+    assert outside == []
+
+
+def test_wall_clock_ok_comment_allowlists_a_read():
+    src = textwrap.dedent(
+        """
+        import time
+
+        def stamp():
+            return time.time()  # wall-clock-ok: operator-facing log line
+        """
+    )
+    findings = staticcheck.check_clock_injection(
+        staticcheck.core.REPO / "rapid_tpu" / "monitoring" / "_probe.py", source=src
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# CLI contract: --json / --select / --ignore, human output + exit codes
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    script = Path(staticcheck.__file__).resolve()
+    return subprocess.run(
+        [sys.executable, str(script), *args],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+
+
+def test_cli_json_select_ignore_and_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f():\n    return mesage\n")
+
+    as_json = _run_cli("--json", str(bad))
+    assert as_json.returncode == 1
+    objs = [json.loads(line) for line in as_json.stdout.splitlines()]
+    assert [(o["check"], o["lineno"]) for o in objs] == [("undefined-name", 2)]
+    assert objs[0]["path"] == str(bad) and "mesage" in objs[0]["message"]
+
+    human = _run_cli(str(bad))
+    assert human.returncode == 1
+    assert "[undefined-name]" in human.stdout
+    assert human.stdout.strip().endswith("staticcheck: 1 finding(s)")
+
+    ignored = _run_cli("--ignore", "undefined-name", str(bad))
+    assert ignored.returncode == 0
+    assert ignored.stdout.strip().endswith("staticcheck: 0 finding(s)")
+
+    selected = _run_cli("--select", "clock-injection", "--json", str(bad))
+    assert selected.returncode == 0 and selected.stdout.strip() == ""
+
+    typo = _run_cli("--select", "no-such-check", str(bad))
+    assert typo.returncode == 2 and "no-such-check" in typo.stderr

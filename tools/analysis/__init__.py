@@ -1,0 +1,60 @@
+"""Resolution-grade static analysis for this repo, as a package.
+
+The reference fails its build on error-prone (-Werror), findbugs, and
+checkstyle findings (root pom.xml + build-common/); the AST style gate in
+tests/test_lint.py covers the checkstyle analog, and this package plays the
+error-prone role — the class of checks that needs RESOLUTION, not just
+syntax. This environment ships no ruff/mypy/pyflakes, so the tier is built
+on the stdlib (``ast``, ``symtable``, ``inspect``).
+
+Check families (one module each; ``core`` owns the driver/CLI/Finding):
+
+1. ``names``        — undefined names (symtable scope resolution)
+2. ``signatures``   — call-signature conformance vs imported runtime modules
+3. ``clocks``       — clock-injection discipline (protocol + monitoring)
+4. ``deadcode``     — dead module-level definitions (tree-wide liveness)
+5. ``concurrency``  — asyncio guarded-by discipline, interleaving hazards,
+                      lock re-entrancy (protocol + messaging)
+6. ``trace_safety`` — JAX jit purity/staticness (ops)
+
+Shared philosophy: conservative resolution, zero-false-positive findings,
+skip-don't-guess. Run via ``python tools/staticcheck.py`` (the compatible
+CLI shim) or the build gate in tests/test_staticcheck.py.
+"""
+
+from __future__ import annotations
+
+from . import core
+from .clocks import CLOCK_DISCIPLINE_PREFIXES, check_clock_injection
+from .concurrency import CONCURRENCY_PREFIXES, check_concurrency
+from .core import (
+    ALL_CHECK_NAMES,
+    DEFAULT_ROOTS,
+    Finding,
+    iter_files,
+    main,
+    run,
+)
+from .deadcode import check_dead_definitions
+from .names import check_undefined_names
+from .signatures import check_call_signatures
+from .trace_safety import TRACE_SAFETY_PREFIXES, check_trace_safety
+
+__all__ = [
+    "ALL_CHECK_NAMES",
+    "CLOCK_DISCIPLINE_PREFIXES",
+    "CONCURRENCY_PREFIXES",
+    "DEFAULT_ROOTS",
+    "Finding",
+    "TRACE_SAFETY_PREFIXES",
+    "check_call_signatures",
+    "check_clock_injection",
+    "check_concurrency",
+    "check_dead_definitions",
+    "check_trace_safety",
+    "check_undefined_names",
+    "core",
+    "iter_files",
+    "main",
+    "run",
+]

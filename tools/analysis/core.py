@@ -1,0 +1,193 @@
+"""Driver, Finding model, and CLI for the resolution-tier static analysis.
+
+The per-check modules (names, signatures, clocks, deadcode, concurrency,
+trace_safety) each export a ``check_*`` function over one parsed file; this
+module owns everything shared: the ``Finding`` record, the root list, file
+iteration (with the fixture-corpus exclusion), the ``run()`` driver that
+parses each file once and fans it out to every check, and the CLI
+(``--json``/``--select``/``--ignore``).
+
+``REPO`` is read through this module at call time (``core.REPO``), never
+imported by value, so tests can retarget the whole analysis at a temporary
+tree with one monkeypatch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import sys
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+DEFAULT_ROOTS = (
+    "rapid_tpu", "tests", "examples", "tools", "bench.py", "__graft_entry__.py"
+)
+
+#: Subtrees holding fixture DATA, not code under analysis: the seeded lint
+#: corpus (tests/data/lint_corpus/) exists to be defective, so sweeping it
+#: into the gate would fail the build on purpose-built defects. Explicit
+#: file roots bypass this (naming a corpus file on the CLI analyzes it).
+EXCLUDED_SUBTREES = ("tests/data/",)
+
+#: Mutating methods of the stdlib containers shared state lives in — the
+#: single source of truth for both the concurrency analyzer (guarded-field
+#: mutation sites) and the trace-safety analyzer (closed-over container
+#: mutation inside jit). One list so the two can never drift apart.
+MUTATING_CONTAINER_METHODS = frozenset({
+    "append", "extend", "insert", "add", "discard", "remove", "pop",
+    "popitem", "clear", "update", "setdefault", "move_to_end", "appendleft",
+    "popleft", "sort", "reverse",
+})
+
+#: Every check name any analyzer can emit — the vocabulary ``--select`` /
+#: ``--ignore`` validate against (a typo'd filter must error, not silently
+#: select nothing and report a green build).
+ALL_CHECK_NAMES = frozenset({
+    "syntax-error",
+    "star-import",
+    "undefined-name",
+    "call-signature",
+    "missing-attribute",
+    "import-error",
+    "clock-injection",
+    "dead-definition",
+    "guarded-by-annotation",
+    "unguarded-mutation",
+    "interleaving-hazard",
+    "lock-reentrancy",
+    "jit-side-effect",
+    "jit-traced-branch",
+})
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    lineno: int
+    check: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.lineno}: [{self.check}] {self.message}"
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"path": self.path, "lineno": self.lineno, "check": self.check,
+             "message": self.message}
+        )
+
+
+def rel(path: Path) -> str:
+    try:
+        return str(path.resolve().relative_to(REPO))
+    except ValueError:
+        return str(path)
+
+
+def iter_files(roots: Sequence[str] = DEFAULT_ROOTS) -> Iterable[Path]:
+    for root in roots:
+        path = (REPO / root) if not Path(root).is_absolute() else Path(root)
+        if path.is_file():
+            yield path  # explicit file roots are never excluded
+        elif path.is_dir():
+            for sub in sorted(path.rglob("*.py")):
+                posix = rel(sub).replace("\\", "/")
+                if any(posix.startswith(ex) for ex in EXCLUDED_SUBTREES):
+                    continue
+                yield sub
+        else:
+            # A typo'd or since-renamed root must fail the gate, not
+            # silently exempt that tree from analysis.
+            raise FileNotFoundError(f"staticcheck root does not exist: {path}")
+
+
+def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
+    # The per-file check imports live here (not module top level) so the
+    # CLI shim can import this module before sys.path is fully arranged.
+    from . import clocks, concurrency, deadcode, names, signatures, trace_safety
+
+    per_file_checks = (
+        names.check_undefined_names,
+        signatures.check_call_signatures,
+        clocks.check_clock_injection,
+        concurrency.check_concurrency,
+        trace_safety.check_trace_safety,
+    )
+    # Mirror pytest's rootdir behavior: test modules import suite-local
+    # helpers both as `tests.helpers` and bare `helpers`. Insert at the
+    # FRONT: `tools`/`tests` are common top-level names, and a foreign
+    # package earlier on sys.path would shadow this repo's namespace
+    # packages and produce spurious import-error findings.
+    for entry in (str(REPO), str(REPO / "tests")):
+        if entry in sys.path:
+            sys.path.remove(entry)
+        sys.path.insert(0, entry)
+    findings: List[Finding] = []
+    trees: List[Tuple[ast.AST, str]] = []  # one parse per file, shared
+    for path in iter_files(roots):
+        src = path.read_text()
+        try:
+            tree = ast.parse(src, filename=str(path))
+        except SyntaxError as exc:
+            # One broken file must not abort the whole gate: report it as a
+            # finding and keep analyzing the rest of the tree.
+            findings.append(
+                Finding(rel(path), exc.lineno or 1, "syntax-error",
+                        f"cannot parse: {exc.msg}")
+            )
+            continue
+        trees.append((tree, rel(path)))
+        for check in per_file_checks:
+            findings.extend(check(path, src, tree))
+    if tuple(roots) == DEFAULT_ROOTS:
+        # Liveness is only meaningful over the FULL tree: with narrowed CLI
+        # roots, code consumed from outside the subset would be reported as
+        # dead — so the check runs only on complete invocations.
+        findings.extend(deadcode.check_dead_definitions(trees))
+    return findings
+
+
+def _check_name_set(parser: argparse.ArgumentParser, spec: str, flag: str) -> set:
+    names = {n.strip() for n in spec.split(",") if n.strip()}
+    unknown = names - ALL_CHECK_NAMES
+    if unknown:
+        parser.error(
+            f"{flag}: unknown check name(s) {sorted(unknown)}; "
+            f"valid: {', '.join(sorted(ALL_CHECK_NAMES))}"
+        )
+    return names
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="staticcheck",
+        description="Resolution-tier static analysis (see tools/analysis/).",
+    )
+    parser.add_argument("roots", nargs="*", help="files/dirs (default: whole tree)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="one JSON object per finding per line")
+    parser.add_argument("--select", default=None, metavar="CHECKS",
+                        help="comma-separated check names to keep")
+    parser.add_argument("--ignore", default=None, metavar="CHECKS",
+                        help="comma-separated check names to drop")
+    args = parser.parse_args(argv)
+    findings = run(args.roots or DEFAULT_ROOTS)
+    if args.select:
+        keep = _check_name_set(parser, args.select, "--select")
+        findings = [f for f in findings if f.check in keep]
+    if args.ignore:
+        drop = _check_name_set(parser, args.ignore, "--ignore")
+        findings = [f for f in findings if f.check not in drop]
+    if args.as_json:
+        for f in findings:
+            print(f.to_json())
+    else:
+        for f in findings:
+            print(f)
+        print(f"staticcheck: {len(findings)} finding(s)")
+    return 1 if findings else 0

@@ -1,0 +1,374 @@
+"""Check family 6: JAX jit trace-safety (purity + staticness lint).
+
+Functions under ``jax.jit`` execute their Python body ONCE per trace, then
+replay the captured computation: a Python side effect fires on trace, not
+per call; a wall-clock or RNG-module read bakes one trace-time value into
+the compiled program forever; and an ``if``/``while`` on a traced value
+raises ``TracerBoolConversionError`` — but only on the first call that
+reaches it, which is exactly the kind of latent error the quorum-math
+kernels in ``rapid_tpu/ops/`` cannot afford (the decision rule must
+vectorize identically on every invocation).
+
+For every function decorated ``@jax.jit`` / ``@partial(jax.jit, ...)`` /
+``@functools.partial(jax.jit, ...)`` — or wrapped at module level via
+``g = jax.jit(f, ...)`` — this checks:
+
+- ``jit-side-effect`` — ``print`` calls (``jax.debug.print`` is the
+  sanctioned spelling), ``global``/``nonlocal`` declarations, mutation of
+  closed-over/global containers, stores to free names' attributes or
+  subscripts, and trace-time impure reads: ``time.*`` wall clocks,
+  ``datetime.now``, and Python-RNG module draws (``random.*``,
+  ``np.random.*`` — device RNG goes through ``jax.random`` keys).
+- ``jit-traced-branch`` — an ``if``/``while`` whose test reads a traced
+  (non-``static_argnames``/``static_argnums``) parameter directly.
+  Exempt, because they are resolved at trace time: ``x is None`` /
+  ``x is not None`` pytree-structure tests, and ``.shape``/``.ndim``/
+  ``.dtype``/``.size`` metadata reads.
+
+Resolution is conservative (skip-don't-guess): only decorations that
+statically resolve to ``jax.jit`` through this module's own imports are
+analyzed, and only direct parameter reads convict a branch.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import core
+from .core import Finding
+
+_MUTATORS = core.MUTATING_CONTAINER_METHODS
+
+TRACE_SAFETY_PREFIXES = ("rapid_tpu/ops/",)
+
+_WALL_CLOCK_ATTRS = frozenset({
+    "time", "time_ns", "perf_counter", "perf_counter_ns",
+    "monotonic", "monotonic_ns",
+})
+
+_RNG_ATTRS = frozenset({
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "shuffle", "sample", "getrandbits", "gauss", "normalvariate", "seed",
+})
+
+_STATIC_METADATA_ATTRS = frozenset({"shape", "ndim", "dtype", "size"})
+
+
+def _import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Module-level name -> dotted runtime path, for resolving ``jax.jit``
+    and ``partial`` spellings through whatever aliases the file uses."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                aliases[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                if alias.name != "*":
+                    aliases[alias.asname or alias.name] = f"{node.module}.{alias.name}"
+    return aliases
+
+
+def _dotted(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id, node.id)
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value, aliases)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _static_params(
+    call: Optional[ast.Call], fn: ast.AST
+) -> Optional[Set[str]]:
+    """Parameter names pinned static by a ``jit``/``partial(jit, ...)``
+    call's ``static_argnames``/``static_argnums``; None = unresolvable
+    (dynamic spec: skip the function, don't guess)."""
+    static: Set[str] = set()
+    if call is None:
+        return static
+    args = fn.args
+    positional = [a.arg for a in (*args.posonlyargs, *args.args)]
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names = kw.value
+            if isinstance(names, ast.Constant) and isinstance(names.value, str):
+                static.add(names.value)
+            elif isinstance(names, (ast.Tuple, ast.List)):
+                for elt in names.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                        static.add(elt.value)
+                    else:
+                        return None
+            else:
+                return None
+        elif kw.arg == "static_argnums":
+            nums = kw.value
+            elts = (
+                nums.elts if isinstance(nums, (ast.Tuple, ast.List)) else [nums]
+            )
+            for elt in elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                    if elt.value < len(positional):
+                        static.add(positional[elt.value])
+                else:
+                    return None
+    return static
+
+
+def _jitted_functions(
+    tree: ast.AST, aliases: Dict[str, str]
+) -> List[Tuple[ast.AST, Set[str]]]:
+    """(function node, static param names) for every statically-resolvable
+    jit application in the module."""
+    out: List[Tuple[ast.AST, Set[str]]] = []
+    partials = {"functools.partial", "partial"}
+    by_name = {
+        n.name: n
+        for n in tree.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _dotted(dec, aliases) == "jax.jit":
+                    out.append((node, set()))
+                elif (
+                    isinstance(dec, ast.Call)
+                    and _dotted(dec.func, aliases) in partials
+                    and dec.args
+                    and _dotted(dec.args[0], aliases) == "jax.jit"
+                ):
+                    static = _static_params(dec, node)
+                    if static is not None:
+                        out.append((node, static))
+    # Module-level wrapping: g = jax.jit(f, static_argnums=...)
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and _dotted(node.value.func, aliases) == "jax.jit"
+            and node.value.args
+            and isinstance(node.value.args[0], ast.Name)
+            and node.value.args[0].id in by_name
+        ):
+            fn = by_name[node.value.args[0].id]
+            static = _static_params(node.value, fn)
+            if static is not None:
+                out.append((fn, static))
+    return out
+
+
+def _bound_names(fn: ast.AST) -> Set[str]:
+    """Every name bound anywhere within the function's scope tree (params,
+    assignments, loop/with/comprehension targets, nested defs and their
+    params): mutating one of these is traced-local, not a side effect."""
+    bound: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            a = node.args
+            for arg in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+                bound.add(arg.arg)
+            if a.vararg:
+                bound.add(a.vararg.arg)
+            if a.kwarg:
+                bound.add(a.kwarg.arg)
+            if not isinstance(node, ast.Lambda):
+                bound.add(node.name)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, (ast.Store, ast.Del)):
+            bound.add(node.id)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            bound.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                if alias.name != "*":
+                    bound.add(alias.asname or alias.name.split(".")[0])
+    return bound
+
+
+def _check_side_effects(
+    fn: ast.AST, rel: str, findings: List[Finding]
+) -> None:
+    bound = _bound_names(fn)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            if node.func.id == "print" and "print" not in bound:
+                findings.append(
+                    Finding(rel, node.lineno, "jit-side-effect",
+                            f"print() inside jitted {fn.name!r} fires once "
+                            "per trace, not per call — use jax.debug.print")
+                )
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            findings.append(
+                Finding(rel, node.lineno, "jit-side-effect",
+                        f"{'global' if isinstance(node, ast.Global) else 'nonlocal'} "
+                        f"write inside jitted {fn.name!r}: the rebinding "
+                        "happens at trace time only")
+            )
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            base = node.func.value
+            attr = node.func.attr
+            if (
+                attr in _MUTATORS
+                and isinstance(base, ast.Name)
+                and base.id not in bound
+            ):
+                findings.append(
+                    Finding(rel, node.lineno, "jit-side-effect",
+                            f"mutation of closed-over/global container "
+                            f"{base.id!r} inside jitted {fn.name!r}: happens "
+                            "once per trace, not per call")
+                )
+            elif (
+                isinstance(base, ast.Name)
+                and base.id == "time"
+                and "time" not in bound
+                and attr in _WALL_CLOCK_ATTRS
+            ):
+                findings.append(
+                    Finding(rel, node.lineno, "jit-side-effect",
+                            f"wall-clock read time.{attr} inside jitted "
+                            f"{fn.name!r}: the trace-time value is baked "
+                            "into the compiled program")
+                )
+            elif (
+                attr == "now"
+                and isinstance(base, ast.Name)
+                and base.id == "datetime"
+                and "datetime" not in bound
+            ):
+                findings.append(
+                    Finding(rel, node.lineno, "jit-side-effect",
+                            f"wall-clock read datetime.now inside jitted "
+                            f"{fn.name!r}: the trace-time value is baked "
+                            "into the compiled program")
+                )
+            elif (
+                attr in _RNG_ATTRS
+                and isinstance(base, ast.Name)
+                and base.id == "random"
+                and "random" not in bound
+            ):
+                findings.append(
+                    Finding(rel, node.lineno, "jit-side-effect",
+                            f"Python RNG read random.{attr} inside jitted "
+                            f"{fn.name!r}: one trace-time draw is baked in — "
+                            "use jax.random with an explicit key")
+                )
+            elif (
+                isinstance(base, ast.Attribute)
+                and base.attr == "random"
+                and isinstance(base.value, ast.Name)
+                and base.value.id in ("np", "numpy")
+                and base.value.id not in bound
+            ):
+                findings.append(
+                    Finding(rel, node.lineno, "jit-side-effect",
+                            f"numpy RNG read {base.value.id}.random.{attr} "
+                            f"inside jitted {fn.name!r}: one trace-time draw "
+                            "is baked in — use jax.random with an explicit key")
+                )
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for target in targets:
+                inner = target
+                while isinstance(inner, (ast.Subscript, ast.Attribute)):
+                    inner = inner.value
+                if (
+                    isinstance(inner, ast.Name)
+                    and inner is not target
+                    and inner.id not in bound
+                ):
+                    findings.append(
+                        Finding(rel, node.lineno, "jit-side-effect",
+                                f"store into closed-over/global {inner.id!r} "
+                                f"inside jitted {fn.name!r}: happens once per "
+                                "trace, not per call")
+                    )
+
+
+def _is_none_guard_names(test: ast.AST) -> Set[int]:
+    """ids of Name nodes used only as ``x is None`` / ``x is not None``
+    operands — pytree-structure tests resolved at trace time."""
+    exempt: Set[int] = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            operands = [node.left, *node.comparators]
+            if any(
+                isinstance(o, ast.Constant) and o.value is None for o in operands
+            ):
+                for o in operands:
+                    if isinstance(o, ast.Name):
+                        exempt.add(id(o))
+    return exempt
+
+
+def _check_traced_branches(
+    fn: ast.AST, static: Set[str], rel: str, findings: List[Finding]
+) -> None:
+    params = set()
+    a = fn.args
+    for arg in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+        params.add(arg.arg)
+    traced = params - static
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        exempt = _is_none_guard_names(node.test)
+        metadata_bases = {
+            id(attr.value)
+            for attr in ast.walk(node.test)
+            if isinstance(attr, ast.Attribute)
+            and attr.attr in _STATIC_METADATA_ATTRS
+        }
+        for name in ast.walk(node.test):
+            if (
+                isinstance(name, ast.Name)
+                and name.id in traced
+                and id(name) not in exempt
+                and id(name) not in metadata_bases
+            ):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                findings.append(
+                    Finding(rel, node.lineno, "jit-traced-branch",
+                            f"`{kind}` in jitted {fn.name!r} tests traced "
+                            f"parameter {name.id!r} — trace-time Python "
+                            "control flow cannot branch on device values "
+                            "(add it to static_argnames, or use jnp.where/"
+                            "lax.cond)")
+                )
+                break
+    return None
+
+
+def check_trace_safety(
+    path: Path,
+    source: Optional[str] = None,
+    tree: "Optional[ast.AST]" = None,
+) -> List[Finding]:
+    rel = core.rel(path)
+    posix = rel.replace("\\", "/")
+    if not any(posix.startswith(p) for p in TRACE_SAFETY_PREFIXES):
+        return []
+    src = source if source is not None else path.read_text()
+    if tree is None:
+        tree = ast.parse(src, filename=str(path))
+    aliases = _import_aliases(tree)
+    findings: List[Finding] = []
+    seen_fns = set()
+    for fn, static in _jitted_functions(tree, aliases):
+        key = (fn.lineno, frozenset(static))
+        if key in seen_fns:
+            continue
+        seen_fns.add(key)
+        _check_side_effects(fn, rel, findings)
+        _check_traced_branches(fn, static, rel, findings)
+    return sorted(set(findings), key=lambda f: (f.lineno, f.check, f.message))

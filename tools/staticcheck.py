@@ -1,596 +1,73 @@
-"""Static-analysis tier: scope resolution + call-signature conformance.
+"""Static-analysis tier — compatible CLI/entry shim over tools/analysis/.
 
-The reference fails its build on error-prone (-Werror), findbugs, and
-checkstyle findings (root pom.xml + build-common/); the AST style gate in
-tests/test_lint.py covers the checkstyle analog, but nothing played the
-error-prone role — the class of checks that needs RESOLUTION, not just
-syntax: does this name exist, does this call match the callee's signature.
-This environment ships no ruff/mypy/pyflakes, so this module implements
-that tier on the stdlib:
+The analyzers grew from two check families into six and moved into the
+``tools/analysis/`` package (core driver + Finding model + one module per
+family — see its docstring for the catalog). This module stays as the
+stable entry point: ``python tools/staticcheck.py [--json] [--select ...]
+[--ignore ...] [paths...]`` and ``import staticcheck`` both keep working,
+re-exporting the package API unchanged.
 
-1. **Undefined names** (`check_undefined_names`) — compiler-grade scope
-   analysis via ``symtable``: every name a scope reads through the global
-   scope must be bound at module level (import/assign/def/class), declared
-   ``global`` and assigned in some function, or a builtin. Catches typos in
-   rarely-executed paths (the error branch that NameErrors only when the
-   error happens), which no test-coverage gate can promise to reach.
-
-2. **Call conformance** (`check_call_signatures`) — for call sites whose
-   callee statically resolves to a module-level object of an imported
-   module (``f(...)`` where ``f`` is module-global in the calling module,
-   or ``mod.f(...)`` where ``mod`` is a module-level module import), bind
-   the call's shape (positional arity + keyword names) against
-   ``inspect.signature`` of the real runtime object. Catches wrong-arity
-   calls, typo'd keywords, and stale references to renamed module
-   attributes — the highest-value slice of what a type checker does for a
-   dynamically-typed codebase. Resolution is deliberately conservative:
-   names shadowed in any enclosing function scope, call sites using
-   ``*args``/``**kwargs``, and objects whose signature is undiscoverable
-   are all skipped, so every finding is a real defect, never a maybe.
-
-Run as a CLI (``python tools/staticcheck.py [paths...]``; nonzero exit on
-findings) or via the build gate in tests/test_staticcheck.py. Importing a
-module to inspect its runtime surface follows the import-time platform
-rules: under pytest, tests/conftest.py has already forced the CPU backend.
+Tests that retarget the analysis at a temporary tree patch
+``staticcheck.core.REPO`` (the package reads it at call time).
 """
 
 from __future__ import annotations
 
-import ast
-import builtins
-import importlib
-import inspect
-import re
-import symtable
 import sys
-import types
-from dataclasses import dataclass
 from pathlib import Path
-from typing import Iterable, List, Optional, Sequence, Tuple
 
-REPO = Path(__file__).resolve().parent.parent
+# The package lives next to this shim. Resolve it regardless of how the
+# shim itself was imported (`staticcheck` with tools/ on sys.path, or
+# `tools.staticcheck` during the gate's own call-signature pass).
+_TOOLS_DIR = str(Path(__file__).resolve().parent)
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
 
-# Module-scope dunders the compiler binds implicitly.
-_IMPLICIT_GLOBALS = {
-    "__name__", "__file__", "__doc__", "__package__", "__spec__",
-    "__loader__", "__builtins__", "__debug__", "__annotations__",
-    "__path__", "__dict__", "__class__",
-}
-
-
-@dataclass(frozen=True)
-class Finding:
-    path: str
-    lineno: int
-    check: str
-    message: str
-
-    def __str__(self) -> str:
-        return f"{self.path}:{self.lineno}: [{self.check}] {self.message}"
-
-
-# ---------------------------------------------------------------------------
-# Check 1: undefined names (symtable scope resolution)
-# ---------------------------------------------------------------------------
-
-
-def _global_assigned_names(table: symtable.SymbolTable) -> set:
-    """Names any nested scope both declares ``global`` and assigns — those
-    are module-bound at runtime even if never assigned at module scope."""
-    names = set()
-    for sym in table.get_symbols():
-        if sym.is_global() and sym.is_assigned():
-            names.add(sym.get_name())
-    for child in table.get_children():
-        names |= _global_assigned_names(child)
-    return names
-
-
-def _undefined_in_table(
-    table: symtable.SymbolTable,
-    bound: set,
-    rel: str,
-    load_lines: dict,
-    findings: List[Finding],
-) -> None:
-    for sym in table.get_symbols():
-        if not (sym.is_global() and sym.is_referenced()):
-            continue
-        name = sym.get_name()
-        if name in bound or hasattr(builtins, name) or name in _IMPLICIT_GLOBALS:
-            continue
-        # Point at the offending READ, not the enclosing def: the first
-        # load site at or after the scope's start line (falling back to the
-        # first in the file — scope start is a lower bound, good enough to
-        # land inside the right function).
-        scope_start = table.get_lineno()
-        lines = load_lines.get(name, [])
-        lineno = next((ln for ln in lines if ln >= scope_start),
-                      lines[0] if lines else scope_start)
-        findings.append(
-            Finding(
-                rel,
-                lineno,
-                "undefined-name",
-                f"{name!r} (read in {table.get_type()} "
-                f"{table.get_name()!r}) is bound nowhere at module scope "
-                "and is not a builtin",
-            )
-        )
-    for child in table.get_children():
-        _undefined_in_table(child, bound, rel, load_lines, findings)
-
-
-def check_undefined_names(
-    path: Path,
-    source: Optional[str] = None,
-    tree: "Optional[ast.AST]" = None,
-) -> List[Finding]:
-    """Every name resolving through the global scope must exist there."""
-    src = source if source is not None else path.read_text()
-    rel = _rel(path)
-    if tree is None:
-        tree = ast.parse(src, filename=str(path))
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and any(
-            a.name == "*" for a in node.names
-        ):
-            # A star import makes the global namespace statically unknowable;
-            # flag the import itself rather than silently skipping the file.
-            return [
-                Finding(rel, node.lineno, "star-import",
-                        "wildcard import defeats scope analysis")
-            ]
-    table = symtable.symtable(src, str(path), "exec")
-    bound = {s.get_name() for s in table.get_symbols() if s.is_local()}
-    bound |= _global_assigned_names(table)
-    load_lines: dict = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
-            load_lines.setdefault(node.id, []).append(node.lineno)
-    for lines in load_lines.values():
-        lines.sort()
-    findings: List[Finding] = []
-    _undefined_in_table(table, bound, rel, load_lines, findings)
-    return findings
-
-
-# ---------------------------------------------------------------------------
-# Check 2: call-signature conformance against imported runtime modules
-# ---------------------------------------------------------------------------
-
-
-class _ScopeStack:
-    """Tracks, per enclosing function/lambda/comprehension scope, the names
-    bound locally — so a module-global resolution is only trusted when no
-    enclosing scope shadows the name."""
-
-    def __init__(self) -> None:
-        self.stack: List[set] = []
-
-    def shadowed(self, name: str) -> bool:
-        return any(name in scope for scope in self.stack)
-
-
-def _local_bindings(node: ast.AST) -> set:
-    """Names bound in THIS function scope (params, assignments, imports,
-    inner defs) — without descending into nested function scopes."""
-    names = set()
-    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
-        a = node.args
-        for arg in [*a.posonlyargs, *a.args, *a.kwonlyargs]:
-            names.add(arg.arg)
-        if a.vararg:
-            names.add(a.vararg.arg)
-        if a.kwarg:
-            names.add(a.kwarg.arg)
-    body = getattr(node, "body", [])
-    stack = list(body) if isinstance(body, list) else []
-    while stack:
-        cur = stack.pop()
-        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-            names.add(cur.name)
-            continue  # nested scope: its internals don't bind here
-        if isinstance(cur, ast.Lambda):
-            continue
-        if isinstance(cur, ast.Name) and isinstance(cur.ctx, (ast.Store, ast.Del)):
-            names.add(cur.id)
-        # Bindings whose target is a plain str, not a Name node:
-        if isinstance(cur, ast.ExceptHandler) and cur.name:
-            names.add(cur.name)
-        if isinstance(cur, (ast.MatchAs, ast.MatchStar)) and cur.name:
-            names.add(cur.name)
-        if isinstance(cur, ast.MatchMapping) and cur.rest:
-            names.add(cur.rest)
-        if isinstance(cur, (ast.Import, ast.ImportFrom)):
-            for alias in cur.names:
-                if alias.name != "*":
-                    names.add(alias.asname or alias.name.split(".")[0])
-        if isinstance(cur, (ast.Global, ast.Nonlocal)):
-            # Declared non-local: reads go to the outer binding — but for
-            # shadow-tracking, treating as local only SKIPS checks (safe).
-            names.update(cur.names)
-        stack.extend(ast.iter_child_nodes(cur))
-    return names
-
-
-def _module_name_for(path: Path) -> Optional[str]:
-    """Import path for a repo file, or None if it isn't importable as a
-    module of this repo (scripts are importable top-level: bench, etc.)."""
-    try:
-        rel = path.resolve().relative_to(REPO)
-    except ValueError:
-        return None
-    parts = rel.with_suffix("").parts
-    if parts[-1] == "__init__":
-        parts = parts[:-1]
-    return ".".join(parts) if parts else None
-
-
-def _bindable(sig: inspect.Signature) -> bool:
-    """Signatures with *args/**kwargs accept almost anything; checking them
-    would only ever produce noise."""
-    return not any(
-        p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD)
-        for p in sig.parameters.values()
-    )
-
-
-def _try_signature(obj) -> Optional[inspect.Signature]:
-    try:
-        return inspect.signature(obj)
-    except (ValueError, TypeError):
-        return None
-
-
-def _check_one_call(
-    call: ast.Call, obj, dotted: str, rel: str, findings: List[Finding]
-) -> None:
-    if any(isinstance(a, ast.Starred) for a in call.args):
-        return
-    if any(kw.arg is None for kw in call.keywords):  # **kwargs at site
-        return
-    sig = _try_signature(obj)
-    if sig is None or not _bindable(sig):
-        return
-    # Bound methods/classmethods accessed via instance aren't resolved here
-    # (module-level objects only), so no self-adjustment is needed.
-    placeholders = [object()] * len(call.args)
-    kwargs = {kw.arg: object() for kw in call.keywords}
-    try:
-        sig.bind(*placeholders, **kwargs)
-    except TypeError as exc:
-        findings.append(
-            Finding(rel, call.lineno, "call-signature",
-                    f"{dotted}{sig} cannot bind this call: {exc}")
-        )
-
-
-def check_call_signatures(
-    path: Path,
-    source: Optional[str] = None,
-    tree: "Optional[ast.AST]" = None,
-) -> List[Finding]:
-    """Arity/keyword conformance for statically-resolvable call sites, plus
-    existence of ``mod.attr`` references on module-level module imports."""
-    src = source if source is not None else path.read_text()
-    rel = _rel(path)
-    if tree is None:
-        tree = ast.parse(src, filename=str(path))
-    mod_name = _module_name_for(path)
-    if mod_name is None:
-        return []
-    try:
-        module = importlib.import_module(mod_name)
-    except BaseException as exc:  # noqa: BLE001 — any import failure is a finding
-        # BaseException, not Exception: pytest.importorskip raises Skipped,
-        # which subclasses BaseException so that test code can't swallow it
-        # by accident — but here it must not propagate and skip/abort the
-        # whole gate.
-        if type(exc).__name__ == "Skipped":
-            # Module-level importorskip: the module declares an optional
-            # dependency this environment lacks (e.g. hypothesis).
-            # Un-analyzable here, not broken — pytest skips it the same way.
-            return []
-        if not isinstance(exc, Exception):
-            raise  # KeyboardInterrupt / SystemExit stay fatal
-        return [Finding(rel, 1, "import-error", f"cannot import {mod_name}: {exc}")]
-
-    findings: List[Finding] = []
-    scopes = _ScopeStack()
-
-    def resolve(expr: ast.AST) -> Tuple[Optional[object], Optional[str]]:
-        """(object, dotted-name) for Name / module-attribute chains bound at
-        module level and unshadowed; (None, None) when not resolvable."""
-        if isinstance(expr, ast.Name) and isinstance(expr.ctx, ast.Load):
-            if scopes.shadowed(expr.id):
-                return None, None
-            if expr.id in vars(module):
-                return vars(module)[expr.id], expr.id
-            return None, None
-        if isinstance(expr, ast.Attribute) and isinstance(expr.ctx, ast.Load):
-            base, dotted = resolve(expr.value)
-            if not isinstance(base, types.ModuleType):
-                return None, None  # instance attrs are dynamic; modules aren't
-            if getattr(base, "__getattr__", None) is not None:
-                return None, None  # module-level __getattr__: unknowable
-            if not hasattr(base, expr.attr):
-                findings.append(
-                    Finding(rel, expr.lineno, "missing-attribute",
-                            f"module {dotted!r} has no attribute {expr.attr!r}")
-                )
-                return None, None
-            return getattr(base, expr.attr), f"{dotted}.{expr.attr}"
-        return None, None
-
-    def visit(node: ast.AST) -> None:
-        is_scope = isinstance(
-            node,
-            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef,
-             ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp),
-        )
-        if is_scope:
-            if isinstance(
-                node,
-                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef),
-            ):
-                # Class bodies execute like function bodies: a name bound
-                # earlier in the body shadows the module level for later
-                # body-level references. (For functions NESTED in the class
-                # the class scope is not on the lookup chain, so treating it
-                # as shadowing there only skips a check — never misjudges.)
-                scopes.stack.append(_local_bindings(node))
-            else:
-                targets = set()
-                for gen in node.generators:
-                    for n in ast.walk(gen.target):
-                        if isinstance(n, ast.Name):
-                            targets.add(n.id)
-                scopes.stack.append(targets)
-        if isinstance(node, ast.Call):
-            obj, dotted = resolve(node.func)
-            if obj is not None:
-                _check_one_call(node, obj, dotted, rel, findings)
-        elif isinstance(node, ast.Attribute):
-            resolve(node)  # existence check on bare module-attr reads
-        for child in ast.iter_child_nodes(node):
-            visit(child)
-        if is_scope:
-            scopes.stack.pop()
-
-    visit(tree)
-    # Attribute chains nest (resolve recurses), so the same missing
-    # attribute can be recorded through both the Call and Attribute hooks.
-    return sorted(set(findings), key=lambda f: (f.lineno, f.message))
-
-
-# ---------------------------------------------------------------------------
-# Check 3: clock injection discipline in rapid_tpu/protocol/
-# ---------------------------------------------------------------------------
-
-#: Wall-clock readers banned inside the protocol package. Every timing
-#: consumer there must go through the injected Clock (utils/clock.py) /
-#: Metrics ``now_ms`` source, or simulated-time tests silently measure wall
-#: time (and phase SLO histograms record garbage under ManualClock).
-_BANNED_CLOCK_ATTRS = frozenset(
-    {"time", "perf_counter", "perf_counter_ns", "monotonic", "monotonic_ns"}
+from analysis import core  # noqa: E402
+from analysis import (  # noqa: E402,F401 — re-exported API surface
+    ALL_CHECK_NAMES,
+    CLOCK_DISCIPLINE_PREFIXES,
+    CONCURRENCY_PREFIXES,
+    DEFAULT_ROOTS,
+    Finding,
+    TRACE_SAFETY_PREFIXES,
+    check_call_signatures,
+    check_clock_injection,
+    check_concurrency,
+    check_dead_definitions,
+    check_trace_safety,
+    check_undefined_names,
+    iter_files,
+    main,
+    run,
 )
 
-#: The tree this discipline applies to (posix-style relative prefix).
-CLOCK_DISCIPLINE_PREFIX = "rapid_tpu/protocol/"
+#: Snapshot for path construction by callers; behavior-affecting resolution
+#: reads ``core.REPO`` at call time (patch that one in tests).
+REPO = core.REPO
 
-
-def check_clock_injection(
-    path: Path,
-    source: Optional[str] = None,
-    tree: "Optional[ast.AST]" = None,
-) -> List[Finding]:
-    """No direct wall-clock reads (``time.time``/``time.perf_counter``/...)
-    in rapid_tpu/protocol/: the clock is injected there, and this check
-    keeps it that way. Both spellings are caught — attribute access on the
-    ``time`` module and ``from time import perf_counter``."""
-    rel = _rel(path)
-    if not rel.replace("\\", "/").startswith(CLOCK_DISCIPLINE_PREFIX):
-        return []
-    src = source if source is not None else path.read_text()
-    if tree is None:
-        tree = ast.parse(src, filename=str(path))
-    findings: List[Finding] = []
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Attribute)
-            and isinstance(node.value, ast.Name)
-            and node.value.id == "time"
-            and node.attr in _BANNED_CLOCK_ATTRS
-        ):
-            findings.append(
-                Finding(rel, node.lineno, "clock-injection",
-                        f"direct wall-clock read time.{node.attr} in the "
-                        "protocol package — use the injected Clock / Metrics "
-                        "now_ms source")
-            )
-        elif isinstance(node, ast.ImportFrom) and node.module == "time":
-            banned = [a.name for a in node.names if a.name in _BANNED_CLOCK_ATTRS]
-            if banned:
-                findings.append(
-                    Finding(rel, node.lineno, "clock-injection",
-                            f"importing {', '.join(banned)} from time in the "
-                            "protocol package — use the injected Clock / "
-                            "Metrics now_ms source")
-                )
-    return findings
-
-
-# ---------------------------------------------------------------------------
-# Check 4: dead module-level definitions (tree-wide liveness)
-# ---------------------------------------------------------------------------
-
-DEFAULT_ROOTS = (
-    "rapid_tpu", "tests", "examples", "tools", "bench.py", "__graft_entry__.py"
-)
-
-_DEF_ALLOW_PREFIXES = ("test_", "Test", "pytest_", "__")
-_DEF_ALLOW_NAMES = {"main", "entry", "dryrun_multichip"}  # external entry points
-_IDENT = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
-
-
-def _collect_definitions(tree: ast.AST, rel: str):
-    for node in tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-            yield node.name, rel, node.lineno
-        # Simple module constants too (plain Name targets only: tuple
-        # unpacking legitimately discards elements, so it is out of scope;
-        # dunders like __all__ fall to the allowlist).
-        elif isinstance(node, ast.Assign):
-            for target in node.targets:
-                if isinstance(target, ast.Name):
-                    yield target.id, rel, node.lineno
-        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
-            yield node.target.id, rel, node.lineno
-
-
-def _collect_references(tree: ast.AST) -> set:
-    """Every way a module-level definition can be consumed: name loads,
-    attribute accesses, function parameter names (pytest fixtures are used
-    by naming them as parameters), and identifiers inside CODE-LOOKING
-    string constants (multi-line or call-shaped — subprocess job scripts,
-    ``python -c`` payloads). Single-word strings deliberately do NOT count:
-    an ``__all__`` entry must not keep an otherwise-unreferenced export
-    alive — re-export padding is exactly what this check exists to catch.
-
-    A module-level definition's OWN subtree never contributes its own name:
-    a dead recursive helper, a class naming itself in a method, or a
-    constant whose initializer/mutation mentions itself must not keep
-    itself alive.
-    """
-
-    def walk(node, self_name):
-        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
-            if node.id != self_name:
-                refs.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            if node.attr != self_name:
-                refs.add(node.attr)
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
-            a = node.args
-            for arg in [*a.posonlyargs, *a.args, *a.kwonlyargs]:
-                refs.add(arg.arg)
-        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
-            if "\n" in node.value or "(" in node.value:
-                refs.update(w for w in _IDENT.findall(node.value) if w != self_name)
-        for child in ast.iter_child_nodes(node):
-            walk(child, self_name)
-
-    refs: set = set()
-    for stmt in getattr(tree, "body", []):
-        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-            for child in ast.iter_child_nodes(stmt):
-                walk(child, stmt.name)
-        elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and isinstance(
-            stmt.targets[0], ast.Name
-        ):
-            walk(stmt.value, stmt.targets[0].id)
-        elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
-            walk(stmt.annotation, None)  # the type names ARE references
-            if stmt.value is not None:
-                walk(stmt.value, stmt.target.id)
-        else:
-            walk(stmt, None)
-    return refs
-
-
-def check_dead_definitions(
-    contributions: "List[Tuple[ast.AST, str]]",
-) -> List[Finding]:
-    """Module-level functions/classes/constants referenced NOWHERE in the tree.
-
-    Takes (tree, relpath) pairs for the WHOLE analyzed tree — liveness is
-    only meaningful over the full root set, so run() skips this check when
-    the CLI narrows the roots. Tree-wide, name-based (not resolution-based):
-    a name collision anywhere keeps a definition alive, so every finding is
-    a definition no file could be using. The repo's standard is that
-    unconsumed code is deleted, not exported (the Mosaic watermark kernel
-    precedent)."""
-    defs: List[Tuple[str, str, int]] = []
-    refs: set = set()
-    for tree, rel in contributions:
-        defs.extend(_collect_definitions(tree, rel))
-        refs |= _collect_references(tree)
-    findings = []
-    for name, rel, lineno in defs:
-        if name.startswith(_DEF_ALLOW_PREFIXES) or name in _DEF_ALLOW_NAMES:
-            continue
-        if name not in refs:
-            findings.append(
-                Finding(rel, lineno, "dead-definition",
-                        f"module-level {name!r} is referenced nowhere in the tree")
-            )
-    return findings
-
-
-# ---------------------------------------------------------------------------
-# Driver
-# ---------------------------------------------------------------------------
-
-
-def iter_files(roots: Sequence[str] = DEFAULT_ROOTS) -> Iterable[Path]:
-    for root in roots:
-        path = (REPO / root) if not Path(root).is_absolute() else Path(root)
-        if path.is_file():
-            yield path
-        elif path.is_dir():
-            yield from sorted(path.rglob("*.py"))
-        else:
-            # A typo'd or since-renamed root must fail the gate, not
-            # silently exempt that tree from analysis.
-            raise FileNotFoundError(f"staticcheck root does not exist: {path}")
-
-
-def _rel(path: Path) -> str:
-    try:
-        return str(path.resolve().relative_to(REPO))
-    except ValueError:
-        return str(path)
-
-
-def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
-    # Mirror pytest's rootdir behavior: test modules import suite-local
-    # helpers both as `tests.helpers` and bare `helpers`. Insert at the
-    # FRONT: `tools`/`tests` are common top-level names, and a foreign
-    # package earlier on sys.path would shadow this repo's namespace
-    # packages and produce spurious import-error findings.
-    for entry in (str(REPO), str(REPO / "tests")):
-        if entry in sys.path:
-            sys.path.remove(entry)
-        sys.path.insert(0, entry)
-    findings: List[Finding] = []
-    trees: List[Tuple[ast.AST, str]] = []  # one parse per file, shared
-    for path in iter_files(roots):
-        src = path.read_text()
-        tree = ast.parse(src, filename=str(path))
-        trees.append((tree, _rel(path)))
-        findings.extend(check_undefined_names(path, src, tree))
-        findings.extend(check_call_signatures(path, src, tree))
-        findings.extend(check_clock_injection(path, src, tree))
-    if tuple(roots) == DEFAULT_ROOTS:
-        # Liveness is only meaningful over the FULL tree: with narrowed CLI
-        # roots, code consumed from outside the subset would be reported as
-        # dead — so the check runs only on complete invocations.
-        findings.extend(check_dead_definitions(trees))
-    return findings
-
-
-def main(argv: Optional[List[str]] = None) -> int:
-    roots = list(argv or DEFAULT_ROOTS)
-    findings = run(roots)
-    for f in findings:
-        print(f)
-    print(f"staticcheck: {len(findings)} finding(s)")
-    return 1 if findings else 0
-
+__all__ = [
+    "ALL_CHECK_NAMES",
+    "CLOCK_DISCIPLINE_PREFIXES",
+    "CONCURRENCY_PREFIXES",
+    "DEFAULT_ROOTS",
+    "Finding",
+    "REPO",
+    "TRACE_SAFETY_PREFIXES",
+    "check_call_signatures",
+    "check_clock_injection",
+    "check_concurrency",
+    "check_dead_definitions",
+    "check_trace_safety",
+    "check_undefined_names",
+    "core",
+    "iter_files",
+    "main",
+    "run",
+]
 
 if __name__ == "__main__":
-    sys.path.insert(0, str(REPO))
+    sys.path.insert(0, str(core.REPO))
     from rapid_tpu.utils.platform import force_platform
 
     force_platform("cpu")  # imports must never touch a (possibly wedged) tunnel
