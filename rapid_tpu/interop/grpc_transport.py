@@ -207,7 +207,9 @@ class GrpcClient(MessagingClient):
             return await self._attempt(remote, request)
         except ShuttingDownError:
             raise
-        except Exception:
+        except Exception:  # noqa: BLE001 — the best-effort contract
+            # (IMessagingClient.java:25-49): one attempt, None on any
+            # transport failure; only shutdown races propagate (above).
             return None
 
     async def shutdown(self) -> None:

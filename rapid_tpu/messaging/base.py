@@ -13,13 +13,35 @@ from __future__ import annotations
 
 import abc
 import asyncio
+import functools
+import logging
 import random
-from typing import List, Optional, TYPE_CHECKING
+from typing import List, Optional, Set, TYPE_CHECKING
 
+from rapid_tpu.errors import ShuttingDownError
 from rapid_tpu.types import Endpoint, RapidRequest, RapidResponse
 
 if TYPE_CHECKING:
     from rapid_tpu.protocol.service import MembershipService
+
+LOG = logging.getLogger(__name__)
+
+
+def _reap_nowait_task(tasks: "Set[asyncio.Task]", task: asyncio.Task) -> None:
+    tasks.discard(task)
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is None:
+        return
+    # Best-effort sends absorb transport failures and return None; the only
+    # EXPECTED escapee is ShuttingDownError racing a late broadcast. Anything
+    # else is a transport bug that must stay as visible as the loop's old
+    # "exception was never retrieved" message, not quieter.
+    if isinstance(exc, ShuttingDownError):
+        LOG.debug("send_nowait raced transport shutdown: %r", exc)
+    else:
+        LOG.warning("send_nowait task failed: %r", exc)
 
 
 class MessagingClient(abc.ABC):
@@ -41,8 +63,20 @@ class MessagingClient(abc.ABC):
         ...
 
     def send_nowait(self, remote: Endpoint, request: RapidRequest) -> None:
-        """Fire-and-forget best-effort send (broadcasts, consensus traffic)."""
-        asyncio.ensure_future(self.send_best_effort(remote, request))
+        """Fire-and-forget best-effort send (broadcasts, consensus traffic).
+        The task is tracked in a per-client strong-reference set — the event
+        loop holds tasks weakly, so an untracked send could be garbage-
+        collected mid-flight — and its outcome is observed by the reaper
+        callback (``send_best_effort`` returns None on failure by contract,
+        but a transport shutting down underneath the send re-raises). The
+        set lives on the client instance (lazily, so abstract subclasses
+        need no ``super().__init__``): when the client is dropped after
+        shutdown, any entry stranded by a loop that closed mid-flight is
+        released with it instead of accumulating for the process lifetime."""
+        tasks: Set[asyncio.Task] = self.__dict__.setdefault("_nowait_tasks", set())
+        task = asyncio.ensure_future(self.send_best_effort(remote, request))
+        tasks.add(task)
+        task.add_done_callback(functools.partial(_reap_nowait_task, tasks))
 
     @abc.abstractmethod
     async def shutdown(self) -> None:

@@ -248,10 +248,11 @@ class TcpClient(MessagingClient):
             # else's in-flight requests) alone.
             conn.pending.pop(correlation_id, None)
             raise
-        except Exception:
+        except Exception:  # noqa: BLE001 — cleanup-and-reraise, not a catch:
+            # any transport-level failure invalidates the cached connection
+            # (GrpcClient.java:106-115's channel invalidation) and then
+            # propagates unchanged to the caller's retry policy.
             conn.pending.pop(correlation_id, None)
-            # Invalidate the cached connection on transport-level failure
-            # (GrpcClient.java:106-115's channel invalidation).
             self._invalidate(remote, conn)
             raise
 
@@ -267,7 +268,9 @@ class TcpClient(MessagingClient):
             return await self._attempt(remote, request)
         except ShuttingDownError:
             raise
-        except Exception:
+        except Exception:  # noqa: BLE001 — the best-effort contract
+            # (IMessagingClient.java:25-49): one attempt, None on any
+            # transport failure; only shutdown races propagate (above).
             return None
 
     async def shutdown(self) -> None:

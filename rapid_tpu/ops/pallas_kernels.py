@@ -32,7 +32,9 @@ try:  # pallas is TPU/Mosaic-gated; keep import soft for CPU-only installs
     from jax.experimental.pallas import tpu as pltpu
 
     _HAS_PALLAS = True
-except Exception:  # pragma: no cover
+except Exception:  # pragma: no cover  # noqa: BLE001 — any import failure
+    # (missing extra, Mosaic ABI mismatch, partial install) means the same
+    # thing here: no pallas, fall back to the pure-JAX kernels.
     _HAS_PALLAS = False
 
 _LANES = 128

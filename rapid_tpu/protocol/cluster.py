@@ -51,6 +51,9 @@ class Cluster:
         self.service = service
         self._server = server
         self._client = client
+        # Set by _from_join_response when the service start is scheduled
+        # rather than awaited; shutdown() settles it first.
+        self._start_task: Optional[asyncio.Task] = None
 
     # -- accessors (Cluster.java:98-129) -------------------------------
 
@@ -102,6 +105,20 @@ class Cluster:
         await self.shutdown()
 
     async def shutdown(self) -> None:
+        if self._start_task is not None:
+            # A join-built cluster scheduled service.start() instead of
+            # awaiting it; settle it so the background loops exist before
+            # service.shutdown() cancels them (start() is await-free, so
+            # this completes in one scheduling step). A failed start must
+            # not abort the teardown below — report it and keep going.
+            (result,) = await asyncio.gather(self._start_task, return_exceptions=True)
+            if isinstance(result, BaseException) and not isinstance(
+                result, asyncio.CancelledError
+            ):
+                LOG.warning(
+                    "%s service start failed before shutdown: %r", self, result
+                )
+            self._start_task = None
         await self._server.shutdown()
         await self.service.shutdown()
 
@@ -360,5 +377,10 @@ class Cluster:
         )
         server.set_membership_service(cls._server_handler(broadcaster, service))
         cluster = cls(listen_address, service, server, client)
-        asyncio.ensure_future(service.start())
+        # This builder is sync (called from the join response loop), so the
+        # service start is scheduled rather than awaited — but retained on
+        # the cluster: an untracked task could be garbage-collected by the
+        # loop before running, and shutdown() awaits it so the background
+        # loops it spawns are fully armed before being torn down.
+        cluster._start_task = asyncio.ensure_future(service.start())
         return cluster

@@ -20,6 +20,7 @@ import random
 from collections import OrderedDict
 from typing import Dict, List, Optional, Set, Tuple
 
+from rapid_tpu.errors import NodeNotInRingError
 from rapid_tpu.messaging.base import Broadcaster, MessagingClient, UnicastToAllBroadcaster
 from rapid_tpu.monitoring.base import EdgeFailureDetectorFactory
 from rapid_tpu.protocol.cut_detector import MultiNodeCutDetector
@@ -198,6 +199,10 @@ class MembershipService:
         self._redeliveries_this_config = 0  # guarded-by: _lock
         self._catch_up_inflight = False  # guarded-by: event-loop
         self._catch_up_tasks: Set[asyncio.Task] = set()  # guarded-by: event-loop
+        # Edge-failure notifications spawned from failure-detector callbacks:
+        # tracked so the loop cannot garbage-collect one mid-flight and so
+        # shutdown can cancel-and-await instead of orphaning them.
+        self._edge_notify_tasks: Set[asyncio.Task] = set()  # guarded-by: event-loop
         self._last_catch_up_ms = float("-inf")  # guarded-by: event-loop
         self._last_beacon_ms = float("-inf")  # guarded-by: event-loop
         # Idle-heartbeat timer starts at construction: a fresh node is
@@ -264,10 +269,14 @@ class MembershipService:
         catch_up_tasks = list(self._catch_up_tasks)
         for task in catch_up_tasks:
             task.cancel()
+        notify_tasks = list(self._edge_notify_tasks)
+        for task in notify_tasks:
+            task.cancel()
         # Await detectors too: a mid-tick probe must finish (or unwind) before
         # the client underneath it is shut down.
         await asyncio.gather(
-            *background_tasks, *fd_tasks, *catch_up_tasks, return_exceptions=True
+            *background_tasks, *fd_tasks, *catch_up_tasks, *notify_tasks,
+            return_exceptions=True,
         )
         await self.client.shutdown()
 
@@ -345,6 +354,11 @@ class MembershipService:
     # ------------------------------------------------------------------
 
     async def handle_message(self, request: RapidRequest) -> RapidResponse:
+        # dispatched-elsewhere: GossipMessage — gossip envelopes are
+        # unwrapped by the broadcaster's router facade (messaging/gossip.py
+        # GossipRouter, installed via Cluster._server_handler) which relays
+        # and then forwards only the PAYLOAD here; a raw GossipMessage never
+        # reaches this chain. The dispatch analyzer verifies the exemption.
         if isinstance(request, ProbeMessage):
             # Probes bypass the protocol context (MembershipService.java:449-452).
             return ProbeResponse()
@@ -883,7 +897,9 @@ class MembershipService:
         config_id = self.view.configuration_id
         try:
             subjects = self.view.subjects_of(self.my_addr)
-        except Exception:
+        except NodeNotInRingError:
+            # Evicted between the view change and this rearm: no ring
+            # position means no subjects to watch — nothing to arm.
             return
         for subject in set(subjects):
             self._fd_tasks.append(
@@ -892,7 +908,9 @@ class MembershipService:
 
     async def _fd_loop(self, subject: Endpoint, generation: int, config_id: int) -> None:
         def notifier() -> None:
-            asyncio.ensure_future(self._notify_edge_failure(subject, config_id))
+            task = asyncio.ensure_future(self._notify_edge_failure(subject, config_id))
+            self._edge_notify_tasks.add(task)
+            task.add_done_callback(self._edge_notify_tasks.discard)
 
         detector = self.fd_factory.create_instance(subject, notifier)
         while not self._stopped and generation == self._fd_generation:
@@ -1470,7 +1488,7 @@ class MembershipService:
     async def leave(self) -> None:
         try:
             observers = self.view.observers_of(self.my_addr)
-        except Exception:
+        except NodeNotInRingError:
             return  # already removed — nothing to announce
         leave_msg = LeaveMessage(sender=self.my_addr)
         sends = [self.client.send_best_effort(observer, leave_msg) for observer in observers]

@@ -47,6 +47,8 @@ def force_platform(platform: str, n_host_devices: int | None = None) -> bool:
     try:
         jax.config.update("jax_platforms", platform)
         return True
-    except Exception as exc:  # pragma: no cover - only with a live backend
+    except Exception as exc:  # pragma: no cover  # noqa: BLE001 — backend
+        # init failures vary by runtime (RuntimeError, plugin errors); all
+        # mean "platform not forced", reported to the caller as False.
         LOG.warning("could not force jax platform %r: %s", platform, exc)
         return False

@@ -1,0 +1,31 @@
+"""Seeded defect: a loop that absorbs its own cancellation.
+
+Catching ``asyncio.CancelledError`` without re-raising keeps the task
+alive after ``task.cancel()`` — shutdown then hangs awaiting it. The
+``# expect:`` marker drives tests/test_staticcheck.py.
+"""
+
+import asyncio
+
+
+class Looper:
+    async def immortal(self):
+        while True:
+            try:
+                await self.tick()
+            except asyncio.CancelledError:  # expect: cancellation-swallow
+                continue
+
+    async def well_behaved(self):
+        while True:
+            try:
+                await self.tick()
+            except asyncio.CancelledError:
+                await self.flush()
+                raise
+
+    async def tick(self):
+        pass
+
+    async def flush(self):
+        pass

@@ -1,0 +1,43 @@
+"""Clean counterpart for the taskflow analyzer: zero findings.
+
+Exercises the shapes the analysis must NOT convict: tracked spawns,
+awaited coroutines, justified broad catches (with and without logging
+bodies), cleanup-then-reraise cancellation handling, and narrow catches.
+"""
+
+import asyncio
+import logging
+
+LOG = logging.getLogger(__name__)
+
+
+class Worker:
+    def __init__(self):
+        self._tasks = set()
+        self._lock = asyncio.Lock()
+
+    async def spawn(self, work):
+        task = asyncio.create_task(work())
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def run_once(self):
+        await self.tick()
+
+    async def loop(self):
+        while True:
+            try:
+                await self.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — the loop must survive a tick
+                LOG.exception("tick failed; continuing")
+
+    async def narrow(self):
+        try:
+            await self.tick()
+        except (ConnectionError, OSError) as exc:
+            LOG.debug("transport fault: %r", exc)
+
+    async def tick(self):
+        await asyncio.sleep(0)

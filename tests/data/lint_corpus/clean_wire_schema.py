@@ -1,0 +1,73 @@
+"""Clean counterpart for the wire_schema analyzer: zero findings.
+
+A complete miniature of the four-mirror surface in one module: union +
+dataclasses, tag table, encode/decode arms for every member, and a proto
+mirror (including the oneof envelope, whose field numbers must equal the
+native tags).
+"""
+
+from dataclasses import dataclass
+from typing import Dict, Type, Union
+
+
+@dataclass(frozen=True)
+class Ping:
+    sender: str
+
+
+@dataclass(frozen=True)
+class Pong:
+    sender: str
+    payload: bytes
+
+
+RapidRequest = Union[Ping, Pong]
+
+_REQUEST_TAGS: Dict[Type, int] = {Ping: 1, Pong: 2}
+
+
+def _encode_request_impl(request):
+    parts = [_REQUEST_TAGS[type(request)]]
+    if isinstance(request, Ping):
+        parts.append(request.sender)
+    elif isinstance(request, Pong):
+        parts.append(request.sender)
+        parts.append(request.payload)
+    return parts
+
+
+def decode_request(frame):
+    tag = frame[0]
+    if tag == 1:
+        out = Ping(frame[1])
+    elif tag == 2:
+        out = Pong(frame[1], frame[2])
+    else:
+        raise ValueError(f"unknown request tag {tag}")
+    return out
+
+
+def _msg(name, *fields):
+    return (name, fields)
+
+
+def _field(name, number, ftype=0):
+    return (name, number, ftype)
+
+
+PROTO_FILE = (
+    _msg(
+        "Ping",
+        _field("sender", 1),
+    ),
+    _msg(
+        "Pong",
+        _field("sender", 1),
+        _field("payload", 2),
+    ),
+    _msg(
+        "RapidRequest",
+        _field("ping", 1),
+        _field("pong", 2),
+    ),
+)

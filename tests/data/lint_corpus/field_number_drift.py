@@ -1,0 +1,43 @@
+"""Seeded defect: the proto mirror no longer covers the dataclass.
+
+``Pong`` grew a ``payload`` field, but its proto message was never given
+a matching field — the interop path silently drops the data on encode.
+The ``# expect:`` marker drives tests/test_staticcheck.py.
+"""
+
+from dataclasses import dataclass
+from typing import Union
+
+
+@dataclass(frozen=True)
+class Ping:
+    sender: str
+
+
+@dataclass(frozen=True)
+class Pong:
+    sender: str
+    payload: bytes
+
+
+RapidRequest = Union[Ping, Pong]
+
+
+def _msg(name, *fields):
+    return (name, fields)
+
+
+def _field(name, number, ftype=0):
+    return (name, number, ftype)
+
+
+PROTO_FILE = (
+    _msg(
+        "Ping",
+        _field("sender", 1),
+    ),
+    _msg(  # expect: field-number-drift
+        "Pong",
+        _field("sender", 1),
+    ),
+)

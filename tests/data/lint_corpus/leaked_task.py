@@ -1,0 +1,28 @@
+"""Seeded defect: a fire-and-forget task nothing retains or observes.
+
+The event loop holds tasks weakly — an untracked ``create_task`` result
+can be garbage-collected mid-flight, and its exception is never
+retrieved. The ``# expect:`` marker drives tests/test_staticcheck.py.
+"""
+
+import asyncio
+
+
+class Spawner:
+    def __init__(self):
+        self._tasks = set()
+
+    async def tracked(self, work):
+        # Retained + done-callback: the blessed shape.
+        task = asyncio.create_task(work())
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        await asyncio.sleep(0)
+
+    async def observed(self, work):
+        # Chained done-callback without retention is also visible to the
+        # analyzer (the statement's call is add_done_callback, not spawn).
+        asyncio.create_task(work()).add_done_callback(print)
+
+    async def leaked(self, work):
+        asyncio.create_task(work())  # expect: leaked-task

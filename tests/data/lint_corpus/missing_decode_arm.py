@@ -1,0 +1,46 @@
+"""Seeded defect: a tagged union member with no decode arm.
+
+``Pong`` is in the union, tagged, and encodable — but ``decode_request``
+never handles tag 2, so every Pong frame a peer sends raises instead of
+decoding. The ``# expect:`` markers drive tests/test_staticcheck.py's
+corpus gate (the wire_schema analyzer reads all mirrors from this one
+module, the way tree sweeps merge types.py/codec.py/proto_schema.py).
+"""
+
+from dataclasses import dataclass
+from typing import Dict, Type, Union
+
+
+@dataclass(frozen=True)
+class Ping:
+    sender: str
+
+
+@dataclass(frozen=True)
+class Pong:
+    sender: str
+    payload: bytes
+
+
+RapidRequest = Union[Ping, Pong]
+
+_REQUEST_TAGS: Dict[Type, int] = {Ping: 1, Pong: 2}
+
+
+def _encode_request_impl(request):
+    parts = [_REQUEST_TAGS[type(request)]]
+    if isinstance(request, Ping):
+        parts.append(request.sender)
+    elif isinstance(request, Pong):
+        parts.append(request.sender)
+        parts.append(request.payload)
+    return parts
+
+
+def decode_request(frame):  # expect: missing-decode-arm
+    tag = frame[0]
+    if tag == 1:
+        out = Ping(frame[1])
+    else:
+        raise ValueError(f"unknown request tag {tag}")
+    return out

@@ -1,0 +1,42 @@
+"""Seeded defect: a dispatch arm made dead by an earlier superclass arm.
+
+``isinstance(request, Probe)`` matches ``DeepProbe`` too, so the later
+``DeepProbe`` arm (and its distinct response) is unreachable. The
+``# expect:`` marker drives tests/test_staticcheck.py.
+"""
+
+from dataclasses import dataclass
+from typing import Union
+
+
+@dataclass(frozen=True)
+class Probe:
+    sender: str
+
+
+@dataclass(frozen=True)
+class DeepProbe(Probe):
+    depth: int = 1
+
+
+@dataclass(frozen=True)
+class Ack:
+    pass
+
+
+@dataclass(frozen=True)
+class DeepAck:
+    pass
+
+
+RapidRequest = Union[Probe, DeepProbe]
+RapidResponse = Union[Ack, DeepAck]
+
+
+class MiniService:
+    async def handle_message(self, request):
+        if isinstance(request, Probe):
+            return Ack()
+        if isinstance(request, DeepProbe):  # expect: shadowed-arm
+            return DeepAck()
+        raise TypeError(f"unidentified request type {type(request)!r}")

@@ -1,0 +1,33 @@
+"""Seeded defect: a broad catch that silently eats every failure.
+
+``except Exception`` with no re-raise and no justification turns any
+crash into a silent no-op — the wedge-over-crash failure mode. The
+``# expect:`` marker drives tests/test_staticcheck.py.
+"""
+
+
+class Guard:
+    def risky(self):
+        raise RuntimeError("boom")
+
+    def swallows(self):
+        try:
+            self.risky()
+        except Exception:  # expect: swallowed-exception
+            pass
+
+    def justified(self):
+        try:
+            self.risky()
+        except Exception:  # noqa: BLE001 — demo fault-isolation boundary
+            pass
+
+    def cleanup_and_reraise(self):
+        try:
+            self.risky()
+        except Exception:
+            self.rollback()
+            raise
+
+    def rollback(self):
+        pass

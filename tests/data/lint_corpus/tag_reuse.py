@@ -1,0 +1,27 @@
+"""Seeded defect: two union members assigned the same wire tag.
+
+A reused tag makes the decoder route one type's frames into the other's
+field layout — a silent wire-format corruption the type system never
+sees. The ``# expect:`` marker drives tests/test_staticcheck.py.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, Type, Union
+
+
+@dataclass(frozen=True)
+class Ping:
+    sender: str
+
+
+@dataclass(frozen=True)
+class Pong:
+    sender: str
+
+
+RapidRequest = Union[Ping, Pong]
+
+_REQUEST_TAGS: Dict[Type, int] = {
+    Ping: 1,
+    Pong: 1,  # expect: tag-reuse
+}

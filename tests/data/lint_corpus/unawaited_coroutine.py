@@ -1,0 +1,27 @@
+"""Seeded defect: a coroutine built and dropped without running.
+
+Calling an ``async def`` as a bare statement creates the coroutine
+object and discards it — the body never executes, silently. The
+``# expect:`` markers drive tests/test_staticcheck.py.
+"""
+
+import asyncio
+
+
+async def flush_queue():
+    await asyncio.sleep(0)
+
+
+class Notifier:
+    async def emit(self):
+        await asyncio.sleep(0)
+
+    async def good(self):
+        await self.emit()
+
+    def dropped_method(self):
+        self.emit()  # expect: unawaited-coroutine
+
+
+def dropped_module_level():
+    flush_queue()  # expect: unawaited-coroutine

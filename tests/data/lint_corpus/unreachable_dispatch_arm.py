@@ -1,0 +1,43 @@
+"""Seeded defect: a union member the dispatch ladder never matches.
+
+``Status`` is in the request union but no ``isinstance`` arm handles it:
+at runtime it falls through to the trailing ``TypeError`` — on a peer's
+schedule, not at build time. The ``# expect:`` marker drives
+tests/test_staticcheck.py.
+"""
+
+from dataclasses import dataclass
+from typing import Union
+
+
+@dataclass(frozen=True)
+class Ping:
+    sender: str
+
+
+@dataclass(frozen=True)
+class Pong:
+    sender: str
+
+
+@dataclass(frozen=True)
+class Status:
+    sender: str
+
+
+@dataclass(frozen=True)
+class Ack:
+    pass
+
+
+RapidRequest = Union[Ping, Pong, Status]
+RapidResponse = Union[Ack]
+
+
+class MiniService:
+    async def handle_message(self, request):  # expect: unreachable-dispatch-arm
+        if isinstance(request, Ping):
+            return Ack()
+        if isinstance(request, Pong):
+            return Ack()
+        raise TypeError(f"unidentified request type {type(request)!r}")

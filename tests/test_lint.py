@@ -178,6 +178,19 @@ def test_clock_injection_check_catches_both_spellings():
     assert check_clock_injection(outside, source=offending) == []
 
 
+def test_library_sweep_is_clean_under_all_families():
+    """The per-file resolution families (incl. the dispatch and taskflow
+    analyzers added with the wire-conformance tier) are clean over
+    rapid_tpu/ — the library keeps its failure paths justified or narrow,
+    its background tasks tracked, and its dispatch chain exhaustive. The
+    whole-tree gate (with the deadcode + wire-lock tree checks) lives in
+    test_staticcheck.py; this pin localizes a regression to the library."""
+    import staticcheck
+
+    findings = staticcheck.run(("rapid_tpu",))
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
 def test_no_mutable_default_arguments():
     offenders = []
     for path in _py_files():

@@ -238,3 +238,25 @@ async def test_lost_phase2_response_recovers_via_config_minus_one():
     assert joiner_id in response.identifiers
     await service.shutdown()
     await server.shutdown()
+
+
+@async_test
+async def test_cancelled_background_loops_actually_exit():
+    """Cancellation hygiene (the taskflow analyzer's contract, enforced
+    end-to-end): every background loop the service arms — alert batcher,
+    redelivery, config sync, failure detectors — must EXIT when cancelled,
+    not absorb the CancelledError and keep looping (the liveness loops
+    catch broad Exception by design, so their explicit CancelledError
+    re-raise is load-bearing; if one swallowed it, shutdown would hang on
+    the gather forever)."""
+    service, _ = make_service(8)
+    await service.start()
+    tasks = list(service._background_tasks) + list(service._fd_tasks)
+    assert tasks, "service.start() armed no background loops"
+    for task in tasks:
+        task.cancel()
+    done, pending = await asyncio.wait(tasks, timeout=5)
+    assert not pending, f"loops survived cancellation: {pending}"
+    for task in done:
+        assert task.cancelled() or task.exception() is None
+    await service.shutdown()

@@ -284,10 +284,19 @@ def test_narrowed_roots_skip_liveness(tmp_path, monkeypatch):
 
 def test_whole_tree_is_finding_free():
     # The gate itself: resolution-tier findings fail the build exactly the
-    # way error-prone fails the reference's. All six check families run
-    # (names, signatures, clock, dead-defs, concurrency, trace-safety).
+    # way error-prone fails the reference's. All nine check families run
+    # (names, signatures, clock, dead-defs, concurrency, trace-safety,
+    # wire-schema + lockfile, dispatch, taskflow) — and the full sweep must
+    # stay fast enough to live in the ordinary test session (<15 s of CPU;
+    # it uses ~8 s today). Process CPU time, not wall-clock: a loaded CI
+    # machine must not fail the gate — only an analyzer going superlinear.
+    import time
+
+    started = time.process_time()
     findings = staticcheck.run()
+    elapsed = time.process_time() - started
     assert not findings, "\n".join(str(f) for f in findings)
+    assert elapsed < 15.0, f"nine-family tree sweep used {elapsed:.1f}s CPU (budget 15s)"
 
 
 # ---------------------------------------------------------------------------
@@ -318,7 +327,9 @@ _EXPECT_RE = re.compile(r"#\s*expect:\s*([a-z][a-z-]*)")
 
 #: corpus file -> (pretend repo path, check function name). The pretend
 #: path places the source inside the prefix each analyzer guards, the way
-#: the clock-injection tests in test_lint.py do.
+#: the clock-injection tests in test_lint.py do. The wire_schema corpus
+#: files keep all schema mirrors as miniatures in one module (tree sweeps
+#: merge the three real mirror files the same way).
 _CORPUS_CHECKERS = {
     "unguarded_mutation.py": ("rapid_tpu/protocol/_corpus.py", "check_concurrency"),
     "interleaving_hazard.py": ("rapid_tpu/protocol/_corpus.py", "check_concurrency"),
@@ -327,6 +338,18 @@ _CORPUS_CHECKERS = {
     "jit_side_effect.py": ("rapid_tpu/ops/_corpus.py", "check_trace_safety"),
     "jit_traced_branch.py": ("rapid_tpu/ops/_corpus.py", "check_trace_safety"),
     "clean_trace_safety.py": ("rapid_tpu/ops/_corpus.py", "check_trace_safety"),
+    "missing_decode_arm.py": ("rapid_tpu/messaging/_corpus.py", "check_wire_schema"),
+    "tag_reuse.py": ("rapid_tpu/messaging/_corpus.py", "check_wire_schema"),
+    "field_number_drift.py": ("rapid_tpu/interop/_corpus.py", "check_wire_schema"),
+    "clean_wire_schema.py": ("rapid_tpu/messaging/_corpus.py", "check_wire_schema"),
+    "unreachable_dispatch_arm.py": ("rapid_tpu/protocol/_corpus.py", "check_dispatch"),
+    "shadowed_arm.py": ("rapid_tpu/protocol/_corpus.py", "check_dispatch"),
+    "clean_dispatch.py": ("rapid_tpu/protocol/_corpus.py", "check_dispatch"),
+    "leaked_task.py": ("rapid_tpu/messaging/_corpus.py", "check_taskflow"),
+    "swallowed_exception.py": ("rapid_tpu/messaging/_corpus.py", "check_taskflow"),
+    "cancellation_swallow.py": ("rapid_tpu/messaging/_corpus.py", "check_taskflow"),
+    "unawaited_coroutine.py": ("rapid_tpu/messaging/_corpus.py", "check_taskflow"),
+    "clean_taskflow.py": ("rapid_tpu/messaging/_corpus.py", "check_taskflow"),
 }
 
 
@@ -495,6 +518,230 @@ def test_wall_clock_ok_comment_allowlists_a_read():
 
 
 # ---------------------------------------------------------------------------
+# Wire-schema lockfile: round-trip, drift naming, end-to-end gate
+# ---------------------------------------------------------------------------
+
+
+def _wire_surface():
+    import ast
+
+    from analysis import wire_schema
+
+    trees = [
+        (ast.parse((staticcheck.core.REPO / rel).read_text()), rel)
+        for rel in staticcheck.WIRE_FILES
+    ]
+    return wire_schema, wire_schema.extract_surface(trees)
+
+
+def test_wire_lock_round_trips_clean():
+    # The committed lock IS the live surface: regenerating changes nothing,
+    # and both the cross-check and the lock comparison are silent.
+    wire_schema, surface = _wire_surface()
+    committed = json.loads((staticcheck.core.REPO / staticcheck.LOCK_REL).read_text())
+    committed.pop("_comment", None)
+    assert wire_schema.surface_to_lock(surface) == committed
+    assert wire_schema.cross_check(surface) == []
+    assert wire_schema.compare_lock(surface, committed) == []
+
+
+def test_wire_lock_drift_names_the_drifted_message():
+    # Buf-style breaking-change reports: each class of mutation (tag
+    # renumber, proto field renumber, dataclass field reorder) produces a
+    # wire-lock-drift finding naming the message type and the regen command.
+    wire_schema, surface = _wire_surface()
+    lock = wire_schema.surface_to_lock(surface)
+    lock["request_tags"]["JoinMessage"] = 12
+    lock["proto"]["Phase1bMessage"]["vval"] = 9
+    lock["fields"]["JoinResponse"] = list(reversed(lock["fields"]["JoinResponse"]))
+    findings = wire_schema.compare_lock(surface, lock)
+    assert {f.check for f in findings} == {"wire-lock-drift"}
+    messages = [f.message for f in findings]
+    assert any("JoinMessage" in m and "12" in m for m in messages)
+    assert any("Phase1bMessage" in m and "vval" in m for m in messages)
+    assert any("JoinResponse" in m and "field order" in m for m in messages)
+    assert all("--update-wire-lock" in m for m in messages)
+
+
+def test_tampered_lock_fails_the_tree_gate(tmp_path, monkeypatch):
+    # End-to-end through the tree-mode entry the driver calls: a lock that
+    # disagrees with the live mirrors produces findings (exit 1 at the CLI).
+    import ast
+
+    from analysis import wire_schema
+
+    lock = json.loads((staticcheck.core.REPO / staticcheck.LOCK_REL).read_text())
+    lock["response_tags"]["ProbeResponse"] = 9
+    del lock["request_tags"]["LeaveMessage"]
+    tampered = tmp_path / "wire.lock.json"
+    tampered.write_text(json.dumps(lock))
+    monkeypatch.setattr(wire_schema, "LOCK_REL", str(tampered))
+    trees = [
+        (ast.parse((staticcheck.core.REPO / rel).read_text()), rel)
+        for rel in staticcheck.WIRE_FILES
+    ]
+    findings = wire_schema.check_wire_lock(trees)
+    assert findings and {f.check for f in findings} == {"wire-lock-drift"}
+    assert any("ProbeResponse" in f.message for f in findings)
+    assert any("LeaveMessage" in f.message for f in findings)
+
+
+def test_narrowed_roots_still_run_intra_file_wire_checks():
+    # A per-file CLI invocation gets the intra-file wire checks (tree
+    # sweeps run the merged three-file check instead, so defects are never
+    # double-reported). The corpus's seeded tag reuse, fed through the real
+    # driver as an explicit root:
+    findings = staticcheck.run([str(CORPUS / "tag_reuse.py")])
+    assert [f.check for f in findings] == ["tag-reuse"]
+
+
+def test_wire_check_is_presence_gated_per_file():
+    # A real mirror file analyzed ALONE must not produce cross-file noise:
+    # codec.py has tags+arms but no union, types.py has the union but no
+    # tags — each is internally consistent, so each is silent. The merged
+    # tree-mode check owns the cross-file obligations.
+    for rel in staticcheck.WIRE_FILES:
+        findings = staticcheck.check_wire_schema(staticcheck.core.REPO / rel)
+        assert findings == [], (rel, findings)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch analyzer unit behaviors not covered by the corpus
+# ---------------------------------------------------------------------------
+
+
+_MINI_DISPATCH_PRELUDE = """
+from dataclasses import dataclass
+from typing import Union
+
+
+@dataclass(frozen=True)
+class Ping:
+    sender: str
+
+
+@dataclass(frozen=True)
+class Ack:
+    pass
+
+
+RapidRequest = Union[Ping]
+RapidResponse = Union[Ack]
+"""
+
+
+def _dispatch(source: str, rel: str = "rapid_tpu/protocol/_probe.py"):
+    return staticcheck.check_dispatch(
+        staticcheck.core.REPO / rel, source=textwrap.dedent(source)
+    )
+
+
+def test_dispatch_return_type_resolved_through_helper_annotation():
+    src = _MINI_DISPATCH_PRELUDE + """
+class S:
+    async def handle_message(self, request):
+        if isinstance(request, Ping):
+            return self._handle(request)
+        raise TypeError(request)
+
+    def _handle(self, request) -> Ping:
+        return Ping("me")
+"""
+    findings = _dispatch(src)
+    assert [f.check for f in findings] == ["dispatch-return"]
+    assert "not a RapidResponse member" in findings[0].message
+
+
+def test_dispatched_elsewhere_typo_is_flagged():
+    # A stale or typo'd exemption must fail the gate, not silently excuse
+    # a genuinely unreachable member.
+    src = _MINI_DISPATCH_PRELUDE + """
+class S:
+    # dispatched-elsewhere: Gone
+    async def handle_message(self, request):
+        if isinstance(request, Ping):
+            return Ack()
+        raise TypeError(request)
+"""
+    findings = _dispatch(src)
+    assert [f.check for f in findings] == ["unreachable-dispatch-arm"]
+    assert "Gone" in findings[0].message and "stale or typo'd" in findings[0].message
+
+
+def test_dispatch_gates_on_protocol_prefix():
+    src = _MINI_DISPATCH_PRELUDE + """
+class S:
+    async def handle_message(self, request):
+        raise TypeError(request)
+"""
+    assert _dispatch(src, rel="rapid_tpu/utils/_probe.py") == []
+    assert [f.check for f in _dispatch(src)] == ["unreachable-dispatch-arm"]
+
+
+# ---------------------------------------------------------------------------
+# Taskflow analyzer unit behaviors not covered by the corpus
+# ---------------------------------------------------------------------------
+
+
+def _taskflow(source: str, rel: str = "rapid_tpu/utils/_probe.py"):
+    return staticcheck.check_taskflow(
+        staticcheck.core.REPO / rel, source=textwrap.dedent(source)
+    )
+
+
+def test_taskflow_gates_on_library_prefix():
+    src = """
+    import asyncio
+
+    def fire(work):
+        asyncio.ensure_future(work())
+    """
+    assert [f.check for f in _taskflow(src)] == ["leaked-task"]
+    assert _taskflow(src, rel="tools/_probe.py") == []
+
+
+def test_taskflow_ok_comment_allowlists_a_finding():
+    src = """
+    import asyncio
+
+    def fire(work):
+        asyncio.ensure_future(work())  # taskflow-ok: test shim, loop torn down next line
+    """
+    assert _taskflow(src) == []
+
+
+def test_plain_except_exception_in_async_def_is_not_a_cancellation_swallow():
+    # CancelledError derives from BaseException since 3.8: a broad-but-
+    # justified Exception catch lets cancellation through and must not be
+    # convicted; an unjustified BaseException catch is convicted twice
+    # (it both swallows errors and absorbs cancellation).
+    src = """
+    import logging
+
+    LOG = logging.getLogger(__name__)
+
+    async def loop(tick):
+        while True:
+            try:
+                await tick()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                LOG.exception("tick failed")
+    """
+    assert _taskflow(src) == []
+    src_base = """
+    async def loop(tick):
+        while True:
+            try:
+                await tick()
+            except BaseException:
+                pass
+    """
+    assert sorted(f.check for f in _taskflow(src_base)) == [
+        "cancellation-swallow", "swallowed-exception",
+    ]
+
+
+# ---------------------------------------------------------------------------
 # CLI contract: --json / --select / --ignore, human output + exit codes
 # ---------------------------------------------------------------------------
 
@@ -532,3 +779,31 @@ def test_cli_json_select_ignore_and_exit_codes(tmp_path):
 
     typo = _run_cli("--select", "no-such-check", str(bad))
     assert typo.returncode == 2 and "no-such-check" in typo.stderr
+
+
+def test_cli_families_lists_all_nine():
+    assert len(staticcheck.FAMILIES) == 9
+    result = _run_cli("--families")
+    assert result.returncode == 0
+    for name, _description in staticcheck.FAMILIES:
+        assert name in result.stdout, name
+
+
+def test_cli_update_wire_lock_is_a_deterministic_round_trip(
+    tmp_path, monkeypatch, capsys
+):
+    # Regenerating over an unchanged tree produces the byte-identical lock —
+    # the committed file is exactly what the generator emits, so the gate
+    # and the regen command can never fight each other. Regenerate into a
+    # REDIRECTED path: writing the repo's lock in place would silently
+    # overwrite the committed file with the live surface — masking the very
+    # divergence this test exists to catch.
+    from analysis import wire_schema
+
+    committed = (staticcheck.core.REPO / staticcheck.LOCK_REL).read_text()
+    target = tmp_path / "wire.lock.json"
+    monkeypatch.setattr(wire_schema, "LOCK_REL", str(target))
+    rc = staticcheck.main(["--update-wire-lock"])
+    assert rc == 0
+    assert "wrote" in capsys.readouterr().out
+    assert target.read_text() == committed

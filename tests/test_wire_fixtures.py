@@ -163,6 +163,33 @@ def test_response_frames_decode_back_to_native():
         assert response_from_proto(envelope) == msg, name
 
 
+def test_golden_fixtures_and_wire_lock_cover_the_same_types():
+    """Cross-validate the two freezes of the wire surface: every message
+    type pinned by the golden frames must be in the staticcheck wire lock
+    (tools/analysis/wire.lock.json) and vice versa, so neither can drift
+    from the bytes the other pins. The lock's native-only extras (the
+    gossip envelope, which the reference never ships) are the exact,
+    enumerated exception."""
+    lock = json.loads(
+        (Path(__file__).parent.parent / "tools" / "analysis" / "wire.lock.json")
+        .read_text()
+    )
+    fixtures = _load_fixtures()
+    native_only_requests = {"GossipMessage"}
+    assert set(fixtures["requests"]) == set(lock["request_tags"]) - native_only_requests
+    assert set(fixtures["responses"]) == set(lock["response_tags"])
+    # The lock's proto section mirrors the envelope numbering the frames
+    # were serialized under: envelope field number == native tag.
+    for name, tag in lock["request_tags"].items():
+        if name in native_only_requests:
+            continue
+        field = name[0].lower() + name[1:]
+        assert lock["proto"]["RapidRequest"][field] == tag, name
+    for name, tag in lock["response_tags"].items():
+        field = name[0].lower() + name[1:]
+        assert lock["proto"]["RapidResponse"][field] == tag, name
+
+
 def _varint(n: int) -> bytes:
     assert n >= 0
     out = bytearray()

@@ -16,6 +16,16 @@ Check families (one module each; ``core`` owns the driver/CLI/Finding):
 5. ``concurrency``  — asyncio guarded-by discipline, interleaving hazards,
                       lock re-entrancy (protocol + messaging)
 6. ``trace_safety`` — JAX jit purity/staticness (ops)
+7. ``wire_schema``  — the four hand-kept wire-schema mirrors cross-checked
+                      and frozen in ``wire.lock.json`` (types/codec/proto)
+8. ``dispatch``     — RapidRequest dispatch exhaustiveness, shadowed arms,
+                      and response return types (protocol)
+9. ``taskflow``     — async failure-path hygiene: leaked tasks, swallowed
+                      exceptions, cancellation swallows, unawaited
+                      coroutines (whole library)
+
+``staticcheck --families`` prints this catalog; ``--update-wire-lock``
+regenerates the wire lockfile after an intentional schema change.
 
 Shared philosophy: conservative resolution, zero-false-positive findings,
 skip-don't-guess. Run via ``python tools/staticcheck.py`` (the compatible
@@ -30,31 +40,51 @@ from .concurrency import CONCURRENCY_PREFIXES, check_concurrency
 from .core import (
     ALL_CHECK_NAMES,
     DEFAULT_ROOTS,
+    FAMILIES,
     Finding,
     iter_files,
     main,
     run,
 )
 from .deadcode import check_dead_definitions
+from .dispatch import DISPATCH_PREFIXES, check_dispatch
 from .names import check_undefined_names
 from .signatures import check_call_signatures
+from .taskflow import TASKFLOW_PREFIXES, check_taskflow
 from .trace_safety import TRACE_SAFETY_PREFIXES, check_trace_safety
+from .wire_schema import (
+    LOCK_REL,
+    WIRE_FILES,
+    check_wire_lock,
+    check_wire_schema,
+    update_wire_lock,
+)
 
 __all__ = [
     "ALL_CHECK_NAMES",
     "CLOCK_DISCIPLINE_PREFIXES",
     "CONCURRENCY_PREFIXES",
     "DEFAULT_ROOTS",
+    "DISPATCH_PREFIXES",
+    "FAMILIES",
     "Finding",
+    "LOCK_REL",
+    "TASKFLOW_PREFIXES",
     "TRACE_SAFETY_PREFIXES",
+    "WIRE_FILES",
     "check_call_signatures",
     "check_clock_injection",
     "check_concurrency",
     "check_dead_definitions",
+    "check_dispatch",
+    "check_taskflow",
     "check_trace_safety",
     "check_undefined_names",
+    "check_wire_lock",
+    "check_wire_schema",
     "core",
     "iter_files",
     "main",
     "run",
+    "update_wire_lock",
 ]

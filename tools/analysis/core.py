@@ -62,7 +62,60 @@ ALL_CHECK_NAMES = frozenset({
     "lock-reentrancy",
     "jit-side-effect",
     "jit-traced-branch",
+    # wire_schema family
+    "missing-tag",
+    "missing-encode-arm",
+    "missing-decode-arm",
+    "tag-reuse",
+    "dead-arm",
+    "field-number-drift",
+    "wire-lock-drift",
+    # dispatch family
+    "unreachable-dispatch-arm",
+    "shadowed-arm",
+    "dispatch-return",
+    # taskflow family
+    "leaked-task",
+    "swallowed-exception",
+    "cancellation-swallow",
+    "unawaited-coroutine",
 })
+
+#: The check families, in documentation order — one (name, description)
+#: per analyzer module, listed by ``staticcheck --families``.
+FAMILIES = (
+    ("names", "undefined names and star imports (symtable scope resolution)"),
+    ("signatures", "call-site conformance against the real runtime callees"),
+    ("clocks", "clock-injection discipline: no wall-clock reads in "
+               "protocol/monitoring"),
+    ("deadcode", "tree-wide liveness of module-level definitions"),
+    ("concurrency", "asyncio guarded-by discipline, interleaving hazards, "
+                    "lock re-entrancy"),
+    ("trace_safety", "JAX jit purity and traced-branch staticness over ops/"),
+    ("wire_schema", "wire mirrors (types/codec/proto) cross-checked and "
+                    "frozen in wire.lock.json"),
+    ("dispatch", "RapidRequest dispatch exhaustiveness, shadowed arms, "
+                 "response return types"),
+    ("taskflow", "async failure paths: leaked tasks, swallowed exceptions, "
+                 "cancellation, unawaited coroutines"),
+)
+
+
+def union_member_names(value: "ast.AST") -> "Optional[List[str]]":
+    """The member names of a ``Union[A, B, ...]`` annotation/value node, or
+    None if the node is not a plain-Name Union subscript. Shared by the
+    wire_schema and dispatch families so the two can never disagree about
+    what counts as a union member (e.g. if types.py ever moves to PEP 604
+    ``A | B`` spellings, both learn it in one place)."""
+    if not (
+        isinstance(value, ast.Subscript)
+        and isinstance(value.value, ast.Name)
+        and value.value.id == "Union"
+    ):
+        return None
+    elts = value.slice.elts if isinstance(value.slice, ast.Tuple) else [value.slice]
+    members = [e.id for e in elts if isinstance(e, ast.Name)]
+    return members or None
 
 
 @dataclass(frozen=True)
@@ -109,15 +162,28 @@ def iter_files(roots: Sequence[str] = DEFAULT_ROOTS) -> Iterable[Path]:
 def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
     # The per-file check imports live here (not module top level) so the
     # CLI shim can import this module before sys.path is fully arranged.
-    from . import clocks, concurrency, deadcode, names, signatures, trace_safety
+    from . import (
+        clocks, concurrency, deadcode, dispatch, names, signatures,
+        taskflow, trace_safety, wire_schema,
+    )
 
-    per_file_checks = (
+    per_file_checks = [
         names.check_undefined_names,
         signatures.check_call_signatures,
         clocks.check_clock_injection,
         concurrency.check_concurrency,
         trace_safety.check_trace_safety,
-    )
+        dispatch.check_dispatch,
+        taskflow.check_taskflow,
+    ]
+    full_tree = tuple(roots) == DEFAULT_ROOTS
+    if not full_tree:
+        # Narrowed invocations still get the intra-file wire checks (tag
+        # reuse, dead arms, proto number reuse — presence-gated, so real
+        # mirror files analyzed alone are silent). Full sweeps instead run
+        # the merged three-file check below, which subsumes these; running
+        # both would double-report any intra-file defect.
+        per_file_checks.append(wire_schema.check_wire_schema)
     # Mirror pytest's rootdir behavior: test modules import suite-local
     # helpers both as `tests.helpers` and bare `helpers`. Insert at the
     # FRONT: `tools`/`tests` are common top-level names, and a foreign
@@ -144,11 +210,14 @@ def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
         trees.append((tree, rel(path)))
         for check in per_file_checks:
             findings.extend(check(path, src, tree))
-    if tuple(roots) == DEFAULT_ROOTS:
+    if full_tree:
         # Liveness is only meaningful over the FULL tree: with narrowed CLI
         # roots, code consumed from outside the subset would be reported as
-        # dead — so the check runs only on complete invocations.
+        # dead — so the check runs only on complete invocations. The wire
+        # lockfile gate is likewise whole-surface: it merges the three
+        # mirror files, which a narrowed root set may not all contain.
         findings.extend(deadcode.check_dead_definitions(trees))
+        findings.extend(wire_schema.check_wire_lock(trees))
     return findings
 
 
@@ -175,7 +244,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="comma-separated check names to keep")
     parser.add_argument("--ignore", default=None, metavar="CHECKS",
                         help="comma-separated check names to drop")
+    parser.add_argument("--families", action="store_true",
+                        help="list the registered check families and exit")
+    parser.add_argument("--update-wire-lock", action="store_true",
+                        dest="update_wire_lock",
+                        help="regenerate tools/analysis/wire.lock.json from "
+                             "the live schema mirrors (refuses while the "
+                             "mirrors disagree with each other)")
     args = parser.parse_args(argv)
+    if args.families:
+        for name, description in FAMILIES:
+            print(f"{name:<14} {description}")
+        return 0
+    if args.update_wire_lock:
+        from . import wire_schema
+
+        findings, lock_path = wire_schema.update_wire_lock()
+        if findings:
+            for f in findings:
+                print(f)
+            print("staticcheck: refusing to lock an inconsistent wire "
+                  "surface — fix the mirror disagreements above first")
+            return 1
+        print(f"wrote {lock_path}")
+        return 0
     findings = run(args.roots or DEFAULT_ROOTS)
     if args.select:
         keep = _check_name_set(parser, args.select, "--select")

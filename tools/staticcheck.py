@@ -1,11 +1,12 @@
 """Static-analysis tier — compatible CLI/entry shim over tools/analysis/.
 
-The analyzers grew from two check families into six and moved into the
+The analyzers grew from two check families into nine and moved into the
 ``tools/analysis/`` package (core driver + Finding model + one module per
-family — see its docstring for the catalog). This module stays as the
-stable entry point: ``python tools/staticcheck.py [--json] [--select ...]
-[--ignore ...] [paths...]`` and ``import staticcheck`` both keep working,
-re-exporting the package API unchanged.
+family — see its docstring for the catalog, or ``--families``). This
+module stays as the stable entry point: ``python tools/staticcheck.py
+[--json] [--select ...] [--ignore ...] [--families] [--update-wire-lock]
+[paths...]`` and ``import staticcheck`` both keep working, re-exporting
+the package API unchanged.
 
 Tests that retarget the analysis at a temporary tree patch
 ``staticcheck.core.REPO`` (the package reads it at call time).
@@ -29,17 +30,27 @@ from analysis import (  # noqa: E402,F401 — re-exported API surface
     CLOCK_DISCIPLINE_PREFIXES,
     CONCURRENCY_PREFIXES,
     DEFAULT_ROOTS,
+    DISPATCH_PREFIXES,
+    FAMILIES,
     Finding,
+    LOCK_REL,
+    TASKFLOW_PREFIXES,
     TRACE_SAFETY_PREFIXES,
+    WIRE_FILES,
     check_call_signatures,
     check_clock_injection,
     check_concurrency,
     check_dead_definitions,
+    check_dispatch,
+    check_taskflow,
     check_trace_safety,
     check_undefined_names,
+    check_wire_lock,
+    check_wire_schema,
     iter_files,
     main,
     run,
+    update_wire_lock,
 )
 
 #: Snapshot for path construction by callers; behavior-affecting resolution
@@ -51,19 +62,29 @@ __all__ = [
     "CLOCK_DISCIPLINE_PREFIXES",
     "CONCURRENCY_PREFIXES",
     "DEFAULT_ROOTS",
+    "DISPATCH_PREFIXES",
+    "FAMILIES",
     "Finding",
+    "LOCK_REL",
     "REPO",
+    "TASKFLOW_PREFIXES",
     "TRACE_SAFETY_PREFIXES",
+    "WIRE_FILES",
     "check_call_signatures",
     "check_clock_injection",
     "check_concurrency",
     "check_dead_definitions",
+    "check_dispatch",
+    "check_taskflow",
     "check_trace_safety",
     "check_undefined_names",
+    "check_wire_lock",
+    "check_wire_schema",
     "core",
     "iter_files",
     "main",
     "run",
+    "update_wire_lock",
 ]
 
 if __name__ == "__main__":
