@@ -163,7 +163,7 @@ def run_workload() -> None:
     if platform == "tpu" and not use_pallas:
         print("bench: pallas kernel unusable; using jnp core", file=sys.stderr)
 
-    def build(seed: int):
+    def build(seed: int, spread: int = delivery_spread, prob_permille: int = 1000):
         vc = VirtualCluster.create(
             n,
             n_slots=n + n_join,
@@ -174,8 +174,9 @@ def run_workload() -> None:
             fd_threshold=fd_threshold,
             seed=seed,
             use_pallas=use_pallas,
-            delivery_spread=delivery_spread,
+            delivery_spread=spread,
             concurrent_coordinators=2,
+            delivery_prob_permille=prob_permille,
             pallas_lanes=lanes_main,
         )
         vc.assign_cohorts_roundrobin()
@@ -292,6 +293,54 @@ def run_workload() -> None:
         assert decided_xl and vcx.membership_size == n_xl - n_xl // 100
         _mark(f"1M point: {xl_ms:.1f} ms")
 
+    # Adverse-network variant: the SAME churn resolved under the chaos
+    # subsystem's churn_under_loss fault schedule (rapid_tpu/sim) — its 5%
+    # symmetric loss compiled onto the engine's delivery knobs by the shared
+    # definition (sim/faults.loss_as_engine_delivery: a lost broadcast is a
+    # delivery delayed into the redelivery horizon). This is the perf
+    # trajectory's first adverse-network axis: resolution latency under
+    # loss, not just clean-network. Skipped past the XL budget like the 1M
+    # point (a slow tunnel day must not starve the headline number).
+    from rapid_tpu.sim.faults import loss_as_engine_delivery
+    from rapid_tpu.sim.fuzz import churn_under_loss
+
+    loss_ms = None
+    loss_permille = max(
+        int(e.args["permille"])
+        for e in churn_under_loss(0).events
+        if e.kind == "loss"
+    )
+    loss_knobs = loss_as_engine_delivery(loss_permille)
+    loss_budget_s = _env_int("RAPID_TPU_BENCH_XL_BUDGET_S", 1500)
+    if time.monotonic() - _START <= loss_budget_s:
+        vc, _ = build(
+            seed=100,
+            spread=loss_knobs["delivery_spread"],
+            prob_permille=loss_knobs["delivery_prob_permille"],
+        )
+        vc.sync()
+        _mark(f"loss variant ({loss_permille} permille): compiling (warm-up)")
+        resolve_churn(vc)
+        loss_samples = []
+        for rep in range(2):
+            vc, victims = build(
+                seed=101 + rep,
+                spread=loss_knobs["delivery_spread"],
+                prob_permille=loss_knobs["delivery_prob_permille"],
+            )
+            vc.sync()
+            t0 = time.perf_counter()
+            cuts = resolve_churn(vc)
+            loss_samples.append((time.perf_counter() - t0) * 1000.0)
+            assert vc.membership_size == n and not vc.alive_mask[victims].any()
+            _mark(
+                f"loss sample {rep + 1}/2: {loss_samples[-1]:.1f} ms ({cuts} view changes)"
+            )
+        loss_ms = min(loss_samples)
+    else:
+        _mark("skipping churn_under_loss variant: past the XL time budget")
+
+
     value = min(samples)
     # Bounded log-bucketed histogram of the timed samples (the same
     # fixed-schedule instrument the membership service uses for its phase
@@ -326,6 +375,17 @@ def run_workload() -> None:
                     (n_crash + n_join) * k_rings * n / (value / 1000.0), 0
                 ),
                 "device_rtt_ms": round(rtt_ms, 3),
+                # Adverse-network axis: the same churn under the sim
+                # subsystem's 5%-loss schedule (None when budget-skipped).
+                **(
+                    {
+                        "churn_under_loss_ms": round(loss_ms, 3),
+                        "loss_permille": loss_permille,
+                        "loss_delivery_spread": loss_knobs["delivery_spread"],
+                    }
+                    if loss_ms is not None
+                    else {}
+                ),
                 # Delivery-kernel tile width in effect for the main workload
                 # (autotune provenance); the 1M width only when the separate
                 # 1M point ran.

@@ -121,7 +121,10 @@ class UnicastToAllBroadcaster(Broadcaster):
     def __init__(self, client: MessagingClient, rng: Optional[random.Random] = None) -> None:
         self._client = client
         self._members: List[Endpoint] = []
-        self._rng = rng if rng is not None else random.Random()
+        # The service always threads its identity-seeded rng; this SPI layer
+        # has no identity of its own to derive a seed from, so a bare
+        # standalone construction keeps the stdlib default.
+        self._rng = rng if rng is not None else random.Random()  # unseeded-ok: no identity at this layer; every in-library caller injects the service's seeded rng
 
     def broadcast(self, request: RapidRequest) -> None:
         for member in self._members:

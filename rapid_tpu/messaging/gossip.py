@@ -70,7 +70,10 @@ class GossipBroadcaster(Broadcaster):
         self._self = self_endpoint
         self._fanout = fanout
         self._ttl = ttl
-        self._rng = rng if rng is not None else random.Random()
+        # Identity-seeded default: relay fan-out picks stay decorrelated
+        # across members (different endpoints) but reproducible across runs
+        # (determinism audit, tools/analysis/determinism.py).
+        self._rng = rng if rng is not None else random.Random(f"gossip:{self_endpoint}")
         # Relay state is event-loop-confined (tools/analysis/concurrency.py):
         # broadcast/accept/_relay are synchronous, so every dedup
         # check-then-remember runs atomically under cooperative scheduling —

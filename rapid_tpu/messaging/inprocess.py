@@ -44,6 +44,11 @@ class InProcessNetwork:
         self.blackholed: set = set()
         # Directional blackholes: (src, dst) pairs that drop.
         self.blackholed_links: set = set()
+        # Statistical link shaping (seeded loss/delay/duplication), consulted
+        # per attempt when set — the sim subsystem's LinkShaper
+        # (rapid_tpu/sim/faults.py) plugs in here. None = a perfect network,
+        # zero overhead on the common path.
+        self.shaper = None
         # Account wire-EQUIVALENT bytes (what the codec would put on a TCP
         # frame) in every client/server TransportStats. Off by default: no
         # bytes actually move in-process, and encoding every message only
@@ -173,6 +178,17 @@ class InProcessClient(MessagingClient):
             raise ConnectionError(f"{remote} unreachable (blackholed)")
         if (self.my_addr, remote) in self._network.blackholed_links:
             raise ConnectionError(f"link {self.my_addr}->{remote} blackholed")
+        shaper = self._network.shaper
+        duplicated = False
+        if shaper is not None:
+            plan = shaper.plan(self.my_addr, remote)
+            if plan.drop:
+                raise ConnectionError(
+                    f"link {self.my_addr}->{remote} dropped (shaper)"
+                )
+            if plan.delay_ms > 0:
+                await shaper.hold_ms(plan.delay_ms)
+            duplicated = plan.duplicate
         server = self._network.server_for(remote)
         if server is None:
             raise ConnectionError(f"no server at {remote}")
@@ -181,6 +197,20 @@ class InProcessClient(MessagingClient):
         )
         # Yield to the loop so in-process delivery preserves async semantics.
         await asyncio.sleep(0)
+        if duplicated:
+            # A duplicated datagram: the server handles the request twice
+            # (exercising receiver-side dedup — gossip first-seen, alert
+            # report idempotency); the caller sees the second response, as a
+            # real retransmit's caller would. The first copy's fate is
+            # independent of the second's: a server-side drop (interceptor)
+            # or timeout on one copy must not fail the other.
+            try:
+                await asyncio.wait_for(
+                    server.handle(request),
+                    timeout=self._timeout_ms_for(request) / 1000.0,
+                )
+            except (ConnectionError, asyncio.TimeoutError):
+                pass
         response = await asyncio.wait_for(
             server.handle(request), timeout=self._timeout_ms_for(request) / 1000.0
         )

@@ -143,6 +143,7 @@ class Cluster:
         cut_detector_factory=None,
         vote_tally_factory=None,
         broadcaster_factory=None,
+        node_id: Optional[NodeId] = None,
     ) -> "Cluster":
         """Bootstrap a one-node cluster (Cluster.java:255-280).
         ``cut_detector_factory(k, h, l)`` swaps the detector implementation
@@ -157,7 +158,9 @@ class Cluster:
         settings.validate()
         client, server = cls._make_transport(listen_address, settings, network, client, server)
         fd_factory = fd_factory or PingPongFailureDetectorFactory(listen_address, client)
-        node_id = NodeId.from_uuid()
+        # An injected identity makes a simulated run a pure function of its
+        # seed (rapid_tpu/sim); production callers omit it and get a UUID.
+        node_id = node_id if node_id is not None else NodeId.from_uuid()
         view = MembershipView(
             settings.k,
             node_ids=[node_id],
@@ -216,6 +219,7 @@ class Cluster:
         cut_detector_factory=None,
         vote_tally_factory=None,
         broadcaster_factory=None,
+        node_id: Optional[NodeId] = None,
     ) -> "Cluster":
         """Two-phase join through ``seed_address`` with retries
         (Cluster.java:303-344)."""
@@ -223,7 +227,10 @@ class Cluster:
         settings.validate()
         client, server = cls._make_transport(listen_address, settings, network, client, server)
         fd_factory = fd_factory or PingPongFailureDetectorFactory(listen_address, client)
-        node_id = NodeId.from_uuid()
+        # Injected identity: see start(). The UUID_ALREADY_IN_RING retry
+        # below still re-mints — identity reuse is rejected by the protocol
+        # whatever the caller supplied.
+        node_id = node_id if node_id is not None else NodeId.from_uuid()
         # The server starts before the service exists; probes are answered
         # with BOOTSTRAPPING in the meantime (Cluster.java:312).
         await server.start()

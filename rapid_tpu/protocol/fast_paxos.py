@@ -64,7 +64,14 @@ class FastPaxos:
         self._broadcast = broadcast_fn
         self._clock = clock
         self._base_delay_ms = consensus_fallback_base_delay_ms
-        self._rng = rng if rng is not None else random.Random()
+        # Identity-seeded default (the service always injects its own rng;
+        # this covers direct construction): decorrelated across nodes AND
+        # configurations, reproducible across runs.
+        self._rng = (
+            rng
+            if rng is not None
+            else random.Random(f"paxos:{my_addr}:{configuration_id}")
+        )
         # Pluggable tally: None = host hash-map counting; a DeviceVoteTally
         # turns each vote into a device-array write with the quorum check on
         # the accelerator (rapid_tpu.protocol.device_vote_tally).

@@ -160,7 +160,12 @@ class MembershipService:
         self.client = client
         self.fd_factory = fd_factory
         self.clock = clock if clock is not None else AsyncioClock()
-        self.rng = rng if rng is not None else random.Random()
+        # Identity-seeded default: per-node jitter streams stay decorrelated
+        # (different endpoints, different seeds) but every run of the same
+        # node is reproducible — the determinism-audit contract the chaos
+        # subsystem (rapid_tpu/sim) builds on. Callers wanting entropy can
+        # still inject random.Random(None) explicitly.
+        self.rng = rng if rng is not None else random.Random(f"rapid:{my_addr}")
         self.metadata_manager = MetadataManager()  # guarded-by: _lock
         if metadata_map:
             self.metadata_manager.add_metadata(metadata_map)

@@ -1,0 +1,38 @@
+"""Deterministic chaos-simulation subsystem.
+
+The reference validates Rapid's headline claim — stable, consistent
+membership under adverse networks — with fault-injection test fixtures
+(``MessageDropInterceptor.java``) driven by hand-written scenarios. This
+package turns that into a subsystem in the FoundationDB/Jepsen mold:
+
+- :mod:`rapid_tpu.sim.faults` — a declarative, serializable fault-schedule
+  model (link loss/delay/duplication, symmetric and asymmetric partitions,
+  crash/restart, clock skew/pause) compiled onto the in-process transport's
+  fault seams and the injected clock, so a whole run is a pure function of
+  one seed;
+- :mod:`rapid_tpu.sim.scenario` — the scenario runner: builds a cluster,
+  steps simulated time, applies the schedule, and captures a replayable
+  repro artifact (schedule + per-node flight recordings + outcome);
+- :mod:`rapid_tpu.sim.oracles` — invariant checkers executed after every
+  run: configuration-chain consistency (no split-brain), per-node
+  monotonicity, final agreement, eviction discipline, bounded convergence,
+  and the differential host<->device oracle that replays the same schedule
+  through the jitted engine;
+- :mod:`rapid_tpu.sim.fuzz` — seeded random-schedule generation plus a
+  greedy shrinker that minimizes any oracle-violating schedule into the
+  smallest repro that still fails.
+
+``tools/chaosrun.py`` is the CLI over all four.
+"""
+
+from rapid_tpu.sim.faults import FaultEvent, FaultSchedule, LinkShaper
+from rapid_tpu.sim.scenario import RunResult, ScenarioRunner, SimHarness
+
+__all__ = [
+    "FaultEvent",
+    "FaultSchedule",
+    "LinkShaper",
+    "RunResult",
+    "ScenarioRunner",
+    "SimHarness",
+]

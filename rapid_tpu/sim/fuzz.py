@@ -1,0 +1,412 @@
+"""Schedule-space search: seeded generation, named families, shrinking.
+
+Three pieces:
+
+- **Named scenario families** — the four canonical adverse-network shapes
+  (partition-heal, asymmetric link, crash-during-join, churn-under-loss),
+  each a seeded generator over a fixed slot geometry so every (family, seed)
+  pair is one pinned, replayable scenario. The tier-1 chaos smoke runs a
+  pinned grid of these; ``tools/chaosrun.py`` runs them by name.
+- **Random schedules** — :func:`random_schedule` draws arbitrary mixes of
+  membership phases and environment faults, sized to keep the cluster
+  decidable (slot 0 never faulted, enough reachable voters for a classic
+  majority, partitions always healed) so a violation means the PROTOCOL
+  broke, not the scenario.
+- **The shrinker** — :func:`shrink` greedily minimizes an oracle-violating
+  schedule: drop events, shrink fault sets, zero dwell times — accepting a
+  reduction only if the original violation (same oracle set) still fires.
+  The result is the smallest repro the greedy pass can reach, which is what
+  gets written to disk and attached to the bug.
+
+All geometry is shared (``N0``/``N_SLOTS``) so the differential oracle's
+engine executable compiles once per process, not once per seed.
+"""
+
+from __future__ import annotations
+
+import random
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from rapid_tpu.sim.faults import FaultEvent, FaultSchedule, ScheduleError
+from rapid_tpu.sim.oracles import Violation, check_all
+from rapid_tpu.sim.scenario import RunResult, ScenarioRunner
+
+#: One slot geometry for every generated scenario: 8 initial members, a
+#: 4-slot joiner pool. Small enough that a full run is cheap, large enough
+#: that H=9-of-K=10 cut detection, fast-quorum arithmetic (7 of 8), and the
+#: classic fallback (majority 5) are all exercised.
+N0 = 8
+N_SLOTS = 12
+
+
+def _initial_live(rng: random.Random) -> List[int]:
+    """Non-seed initial members, shuffled — faultable in draw order."""
+    live = list(range(1, N0))
+    rng.shuffle(live)
+    return live
+
+
+# ---------------------------------------------------------------------------
+# the four named families
+# ---------------------------------------------------------------------------
+
+
+def partition_heal(seed: int) -> FaultSchedule:
+    """One-way partition across a crash decision, then heal: the blocked
+    members' ingress is dead through the view change — they miss the
+    decision (and hold the fast round below quorum, so the CLASSIC path
+    decides) and must re-join the configuration through config pulls, first
+    through the partition, then after the heal."""
+    rng = random.Random(f"partition-heal:{seed}")
+    pool = _initial_live(rng)
+    blocked, victim = sorted(pool[:2]), pool[2]
+    return FaultSchedule(
+        n0=N0, n_slots=N_SLOTS, seed=seed, name=f"partition_heal/{seed}",
+        events=[
+            FaultEvent("ingress_block", tuple(blocked), dwell_ms=500),
+            FaultEvent("crash", (victim,), dwell_ms=1_000),
+            FaultEvent("heal_partitions", dwell_ms=500),
+        ],
+    )
+
+
+def asymmetric_link(seed: int) -> FaultSchedule:
+    """A one-way ingress partition (the victim still sends — the asymmetric
+    failure the paper's §1 motivates): observers evict it; a fresh joiner
+    then arrives through the healed network."""
+    rng = random.Random(f"asymmetric-link:{seed}")
+    pool = _initial_live(rng)
+    victim, skewed = pool[0], pool[1]
+    joiner = N0 + (seed % (N_SLOTS - N0))
+    return FaultSchedule(
+        n0=N0, n_slots=N_SLOTS, seed=seed, name=f"asymmetric_link/{seed}",
+        events=[
+            FaultEvent("clock_skew", (skewed,), args={"offset_ms": 350.0}),
+            FaultEvent("partition_oneway", (victim,), dwell_ms=1_000),
+            FaultEvent("heal_partitions", dwell_ms=500),
+            FaultEvent("join", (joiner,), dwell_ms=500),
+        ],
+    )
+
+
+def crash_during_join(seed: int) -> FaultSchedule:
+    """A join wave overlapped with a crash (settle=False): the join's UP
+    alerts and the crash's DOWN alerts race into the same cut detectors —
+    the straddling-configuration shape of the fixed-scenario oracle."""
+    rng = random.Random(f"crash-during-join:{seed}")
+    pool = _initial_live(rng)
+    victim = pool[0]
+    joiners = tuple(range(N0, N0 + 2))
+    return FaultSchedule(
+        n0=N0, n_slots=N_SLOTS, seed=seed, name=f"crash_during_join/{seed}",
+        events=[
+            FaultEvent("join", joiners, settle=False),
+            FaultEvent("crash", (victim,), dwell_ms=1_000),
+        ],
+    )
+
+
+def churn_under_loss(seed: int) -> FaultSchedule:
+    """Sustained 5% symmetric message loss (plus duplication) while the
+    membership churns — joins, a crash, a graceful leave. The delivery-
+    liveness machinery (alert redelivery, config-sync pulls) must absorb
+    the loss; the decided cuts must be exactly the clean-network ones."""
+    rng = random.Random(f"churn-under-loss:{seed}")
+    pool = _initial_live(rng)
+    victim, leaver = pool[0], pool[1]
+    joiners = tuple(range(N0, N0 + 2))
+    return FaultSchedule(
+        n0=N0, n_slots=N_SLOTS, seed=seed, name=f"churn_under_loss/{seed}",
+        events=[
+            FaultEvent("loss", args={"permille": 50}),
+            FaultEvent("duplicate", args={"permille": 20}),
+            FaultEvent("join", joiners, dwell_ms=500),
+            FaultEvent("crash", (victim,), dwell_ms=500),
+            FaultEvent("leave", (leaver,), dwell_ms=500),
+            FaultEvent("loss", args={"permille": 0}),
+        ],
+    )
+
+
+FAMILIES: Dict[str, Callable[[int], FaultSchedule]] = {
+    "partition_heal": partition_heal,
+    "asymmetric_link": asymmetric_link,
+    "crash_during_join": crash_during_join,
+    "churn_under_loss": churn_under_loss,
+}
+
+
+def scenario_family(name: str, seed: int) -> FaultSchedule:
+    try:
+        return FAMILIES[name](seed)
+    except KeyError:
+        raise ScheduleError(
+            f"unknown scenario family {name!r}; known: {sorted(FAMILIES)}"
+        ) from None
+
+
+# ---------------------------------------------------------------------------
+# random schedules
+# ---------------------------------------------------------------------------
+
+
+def random_schedule(seed: int, phases: Optional[int] = None) -> FaultSchedule:
+    """A seeded random mix of membership phases and environment faults over
+    the shared geometry. Sizing rules keep every schedule decidable — a
+    violation means the protocol broke, not the scenario: slot 0 is never
+    faulted, at most 2 slots are ingress-blocked at once, full symmetric
+    partitions only appear as (partition, heal) brackets with no membership
+    phase in between (spanning one can legitimately wedge detection below
+    H — the shape reserved for the shrinker's violating schedules), every
+    block heals, loss stays <= 8%, and membership never drops below 2/3 of
+    its peak."""
+    rng = random.Random(f"rapid-fuzz:{seed}")
+    live = set(range(N0))
+    peak = N0
+    fresh = list(range(N0, N_SLOTS))
+    removed: List[int] = []
+    events: List[FaultEvent] = []
+    partitioned = False
+    blocked_now: set = set()
+
+    lossy = rng.random() < 0.5
+    if lossy:
+        events.append(FaultEvent("loss", args={"permille": rng.choice([20, 50, 80])}))
+    if rng.random() < 0.3:
+        events.append(FaultEvent("duplicate", args={"permille": 20}))
+    if rng.random() < 0.3:
+        events.append(FaultEvent(
+            "delay", args={"min_ms": 0.0, "max_ms": float(rng.choice([50, 150]))}
+        ))
+
+    for _ in range(phases if phases is not None else rng.randint(2, 4)):
+        floor = (peak * 2) // 3
+        removable = len(live) - floor
+        choices = ["join", "crash", "leave", "partition_oneway"]
+        if removed:
+            choices.append("restart")
+        if not partitioned and removable >= 2 and rng.random() < 0.4:
+            blocked = rng.sample(sorted(live - {0}), rng.randint(1, 2))
+            events.append(
+                FaultEvent("ingress_block", tuple(sorted(blocked)), dwell_ms=500)
+            )
+            partitioned = True
+            blocked_now = set(blocked)
+        elif not partitioned and rng.random() < 0.2:
+            # A full symmetric partition, healed before the next membership
+            # phase: a sub-detection-threshold network blip the cluster must
+            # ride out without any membership effect.
+            blipped = rng.sample(sorted(live - {0}), 1)
+            events.append(FaultEvent("partition", tuple(blipped), dwell_ms=1_000))
+            events.append(FaultEvent("heal_partitions", dwell_ms=500))
+        kind = rng.choice(choices)
+        if kind in ("join", "restart") and blocked_now:
+            # An admission while members are ingress-blocked can wedge
+            # legitimately: if >= K-H+1 of the joiner's gatekeepers cannot
+            # RECEIVE its phase-2 join messages, the admission cut sits
+            # below H until the heal — a real protocol property, but not a
+            # schedule that must converge. Generated schedules admit only
+            # on an unblocked network; the pinned chaos soak covers the
+            # join-under-partition shapes that do work.
+            kind = "crash"
+        if kind == "join" and fresh:
+            size = rng.randint(1, min(2, len(fresh)))
+            slots = [fresh.pop(0) for _ in range(size)]
+            events.append(FaultEvent("join", tuple(slots), dwell_ms=500))
+            live |= set(slots)
+            peak = max(peak, len(live))
+        elif kind == "restart" and removed:
+            slot = removed.pop(0)
+            events.append(FaultEvent("restart", (slot,), dwell_ms=500))
+            live.add(slot)
+            peak = max(peak, len(live))
+        # Quorum headroom: the decision evicting this phase's victims runs
+        # inside the PRE-phase configuration (majority of len(live)), and
+        # neither the victims nor the ingress-blocked members can vote (the
+        # blocked cannot hear the proposal). Reachable voters must keep a
+        # classic majority or the phase wedges until the heal — a real
+        # protocol property, but not a schedule that must converge.
+        # Under sustained loss, a margin-less quorum (exactly majority
+        # reachable) can stall for many simulated seconds — every consensus
+        # message of some round must land. Keep one voter of slack.
+        max_victims = (
+            len(live) - len(blocked_now) - (len(live) // 2 + 1) - (1 if lossy else 0)
+        )
+        if kind == "crash" and removable >= 1 and max_victims >= 1:
+            candidates = sorted(live - {0} - blocked_now)
+            if not candidates:
+                continue
+            size = rng.randint(1, min(2, removable, max_victims, len(candidates)))
+            slots = rng.sample(candidates, size)
+            events.append(FaultEvent("crash", tuple(sorted(slots)), dwell_ms=500))
+            live -= set(slots)
+            removed.extend(slots)
+        elif kind in ("leave", "partition_oneway") and removable >= 1 and max_victims >= 1:
+            candidates = sorted(live - {0} - blocked_now)
+            if not candidates:
+                continue
+            slot = rng.choice(candidates)
+            events.append(FaultEvent(kind, (slot,), dwell_ms=500))
+            live -= {slot}
+            removed.append(slot)
+        if partitioned and rng.random() < 0.6:
+            events.append(FaultEvent("heal_partitions", dwell_ms=500))
+            partitioned = False
+            blocked_now = set()
+
+    if partitioned:
+        events.append(FaultEvent("heal_partitions", dwell_ms=500))
+    events.append(FaultEvent("loss", args={"permille": 0}))
+    schedule = FaultSchedule(
+        n0=N0, n_slots=N_SLOTS, seed=seed, name=f"fuzz/{seed}", events=events
+    )
+    schedule.validate()
+    return schedule
+
+
+# ---------------------------------------------------------------------------
+# running, shrinking, replaying
+# ---------------------------------------------------------------------------
+
+
+def run_schedule(schedule: FaultSchedule) -> RunResult:
+    return ScenarioRunner(schedule).run()
+
+
+def _violation_names(violations: Iterable[Violation]) -> frozenset:
+    return frozenset(v.oracle for v in violations)
+
+
+def _shrink_candidates(schedule: FaultSchedule) -> Iterable[FaultSchedule]:
+    """Reductions in decreasing aggressiveness: drop an event, drop one slot
+    from a multi-slot fault, zero a dwell. Each candidate revalidates, so a
+    reduction that orphans a later event (e.g. removing a join whose slot is
+    later crashed) is skipped, not crashed on."""
+    events = schedule.events
+
+    def rebuilt(new_events: List[FaultEvent]) -> FaultSchedule:
+        return FaultSchedule(
+            n0=schedule.n0, n_slots=schedule.n_slots, seed=schedule.seed,
+            events=new_events, converge_budget_ms=schedule.converge_budget_ms,
+            phase_budget_ms=schedule.phase_budget_ms, name=schedule.name,
+        )
+
+    for i in range(len(events)):
+        yield rebuilt(events[:i] + events[i + 1:])
+    for i, event in enumerate(events):
+        if len(event.slots) > 1:
+            for j in range(len(event.slots)):
+                slots = event.slots[:j] + event.slots[j + 1:]
+                reduced = FaultEvent(
+                    event.kind, slots, dict(event.args), event.dwell_ms, event.settle
+                )
+                yield rebuilt(events[:i] + [reduced] + events[i + 1:])
+    for i, event in enumerate(events):
+        if event.dwell_ms > 0:
+            reduced = FaultEvent(
+                event.kind, event.slots, dict(event.args), 0.0, event.settle
+            )
+            yield rebuilt(events[:i] + [reduced] + events[i + 1:])
+
+
+def shrink(
+    schedule: FaultSchedule,
+    violations: List[Violation],
+    max_runs: int = 80,
+) -> Tuple[FaultSchedule, List[Violation], int]:
+    """Greedily minimize an oracle-violating schedule: accept any reduction
+    under which every oracle of the ORIGINAL violation set still fires.
+    The differential oracle is excluded from the preserved set — the loop
+    re-runs candidates without the (expensive) engine replay, so it could
+    never observe a differential violation and would otherwise reject every
+    reduction; callers re-verify the final repro with the full battery.
+    Returns (minimal schedule, its violations, runs spent)."""
+    target = _violation_names(violations) - {"differential"}
+    if not target:
+        if _violation_names(violations):
+            raise ValueError(
+                "cannot shrink a differential-only violation: the shrink "
+                "loop runs without the engine replay"
+            )
+        raise ValueError("nothing to shrink: the schedule passed its oracles")
+    current, current_violations = schedule, violations
+    runs = 0
+    improved = True
+    while improved and runs < max_runs:
+        improved = False
+        for candidate in _shrink_candidates(current):
+            if runs >= max_runs:
+                break
+            try:
+                candidate.validate()
+            except ScheduleError:
+                continue
+            result = run_schedule(candidate)
+            runs += 1
+            got = check_all(result, differential=False)
+            if target <= _violation_names(got):
+                current, current_violations = candidate, got
+                improved = True
+                break
+    return current, current_violations, runs
+
+
+def write_repro(
+    result: RunResult,
+    violations: List[Violation],
+    directory,
+) -> Path:
+    """The full repro artifact: the run's schedule and captures, plus the
+    violations it proves."""
+    directory = Path(result.write_repro(directory))
+    (directory / "violations.txt").write_text(
+        "".join(f"{v}\n" for v in violations) or "(none)\n"
+    )
+    return directory
+
+
+def replay(directory) -> Tuple[RunResult, List[Violation]]:
+    """Re-run a written repro: loads ``schedule.json`` and replays it (same
+    seed, same draws, same simulated clock) through the full oracle
+    battery. Deterministic: the violations reproduce exactly."""
+    schedule = FaultSchedule.from_json(
+        (Path(directory) / "schedule.json").read_text()
+    )
+    result = run_schedule(schedule)
+    return result, check_all(result)
+
+
+def fuzz(
+    seeds: Iterable[int],
+    out_dir=None,
+    shrink_failures: bool = True,
+) -> List[dict]:
+    """Run random schedules over ``seeds``; on any oracle violation, shrink
+    to a minimal repro and (when ``out_dir`` is given) write it to
+    ``<out_dir>/seed<N>/``. Returns one summary dict per seed."""
+    summaries = []
+    for seed in seeds:
+        schedule = random_schedule(seed)
+        result = run_schedule(schedule)
+        violations = check_all(result)
+        summary: dict = {
+            "seed": seed,
+            "events": len(schedule.events),
+            "violations": [str(v) for v in violations],
+        }
+        if violations and shrink_failures:
+            minimal, _, runs = shrink(schedule, violations)
+            summary["shrunk_events"] = len(minimal.events)
+            summary["shrink_runs"] = runs
+            if out_dir is not None:
+                repro_dir = Path(out_dir) / f"seed{seed}"
+                # Re-verify the minimal schedule with the FULL battery
+                # (shrink ran without the differential replay): the repro's
+                # recorded violations must be exactly what a replay sees,
+                # or `chaosrun replay` would flag its own artifact.
+                min_result = run_schedule(minimal)
+                write_repro(min_result, check_all(min_result), repro_dir)
+                summary["repro"] = str(repro_dir)
+        summaries.append(summary)
+    return summaries

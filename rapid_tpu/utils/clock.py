@@ -61,6 +61,90 @@ class AsyncioClock(Clock):
         return CancelHandle(handle.cancel)
 
 
+class NodeClock(Clock):
+    """Per-node view of a shared base clock, with injectable skew and pause.
+
+    The chaos-simulation subsystem (rapid_tpu/sim) gives every simulated
+    node its own ``NodeClock`` over the test's one ``ManualClock`` so fault
+    schedules can express per-node clock faults deterministically:
+
+    - **skew**: ``set_skew(offset_ms)`` shifts this node's ``now_ms``
+      readings (timestamps, metrics, batching-window arithmetic) without
+      touching anyone else's — the classic mis-set-NTP failure mode;
+    - **pause**: ``pause()`` freezes ``now_ms`` AND defers every timer the
+      node scheduled (its failure detectors, alert batcher, sync loops all
+      stop firing) until ``resume()`` — a GC pause / VM freeze. The node
+      still answers inbound RPCs, which is exactly what makes real frozen
+      processes so confusing to their peers.
+
+    Timers are scheduled on the base clock; a callback landing while paused
+    is parked and re-armed (delay 0) at resume, so no tick is lost, only
+    late — matching a thawed process running its overdue timers.
+    """
+
+    def __init__(self, base: Clock) -> None:
+        self._base = base
+        self._offset_ms = 0.0
+        self._paused = False
+        self._paused_at = 0.0
+        self._parked: List[Callable[[], None]] = []
+
+    def now_ms(self) -> float:
+        if self._paused:
+            return self._paused_at
+        return self._base.now_ms() + self._offset_ms
+
+    def set_skew(self, offset_ms: float) -> None:
+        if self._paused:
+            raise RuntimeError("cannot re-skew a paused clock (resume first)")
+        self._offset_ms = offset_ms
+
+    @property
+    def paused(self) -> bool:
+        return self._paused
+
+    def pause(self) -> None:
+        if self._paused:
+            return
+        self._paused_at = self.now_ms()
+        self._paused = True
+
+    def resume(self) -> None:
+        if not self._paused:
+            return
+        self._paused = False
+        parked, self._parked = self._parked, []
+        for fn in parked:
+            # Re-armed rather than run inline: resume() is called from
+            # synchronous schedule-application code, and overdue callbacks
+            # must fire from the clock/loop context they were written for.
+            self._base.call_later_ms(0, fn)
+
+    async def sleep_ms(self, delay_ms: float) -> None:
+        event = asyncio.Event()
+        self.call_later_ms(delay_ms, event.set)
+        await event.wait()
+
+    def call_later_ms(self, delay_ms: float, fn: Callable[[], None]) -> CancelHandle:
+        cancelled = [False]
+
+        def fire() -> None:
+            if cancelled[0]:
+                return
+            if self._paused:
+                self._parked.append(fire)
+            else:
+                fn()
+
+        inner = self._base.call_later_ms(delay_ms, fire)
+
+        def cancel() -> None:
+            cancelled[0] = True
+            inner.cancel()
+
+        return CancelHandle(cancel)
+
+
 class ManualClock(Clock):
     """Deterministic clock for unit tests: time only moves via ``advance_ms``."""
 

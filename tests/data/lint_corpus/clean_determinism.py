@@ -1,0 +1,28 @@
+"""Seeded corpus, clean counterpart: every randomness source is injectable
+or identity-seeded, plus the annotated-exception spelling — none of these
+may produce a finding."""
+
+import random
+
+import numpy as np
+
+
+class SeededJitter:
+    def __init__(self, my_addr, rng=None):
+        self.rng = rng if rng is not None else random.Random(f"jitter:{my_addr}")
+
+    def pick(self, members):
+        return self.rng.choice(members)
+
+
+def explicit_entropy(rng=None):
+    # The documented escape hatch: a deliberate entropy default.
+    return rng if rng is not None else random.Random()  # unseeded-ok: corpus example of the annotated exception
+
+
+def seeded_numpy(seed):
+    return np.random.default_rng(seed)
+
+
+def constructed_generator(seed):
+    return np.random.Generator(np.random.PCG64(seed))

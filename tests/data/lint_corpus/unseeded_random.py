@@ -1,0 +1,46 @@
+"""Seeded corpus: unseeded randomness inside the library.
+
+Every spelling of entropy-seeded randomness the determinism family bans:
+an unseeded ``random.Random()``, module-level draws on the global
+generator, from-imports aliasing it, and numpy's unseeded ``default_rng``.
+The ``# expect:`` markers drive tests/test_staticcheck.py's corpus gate.
+"""
+
+import random
+from random import choice  # expect: unseeded-random
+
+import numpy as np
+
+
+class JitterSource:
+    def __init__(self, rng=None):
+        self.rng = rng if rng is not None else random.Random()  # expect: unseeded-random
+
+
+def pick_peer(members):
+    return random.choice(members)  # expect: unseeded-random
+
+
+def delay_ms():
+    return random.random() * 100.0  # expect: unseeded-random
+
+
+def reseed_global():
+    random.seed()  # expect: unseeded-random
+
+
+def seeded_looking_system_random(seed):
+    # SystemRandom IGNORES its seed argument: flagged even when "seeded".
+    return random.SystemRandom(seed)  # expect: unseeded-random
+
+
+def numpy_stream():
+    return np.random.default_rng()  # expect: unseeded-random
+
+
+def numpy_legacy(n):
+    return np.random.permutation(n)  # expect: unseeded-random
+
+
+def aliased(members):
+    return choice(members)

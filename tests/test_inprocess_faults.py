@@ -1,0 +1,172 @@
+"""Direct unit tests for the in-process transport's fault seams.
+
+The chaos subsystem compiles schedules onto ``blackholed``,
+``blackholed_links``, ``ServerDropFirstN``, and the ``shaper`` hook — until
+now those seams were only exercised incidentally inside whole-cluster chaos
+tests. These tests pin their exact semantics at the transport level:
+directionality of link blackholes, heal behavior, interceptor interaction,
+and the shaper's three message fates (drop / simulated-time delay /
+server-side double delivery)."""
+
+import asyncio
+import functools
+import random
+
+import pytest
+
+from rapid_tpu.messaging.inprocess import (
+    InProcessClient,
+    InProcessNetwork,
+    InProcessServer,
+    ServerDropFirstN,
+)
+from rapid_tpu.sim.faults import LinkShaper
+from rapid_tpu.types import (
+    Endpoint,
+    NodeStatus,
+    ProbeMessage,
+    ProbeResponse,
+)
+from rapid_tpu.utils.clock import ManualClock
+
+A = Endpoint("10.99.0.1", 1)
+B = Endpoint("10.99.0.2", 2)
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        async def with_timeout():
+            await asyncio.wait_for(fn(*args, **kwargs), timeout=30)
+
+        asyncio.run(with_timeout())
+
+    return wrapper
+
+
+async def _pair(network):
+    """Servers at A and B (service-less: probes answered BOOTSTRAPPING) and
+    clients at both, attached to one network."""
+    servers = {}
+    clients = {}
+    for endpoint in (A, B):
+        server = InProcessServer(network, endpoint)
+        await server.start()
+        servers[endpoint] = server
+        clients[endpoint] = InProcessClient(network, endpoint)
+    return servers, clients
+
+
+@async_test
+async def test_blackholed_links_are_directional():
+    network = InProcessNetwork()
+    _, clients = await _pair(network)
+    network.blackholed_links.add((A, B))
+
+    # A -> B drops ...
+    assert await clients[A].send_best_effort(B, ProbeMessage(sender=A)) is None
+    # ... while B -> A delivers on the very same link pair.
+    response = await clients[B].send_best_effort(A, ProbeMessage(sender=B))
+    assert isinstance(response, ProbeResponse)
+    assert response.status == NodeStatus.BOOTSTRAPPING
+
+
+@async_test
+async def test_blackhole_then_heal_restores_delivery():
+    network = InProcessNetwork()
+    _, clients = await _pair(network)
+
+    network.blackholed.add(B)
+    assert await clients[A].send_best_effort(B, ProbeMessage(sender=A)) is None
+    # A node-level blackhole also silences the victim's EGRESS (a crashed
+    # process neither answers nor sends).
+    assert await clients[B].send_best_effort(A, ProbeMessage(sender=B)) is None
+
+    network.blackholed.discard(B)
+    assert await clients[A].send_best_effort(B, ProbeMessage(sender=A)) is not None
+
+    network.blackholed_links.add((A, B))
+    assert await clients[A].send_best_effort(B, ProbeMessage(sender=A)) is None
+    network.blackholed_links.discard((A, B))
+    assert await clients[A].send_best_effort(B, ProbeMessage(sender=A)) is not None
+
+
+@async_test
+async def test_drop_first_n_interacts_with_link_faults():
+    network = InProcessNetwork()
+    servers, clients = await _pair(network)
+    servers[B].drop_interceptors.append(ServerDropFirstN(ProbeMessage, 2))
+
+    # While the link is blackholed the message never REACHES the server, so
+    # the interceptor's drop budget must not be consumed.
+    network.blackholed_links.add((A, B))
+    assert await clients[A].send_best_effort(B, ProbeMessage(sender=A)) is None
+    network.blackholed_links.discard((A, B))
+
+    # The budget is intact: exactly the next two server-side deliveries drop.
+    assert await clients[A].send_best_effort(B, ProbeMessage(sender=A)) is None
+    assert await clients[A].send_best_effort(B, ProbeMessage(sender=A)) is None
+    assert await clients[A].send_best_effort(B, ProbeMessage(sender=A)) is not None
+
+
+@async_test
+async def test_shaper_drop_and_duplicate_fates():
+    network = InProcessNetwork()
+    servers, clients = await _pair(network)
+    shaper = LinkShaper(random.Random(0), ManualClock())
+    network.shaper = shaper
+
+    shaper.loss_permille = 1000  # every message dropped
+    assert await clients[A].send_best_effort(B, ProbeMessage(sender=A)) is None
+    assert shaper.dropped == 1
+
+    shaper.loss_permille = 0
+    shaper.dup_permille = 1000  # every message delivered twice
+    servers[B].drop_interceptors.append(ServerDropFirstN(ProbeMessage, 1))
+    # One logical send: the duplicate consumes the interceptor's single drop
+    # at the server, and the caller still gets the second copy's response —
+    # receiver-side dedup is what duplication exercises.
+    response = await clients[A].send_best_effort(B, ProbeMessage(sender=A))
+    assert isinstance(response, ProbeResponse)
+    assert shaper.duplicated == 1
+
+
+@async_test
+async def test_shaper_delay_holds_for_simulated_time():
+    network = InProcessNetwork()
+    clock = ManualClock()
+    _, clients = await _pair(network)
+    shaper = LinkShaper(random.Random(0), clock)
+    network.shaper = shaper
+    shaper.delay_min_ms = 100.0
+    shaper.delay_max_ms = 100.0
+
+    task = asyncio.ensure_future(
+        clients[A].send_best_effort(B, ProbeMessage(sender=A))
+    )
+    for _ in range(20):
+        await asyncio.sleep(0)
+    assert not task.done()  # held: simulated time has not advanced
+    clock.advance_ms(101)
+    for _ in range(20):
+        await asyncio.sleep(0)
+    assert task.done()
+    assert isinstance(task.result(), ProbeResponse)
+    assert shaper.delayed == 1
+
+
+@async_test
+async def test_shaper_none_is_the_default_clean_path():
+    network = InProcessNetwork()
+    _, clients = await _pair(network)
+    assert network.shaper is None
+    assert isinstance(
+        await clients[A].send_best_effort(B, ProbeMessage(sender=A)),
+        ProbeResponse,
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))

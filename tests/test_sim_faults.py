@@ -1,0 +1,243 @@
+"""Unit tests for the chaos-simulation fault model (rapid_tpu/sim/faults.py)
+and the per-node clock (utils/clock.NodeClock): schedule serialization round
+trips, lifecycle validation, shaper determinism, and clock skew/pause
+semantics — the pieces everything else in the subsystem builds on."""
+
+import asyncio
+import functools
+
+import pytest
+
+from rapid_tpu.sim.faults import (
+    FaultEvent,
+    FaultSchedule,
+    LinkShaper,
+    ScheduleError,
+    loss_as_engine_delivery,
+    schedule_rng,
+)
+from rapid_tpu.sim.fuzz import FAMILIES, random_schedule
+from rapid_tpu.utils.clock import ManualClock, NodeClock
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        async def with_timeout():
+            await asyncio.wait_for(fn(*args, **kwargs), timeout=60)
+
+        asyncio.run(with_timeout())
+
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# schedule model
+# ---------------------------------------------------------------------------
+
+
+def test_schedule_json_round_trip_is_identity():
+    schedule = FaultSchedule(
+        n0=8, n_slots=12, seed=77, name="rt",
+        converge_budget_ms=44_000.0, phase_budget_ms=33_000.0,
+        events=[
+            FaultEvent("loss", args={"permille": 50}),
+            FaultEvent("join", (8, 9), settle=False),
+            FaultEvent("crash", (3,), dwell_ms=250.5),
+            FaultEvent("clock_skew", (2,), args={"offset_ms": 100.0}),
+            FaultEvent("partition", (4,), dwell_ms=1000),
+            FaultEvent("heal_partitions"),
+        ],
+    )
+    schedule.validate()
+    restored = FaultSchedule.from_json(schedule.to_json())
+    assert restored == schedule
+    # And the round trip is stable at the byte level (repro files diff clean).
+    assert restored.to_json() == schedule.to_json()
+
+
+@pytest.mark.parametrize("events,message", [
+    ([FaultEvent("explode", (1,))], "unknown kind"),
+    ([FaultEvent("crash", (0,))], "slot 0"),
+    ([FaultEvent("crash", (9,))], "non-live"),
+    ([FaultEvent("join", (1,))], "non-fresh"),
+    ([FaultEvent("restart", (3,))], "never-removed"),
+    ([FaultEvent("leave", (1, 2))], "exactly one"),
+    ([FaultEvent("loss", args={"permille": 2000})], "permille"),
+    ([FaultEvent("delay", args={"min_ms": 5, "max_ms": 1})], "min_ms"),
+    ([FaultEvent("clock_resume", (1,))], "paused"),
+    ([FaultEvent("drop_first_n", (1,), args={"message": "fast_round", "count": 2})],
+     "message must be one of"),
+    ([FaultEvent("drop_first_n", (1,), args={"message": "probe"})], "count"),
+    ([FaultEvent("clock_pause", (1,)),
+      FaultEvent("clock_skew", (1,), args={"offset_ms": 5.0})], "is paused"),
+    ([FaultEvent("crash", (1,), settle=False)], "last event must settle"),
+])
+def test_validate_rejects_ill_formed_schedules(events, message):
+    schedule = FaultSchedule(n0=8, n_slots=12, events=events)
+    with pytest.raises(ScheduleError, match=message):
+        schedule.validate()
+
+
+def test_membership_phases_group_overlapped_events():
+    schedule = FaultSchedule(
+        n0=8, n_slots=12,
+        events=[
+            FaultEvent("loss", args={"permille": 10}),
+            FaultEvent("join", (8, 9), settle=False),
+            FaultEvent("crash", (3,)),
+            FaultEvent("leave", (4,)),
+        ],
+    )
+    schedule.validate()
+    assert schedule.membership_phases() == [
+        [("join", (8, 9)), ("crash", (3,))],
+        [("leave", (4,))],
+    ]
+    assert schedule.expected_members() == 8 + 2 - 1 - 1
+    assert schedule.expected_removed_slots() == {3, 4}
+
+
+def test_restart_undoes_removal_in_expected_sets():
+    schedule = FaultSchedule(
+        n0=8, n_slots=12,
+        events=[FaultEvent("crash", (5,)), FaultEvent("restart", (5,))],
+    )
+    schedule.validate()
+    assert schedule.expected_removed_slots() == set()
+    assert schedule.expected_members() == 8
+    assert not schedule.engine_compatible  # restarts cannot replay on device
+
+
+def test_generated_schedules_validate_across_many_seeds():
+    # The generator's own sizing rules must keep every draw well-formed
+    # (validate() raising inside random_schedule would fail loudly here).
+    for seed in range(200):
+        schedule = random_schedule(seed)
+        assert schedule.events
+    for name, family in FAMILIES.items():
+        for seed in range(25):
+            family(seed).validate()
+
+
+def test_loss_as_engine_delivery_maps_the_shared_definition():
+    assert loss_as_engine_delivery(50) == {
+        "delivery_prob_permille": 50,
+        "delivery_spread": 2,
+    }
+    assert loss_as_engine_delivery(0)["delivery_spread"] == 0
+    with pytest.raises(ScheduleError):
+        loss_as_engine_delivery(1001)
+
+
+# ---------------------------------------------------------------------------
+# shaper determinism
+# ---------------------------------------------------------------------------
+
+
+def test_shaper_draws_are_a_pure_function_of_the_seed():
+    def draws(seed):
+        schedule = FaultSchedule(n0=4, n_slots=4, seed=seed)
+        shaper = LinkShaper(schedule_rng(schedule), ManualClock())
+        shaper.loss_permille = 300
+        shaper.delay_max_ms = 20.0
+        shaper.dup_permille = 100
+        return [shaper.plan("a", "b") for _ in range(64)]
+
+    assert draws(7) == draws(7)
+    assert draws(7) != draws(8)
+
+
+# ---------------------------------------------------------------------------
+# NodeClock: skew and pause
+# ---------------------------------------------------------------------------
+
+
+def test_node_clock_skew_shifts_readings_only_per_node():
+    base = ManualClock()
+    a, b = NodeClock(base), NodeClock(base)
+    base.advance_ms(1000)
+    a.set_skew(250.0)
+    assert a.now_ms() == 1250.0
+    assert b.now_ms() == 1000.0
+    assert base.now_ms() == 1000.0
+
+
+@async_test
+async def test_node_clock_pause_freezes_time_and_parks_timers():
+    base = ManualClock()
+    clock = NodeClock(base)
+    fired = []
+    clock.call_later_ms(100, lambda: fired.append("t1"))
+    clock.pause()
+    frozen = clock.now_ms()
+    base.advance_ms(500)  # t1 comes due during the pause: parked, not run
+    assert fired == []
+    assert clock.now_ms() == frozen  # readings are frozen too
+    clock.call_later_ms(50, lambda: fired.append("t2"))
+    base.advance_ms(500)
+    assert fired == []
+    clock.resume()
+    # Every timer that came due during the freeze is overdue: all fire on
+    # the next tick after the thaw (re-armed at delay 0), in park order.
+    base.advance_ms(1)
+    assert fired == ["t1", "t2"]
+    assert clock.now_ms() == base.now_ms()  # skew-free clock tracks base again
+
+
+@async_test
+async def test_node_clock_cancel_works_across_a_pause():
+    base = ManualClock()
+    clock = NodeClock(base)
+    fired = []
+    handle = clock.call_later_ms(100, lambda: fired.append("x"))
+    clock.pause()
+    base.advance_ms(200)
+    handle.cancel()  # cancelled while parked
+    clock.resume()
+    base.advance_ms(10)
+    assert fired == []
+
+
+@async_test
+async def test_node_clock_sleep_suspends_through_a_pause():
+    base = ManualClock()
+    clock = NodeClock(base)
+    done = []
+
+    async def sleeper():
+        await clock.sleep_ms(100)
+        done.append(True)
+
+    task = asyncio.ensure_future(sleeper())
+    await asyncio.sleep(0)
+    clock.pause()
+    base.advance_ms(1000)
+    for _ in range(5):
+        await asyncio.sleep(0)
+    assert not done  # the node is frozen; its sleeper must not wake
+    clock.resume()
+    base.advance_ms(1)
+    for _ in range(5):
+        await asyncio.sleep(0)
+    assert done
+    await task
+
+
+def test_pause_is_idempotent_and_skew_rejected_while_paused():
+    clock = NodeClock(ManualClock())
+    clock.pause()
+    clock.pause()  # no-op, not an error
+    with pytest.raises(RuntimeError):
+        clock.set_skew(10.0)
+    clock.resume()
+    clock.resume()  # no-op
+    clock.set_skew(10.0)
+
+
+def test_schedule_rng_is_stable_across_processes():
+    # random.Random(str) seeds via a hash of the bytes, not PYTHONHASHSEED,
+    # so a repro file replayed in a fresh process draws identically. Pin the
+    # first draws; a change here means every committed repro is invalidated.
+    rng = schedule_rng(FaultSchedule(n0=2, n_slots=2, seed=123))
+    assert [rng.randrange(1000) for _ in range(3)] == [240, 72, 796]

@@ -1,0 +1,115 @@
+"""Schedule fuzzing and shrinking: the regression half of the chaos
+subsystem.
+
+The tier-1 pieces prove the search machinery BITES: a known
+oracle-violating schedule (a full symmetric partition spanning a crash
+decision, never healed — the isolated member goes permanently stale)
+shrinks to a minimal repro that still fails with the same violations, and
+a written repro file replays to the identical violation set. The broad
+fuzz sweep over many random seeds is marked ``slow`` (excluded from
+tier-1; run it with ``-m slow`` or ``tools/chaosrun.py fuzz``)."""
+
+import pytest
+
+from rapid_tpu.sim.faults import FaultEvent, FaultSchedule
+from rapid_tpu.sim.fuzz import (
+    fuzz,
+    random_schedule,
+    replay,
+    run_schedule,
+    shrink,
+    write_repro,
+)
+from rapid_tpu.sim.oracles import check_all
+
+
+def _known_violating_schedule() -> FaultSchedule:
+    """A schedule that genuinely breaks the invariants: slot 3 is fully
+    isolated (symmetric partition, below the detection threshold so it is
+    never evicted) across a crash decision and the partition never heals —
+    slot 3 can neither hear the decision nor pull it, so the cluster never
+    re-converges. The loss and join events are noise the shrinker must
+    strip. Budgets are tight: every shrink attempt re-runs the scenario,
+    and a wedged phase burns its whole simulated budget."""
+    return FaultSchedule(
+        n0=8, n_slots=12, seed=5, name="violating/partition-no-heal",
+        phase_budget_ms=20_000, converge_budget_ms=10_000,
+        events=[
+            FaultEvent("loss", args={"permille": 30}),
+            FaultEvent("join", (8,), dwell_ms=500),
+            FaultEvent("partition", (3,), dwell_ms=500),
+            FaultEvent("crash", (2,), dwell_ms=500),
+        ],
+    )
+
+
+def test_shrinker_reduces_known_violation_to_minimal_repro(tmp_path):
+    schedule = _known_violating_schedule()
+    result = run_schedule(schedule)
+    violations = check_all(result, differential=False)
+    names = {v.oracle for v in violations}
+    assert "bounded-convergence" in names  # the violation is real
+
+    minimal, min_violations, runs = shrink(schedule, violations)
+    assert runs > 0
+    # Greedy floor: nothing survives but the partition and the decision it
+    # conceals — the noise events (loss, join) are gone, dwells zeroed.
+    assert [e.kind for e in minimal.events] == ["partition", "crash"]
+    assert all(e.dwell_ms == 0 for e in minimal.events)
+    assert len(minimal.events[0].slots) == 1
+    # The minimal repro still fails with (at least) the original violations.
+    assert names <= {v.oracle for v in min_violations}
+
+    # The written repro replays to the IDENTICAL violation set.
+    min_result = run_schedule(minimal)
+    repro_dir = write_repro(min_result, min_violations, tmp_path)
+    assert (repro_dir / "schedule.json").exists()
+    assert (repro_dir / "violations.txt").read_text().strip()
+    replayed_result, replayed_violations = replay(repro_dir)
+    assert sorted(map(str, replayed_violations)) == sorted(
+        map(str, check_all(min_result))
+    )
+    assert replayed_result.cuts == min_result.cuts
+
+
+def test_shrink_refuses_a_passing_schedule():
+    schedule = random_schedule(0)
+    with pytest.raises(ValueError, match="nothing to shrink"):
+        shrink(schedule, [])
+
+
+def test_fuzz_writes_repro_for_violating_seed(tmp_path, monkeypatch):
+    # Drive the fuzz loop's failure path deterministically: patch the
+    # generator to return the known-violating schedule, and verify the loop
+    # shrinks it and writes a replayable repro directory.
+    import rapid_tpu.sim.fuzz as simfuzz
+
+    monkeypatch.setattr(
+        simfuzz, "random_schedule", lambda seed: _known_violating_schedule()
+    )
+    (summary,) = fuzz([42], out_dir=tmp_path)
+    assert summary["violations"]
+    assert summary["shrunk_events"] < summary["events"]
+    repro = tmp_path / "seed42"
+    assert (repro / "schedule.json").exists()
+    _, replayed = replay(repro)
+    assert replayed  # the repro still fails after the round trip
+
+
+@pytest.mark.slow
+def test_fuzz_sweep_random_schedules_are_clean():
+    # The actual search: random schedules across a seed range must uphold
+    # every oracle (a failure here is a protocol bug — the summaries carry
+    # the shrunk repro). Excluded from tier-1 by the slow marker; the
+    # pinned-family coverage lives in test_sim_smoke.py.
+    summaries = fuzz(range(12), out_dir=None, shrink_failures=False)
+    failing = [s for s in summaries if s["violations"]]
+    assert not failing, "\n".join(
+        f"seed {s['seed']}: {s['violations']}" for s in failing
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))

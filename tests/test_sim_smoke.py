@@ -1,0 +1,104 @@
+"""Tier-1 chaos smoke: the four scenario families over pinned seeds, every
+oracle, explicit CPU budget.
+
+20 pinned (family, seed) runs — partition-heal, asymmetric link,
+crash-during-join, churn-under-loss at 5 seeds each — each through the FULL
+oracle battery including the host<->device differential replay. One test
+drives the whole grid so the asserted budget covers everything: the budget
+is process CPU time (wall clock would flake under CI contention), and it
+bounds what the tier-1 gate is allowed to spend on chaos coverage — a
+regression that slows simulated runs 5x is a finding, not an
+inconvenience. Schedule-space *search* (fuzzing many random seeds) is the
+slow-marked job in test_sim_fuzz.py; this is coverage, pinned."""
+
+import time
+
+import pytest
+
+from rapid_tpu.sim.fuzz import FAMILIES, run_schedule, scenario_family
+from rapid_tpu.sim.oracles import check_all
+
+#: 5 pinned seeds per family = 20 pinned scenarios in tier-1.
+SEEDS = (1, 2, 3, 4, 5)
+
+#: Process-CPU budget for the full grid, including the engine compile the
+#: first differential replay pays (~7 s) and JAX/CPU variance headroom: the
+#: grid measures ~35 s on an idle container.
+CPU_BUDGET_S = 240.0
+
+
+def test_pinned_chaos_grid_upholds_every_oracle():
+    started = time.process_time()
+    failures = []
+    runs = 0
+    for family in sorted(FAMILIES):
+        for seed in SEEDS:
+            schedule = scenario_family(family, seed)
+            result = run_schedule(schedule)
+            violations = check_all(result)  # differential included
+            runs += 1
+            if violations:
+                failures.append(
+                    f"{schedule.name}: "
+                    + "; ".join(str(v) for v in violations)
+                )
+            if not result.cuts:
+                failures.append(f"{schedule.name}: produced no cuts (vacuous run)")
+    spent = time.process_time() - started
+    assert runs == len(FAMILIES) * len(SEEDS) == 20
+    assert not failures, "\n".join(failures)
+    assert spent < CPU_BUDGET_S, (
+        f"chaos smoke burned {spent:.1f}s CPU (budget {CPU_BUDGET_S}s): "
+        "simulated runs regressed"
+    )
+
+
+def test_family_runs_are_deterministic():
+    # The subsystem's foundational claim: a run is a pure function of its
+    # schedule. Same family, same seed, fresh event loop -> identical cut
+    # sequence, configuration chains, and outcome.
+    a = run_schedule(scenario_family("churn_under_loss", 9))
+    b = run_schedule(scenario_family("churn_under_loss", 9))
+    assert a.cuts == b.cuts
+    assert a.configs == b.configs
+    assert a.final_membership == b.final_membership
+    assert a.final_converge_sim_ms == b.final_converge_sim_ms
+    assert a.shaper_stats == b.shaper_stats
+    # And the loss schedule genuinely shaped traffic (not a vacuous pass).
+    assert a.shaper_stats["dropped"] > 0
+
+
+def test_repro_artifacts_feed_traceview(tmp_path):
+    # The artifact directory a run writes is exactly what tools/traceview.py
+    # renders end-to-end: per-node recordings plus the fault-injection lane.
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    import traceview
+
+    result = run_schedule(scenario_family("partition_heal", 2))
+    result.write_repro(tmp_path)
+    paths, faultlog = traceview.expand_scenario_dir(str(tmp_path))
+    assert len(paths) == len(result.snapshots)
+    assert faultlog is not None
+    snapshots = traceview.load_snapshots(paths)
+    lane = traceview.fault_snapshot(faultlog)
+    events = traceview.merge_events(snapshots + [lane])
+    names = {e["name"] for e in events}
+    assert "fault:ingress_block" in names and "fault:crash" in names
+    assert "fault:heal_partitions" in names
+    assert "view_change" in names  # real recorder events merged alongside
+    # The chaos lane renders in the Chrome trace like any node lane.
+    chrome = traceview.chrome_trace(events)
+    process_names = {
+        e["args"]["name"] for e in chrome["traceEvents"]
+        if e.get("name") == "process_name"
+    }
+    assert traceview.FAULT_LANE in process_names
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -350,6 +350,8 @@ _CORPUS_CHECKERS = {
     "cancellation_swallow.py": ("rapid_tpu/messaging/_corpus.py", "check_taskflow"),
     "unawaited_coroutine.py": ("rapid_tpu/messaging/_corpus.py", "check_taskflow"),
     "clean_taskflow.py": ("rapid_tpu/messaging/_corpus.py", "check_taskflow"),
+    "unseeded_random.py": ("rapid_tpu/messaging/_corpus.py", "check_determinism"),
+    "clean_determinism.py": ("rapid_tpu/messaging/_corpus.py", "check_determinism"),
 }
 
 
@@ -781,8 +783,8 @@ def test_cli_json_select_ignore_and_exit_codes(tmp_path):
     assert typo.returncode == 2 and "no-such-check" in typo.stderr
 
 
-def test_cli_families_lists_all_nine():
-    assert len(staticcheck.FAMILIES) == 9
+def test_cli_families_lists_all_families():
+    assert len(staticcheck.FAMILIES) == 10
     result = _run_cli("--families")
     assert result.returncode == 0
     for name, _description in staticcheck.FAMILIES:

@@ -23,6 +23,9 @@ Check families (one module each; ``core`` owns the driver/CLI/Finding):
 9. ``taskflow``     — async failure-path hygiene: leaked tasks, swallowed
                       exceptions, cancellation swallows, unawaited
                       coroutines (whole library)
+10. ``determinism`` — no unseeded randomness in the library: every rng is
+                      injectable or identity-seeded, so simulated chaos
+                      runs (rapid_tpu/sim) are pure functions of one seed
 
 ``staticcheck --families`` prints this catalog; ``--update-wire-lock``
 regenerates the wire lockfile after an intentional schema change.
@@ -47,6 +50,7 @@ from .core import (
     run,
 )
 from .deadcode import check_dead_definitions
+from .determinism import DETERMINISM_PREFIXES, check_determinism
 from .dispatch import DISPATCH_PREFIXES, check_dispatch
 from .names import check_undefined_names
 from .signatures import check_call_signatures
@@ -65,6 +69,7 @@ __all__ = [
     "CLOCK_DISCIPLINE_PREFIXES",
     "CONCURRENCY_PREFIXES",
     "DEFAULT_ROOTS",
+    "DETERMINISM_PREFIXES",
     "DISPATCH_PREFIXES",
     "FAMILIES",
     "Finding",
@@ -76,6 +81,7 @@ __all__ = [
     "check_clock_injection",
     "check_concurrency",
     "check_dead_definitions",
+    "check_determinism",
     "check_dispatch",
     "check_taskflow",
     "check_trace_safety",

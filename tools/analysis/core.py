@@ -79,6 +79,8 @@ ALL_CHECK_NAMES = frozenset({
     "swallowed-exception",
     "cancellation-swallow",
     "unawaited-coroutine",
+    # determinism family
+    "unseeded-random",
 })
 
 #: The check families, in documentation order — one (name, description)
@@ -98,6 +100,8 @@ FAMILIES = (
                  "response return types"),
     ("taskflow", "async failure paths: leaked tasks, swallowed exceptions, "
                  "cancellation, unawaited coroutines"),
+    ("determinism", "no unseeded randomness in the library: simulated runs "
+                    "are pure functions of their seed"),
 )
 
 
@@ -163,8 +167,8 @@ def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
     # The per-file check imports live here (not module top level) so the
     # CLI shim can import this module before sys.path is fully arranged.
     from . import (
-        clocks, concurrency, deadcode, dispatch, names, signatures,
-        taskflow, trace_safety, wire_schema,
+        clocks, concurrency, deadcode, determinism, dispatch, names,
+        signatures, taskflow, trace_safety, wire_schema,
     )
 
     per_file_checks = [
@@ -175,6 +179,7 @@ def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
         trace_safety.check_trace_safety,
         dispatch.check_dispatch,
         taskflow.check_taskflow,
+        determinism.check_determinism,
     ]
     full_tree = tuple(roots) == DEFAULT_ROOTS
     if not full_tree:

@@ -1,0 +1,163 @@
+"""Run, fuzz, and replay deterministic chaos scenarios (rapid_tpu/sim).
+
+Three subcommands:
+
+``run``     one named scenario family at one seed (or a schedule JSON file),
+            through the full oracle battery, writing the repro artifact
+            directory (schedule + per-node flight recordings + outcome) and,
+            with ``--chrome``, a Chrome trace-event file of the merged
+            timeline with fault-injection annotations (via tools/traceview).
+
+``fuzz``    N seeded random schedules; every oracle violation is shrunk to a
+            minimal repro and written under the output directory.
+
+``replay``  re-run a written repro directory; exits nonzero iff the recorded
+            violations reproduce (they must — a repro that stops failing is
+            itself news worth printing).
+
+Usage:
+
+    python tools/chaosrun.py run partition_heal --seed 3 --artifacts /tmp/r
+    python tools/chaosrun.py run --schedule repro/schedule.json
+    python tools/chaosrun.py fuzz --seeds 20 --out /tmp/fuzz
+    python tools/chaosrun.py replay /tmp/fuzz/seed7
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+from pathlib import Path
+from typing import List, Optional
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from rapid_tpu.utils.platform import force_platform  # noqa: E402
+
+force_platform("cpu")  # chaos simulation is a host workload; never touch a tunnel
+
+from rapid_tpu.sim import fuzz as simfuzz  # noqa: E402
+from rapid_tpu.sim.faults import FaultSchedule, ScheduleError  # noqa: E402
+from rapid_tpu.sim.oracles import check_all  # noqa: E402
+
+
+def _write_chrome(artifacts: Path, out: str) -> None:
+    import traceview
+
+    events = traceview.merge_events(traceview.scenario_snapshots(artifacts))
+    traceview.write_chrome(events, out)
+    print(f"wrote {out} ({len(events)} events)")
+
+
+def cmd_run(args: argparse.Namespace) -> int:
+    if args.schedule:
+        schedule = FaultSchedule.from_json(Path(args.schedule).read_text())
+    else:
+        if not args.family:
+            print("chaosrun run: need a family name or --schedule", file=sys.stderr)
+            return 2
+        schedule = simfuzz.scenario_family(args.family, args.seed)
+    result = simfuzz.run_schedule(schedule)
+    violations = check_all(result)
+    artifacts = Path(
+        args.artifacts
+        or tempfile.mkdtemp(prefix=f"chaosrun-{schedule.name.replace('/', '-')}-")
+    )
+    simfuzz.write_repro(result, violations, artifacts)
+    print(f"scenario {schedule.name or '(file)'}: {len(result.cuts)} cut(s), "
+          f"converged={result.final_converged}, artifacts in {artifacts}")
+    if args.chrome:
+        _write_chrome(artifacts, args.chrome)
+    for v in violations:
+        print(f"VIOLATION {v}")
+    return 1 if violations else 0
+
+
+def cmd_fuzz(args: argparse.Namespace) -> int:
+    out = Path(args.out) if args.out else Path(tempfile.mkdtemp(prefix="chaosfuzz-"))
+    seeds = range(args.base_seed, args.base_seed + args.seeds)
+    summaries = simfuzz.fuzz(seeds, out_dir=out)
+    failing = [s for s in summaries if s["violations"]]
+    for s in summaries:
+        status = "FAIL" if s["violations"] else "ok"
+        extra = (
+            f" -> shrunk {s['events']}->{s['shrunk_events']} events, "
+            f"repro {s.get('repro', '(not written)')}"
+            if s["violations"]
+            else ""
+        )
+        print(f"seed {s['seed']}: {status}{extra}")
+        for v in s["violations"]:
+            print(f"  {v}")
+    print(f"{len(summaries) - len(failing)}/{len(summaries)} seeds clean; "
+          f"repros under {out}" if failing else
+          f"{len(summaries)}/{len(summaries)} seeds clean")
+    return 1 if failing else 0
+
+
+def cmd_replay(args: argparse.Namespace) -> int:
+    recorded_path = Path(args.repro) / "violations.txt"
+    recorded = (
+        [line for line in recorded_path.read_text().splitlines()
+         if line and line != "(none)"]
+        if recorded_path.exists()
+        else []
+    )
+    result, violations = simfuzz.replay(args.repro)
+    for v in violations:
+        print(f"VIOLATION {v}")
+    if recorded and sorted(map(str, violations)) != sorted(recorded):
+        print("chaosrun replay: violations DIVERGED from the recorded repro:",
+              file=sys.stderr)
+        for line in recorded:
+            print(f"  recorded: {line}", file=sys.stderr)
+        return 1
+    if args.chrome:
+        with tempfile.TemporaryDirectory() as fresh:
+            simfuzz.write_repro(result, violations, fresh)
+            _write_chrome(Path(fresh), args.chrome)
+    return 1 if violations else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="chaosrun",
+        description="deterministic chaos scenarios: run, fuzz, replay",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    run_p = sub.add_parser("run", help="run one named scenario or schedule file")
+    run_p.add_argument("family", nargs="?", default=None,
+                       help=f"scenario family: {', '.join(sorted(simfuzz.FAMILIES))}")
+    run_p.add_argument("--seed", type=int, default=0)
+    run_p.add_argument("--schedule", default=None, metavar="JSON",
+                       help="run this schedule file instead of a named family")
+    run_p.add_argument("--artifacts", default=None, metavar="DIR",
+                       help="repro artifact directory (default: a fresh tmpdir)")
+    run_p.add_argument("--chrome", default=None, metavar="OUT.json",
+                       help="also write a Chrome trace of the merged timeline")
+    run_p.set_defaults(fn=cmd_run)
+
+    fuzz_p = sub.add_parser("fuzz", help="fuzz N random schedules, shrink failures")
+    fuzz_p.add_argument("--seeds", type=int, default=10)
+    fuzz_p.add_argument("--base-seed", type=int, default=0)
+    fuzz_p.add_argument("--out", default=None, metavar="DIR")
+    fuzz_p.set_defaults(fn=cmd_fuzz)
+
+    replay_p = sub.add_parser("replay", help="re-run a written repro directory")
+    replay_p.add_argument("repro")
+    replay_p.add_argument("--chrome", default=None, metavar="OUT.json")
+    replay_p.set_defaults(fn=cmd_replay)
+
+    args = parser.parse_args(argv)
+    try:
+        return args.fn(args)
+    except (ScheduleError, FileNotFoundError, json.JSONDecodeError) as exc:
+        print(f"chaosrun: {exc}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

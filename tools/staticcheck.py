@@ -1,6 +1,6 @@
 """Static-analysis tier — compatible CLI/entry shim over tools/analysis/.
 
-The analyzers grew from two check families into nine and moved into the
+The analyzers grew from two check families into ten and moved into the
 ``tools/analysis/`` package (core driver + Finding model + one module per
 family — see its docstring for the catalog, or ``--families``). This
 module stays as the stable entry point: ``python tools/staticcheck.py
@@ -30,6 +30,7 @@ from analysis import (  # noqa: E402,F401 — re-exported API surface
     CLOCK_DISCIPLINE_PREFIXES,
     CONCURRENCY_PREFIXES,
     DEFAULT_ROOTS,
+    DETERMINISM_PREFIXES,
     DISPATCH_PREFIXES,
     FAMILIES,
     Finding,
@@ -41,6 +42,7 @@ from analysis import (  # noqa: E402,F401 — re-exported API surface
     check_clock_injection,
     check_concurrency,
     check_dead_definitions,
+    check_determinism,
     check_dispatch,
     check_taskflow,
     check_trace_safety,
@@ -62,6 +64,7 @@ __all__ = [
     "CLOCK_DISCIPLINE_PREFIXES",
     "CONCURRENCY_PREFIXES",
     "DEFAULT_ROOTS",
+    "DETERMINISM_PREFIXES",
     "DISPATCH_PREFIXES",
     "FAMILIES",
     "Finding",
@@ -74,6 +77,7 @@ __all__ = [
     "check_clock_injection",
     "check_concurrency",
     "check_dead_definitions",
+    "check_determinism",
     "check_dispatch",
     "check_taskflow",
     "check_trace_safety",

@@ -17,10 +17,19 @@ node: ``--trace`` filters to a single change, and the Chrome trace output
 (``--chrome out.json``, the trace-event format Perfetto and chrome://tracing
 read) lanes events by node so the cross-node cascade is visible at a glance.
 
+A chaos-scenario artifact directory (what ``tools/chaosrun.py`` and
+``RunResult.write_repro`` emit: ``nodes/*.json`` snapshots plus a
+``faultlog.json``) can be passed directly: the per-node recordings are
+merged as usual and the fault-injection events (partition start/heal,
+crash, restart, clock faults) are woven into the timeline as a synthetic
+``(chaos)`` lane, so a repro reads end-to-end — injection, detection,
+agreement, delivery.
+
 Usage:
 
     python tools/traceview.py node1.json node2.json node3.json
     python tools/traceview.py dumps/*.json --trace 0x1b3 --chrome view.json
+    python tools/traceview.py repro-dir/ --chrome view.json
 """
 
 from __future__ import annotations
@@ -29,7 +38,7 @@ import argparse
 import json
 import sys
 from pathlib import Path
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
@@ -83,6 +92,90 @@ def _recorder_of(snapshot: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     if "events" in snapshot:  # bare FlightRecorder.snapshot()
         return snapshot
     return snapshot.get("recorder")
+
+
+#: Synthetic node name for fault-injection annotations: sorts apart from
+#: real endpoints and renders as its own lane in the Chrome trace.
+FAULT_LANE = "(chaos)"
+
+
+def fault_snapshot(faultlog_path) -> Optional[Dict[str, Any]]:
+    """The fault-injection events of a scenario ``faultlog.json`` (the
+    ``ScenarioRunner`` capture: one ``{t_ms, kind, slots, args...}`` entry
+    per applied schedule event) as a bare recorder-style snapshot for the
+    synthetic :data:`FAULT_LANE` node, so :func:`merge_events` weaves the
+    injections into the cluster timeline like any other recording. A
+    missing file returns None — plain telemetry dumps have no fault log."""
+    path = Path(faultlog_path)
+    if not path.exists():
+        return None
+    try:
+        entries = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError) as exc:
+        raise SnapshotLoadError(f"{path}: cannot read fault log: {exc}") from exc
+    if not isinstance(entries, list):
+        raise SnapshotLoadError(f"{path}: fault log is not a JSON list")
+    events = []
+    for i, entry in enumerate(entries):
+        if not isinstance(entry, dict):
+            raise SnapshotLoadError(
+                f"{path}: fault-log entry {i} is not a JSON object "
+                f"(got {type(entry).__name__})"
+            )
+        fields: Dict[str, Any] = {}
+        if entry.get("slots"):
+            fields["slots"] = entry["slots"]
+        fields.update(entry.get("args") or {})
+        events.append({
+            "seq": i,
+            "t_ms": entry.get("t_ms", 0.0),
+            "node": FAULT_LANE,
+            "name": f"fault:{entry.get('kind', '?')}",
+            "config_id": None,
+            "trace_id": None,
+            "fields": fields,
+        })
+    return {"node": FAULT_LANE, "events": events}
+
+
+def expand_scenario_dir(path: str) -> Tuple[List[str], Optional[Path]]:
+    """A scenario artifact directory expands to its per-node snapshots plus
+    its fault log: ``nodes/*.json`` when the ``write_repro`` layout is
+    present, else any ``*.json`` directly inside (minus the scenario
+    metadata files, which are not snapshots)."""
+    root = Path(path)
+    nodes_dir = root / "nodes"
+    if nodes_dir.is_dir():
+        snapshots = sorted(str(p) for p in nodes_dir.glob("*.json"))
+    else:
+        skip = {"schedule.json", "result.json", "faultlog.json"}
+        snapshots = sorted(
+            str(p) for p in root.glob("*.json") if p.name not in skip
+        )
+    faultlog = root / "faultlog.json"
+    return snapshots, faultlog if faultlog.exists() else None
+
+
+def scenario_snapshots(path) -> List[Dict[str, Any]]:
+    """Everything mergeable inside one scenario artifact directory: the
+    per-node snapshots plus the fault-injection lane. THE loader for repro
+    directories — traceview's own CLI and tools/chaosrun.py both go through
+    it, so the two can never render the same repro differently."""
+    paths, faultlog = expand_scenario_dir(str(path))
+    snapshots = load_snapshots(paths)
+    if faultlog is not None:
+        lane = fault_snapshot(faultlog)
+        if lane is not None:
+            snapshots.append(lane)
+    return snapshots
+
+
+def write_chrome(events: List[Dict[str, Any]], out: str) -> None:
+    """Dump a merged timeline as Chrome trace-event JSON (shared by the two
+    CLIs for the same never-diverge reason as :func:`scenario_snapshots`)."""
+    with open(out, "w") as f:
+        json.dump(chrome_trace(events), f, indent=1)
+        f.write("\n")
 
 
 def merge_events(
@@ -205,7 +298,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     parser.add_argument(
         "snapshots", nargs="+",
-        help="telemetry-snapshot JSON files, one per node (--metrics-dump output)",
+        help="telemetry-snapshot JSON files, one per node (--metrics-dump "
+             "output), and/or chaos-scenario artifact directories "
+             "(chaosrun output: nodes/*.json + faultlog.json)",
     )
     parser.add_argument(
         "--trace", type=_parse_trace_id, default=None, metavar="ID",
@@ -218,7 +313,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     try:
-        snapshots = load_snapshots(args.snapshots)
+        snapshots: List[Dict[str, Any]] = []
+        for arg in args.snapshots:
+            if Path(arg).is_dir():
+                snapshots.extend(scenario_snapshots(arg))
+            else:
+                snapshots.extend(load_snapshots([arg]))
     except SnapshotLoadError as exc:
         print(f"traceview: {exc}", file=sys.stderr)
         return 2
@@ -239,9 +339,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     events = merge_events(snapshots, trace_id=args.trace)
     sys.stdout.write(render_text(events))
     if args.chrome:
-        with open(args.chrome, "w") as f:
-            json.dump(chrome_trace(events), f, indent=1)
-            f.write("\n")
+        write_chrome(events, args.chrome)
         sys.stdout.write(f"wrote {args.chrome} ({len(events)} events)\n")
     return 0
 
