@@ -109,6 +109,60 @@ def _mark(msg: str) -> None:
     print(f"bench[{time.monotonic() - _START:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
+class _heartbeat:
+    """Context manager emitting periodic ``_mark`` liveness lines from a
+    daemon thread while a long silent stage (state build, XLA compile)
+    runs. The parent watchdog's first-mark/idle budgets judge the child by
+    its marks; the ~69 s warm-up-and-compile phase used to sit mark-silent
+    long enough to trip them on a slow day — now every stage heartbeats."""
+
+    def __init__(self, stage: str, period_s: float = 20.0) -> None:
+        self._stage = stage
+        self._period_s = period_s
+
+    def __enter__(self):
+        import threading
+
+        self._stop = threading.Event()
+
+        def beat() -> None:
+            started = time.monotonic()
+            while not self._stop.wait(self._period_s):
+                _mark(f"{self._stage}: still running ({time.monotonic() - started:.0f}s)")
+
+        self._thread = threading.Thread(target=beat, daemon=True)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        self._thread.join(timeout=1.0)
+
+
+def _enable_persistent_compile_cache() -> None:
+    """Point JAX's persistent compilation cache at a stable directory so
+    repeated bench rounds (and the watchdog's retry attempts) skip the
+    multi-minute XLA compiles entirely — the cache key includes the
+    computation and platform, so reuse is safe across runs of the same
+    code. Best-effort: an old jax without the knobs just compiles."""
+    cache_dir = os.environ.get("RAPID_TPU_JAX_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "rapid_tpu_jax"
+    )
+    import jax
+
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # Cache even quick compiles: the bench's many medium executables
+        # add up, and the directory is bounded by workload variety.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        _mark(f"persistent compilation cache at {cache_dir}")
+    except Exception as exc:  # noqa: BLE001 — cache is
+        # strictly an optimization; any flag/filesystem gap means "compile
+        # as before", never "fail the bench".
+        _mark(f"persistent compilation cache unavailable ({exc!r}); compiling cold")
+
+
 # ---------------------------------------------------------------------------
 # The workload (runs inside the watchdogged child, or inline on CPU).
 # ---------------------------------------------------------------------------
@@ -126,6 +180,7 @@ def run_workload() -> None:
 
     platform = jax.devices()[0].platform
     _mark(f"devices initialized: platform={platform} count={len(jax.devices())}")
+    _enable_persistent_compile_cache()
 
     import numpy as np
 
@@ -207,11 +262,15 @@ def run_workload() -> None:
         return cuts
 
     # Warm-up: compile every branch the timed run takes (convergence loop,
-    # view-change application, second-cut re-entry).
-    vc, _ = build(seed=0)
-    vc.sync()
+    # view-change application, second-cut re-entry). Heartbeat throughout:
+    # state build + compile is the longest mark-silent stretch of the run
+    # (~69 s cold), and the parent watchdog judges liveness by marks.
+    with _heartbeat(f"N={n} state build"):
+        vc, _ = build(seed=0)
+        vc.sync()
     _mark(f"N={n} state built and on device; compiling engine (warm-up run)")
-    resolve_churn(vc)
+    with _heartbeat(f"N={n} warm-up compile"):
+        resolve_churn(vc)
     _mark("warm-up convergence done (executables cached)")
 
     # Timed runs on fresh state (same shapes -> cached executables).
@@ -281,10 +340,12 @@ def run_workload() -> None:
             )
             return vcx
 
-        vcx = build_xl(7)
-        vcx.sync()
+        with _heartbeat("1M state build"):
+            vcx = build_xl(7)
+            vcx.sync()
         _mark("1M state on device; compiling 1M executable (warm-up)")
-        vcx.run_to_decision(max_steps=96)  # warm-up/compile
+        with _heartbeat("1M warm-up compile"):
+            vcx.run_to_decision(max_steps=96)  # warm-up/compile
         vcx = build_xl(8)
         vcx.sync()
         t0 = time.perf_counter()
@@ -320,7 +381,8 @@ def run_workload() -> None:
         )
         vc.sync()
         _mark(f"loss variant ({loss_permille} permille): compiling (warm-up)")
-        resolve_churn(vc)
+        with _heartbeat("loss-variant warm-up compile"):
+            resolve_churn(vc)
         loss_samples = []
         for rep in range(2):
             vc, victims = build(

@@ -18,6 +18,9 @@ _REQUEST_FIELDS = {
     t.Phase2aMessage: "phase2aMessage",
     t.Phase2bMessage: "phase2bMessage",
     t.LeaveMessage: "leaveMessage",
+    t.CohortCutMessage: "cohortCutMessage",
+    t.DelegateDecisionMessage: "delegateDecisionMessage",
+    t.GlobalTierMessage: "globalTierMessage",
 }
 
 _RESPONSE_FIELDS = {
@@ -159,6 +162,33 @@ def request_to_proto(request: t.RapidRequest):
             sub.endpoints.add().CopyFrom(_ep(ep))
     elif isinstance(request, t.LeaveMessage):
         sub.sender.CopyFrom(_ep(request.sender))
+    elif isinstance(request, t.CohortCutMessage):
+        sub.sender.CopyFrom(_ep(request.sender))
+        sub.configurationId = _i64(request.configuration_id)
+        sub.cohort = request.cohort
+        for ep in request.endpoints:
+            sub.endpoints.add().CopyFrom(_ep(ep))
+        for ep in request.joiner_eps:
+            sub.joinerEps.add().CopyFrom(_ep(ep))
+        for nid in request.joiner_ids:
+            sub.joinerIds.add().CopyFrom(_nid(nid))
+    elif isinstance(request, t.DelegateDecisionMessage):
+        sub.sender.CopyFrom(_ep(request.sender))
+        sub.configurationId = _i64(request.configuration_id)
+        for ep in request.endpoints:
+            sub.endpoints.add().CopyFrom(_ep(ep))
+        for ep in request.joiner_eps:
+            sub.joinerEps.add().CopyFrom(_ep(ep))
+        for nid in request.joiner_ids:
+            sub.joinerIds.add().CopyFrom(_nid(nid))
+    elif isinstance(request, t.GlobalTierMessage):
+        if isinstance(request.payload, (t.GlobalTierMessage, t.GossipMessage)):
+            # One level of nesting only — the same contract the native codec
+            # enforces; serializing deeper here would emit frames a
+            # native-codec peer refuses to decode.
+            raise ValueError("nested envelope in GlobalTierMessage payload")
+        sub.sender.CopyFrom(_ep(request.sender))
+        sub.payload.CopyFrom(request_to_proto(request.payload))
     else:  # pragma: no cover
         raise TypeError(type(request))
     return envelope
@@ -209,6 +239,32 @@ def request_from_proto(envelope) -> t.RapidRequest:
         )
     if which == "leaveMessage":
         return t.LeaveMessage(_ep_back(sub.sender))
+    if which == "cohortCutMessage":
+        return t.CohortCutMessage(
+            sender=_ep_back(sub.sender),
+            configuration_id=sub.configurationId,
+            cohort=sub.cohort,
+            endpoints=tuple(_ep_back(e) for e in sub.endpoints),
+            joiner_eps=tuple(_ep_back(e) for e in sub.joinerEps),
+            joiner_ids=tuple(_nid_back(n) for n in sub.joinerIds),
+        )
+    if which == "delegateDecisionMessage":
+        return t.DelegateDecisionMessage(
+            sender=_ep_back(sub.sender),
+            configuration_id=sub.configurationId,
+            endpoints=tuple(_ep_back(e) for e in sub.endpoints),
+            joiner_eps=tuple(_ep_back(e) for e in sub.joinerEps),
+            joiner_ids=tuple(_nid_back(n) for n in sub.joinerIds),
+        )
+    if which == "globalTierMessage":
+        if sub.payload.WhichOneof("content") == "globalTierMessage":
+            # One level of nesting only, mirroring the native codec's decode
+            # guard (unbounded recursion is a parser DoS).
+            raise ValueError("nested envelope in GlobalTierMessage payload")
+        return t.GlobalTierMessage(
+            sender=_ep_back(sub.sender),
+            payload=request_from_proto(sub.payload),
+        )
     raise ValueError(f"empty or unknown RapidRequest content: {which}")
 
 

@@ -188,6 +188,35 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
     fd.message_type.add().CopyFrom(_msg("Response"))
     fd.message_type.add().CopyFrom(_msg("ConsensusResponse"))
 
+    # Hierarchical-membership extension (rapid_tpu/hier): not part of the
+    # reference IDL — a reference JVM peer never speaks these — but mirrored
+    # here so the wire surface has exactly one schema story and the
+    # wire_schema/staticcheck gate can cross-check all four mirrors. The
+    # envelope field numbers equal the native codec tags (12-14), continuing
+    # the reference's numbering convention.
+    fd.message_type.add().CopyFrom(_msg(
+        "CohortCutMessage",
+        _field("sender", 1, T.TYPE_MESSAGE, type_name=ep),
+        _field("configurationId", 2, T.TYPE_INT64),
+        _field("cohort", 3, T.TYPE_INT32),
+        _field("endpoints", 4, T.TYPE_MESSAGE, L.LABEL_REPEATED, ep),
+        _field("joinerEps", 5, T.TYPE_MESSAGE, L.LABEL_REPEATED, ep),
+        _field("joinerIds", 6, T.TYPE_MESSAGE, L.LABEL_REPEATED, nid),
+    ))
+    fd.message_type.add().CopyFrom(_msg(
+        "DelegateDecisionMessage",
+        _field("sender", 1, T.TYPE_MESSAGE, type_name=ep),
+        _field("configurationId", 2, T.TYPE_INT64),
+        _field("endpoints", 3, T.TYPE_MESSAGE, L.LABEL_REPEATED, ep),
+        _field("joinerEps", 4, T.TYPE_MESSAGE, L.LABEL_REPEATED, ep),
+        _field("joinerIds", 5, T.TYPE_MESSAGE, L.LABEL_REPEATED, nid),
+    ))
+    fd.message_type.add().CopyFrom(_msg(
+        "GlobalTierMessage",
+        _field("sender", 1, T.TYPE_MESSAGE, type_name=ep),
+        _field("payload", 2, T.TYPE_MESSAGE, type_name=".remoting.RapidRequest"),
+    ))
+
     # RapidRequest / RapidResponse oneof envelopes.
     request = _msg(
         "RapidRequest",
@@ -203,6 +232,13 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
         _field("phase2aMessage", 8, T.TYPE_MESSAGE, type_name=".remoting.Phase2aMessage", oneof=0),
         _field("phase2bMessage", 9, T.TYPE_MESSAGE, type_name=".remoting.Phase2bMessage", oneof=0),
         _field("leaveMessage", 10, T.TYPE_MESSAGE, type_name=".remoting.LeaveMessage", oneof=0),
+        # 11 is the native gossip envelope (no proto mirror by design).
+        _field("cohortCutMessage", 12, T.TYPE_MESSAGE,
+               type_name=".remoting.CohortCutMessage", oneof=0),
+        _field("delegateDecisionMessage", 13, T.TYPE_MESSAGE,
+               type_name=".remoting.DelegateDecisionMessage", oneof=0),
+        _field("globalTierMessage", 14, T.TYPE_MESSAGE,
+               type_name=".remoting.GlobalTierMessage", oneof=0),
     )
     request.oneof_decl.add().name = "content"
     fd.message_type.add().CopyFrom(request)
@@ -231,7 +267,8 @@ _CLASSES = {
         "AlertMessage", "BatchedAlertMessage", "ProbeMessage", "ProbeResponse",
         "FastRoundPhase2bMessage", "Rank", "Phase1aMessage", "Phase1bMessage",
         "Phase2aMessage", "Phase2bMessage", "LeaveMessage", "Response",
-        "ConsensusResponse", "RapidRequest", "RapidResponse",
+        "ConsensusResponse", "CohortCutMessage", "DelegateDecisionMessage",
+        "GlobalTierMessage", "RapidRequest", "RapidResponse",
     )
 }
 

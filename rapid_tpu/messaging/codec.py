@@ -17,10 +17,13 @@ from rapid_tpu.utils.xxhash import to_signed64 as _signed64
 from rapid_tpu.types import (
     AlertMessage,
     BatchedAlertMessage,
+    CohortCutMessage,
     ConsensusResponse,
+    DelegateDecisionMessage,
     EdgeStatus,
     Endpoint,
     FastRoundPhase2bMessage,
+    GlobalTierMessage,
     GossipMessage,
     JoinMessage,
     JoinResponse,
@@ -238,6 +241,9 @@ _REQUEST_TAGS: Dict[Type, int] = {
     Phase2bMessage: 9,
     LeaveMessage: 10,
     GossipMessage: 11,
+    CohortCutMessage: 12,
+    DelegateDecisionMessage: 13,
+    GlobalTierMessage: 14,
 }
 
 _RESPONSE_TAGS: Dict[Type, int] = {
@@ -326,6 +332,32 @@ def _encode_request_impl(request: RapidRequest) -> bytes:
         _w_opt_trace(w, request.trace_id)
     elif isinstance(request, LeaveMessage):
         _w_endpoint(w, request.sender)
+    elif isinstance(request, CohortCutMessage):
+        _w_endpoint(w, request.sender)
+        w.i64(request.configuration_id)
+        w.u32(request.cohort)
+        _w_endpoints(w, request.endpoints)
+        _w_endpoints(w, request.joiner_eps)
+        w.u32(len(request.joiner_ids))
+        for nid in request.joiner_ids:
+            _w_node_id(w, nid)
+        _w_opt_trace(w, request.trace_id)
+    elif isinstance(request, DelegateDecisionMessage):
+        _w_endpoint(w, request.sender)
+        w.i64(request.configuration_id)
+        _w_endpoints(w, request.endpoints)
+        _w_endpoints(w, request.joiner_eps)
+        w.u32(len(request.joiner_ids))
+        for nid in request.joiner_ids:
+            _w_node_id(w, nid)
+        _w_opt_trace(w, request.trace_id)
+    elif isinstance(request, GlobalTierMessage):
+        if isinstance(request.payload, (GlobalTierMessage, GossipMessage)):
+            raise CodecError("nested envelope in GlobalTierMessage payload")
+        _w_endpoint(w, request.sender)
+        # Nested envelope: the payload is a complete request of its own
+        # (the GossipMessage framing precedent).
+        w.blob(_encode_request_impl(request.payload))
     elif isinstance(request, GossipMessage):
         if isinstance(request.payload, GossipMessage):
             raise CodecError("nested GossipMessage payload")
@@ -389,6 +421,34 @@ def decode_request(data: bytes) -> RapidRequest:
             # meaningless and unbounded recursion is a parser DoS.
             raise CodecError("nested GossipMessage payload")
         out = GossipMessage(origin, msg_id, ttl, payload)
+    elif tag == 12:
+        out = CohortCutMessage(
+            sender=_r_endpoint(r),
+            configuration_id=r.i64(),
+            cohort=r.u32(),
+            endpoints=_r_endpoints(r),
+            joiner_eps=_r_endpoints(r),
+            joiner_ids=tuple(_r_node_id(r) for _ in range(r.u32())),
+            trace_id=_r_opt_trace(r),
+        )
+    elif tag == 13:
+        out = DelegateDecisionMessage(
+            sender=_r_endpoint(r),
+            configuration_id=r.i64(),
+            endpoints=_r_endpoints(r),
+            joiner_eps=_r_endpoints(r),
+            joiner_ids=tuple(_r_node_id(r) for _ in range(r.u32())),
+            trace_id=_r_opt_trace(r),
+        )
+    elif tag == 14:
+        sender = _r_endpoint(r)
+        payload = decode_request(r.blob())
+        if isinstance(payload, (GlobalTierMessage, GossipMessage)):
+            # One level of nesting only, as for gossip: an envelope inside
+            # the envelope is meaningless and unbounded recursion is a
+            # parser DoS.
+            raise CodecError("nested envelope in GlobalTierMessage payload")
+        out = GlobalTierMessage(sender, payload)
     else:
         raise CodecError(f"unknown request tag {tag}")
     if not r.done():

@@ -79,6 +79,11 @@ class GossipBroadcaster(Broadcaster):
         # check-then-remember runs atomically under cooperative scheduling —
         # the annotation keeps it that way (an await slipped between a _seen
         # lookup and its _remember would re-relay duplicate envelopes).
+        #: Optional fan-out scope (set by the hierarchical service,
+        #: rapid_tpu/hier): maps the full membership to the subset this
+        #: node relays to — gossip then spreads within the cohort instead of
+        #: cluster-wide, keeping the epidemic's per-node egress O(log c).
+        self.scope_fn = None  # guarded-by: event-loop
         self._members: List[Endpoint] = []  # guarded-by: event-loop
         self._seen: "OrderedDict[Tuple[Endpoint, int], None]" = OrderedDict()  # guarded-by: event-loop
         self.relays_sent = 0  # observability: total envelope transmissions
@@ -110,7 +115,8 @@ class GossipBroadcaster(Broadcaster):
             self._client.send_nowait(self._self, request)
 
     def set_membership(self, members: List[Endpoint]) -> None:
-        self._members = list(members)
+        scoped = self.scope_fn(members) if self.scope_fn is not None else members
+        self._members = list(scoped)
 
     # -- relay side (called by the router facade) -----------------------
 

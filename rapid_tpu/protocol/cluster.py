@@ -173,7 +173,7 @@ class Cluster:
         broadcaster = (
             broadcaster_factory(client, listen_address, rng) if broadcaster_factory else None
         )
-        service = MembershipService(
+        service = cls._service_class(settings)(
             my_addr=listen_address,
             cut_detector=cut_detector,
             view=view,
@@ -192,6 +192,16 @@ class Cluster:
         await server.start()
         await service.start()
         return cls(listen_address, service, server, client)
+
+    @staticmethod
+    def _service_class(settings: Settings):
+        """Flat or two-level service, by configuration. Imported lazily:
+        the hier package depends on protocol/, not the other way around."""
+        if settings.hier_target_cohort_size > 0:
+            from rapid_tpu.hier.service import HierMembershipService
+
+            return HierMembershipService
+        return MembershipService
 
     @staticmethod
     def _server_handler(broadcaster, service):
@@ -367,7 +377,7 @@ class Cluster:
         broadcaster = (
             broadcaster_factory(client, listen_address, rng) if broadcaster_factory else None
         )
-        service = MembershipService(
+        service = cls._service_class(settings)(
             my_addr=listen_address,
             cut_detector=cut_detector,
             view=view,

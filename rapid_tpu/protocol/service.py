@@ -32,9 +32,12 @@ from rapid_tpu.settings import Settings
 from rapid_tpu.types import (
     AlertMessage,
     BatchedAlertMessage,
+    CohortCutMessage,
+    DelegateDecisionMessage,
     EdgeStatus,
     Endpoint,
     FastRoundPhase2bMessage,
+    GlobalTierMessage,
     JoinMessage,
     JoinResponse,
     JoinStatusCode,
@@ -66,6 +69,12 @@ CONSENSUS_TYPES = (
     Phase2aMessage,
     Phase2bMessage,
 )
+
+#: Hierarchical-membership traffic (rapid_tpu/hier). The flat service
+#: acknowledges-and-ignores these (a flat node can share a wire with a
+#: hierarchical cluster without raising); HierMembershipService overrides
+#: ``_handle_hier_message`` with the real cohort/global-tier routing.
+HIER_TYPES = (CohortCutMessage, DelegateDecisionMessage, GlobalTierMessage)
 
 #: Member-initiated config pulls ride the join phase-2 handler stamped with
 #: the requester's CURRENT configuration id: an up-to-date peer recognizes a
@@ -324,7 +333,7 @@ class MembershipService:
             return NodeHealth.WEDGED
         if self._decision_pending_catch_up:
             return NodeHealth.CATCHING_UP
-        if self._announced_proposal and not self._fast_paxos.decided:
+        if self._consensus_pending():
             return NodeHealth.PROPOSING
         if self._send_queue or self.cut_detector.has_pending_reports():
             return NodeHealth.DETECTING
@@ -391,11 +400,50 @@ class MembershipService:
                     request.sender, self.view.configuration_id
                 )
             return Response()
+        if isinstance(request, HIER_TYPES):
+            self._note_config_evidence(request)
+            async with self._lock:
+                return self._handle_hier_message(request)
         raise TypeError(f"unidentified request type {type(request)!r}")
 
     # ------------------------------------------------------------------
     # join protocol, server side
     # ------------------------------------------------------------------
+
+    # ------------------------------------------------------------------
+    # hierarchy seams (rapid_tpu/hier overrides these; flat defaults here)
+    # ------------------------------------------------------------------
+
+    def _handle_hier_message(self, request: RapidRequest) -> RapidResponse:
+        """Cohort-cut / delegate / global-tier traffic. Flat mode has no
+        hierarchy: acknowledge and ignore (a stray hier frame must not crash
+        a flat node). HierMembershipService overrides with real routing."""
+        LOG.debug(
+            "%s ignoring hierarchical message %s (flat topology)",
+            self.my_addr, type(request).__name__,
+        )
+        return Response()
+
+    def _monitor_topology(self):
+        """Who monitors whom: an object answering ``subjects_of`` /
+        ``observers_of`` / ``expected_observers_of`` / ``ring_numbers``.
+        Flat mode monitors over the full K-ring view; hierarchical mode
+        returns the cohort-scoped topology (rapid_tpu/hier/cohorts.py)."""
+        return self.view
+
+    def _cut_view(self):
+        """The view the cut detector's implicit edge invalidation walks:
+        the full view in flat mode, the node's cohort mini-view in
+        hierarchical mode (ring numbers must come from the same ring space
+        the explicit alerts used)."""
+        return self.view
+
+    def _consensus_pending(self) -> bool:
+        """True while a membership change this node knows about is agreed
+        but not yet applied — the suspicion signal the redelivery and
+        config-sync loops (and the health model) act on. Hierarchical mode
+        extends it with 'cohort decided, global decision outstanding'."""
+        return self._announced_proposal and not self._fast_paxos.decided
 
     def _adopt_trace(self, trace_id: Optional[int]) -> None:
         """Dapper-style context propagation, receive side: the first traced
@@ -410,7 +458,10 @@ class MembershipService:
         status = self.view.is_safe_to_join(msg.sender, msg.node_id)
         endpoints: Tuple[Endpoint, ...] = ()
         if status in (JoinStatusCode.SAFE_TO_JOIN, JoinStatusCode.HOSTNAME_ALREADY_IN_RING):
-            endpoints = tuple(self.view.expected_observers_of(msg.sender))
+            # Gatekeepers come from the monitoring topology: the full view's
+            # predecessor rings in flat mode, the joiner's target cohort's
+            # rings in hierarchical mode.
+            endpoints = tuple(self._monitor_topology().expected_observers_of(msg.sender))
         LOG.info(
             "join at seed %s for %s: %s (config %d, size %d)",
             self.my_addr, msg.sender, status.name,
@@ -532,7 +583,9 @@ class MembershipService:
 
         # One batched detector pass (host hash-map or device kernel —
         # DeviceCutDetector overrides aggregate_batch with a fused kernel).
-        proposal = self.cut_detector.aggregate_batch(valid, self.view)
+        # The invalidation view matches the ring space the alerts reported
+        # in (_cut_view: full view flat, cohort mini-view hierarchical).
+        proposal = self.cut_detector.aggregate_batch(valid, self._cut_view())
 
         if proposal:
             LOG.info("%s proposing membership change of size %d", self.my_addr, len(proposal))
@@ -890,7 +943,9 @@ class MembershipService:
                 edge_dst=subject,
                 edge_status=EdgeStatus.DOWN,
                 configuration_id=config_id,
-                ring_numbers=tuple(self.view.ring_numbers(self.my_addr, subject)),
+                ring_numbers=tuple(
+                    self._monitor_topology().ring_numbers(self.my_addr, subject)
+                ),
             )
         )
 
@@ -901,7 +956,7 @@ class MembershipService:
         generation = self._fd_generation
         config_id = self.view.configuration_id
         try:
-            subjects = self.view.subjects_of(self.my_addr)
+            subjects = self._monitor_topology().subjects_of(self.my_addr)
         except NodeNotInRingError:
             # Evicted between the view change and this rearm: no ring
             # position means no subjects to watch — nothing to arm.
@@ -1063,9 +1118,7 @@ class MembershipService:
                     ))
                     if not pending or self._redeliveries_this_config >= _MAX_REDELIVERIES:
                         continue
-                    unresolved = (
-                        self._announced_proposal and not self._fast_paxos.decided
-                    ) or (
+                    unresolved = self._consensus_pending() or (
                         not self._announced_proposal
                         and self.cut_detector.has_pending_reports()
                     )
@@ -1113,7 +1166,7 @@ class MembershipService:
                     # An undecided proposal is normal for the first couple of
                     # intervals of any slow classic decision; only a
                     # PERSISTENTLY undecided one warrants pulling snapshots.
-                    if self._announced_proposal and not self._fast_paxos.decided:
+                    if self._consensus_pending():
                         self._undecided_suspicion_ticks += 1
                     else:
                         self._undecided_suspicion_ticks = 0
@@ -1194,6 +1247,13 @@ class MembershipService:
             return
         if isinstance(request, BatchedAlertMessage):
             config_ids = {m.configuration_id for m in request.messages}
+        elif isinstance(request, GlobalTierMessage):
+            # The envelope itself is unstamped; the consensus payload inside
+            # carries the configuration the sender inhabits. A payload
+            # without a stamp (never sent by this implementation) is simply
+            # not evidence.
+            payload_cid = getattr(request.payload, "configuration_id", None)
+            config_ids = set() if payload_cid is None else {payload_cid}
         else:
             config_ids = {request.configuration_id}
         unknown = frozenset(
@@ -1492,7 +1552,7 @@ class MembershipService:
 
     async def leave(self) -> None:
         try:
-            observers = self.view.observers_of(self.my_addr)
+            observers = self._monitor_topology().observers_of(self.my_addr)
         except NodeNotInRingError:
             return  # already removed — nothing to announce
         leave_msg = LeaveMessage(sender=self.my_addr)

@@ -62,6 +62,19 @@ class Settings:
     # because a reference JVM peer has no unchanged fast path).
     config_sync_idle_interval_ms: int = 30_000
 
+    # Two-level hierarchical membership (rapid_tpu/hier; ROADMAP item 3).
+    # 0 = flat Rapid (every alert/vote fans out cluster-wide). > 0 = cohort
+    # mode: the membership is deterministically partitioned into cohorts of
+    # roughly this size (seeded by hier_seed, rebalanced only at
+    # reconfiguration); failure detection, alert broadcast, and the fast
+    # consensus round are scoped to the cohort, and a small delegate
+    # committee serializes cohort cut proposals into the single cluster-wide
+    # configuration chain. Cluster-wide knob: every member must agree on
+    # both values or nodes compute different cohort maps and the fast path
+    # degrades to anti-entropy catch-up.
+    hier_target_cohort_size: int = 0
+    hier_seed: int = 0
+
     # Topology mode: "native" (tpu-first default: 8-byte port hashing,
     # unsigned key/identifier ordering) or "java" (reference-exact ring
     # ordering and configuration-id fold, MembershipView.java:544-587 —
@@ -80,4 +93,11 @@ class Settings:
         if self.topology not in TOPOLOGIES:
             raise ValueError(
                 f"topology must be one of {TOPOLOGIES}, got {self.topology!r}"
+            )
+        if self.hier_target_cohort_size < 0 or self.hier_target_cohort_size == 1:
+            # A 1-member cohort could never detect its own failure; 0 means
+            # flat mode, >= 2 is a real hierarchy.
+            raise ValueError(
+                "hier_target_cohort_size must be 0 (flat) or >= 2, got "
+                f"{self.hier_target_cohort_size}"
             )

@@ -49,6 +49,14 @@ kind                 semantics
                      handles the request twice — receiver-side dedup)
 ``drop_first_n``     drop the first N requests of one type at a slot's
                      server (MessageDropInterceptor.java:24-49 semantics)
+``wan_asym``         WAN-shaped asymmetry: messages crossing the boundary
+                     between ``slots`` and the rest suffer ADDITIONAL
+                     seeded loss (``loss_permille``) and delay
+                     (``delay_min_ms``..``delay_max_ms``) on top of any
+                     global shaping; intra-group links are untouched (the
+                     inter-cohort adverse-network shape of the
+                     hierarchical-membership families). Empty slots +
+                     zero parameters clears it.
 ``clock_skew``       shift one slot's clock readings by offset_ms
 ``clock_pause``      freeze one slot's clock and park its timers (GC pause)
 ``clock_resume``     thaw a paused clock; parked timers fire late
@@ -98,7 +106,7 @@ MEMBER_DELTA = {"crash": -1, "restart": +1, "join": +1, "leave": -1, "partition_
 #: Network/clock events: applied instantaneously, never convergence-waited.
 ENVIRONMENT_KINDS = frozenset({
     "partition", "ingress_block", "heal_partitions", "link_block", "link_heal",
-    "loss", "delay", "duplicate", "drop_first_n",
+    "loss", "delay", "duplicate", "drop_first_n", "wan_asym",
     "clock_skew", "clock_pause", "clock_resume",
 })
 
@@ -131,21 +139,44 @@ class LinkShaper:
         self.delay_min_ms = 0.0
         self.delay_max_ms = 0.0
         self.dup_permille = 0
+        # WAN asymmetry (the ``wan_asym`` event): links CROSSING the
+        # boundary between ``asym_group`` and everyone else pay additional
+        # loss/delay; intra-group links are untouched.
+        self.asym_group: set = set()
+        self.asym_loss_permille = 0
+        self.asym_delay_min_ms = 0.0
+        self.asym_delay_max_ms = 0.0
         # Observability: totals per fate, for artifacts and assertions.
         self.dropped = 0
         self.delayed = 0
         self.duplicated = 0
+        self.asym_dropped = 0
+        self.asym_delayed = 0
 
     def plan(self, src, dst) -> LinkPlan:
         drop = self.loss_permille > 0 and self._rng.randrange(1000) < self.loss_permille
         if drop:
             self.dropped += 1
             return LinkPlan(True, 0.0, False)
+        cross = bool(self.asym_group) and (
+            (src in self.asym_group) != (dst in self.asym_group)
+        )
+        if (
+            cross
+            and self.asym_loss_permille > 0
+            and self._rng.randrange(1000) < self.asym_loss_permille
+        ):
+            self.dropped += 1
+            self.asym_dropped += 1
+            return LinkPlan(True, 0.0, False)
         delay = 0.0
         if self.delay_max_ms > 0:
             delay = self._rng.uniform(self.delay_min_ms, self.delay_max_ms)
-            if delay > 0:
-                self.delayed += 1
+        if cross and self.asym_delay_max_ms > 0:
+            delay += self._rng.uniform(self.asym_delay_min_ms, self.asym_delay_max_ms)
+            self.asym_delayed += 1
+        if delay > 0:
+            self.delayed += 1
         dup = self.dup_permille > 0 and self._rng.randrange(1000) < self.dup_permille
         if dup:
             self.duplicated += 1
@@ -218,11 +249,15 @@ class FaultSchedule:
     #: single decision + catch-up may take before the run counts as wedged).
     phase_budget_ms: float = 90_000.0
     name: str = ""
+    #: Protocol profile the runner boots the cluster with: "flat" (the
+    #: classic O(N) protocol) or "hier" (two-level hierarchical membership,
+    #: rapid_tpu/hier — the WAN-shaped families run under it).
+    profile: str = "flat"
 
     # -- serialization (the repro artifact format) ----------------------
 
     def to_dict(self) -> Dict[str, object]:
-        return {
+        out: Dict[str, object] = {
             "version": 1,
             "name": self.name,
             "n0": self.n0,
@@ -232,6 +267,11 @@ class FaultSchedule:
             "phase_budget_ms": self.phase_budget_ms,
             "events": [e.to_dict() for e in self.events],
         }
+        if self.profile != "flat":
+            # Written only when non-default: pre-hier repro files stay
+            # byte-identical through a load/save round trip.
+            out["profile"] = self.profile
+        return out
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), indent=1) + "\n"
@@ -250,6 +290,7 @@ class FaultSchedule:
                 converge_budget_ms=float(data.get("converge_budget_ms", 120_000.0)),  # type: ignore[arg-type]
                 phase_budget_ms=float(data.get("phase_budget_ms", 90_000.0)),  # type: ignore[arg-type]
                 name=str(data.get("name", "")),
+                profile=str(data.get("profile", "flat")),
             )
         except (KeyError, TypeError, ValueError) as exc:
             # A hand-edited or corrupted schedule file must surface as a
@@ -269,6 +310,8 @@ class FaultSchedule:
         shrink step can never produce a schedule the runner would crash on."""
         if not 1 <= self.n0 <= self.n_slots:
             raise ScheduleError(f"n0 must be in [1, n_slots], got {self.n0}/{self.n_slots}")
+        if self.profile not in ("flat", "hier"):
+            raise ScheduleError(f"unknown profile {self.profile!r}")
         live = set(range(self.n0))
         fresh = set(range(self.n0, self.n_slots))
         removed: set = set()
@@ -343,6 +386,19 @@ class FaultSchedule:
                     )
                 if int(event.args.get("count", 0)) < 1:  # type: ignore[arg-type]
                     raise ScheduleError(f"{where}: needs count >= 1")
+            elif event.kind == "wan_asym":
+                bad = set(event.slots) - live
+                if bad:
+                    raise ScheduleError(f"{where}: wan_asym over non-live slots {sorted(bad)}")
+                p = int(event.args.get("loss_permille", 0))  # type: ignore[arg-type]
+                if not 0 <= p <= 1000:
+                    raise ScheduleError(f"{where}: loss_permille must be in [0, 1000]")
+                lo = float(event.args.get("delay_min_ms", 0.0))  # type: ignore[arg-type]
+                hi = float(event.args.get("delay_max_ms", 0.0))  # type: ignore[arg-type]
+                if not 0 <= lo <= max(hi, 0.0) or hi < 0:
+                    raise ScheduleError(f"{where}: need 0 <= delay_min_ms <= delay_max_ms")
+                if event.slots and p == 0 and hi == 0:
+                    raise ScheduleError(f"{where}: a non-empty group needs loss or delay")
             elif event.kind == "clock_skew":
                 if len(event.slots) != 1 or "offset_ms" not in event.args:
                     raise ScheduleError(f"{where}: needs one slot and offset_ms")

@@ -3,10 +3,14 @@
 Three pieces:
 
 - **Named scenario families** — the four canonical adverse-network shapes
-  (partition-heal, asymmetric link, crash-during-join, churn-under-loss),
-  each a seeded generator over a fixed slot geometry so every (family, seed)
-  pair is one pinned, replayable scenario. The tier-1 chaos smoke runs a
-  pinned grid of these; ``tools/chaosrun.py`` runs them by name.
+  (partition-heal, asymmetric link, crash-during-join, churn-under-loss)
+  plus the three WAN-shaped hierarchical-membership shapes (inter-cohort
+  loss/latency asymmetry, delegate gray failure, cohort-boundary flapping —
+  ``profile="hier"``, run over the two-level protocol of
+  :mod:`rapid_tpu.hier`), each a seeded generator over a fixed slot
+  geometry so every (family, seed) pair is one pinned, replayable scenario.
+  The tier-1 chaos smoke runs a pinned grid of these; ``tools/chaosrun.py``
+  runs them by name.
 - **Random schedules** — :func:`random_schedule` draws arbitrary mixes of
   membership phases and environment faults, sized to keep the cluster
   decidable (slot 0 never faulted, enough reachable voters for a classic
@@ -28,9 +32,15 @@ import random
 from pathlib import Path
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
+from rapid_tpu.hier.cohorts import CohortMap
 from rapid_tpu.sim.faults import FaultEvent, FaultSchedule, ScheduleError
 from rapid_tpu.sim.oracles import Violation, check_all
-from rapid_tpu.sim.scenario import RunResult, ScenarioRunner
+from rapid_tpu.sim.scenario import (
+    RunResult,
+    ScenarioRunner,
+    endpoints_for,
+    hier_sim_settings,
+)
 
 #: One slot geometry for every generated scenario: 8 initial members, a
 #: 4-slot joiner pool. Small enough that a full run is cheap, large enough
@@ -48,7 +58,7 @@ def _initial_live(rng: random.Random) -> List[int]:
 
 
 # ---------------------------------------------------------------------------
-# the four named families
+# the flat named families
 # ---------------------------------------------------------------------------
 
 
@@ -129,11 +139,119 @@ def churn_under_loss(seed: int) -> FaultSchedule:
     )
 
 
+# ---------------------------------------------------------------------------
+# WAN-shaped hierarchical families (rapid_tpu/hier; profile="hier")
+# ---------------------------------------------------------------------------
+
+
+def _hier_geometry(seed: int):
+    """The cohort structure of the INITIAL 8-member hierarchical cluster for
+    a family seed: (cohort map, slot-of-endpoint). Deterministic — the
+    generator reasons about the exact cohorts the runner will boot, so a
+    family can aim a fault at a real delegate or a real cohort boundary."""
+    settings = hier_sim_settings()
+    endpoints = endpoints_for(seed, N_SLOTS)
+    cmap = CohortMap(
+        endpoints[:N0], settings.hier_seed, settings.hier_target_cohort_size
+    )
+    slot_of = {ep: i for i, ep in enumerate(endpoints)}
+    return cmap, endpoints, slot_of
+
+
+def wan_cohort_asym(seed: int) -> FaultSchedule:
+    """Inter-cohort latency/loss asymmetry: the cohort on the far side of a
+    lossy, slow WAN boundary (25% cross-boundary loss, +20..120 ms
+    cross-boundary delay) loses a member and admits a joiner. The cohort-
+    local fast path never crosses the boundary — detection and cohort
+    agreement run at LAN speed — and only the thin global tier pays the WAN;
+    its redelivery/classic machinery must absorb the loss."""
+    cmap, endpoints, slot_of = _hier_geometry(seed)
+    rng = random.Random(f"wan-cohort-asym:{seed}")
+    seed_cohort = cmap.cohort_of(endpoints[0])
+    far = next(c for c in range(cmap.n_cohorts) if c != seed_cohort)
+    group = sorted(slot_of[ep] for ep in cmap.members_of(far))
+    victim = rng.choice(group)
+    joiner = N0 + (seed % (N_SLOTS - N0))
+    return FaultSchedule(
+        n0=N0, n_slots=N_SLOTS, seed=seed, profile="hier",
+        name=f"wan_cohort_asym/{seed}",
+        events=[
+            FaultEvent("wan_asym", tuple(group),
+                       args={"loss_permille": 250, "delay_min_ms": 20.0,
+                             "delay_max_ms": 120.0}),
+            FaultEvent("crash", (victim,), dwell_ms=1_000),
+            FaultEvent("join", (joiner,), dwell_ms=500),
+            FaultEvent("wan_asym", args={"loss_permille": 0}),
+        ],
+    )
+
+
+def delegate_gray_failure(seed: int) -> FaultSchedule:
+    """Gray failure of a delegate: a global-committee member keeps SENDING
+    (its egress is open) but hears nothing (ingress partitioned) — the
+    asymmetric half-death that wedges naive leader-based designs. Its
+    cohort must detect it, decide the cut without it, fail over the
+    forwarding chain, and the committee must decide classically around the
+    unresponsive member; a joiner then lands through the healed network."""
+    cmap, endpoints, slot_of = _hier_geometry(seed)
+    rng = random.Random(f"delegate-gray:{seed}")
+    committee = [ep for ep in cmap.committee() if ep != endpoints[0]]
+    victim = slot_of[rng.choice(committee)]
+    skew_pool = [s for s in range(1, N0) if s != victim]
+    skewed = rng.choice(skew_pool)
+    joiner = N0 + (seed % (N_SLOTS - N0))
+    return FaultSchedule(
+        n0=N0, n_slots=N_SLOTS, seed=seed, profile="hier",
+        name=f"delegate_gray_failure/{seed}",
+        events=[
+            FaultEvent("clock_skew", (skewed,), args={"offset_ms": 250.0}),
+            FaultEvent("partition_oneway", (victim,), dwell_ms=1_000),
+            FaultEvent("heal_partitions", dwell_ms=500),
+            FaultEvent("join", (joiner,), dwell_ms=500),
+        ],
+    )
+
+
+def cohort_boundary_flap(seed: int) -> FaultSchedule:
+    """Flapping across the cohort boundary: one inter-cohort link blocks
+    and heals repeatedly — in both directions — while a join overlaps a
+    crash. The flap touches only cross-cohort traffic (the global tier and
+    config pulls); cohort-local detection must stay quiet about it (no
+    false evictions of the flapping link's endpoints) and the overlapped
+    churn must still serialize into one consistent chain."""
+    cmap, endpoints, slot_of = _hier_geometry(seed)
+    rng = random.Random(f"boundary-flap:{seed}")
+    seed_cohort = cmap.cohort_of(endpoints[0])
+    far = next(c for c in range(cmap.n_cohorts) if c != seed_cohort)
+    near_pool = [
+        slot_of[ep] for ep in cmap.members_of(seed_cohort) if ep != endpoints[0]
+    ]
+    far_pool = sorted(slot_of[ep] for ep in cmap.members_of(far))
+    a = rng.choice(near_pool)
+    b, victim = rng.sample(far_pool, 2)
+    joiner = N0 + (seed % (N_SLOTS - N0))
+    return FaultSchedule(
+        n0=N0, n_slots=N_SLOTS, seed=seed, profile="hier",
+        name=f"cohort_boundary_flap/{seed}",
+        events=[
+            FaultEvent("link_block", args={"src": a, "dst": b}, dwell_ms=400),
+            FaultEvent("link_heal", args={"src": a, "dst": b}, dwell_ms=200),
+            FaultEvent("link_block", args={"src": b, "dst": a}, dwell_ms=400),
+            FaultEvent("join", (joiner,), settle=False),
+            FaultEvent("crash", (victim,), dwell_ms=800),
+            FaultEvent("link_heal", args={"src": b, "dst": a}, dwell_ms=300),
+        ],
+    )
+
+
 FAMILIES: Dict[str, Callable[[int], FaultSchedule]] = {
     "partition_heal": partition_heal,
     "asymmetric_link": asymmetric_link,
     "crash_during_join": crash_during_join,
     "churn_under_loss": churn_under_loss,
+    "wan_cohort_asym": wan_cohort_asym,
+    "delegate_gray_failure": delegate_gray_failure,
+    "cohort_boundary_flap": cohort_boundary_flap,
 }
 
 
@@ -290,6 +408,7 @@ def _shrink_candidates(schedule: FaultSchedule) -> Iterable[FaultSchedule]:
             n0=schedule.n0, n_slots=schedule.n_slots, seed=schedule.seed,
             events=new_events, converge_budget_ms=schedule.converge_budget_ms,
             phase_budget_ms=schedule.phase_budget_ms, name=schedule.name,
+            profile=schedule.profile,
         )
 
     for i in range(len(events)):

@@ -395,6 +395,18 @@ class RunResult:
         return directory
 
 
+def endpoints_for(seed: int, n_slots: int) -> List[Endpoint]:
+    """THE slot->endpoint derivation for generated scenarios — one
+    definition, shared by the runner and by family generators that need to
+    reason about endpoint-dependent structure (the hierarchical families
+    compute the cohort map of the initial cluster to pick delegates and
+    cross-cohort links deterministically)."""
+    return [
+        Endpoint(f"10.83.{seed % 250}.{i % 250}", 7800 + i)
+        for i in range(n_slots)
+    ]
+
+
 def sim_settings() -> Settings:
     """The chaos-simulation settings profile: reference protocol defaults,
     with the anti-entropy idle pull fast enough that members healed out of a
@@ -404,6 +416,21 @@ def sim_settings() -> Settings:
     that reaches an evidence-free partition survivor)."""
     settings = Settings()
     settings.config_sync_idle_interval_ms = 2_000
+    return settings
+
+
+#: Cohort size for hierarchical simulation profiles: 4 over the shared
+#: 8-member geometry gives exactly two cohorts — the smallest topology where
+#: the global reconfiguration tier does real work (cross-cohort stitching,
+#: delegate failover) while every cohort stays big enough to self-detect.
+HIER_SIM_COHORT_SIZE = 4
+
+
+def hier_sim_settings() -> Settings:
+    """The chaos settings profile for two-level hierarchical membership
+    (rapid_tpu/hier): the flat sim profile plus cohort mode."""
+    settings = sim_settings()
+    settings.hier_target_cohort_size = HIER_SIM_COHORT_SIZE
     return settings
 
 
@@ -418,15 +445,16 @@ class ScenarioRunner:
     ) -> None:
         schedule.validate()
         self.schedule = schedule
-        self.settings = settings if settings is not None else sim_settings()
+        if settings is not None:
+            self.settings = settings
+        elif schedule.profile == "hier":
+            self.settings = hier_sim_settings()
+        else:
+            self.settings = sim_settings()
         self.wall_timeout_s = wall_timeout_s
 
     def endpoints(self) -> List[Endpoint]:
-        s = self.schedule
-        return [
-            Endpoint(f"10.83.{s.seed % 250}.{i % 250}", 7800 + i)
-            for i in range(s.n_slots)
-        ]
+        return endpoints_for(self.schedule.seed, self.schedule.n_slots)
 
     def run(self) -> RunResult:
         async def with_timeout() -> RunResult:
@@ -524,6 +552,8 @@ class ScenarioRunner:
                 "dropped": shaper.dropped if shaper else 0,
                 "delayed": shaper.delayed if shaper else 0,
                 "duplicated": shaper.duplicated if shaper else 0,
+                "asym_dropped": shaper.asym_dropped if shaper else 0,
+                "asym_delayed": shaper.asym_delayed if shaper else 0,
             },
             snapshots=snapshots,
         )
@@ -567,6 +597,12 @@ class ScenarioRunner:
         elif kind == "duplicate":
             assert harness.shaper is not None
             harness.shaper.dup_permille = int(args["permille"])
+        elif kind == "wan_asym":
+            assert harness.shaper is not None
+            harness.shaper.asym_group = {harness.endpoints[s] for s in slots}
+            harness.shaper.asym_loss_permille = int(args.get("loss_permille", 0))
+            harness.shaper.asym_delay_min_ms = float(args.get("delay_min_ms", 0.0))
+            harness.shaper.asym_delay_max_ms = float(args.get("delay_max_ms", 0.0))
         elif kind == "drop_first_n":
             harness.drop_first_n(slots[0], str(args["message"]), int(args["count"]))
         elif kind == "clock_skew":

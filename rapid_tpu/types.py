@@ -201,6 +201,54 @@ class LeaveMessage:
 
 
 @dataclass(frozen=True)
+class CohortCutMessage:
+    """Hierarchical membership (rapid_tpu/hier): a cohort's *decided* cut
+    proposal, forwarded by the cohort's delegate (or a failover candidate)
+    to the global reconfiguration committee. ``cohort`` is the sender's
+    cohort index under the current configuration's cohort map; ``endpoints``
+    is the cut the cohort's Fast Paxos agreed on. ``joiner_eps``/``joiner_ids``
+    carry the identifiers of any joiners in the cut (their UP alerts only
+    circulated inside the gatekeeper cohort, so the committee — and later
+    every other cohort — learns them here)."""
+
+    sender: Endpoint
+    configuration_id: int
+    cohort: int
+    endpoints: Tuple[Endpoint, ...]
+    joiner_eps: Tuple[Endpoint, ...] = ()
+    joiner_ids: Tuple[NodeId, ...] = ()
+    trace_id: Optional[int] = field(default=None, compare=False)
+
+
+@dataclass(frozen=True)
+class DelegateDecisionMessage:
+    """Hierarchical membership (rapid_tpu/hier): the globally-decided view
+    change, disseminated by each committee member to its own cohort's plain
+    members so every node applies the identical, totally-ordered
+    configuration change without having participated in the global tier."""
+
+    sender: Endpoint
+    configuration_id: int
+    endpoints: Tuple[Endpoint, ...]
+    joiner_eps: Tuple[Endpoint, ...] = ()
+    joiner_ids: Tuple[NodeId, ...] = ()
+    trace_id: Optional[int] = field(default=None, compare=False)
+
+
+@dataclass(frozen=True)
+class GlobalTierMessage:
+    """Hierarchical membership (rapid_tpu/hier): envelope distinguishing the
+    global reconfiguration tier's consensus traffic (the five Fast-Paxos /
+    classic-Paxos message types, scoped to the delegate committee) from the
+    cohort-local fast path's — both tiers speak the same consensus message
+    types over the same configuration id, so the envelope is what routes a
+    frame to the right engine. ``payload`` is a complete consensus request."""
+
+    sender: Endpoint
+    payload: "RapidRequest"
+
+
+@dataclass(frozen=True)
 class GossipMessage:
     """Epidemic-relay envelope for broadcast traffic — the alternate
     broadcast strategy ``IBroadcaster.java:24-29``'s docs name but the
@@ -226,6 +274,9 @@ RapidRequest = Union[
     Phase2bMessage,
     LeaveMessage,
     GossipMessage,
+    CohortCutMessage,
+    DelegateDecisionMessage,
+    GlobalTierMessage,
 ]
 
 

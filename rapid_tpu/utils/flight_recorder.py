@@ -65,6 +65,11 @@ class EventName(enum.Enum):
     CLASSIC_ROUND_START = "classic_round_start"
     CLASSIC_PHASE2A_TX = "classic_phase2a_tx"
     CONSENSUS_DECIDED = "consensus_decided"
+    # Hierarchical membership (rapid_tpu/hier): cohort fast path + global tier
+    COHORT_CUT_DECIDED = "cohort_cut_decided"
+    COHORT_CUT_FORWARDED = "cohort_cut_forwarded"
+    COHORT_CUT_RX = "cohort_cut_rx"
+    GLOBAL_DECISION = "global_decision"
     # View lifecycle
     VIEW_CHANGE = "view_change"
     KICKED = "kicked"
@@ -94,6 +99,12 @@ _PHASE_RANK: Dict[EventName, int] = {
     EventName.CLASSIC_ROUND_START: 8,
     EventName.CLASSIC_PHASE2A_TX: 9,
     EventName.CONSENSUS_DECIDED: 10,
+    # The hierarchy's second tier runs after a cohort's consensus decided
+    # and before the view change delivers: rank between them.
+    EventName.COHORT_CUT_DECIDED: 10,
+    EventName.COHORT_CUT_FORWARDED: 11,
+    EventName.COHORT_CUT_RX: 11,
+    EventName.GLOBAL_DECISION: 12,
     EventName.CATCH_UP_PULL: 11,
     EventName.CATCH_UP_RESULT: 12,
     EventName.CONFIG_BEACON_TX: 11,

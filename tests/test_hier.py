@@ -1,0 +1,353 @@
+"""Two-level hierarchical membership (rapid_tpu/hier).
+
+Four layers of coverage:
+
+- the deterministic cohort map (pure unit: stability under a seed,
+  rebalance-only-at-reconfiguration semantics, joiner assignment, balanced
+  chunk sizes, delegate/committee selection and failover order);
+- wire framing for the three hier messages (native codec round trips,
+  envelope nesting guards);
+- protocol end-to-end on an in-process 2-cohort cluster (cohort-local crash
+  and join resolve through the global tier; every node delivers the same
+  totally-ordered chain; delegate failover when the delegate itself is the
+  failure);
+- the headline scaling claim: a cohort-local failure resolves with message
+  fan-out bounded by the cohort, asserted on the transports' network-stats
+  counters against the flat protocol on the identical topology.
+"""
+
+import asyncio
+
+import pytest
+
+from rapid_tpu.hier.cohorts import COMMITTEE_PER_COHORT, CohortMap
+from rapid_tpu.messaging.codec import CodecError, decode_request, encode_request
+from rapid_tpu.messaging.gossip import GossipBroadcaster
+from rapid_tpu.sim.scenario import SimHarness, hier_sim_settings, sim_settings
+from rapid_tpu.types import (
+    CohortCutMessage,
+    DelegateDecisionMessage,
+    Endpoint,
+    GlobalTierMessage,
+    GossipMessage,
+    NodeId,
+    ProbeMessage,
+)
+
+
+def _eps(n, base=7900, net="10.77.0"):
+    return [Endpoint(f"{net}.{i}", base + i) for i in range(n)]
+
+
+def async_test(fn):
+    def wrapper(*args, **kwargs):
+        asyncio.run(fn(*args, **kwargs))
+
+    wrapper.__name__ = fn.__name__
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# cohort map
+# ---------------------------------------------------------------------------
+
+
+def test_cohort_map_is_a_pure_function_of_members_and_seed():
+    members = _eps(10)
+    a = CohortMap(members, seed=7, target_size=4)
+    b = CohortMap(list(reversed(members)), seed=7, target_size=4)  # order-free
+    assert a.n_cohorts == b.n_cohorts
+    for ep in members:
+        assert a.cohort_of(ep) == b.cohort_of(ep)
+    for c in range(a.n_cohorts):
+        assert a.members_of(c) == b.members_of(c)
+    # A different seed draws a different partition (overwhelmingly likely
+    # for 10 members; pinned seeds keep it deterministic).
+    c_map = CohortMap(members, seed=8, target_size=4)
+    assert any(
+        a.cohort_of(ep) != c_map.cohort_of(ep) for ep in members
+    ) or a.members_of(0) != c_map.members_of(0)
+
+
+def test_cohort_map_rebalances_only_with_membership_change():
+    members = _eps(8)
+    before = CohortMap(members, seed=1, target_size=4)
+    unchanged = CohortMap(members, seed=1, target_size=4)
+    # Same membership, same seed -> identical partition (the map is only
+    # ever rebuilt at reconfiguration; an unchanged configuration must not
+    # shuffle anyone between cohorts).
+    for c in range(before.n_cohorts):
+        assert before.members_of(c) == unchanged.members_of(c)
+
+
+def test_cohort_sizes_stay_balanced():
+    for n in range(2, 40):
+        cmap = CohortMap(_eps(n), seed=3, target_size=4)
+        sizes = [len(cmap.members_of(c)) for c in range(cmap.n_cohorts)]
+        assert sum(sizes) == n
+        assert max(sizes) - min(sizes) <= 1
+        if n >= 4:
+            # No cohort below the self-detectability floor of 2 members.
+            assert min(sizes) >= 2
+
+
+def test_joiner_assignment_is_deterministic_and_member_free():
+    members = _eps(8)
+    cmap = CohortMap(members, seed=5, target_size=4)
+    joiner = Endpoint("10.99.9.9", 4242)
+    target = cmap.cohort_of(joiner)
+    assert 0 <= target < cmap.n_cohorts
+    assert not cmap.is_member(joiner)
+    # Every node computes the identical target cohort.
+    assert CohortMap(members, seed=5, target_size=4).cohort_of(joiner) == target
+
+
+def test_delegate_failover_order_is_deterministic():
+    cmap = CohortMap(_eps(8), seed=2, target_size=4)
+    for c in range(cmap.n_cohorts):
+        chunk = cmap.members_of(c)
+        assert cmap.delegate_of(c) == chunk[0]
+        # Excluding the delegate promotes the next chunk member, in order.
+        assert cmap.delegate_of(c, exclude=[chunk[0]]) == chunk[1]
+        assert cmap.forward_candidates(c, exclude=[chunk[0]]) == chunk[1:]
+    committee = cmap.committee()
+    assert len(committee) == cmap.n_cohorts * COMMITTEE_PER_COHORT
+    for c in range(cmap.n_cohorts):
+        assert set(cmap.members_of(c)[:COMMITTEE_PER_COHORT]) <= set(committee)
+
+
+# ---------------------------------------------------------------------------
+# wire framing
+# ---------------------------------------------------------------------------
+
+
+def test_hier_messages_round_trip_through_the_codec():
+    ep1, ep2 = Endpoint("a", 1), Endpoint("b", 2)
+    nid = NodeId(10, 20)
+    for msg in (
+        CohortCutMessage(
+            sender=ep1, configuration_id=-9, cohort=1, endpoints=(ep2,),
+            joiner_eps=(ep2,), joiner_ids=(nid,), trace_id=77,
+        ),
+        DelegateDecisionMessage(
+            sender=ep2, configuration_id=4, endpoints=(ep1, ep2),
+        ),
+        GlobalTierMessage(sender=ep1, payload=ProbeMessage(sender=ep2)),
+    ):
+        assert decode_request(encode_request(msg)) == msg
+
+
+def test_global_tier_envelope_rejects_nested_envelopes():
+    ep = Endpoint("a", 1)
+    nested = GlobalTierMessage(
+        sender=ep, payload=GlobalTierMessage(sender=ep, payload=ProbeMessage(ep))
+    )
+    with pytest.raises(CodecError):
+        encode_request(nested)
+    gossiped = GlobalTierMessage(
+        sender=ep,
+        payload=GossipMessage(origin=ep, msg_id=1, ttl=2, payload=ProbeMessage(ep)),
+    )
+    with pytest.raises(CodecError):
+        encode_request(gossiped)
+
+
+def test_global_tier_nesting_rule_holds_on_the_interop_path_too():
+    # The proto converters must enforce the same one-level rule as the
+    # native codec, or the two transports disagree on the wire contract.
+    from rapid_tpu.interop.convert import request_from_proto, request_to_proto
+    from rapid_tpu.interop.proto_schema import proto_class
+
+    ep = Endpoint("a", 1)
+    nested = GlobalTierMessage(
+        sender=ep, payload=GlobalTierMessage(sender=ep, payload=ProbeMessage(ep))
+    )
+    with pytest.raises(ValueError):
+        request_to_proto(nested)
+    # Decode direction: hand-assemble the nested envelope a non-conforming
+    # peer could send and assert it is refused, not recursed into.
+    envelope = proto_class("RapidRequest")()
+    inner = proto_class("RapidRequest")()
+    inner.globalTierMessage.sender.hostname = b"a"
+    inner.globalTierMessage.sender.port = 1
+    inner.globalTierMessage.payload.probeMessage.sender.hostname = b"a"
+    inner.globalTierMessage.payload.probeMessage.sender.port = 1
+    envelope.globalTierMessage.sender.hostname = b"a"
+    envelope.globalTierMessage.sender.port = 1
+    envelope.globalTierMessage.payload.CopyFrom(inner)
+    with pytest.raises(ValueError):
+        request_from_proto(envelope)
+
+
+def test_gossip_broadcaster_honors_cohort_scope():
+    class _NullClient:
+        def send_nowait(self, remote, request):
+            pass
+
+    members = _eps(8)
+    g = GossipBroadcaster(_NullClient(), members[0], fanout=3, ttl=2)
+    g.scope_fn = lambda all_members: all_members[:4]
+    g.set_membership(members)
+    assert set(g._members) == set(members[:4])
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: 2-cohort in-process cluster
+# ---------------------------------------------------------------------------
+
+
+def _chains_consistent(harness):
+    """Every node's delivered chain is an ordered subsequence of node 0's,
+    and equal ids carry equal memberships (the chain-consistency oracle,
+    inline)."""
+    reference = [cid for cid, _ in harness.configs[0]]
+    ref_index = {cid: i for i, cid in enumerate(reference)}
+    membership_of = {}
+    for slot, history in harness.configs.items():
+        positions = []
+        for cid, members in history:
+            assert cid in ref_index, f"slot {slot} forked: {cid:#x} not on node 0's chain"
+            positions.append(ref_index[cid])
+            seen = membership_of.setdefault(cid, frozenset(members))
+            assert seen == frozenset(members), f"config {cid:#x} has two memberships"
+        assert positions == sorted(positions)
+    return True
+
+
+@async_test
+async def test_two_cohort_cluster_resolves_cohort_local_crash():
+    settings = hier_sim_settings()
+    harness = SimHarness(_eps(12, net="10.77.1"), settings=settings, id_seed=11)
+    await harness.bootstrap(8)
+    service = harness.clusters[0].service
+    cmap = service._cohort_map
+    assert cmap.n_cohorts == 2
+    committee = set(cmap.committee())
+    victim = next(
+        i for i in range(1, 8) if harness.endpoints[i] not in committee
+    )
+    harness.crash([victim])
+    await harness.converge_members(7, budget_ms=60_000)
+    assert _chains_consistent(harness)
+    # The two-tier machinery genuinely ran: a cohort cut was decided and
+    # serialized by the global tier somewhere in the cluster.
+    totals = {"cohort_cuts_decided": 0, "cohort_global_decisions": 0}
+    for cluster in harness.clusters.values():
+        counters = cluster.service.metrics.counters
+        for key in totals:
+            totals[key] += counters.get(key, 0)
+    assert totals["cohort_cuts_decided"] > 0
+    assert totals["cohort_global_decisions"] > 0
+    await harness.shutdown()
+
+
+@async_test
+async def test_delegate_failure_fails_over_and_still_converges():
+    settings = hier_sim_settings()
+    harness = SimHarness(_eps(12, net="10.77.2"), settings=settings, id_seed=13)
+    await harness.bootstrap(8)
+    cmap = harness.clusters[0].service._cohort_map
+    seed_ep = harness.endpoints[0]
+    # Crash a cohort DELEGATE (never the seed — slot 0 anchors the oracle).
+    victim_ep = next(
+        cmap.delegate_of(c)
+        for c in range(cmap.n_cohorts)
+        if cmap.delegate_of(c) != seed_ep
+    )
+    victim = harness.endpoints.index(victim_ep)
+    harness.crash([victim])
+    await harness.converge_members(7, budget_ms=60_000)
+    assert _chains_consistent(harness)
+    # The cut containing the delegate was forwarded by a surviving failover
+    # candidate, not the (dead) delegate itself.
+    forwarders = [
+        slot
+        for slot, cluster in harness.clusters.items()
+        if cluster.service.metrics.counters.get("cohort_cuts_forwarded", 0) > 0
+    ]
+    assert forwarders and victim not in forwarders
+    await harness.shutdown()
+
+
+@async_test
+async def test_join_lands_through_cohort_gatekeepers():
+    settings = hier_sim_settings()
+    harness = SimHarness(_eps(12, net="10.77.3"), settings=settings, id_seed=17)
+    await harness.bootstrap(8)
+    await harness.join_one(8)
+    await harness.converge_members(9, budget_ms=60_000)
+    assert _chains_consistent(harness)
+    # The joiner is a member of exactly the cohort the (rebuilt) map says.
+    service = harness.clusters[0].service
+    cmap = service._cohort_map
+    joiner_ep = harness.endpoints[8]
+    assert cmap.is_member(joiner_ep)
+    await harness.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the scaling claim: O(cohort) fan-out, counted on the wire
+# ---------------------------------------------------------------------------
+
+
+@async_test
+async def test_cohort_local_failure_fans_out_o_cohort_not_o_n():
+    """Same 16-node topology, same crash, flat vs hierarchical: the
+    hierarchy must spend well under the flat protocol's messages in total,
+    and a plain member OUTSIDE the affected cohort (and off the committee)
+    must see near-zero protocol traffic — the whole point of the tier
+    split. Counted on TransportStats (the paper's Table 2 instrument)."""
+    n = 16
+    victim = 5
+
+    async def resolve(settings):
+        harness = SimHarness(
+            _eps(n + 1, net="10.77.4"), settings=settings, id_seed=3
+        )
+        await harness.bootstrap(n)
+        await harness.advance(3_000)  # settle the bootstrap tail
+        for cluster in harness.clusters.values():
+            cluster.service.client.stats.reset_window()
+        harness.crash([victim])
+        await harness.converge_members(n - 1, budget_ms=60_000)
+        tx = {
+            slot: cluster.service.client.stats.msgs_tx
+            for slot, cluster in harness.clusters.items()
+        }
+        cmap = getattr(harness.clusters[0].service, "_cohort_map", None)
+        await harness.shutdown()
+        return tx, cmap
+
+    flat_tx, _ = await resolve(sim_settings())
+    hier_tx, cmap = await resolve(hier_sim_settings())
+    flat_total = sum(flat_tx.values())
+    hier_total = sum(hier_tx.values())
+    # Totals: the hierarchy resolves the same failure in well under the
+    # flat protocol's message budget (measured ~0.45x; the bound leaves
+    # headroom for scheduling jitter, not for regressions to O(N)).
+    assert hier_total < flat_total * 0.65, (hier_total, flat_total)
+    # Per-node: members outside the victim's cohort that hold no committee
+    # seat exchange only anti-entropy heartbeats — their egress must not
+    # scale with the cluster-wide change at all.
+    committee = set(cmap.committee())
+    victim_cohort = cmap.cohort_of(Endpoint("10.77.4.5", 7905))
+    bystanders = [
+        slot
+        for slot, ep in enumerate(
+            Endpoint(f"10.77.4.{i}", 7900 + i) for i in range(n)
+        )
+        if slot != victim
+        and cmap.cohort_of(ep) != victim_cohort
+        and ep not in committee
+    ]
+    assert bystanders, "topology produced no plain bystanders"
+    for slot in bystanders:
+        assert hier_tx[slot] <= 6, (slot, hier_tx)
+    # The same bystanders under flat Rapid each paid O(N) broadcasts.
+    assert min(flat_tx[slot] for slot in bystanders) >= n, (flat_tx, bystanders)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -71,10 +71,14 @@ def test_response_proto_roundtrip(response_msg):
 
 def test_field_numbers_match_reference_layout():
     # Spot-check the wire-critical field numbers against the documented
-    # schema (SURVEY §2.4 / rapid.proto): RapidRequest oneof 1..10,
+    # schema (SURVEY §2.4 / rapid.proto): RapidRequest oneof 1..10 for the
+    # reference types (11 is the native-only gossip envelope, 12-14 the
+    # hierarchical-membership extension — both outside rapid.proto),
     # JoinResponse fields 1..7, AlertMessage nodeId=6/metadata=7.
     req = proto_class("RapidRequest").DESCRIPTOR
-    assert [f.number for f in req.oneofs[0].fields] == list(range(1, 11))
+    assert [f.number for f in req.oneofs[0].fields] == (
+        list(range(1, 11)) + [12, 13, 14]
+    )
     join_response = proto_class("JoinResponse").DESCRIPTOR
     assert [f.name for f in join_response.fields] == [
         "sender", "statusCode", "configurationId", "endpoints",

@@ -15,6 +15,8 @@ import sys
 import time
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 
 
@@ -25,6 +27,78 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+# Capability probe: some containers' jaxlib builds reject cross-process
+# collectives outright ("Multiprocess computations aren't implemented on the
+# CPU backend") — the two-process test then fails identically on the
+# pristine seed every run. Probe once per session with a minimal two-process
+# allgather job and skip (with the probe's own diagnostic) instead of
+# re-reporting a known environment gap as a code failure.
+_PROBE_JOB = """
+import sys
+process_id = int(sys.argv[1])
+port = int(sys.argv[2])
+from rapid_tpu.utils.platform import force_platform
+assert force_platform("cpu", n_host_devices=2)
+import jax
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+    process_id=process_id,
+)
+try:
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    out = multihost_utils.process_allgather(jnp.int32(process_id))
+    assert out.sum() == 1, out
+    print(f"PROBE_OK_{process_id}")
+finally:
+    jax.distributed.shutdown()
+"""
+
+_probe_result = None  # (supported: bool, detail: str), cached per session
+
+
+def _multiprocess_cpu_supported():
+    global _probe_result
+    if _probe_result is not None:
+        return _probe_result
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{REPO}:" + env.get("PYTHONPATH", "")
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _PROBE_JOB, str(pid), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=REPO,
+        )
+        for pid in (0, 1)
+    ]
+    deadline = time.monotonic() + 120
+    while any(p.poll() is None for p in procs) and time.monotonic() < deadline:
+        time.sleep(0.5)
+    timed_out = any(p.poll() is None for p in procs)
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    outputs = [p.communicate()[0] for p in procs]
+    ok = (
+        not timed_out
+        and all(p.returncode == 0 for p in procs)
+        and all(f"PROBE_OK_{pid}" in out for pid, out in enumerate(outputs))
+    )
+    if ok:
+        detail = "supported"
+    else:
+        tails = " | ".join(
+            (out.strip().splitlines() or ["(no output)"])[-1] for out in outputs
+        )
+        detail = "probe timed out" if timed_out else tails
+    _probe_result = (ok, detail)
+    return _probe_result
 
 _JOB = """
 import sys
@@ -147,6 +221,9 @@ finally:
 
 
 def test_two_process_distributed_job_runs_sharded_step():
+    supported, detail = _multiprocess_cpu_supported()
+    if not supported:
+        pytest.skip(f"multiprocess CPU computations unavailable here: {detail}")
     env = dict(os.environ)
     env["PYTHONPATH"] = f"{REPO}:" + env.get("PYTHONPATH", "")
     port = _free_port()  # both processes must agree on the coordinator

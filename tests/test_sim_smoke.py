@@ -1,15 +1,18 @@
-"""Tier-1 chaos smoke: the four scenario families over pinned seeds, every
+"""Tier-1 chaos smoke: the seven scenario families over pinned seeds, every
 oracle, explicit CPU budget.
 
-20 pinned (family, seed) runs — partition-heal, asymmetric link,
-crash-during-join, churn-under-loss at 5 seeds each — each through the FULL
-oracle battery including the host<->device differential replay. One test
-drives the whole grid so the asserted budget covers everything: the budget
-is process CPU time (wall clock would flake under CI contention), and it
-bounds what the tier-1 gate is allowed to spend on chaos coverage — a
-regression that slows simulated runs 5x is a finding, not an
-inconvenience. Schedule-space *search* (fuzzing many random seeds) is the
-slow-marked job in test_sim_fuzz.py; this is coverage, pinned."""
+35 pinned (family, seed) runs — the four flat families (partition-heal,
+asymmetric link, crash-during-join, churn-under-loss) plus the three
+WAN-shaped hierarchical families (wan_cohort_asym, delegate_gray_failure,
+cohort_boundary_flap — profile="hier", two cohorts, rapid_tpu/hier) at 5
+seeds each — each through the FULL oracle battery including the
+host<->device differential replay. One test drives the whole grid so the
+asserted budget covers everything: the budget is process CPU time (wall
+clock would flake under CI contention), and it bounds what the tier-1 gate
+is allowed to spend on chaos coverage — a regression that slows simulated
+runs 5x is a finding, not an inconvenience. Schedule-space *search*
+(fuzzing many random seeds) is the slow-marked job in test_sim_fuzz.py;
+this is coverage, pinned."""
 
 import time
 
@@ -18,13 +21,13 @@ import pytest
 from rapid_tpu.sim.fuzz import FAMILIES, run_schedule, scenario_family
 from rapid_tpu.sim.oracles import check_all
 
-#: 5 pinned seeds per family = 20 pinned scenarios in tier-1.
+#: 5 pinned seeds per family = 35 pinned scenarios in tier-1.
 SEEDS = (1, 2, 3, 4, 5)
 
 #: Process-CPU budget for the full grid, including the engine compile the
 #: first differential replay pays (~7 s) and JAX/CPU variance headroom: the
-#: grid measures ~35 s on an idle container.
-CPU_BUDGET_S = 240.0
+#: grid measures ~45 s on an idle container.
+CPU_BUDGET_S = 280.0
 
 
 def test_pinned_chaos_grid_upholds_every_oracle():
@@ -45,7 +48,7 @@ def test_pinned_chaos_grid_upholds_every_oracle():
             if not result.cuts:
                 failures.append(f"{schedule.name}: produced no cuts (vacuous run)")
     spent = time.process_time() - started
-    assert runs == len(FAMILIES) * len(SEEDS) == 20
+    assert runs == len(FAMILIES) * len(SEEDS) == 35
     assert not failures, "\n".join(failures)
     assert spent < CPU_BUDGET_S, (
         f"chaos smoke burned {spent:.1f}s CPU (budget {CPU_BUDGET_S}s): "
@@ -66,6 +69,20 @@ def test_family_runs_are_deterministic():
     assert a.shaper_stats == b.shaper_stats
     # And the loss schedule genuinely shaped traffic (not a vacuous pass).
     assert a.shaper_stats["dropped"] > 0
+
+
+def test_hier_family_runs_are_deterministic():
+    # The hierarchical profile upholds the same purity claim: same family,
+    # same seed, fresh event loop -> identical chains and outcome — the
+    # cohort map, delegate forwarding, and global tier introduce no hidden
+    # entropy. And the WAN asymmetry genuinely shaped cross-cohort traffic.
+    a = run_schedule(scenario_family("wan_cohort_asym", 7))
+    b = run_schedule(scenario_family("wan_cohort_asym", 7))
+    assert a.cuts == b.cuts
+    assert a.configs == b.configs
+    assert a.final_membership == b.final_membership
+    assert a.shaper_stats == b.shaper_stats
+    assert a.shaper_stats["asym_dropped"] > 0
 
 
 def test_repro_artifacts_feed_traceview(tmp_path):

@@ -88,6 +88,25 @@ def canonical_requests():
             sender=_EP1, configuration_id=42, rnd=_RANK, endpoints=(_EP1, _EP2),
         ),
         "LeaveMessage": t.LeaveMessage(sender=_EP2),
+        # Hierarchical-membership extension (rapid_tpu/hier): envelope
+        # numbers 12-14, mirroring the native codec tags. Not part of the
+        # reference IDL, but frozen the same way so descriptor drift on the
+        # extension breaks the build exactly like drift on the core.
+        "CohortCutMessage": t.CohortCutMessage(
+            sender=_EP1, configuration_id=-6148914691236517206, cohort=3,
+            endpoints=(_EP2, _EP3), joiner_eps=(_EP3,), joiner_ids=(_NID,),
+        ),
+        "DelegateDecisionMessage": t.DelegateDecisionMessage(
+            sender=_EP2, configuration_id=1234567890123456789,
+            endpoints=(_EP1, _EP3), joiner_eps=(_EP3,),
+            joiner_ids=(t.NodeId(1, 2),),
+        ),
+        "GlobalTierMessage": t.GlobalTierMessage(
+            sender=_EP3,
+            payload=t.Phase2aMessage(
+                sender=_EP3, configuration_id=42, rnd=_RANK, vval=(_EP2,),
+            ),
+        ),
     }
 
 

@@ -130,7 +130,11 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     run_p = sub.add_parser("run", help="run one named scenario or schedule file")
     run_p.add_argument("family", nargs="?", default=None,
-                       help=f"scenario family: {', '.join(sorted(simfuzz.FAMILIES))}")
+                       help=f"scenario family: {', '.join(sorted(simfuzz.FAMILIES))} "
+                            "(wan_cohort_asym / delegate_gray_failure / "
+                            "cohort_boundary_flap boot the two-level hierarchical "
+                            "profile, rapid_tpu/hier; traceview lanes their "
+                            "artifacts by cohort)")
     run_p.add_argument("--seed", type=int, default=0)
     run_p.add_argument("--schedule", default=None, metavar="JSON",
                        help="run this schedule file instead of a named family")

@@ -194,9 +194,16 @@ def merge_events(
         recorder = _recorder_of(snapshot)
         if not recorder:
             continue
+        # Hierarchical-membership snapshots carry the node's cohort index
+        # (HierMembershipService.telemetry_snapshot): stamp it onto the
+        # events so the rendered timeline lanes by cohort.
+        cohort = snapshot.get("cohort")
         for event in recorder.get("events", ()):
             if trace_id is not None and event.get("trace_id") != trace_id:
                 continue
+            if cohort is not None and "cohort_lane" not in event:
+                event = dict(event)
+                event["cohort_lane"] = cohort
             merged.append(event)
     merged.sort(
         key=lambda e: (
@@ -215,19 +222,28 @@ def render_text(events: List[Dict[str, Any]]) -> str:
     if not events:
         return "(no events)\n"
     t0 = events[0].get("t_ms", 0.0)
-    width = max(len(str(e.get("node", ""))) for e in events)
+    width = max(len(_node_label(e)) for e in events)
     lines = []
     for e in events:
         fields = " ".join(f"{k}={v}" for k, v in (e.get("fields") or {}).items())
         trace = e.get("trace_id")
         lines.append(
-            f"{e.get('t_ms', 0.0) - t0:>10.3f}ms  {str(e.get('node', '')):<{width}}  "
+            f"{e.get('t_ms', 0.0) - t0:>10.3f}ms  {_node_label(e):<{width}}  "
             f"{e.get('name', '?'):<22}"
             f" cfg={e.get('config_id')}"
             + (f" trace={trace:#x}" if trace is not None else "")
             + (f"  {fields}" if fields else "")
         )
     return "\n".join(lines) + "\n"
+
+
+def _node_label(event: Dict[str, Any]) -> str:
+    """The lane label for one event: ``c<cohort>:<node>`` for hierarchical
+    recordings (so a merged timeline reads cohort-by-cohort), the bare node
+    otherwise."""
+    node = str(event.get("node", ""))
+    cohort = event.get("cohort_lane")
+    return node if cohort is None else f"c{cohort}:{node}"
 
 
 def chrome_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -240,7 +256,7 @@ def chrome_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     named_lanes: set = set()  # (pid, tid) pairs with thread_name emitted
     trace_events: List[Dict[str, Any]] = []
     for e in events:
-        node = str(e.get("node", "?"))
+        node = _node_label(e) or "?"
         if node not in pids:
             pids[node] = len(pids) + 1
             trace_events.append(
