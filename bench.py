@@ -21,15 +21,26 @@ Execution structure: the accelerator attempt runs in a WATCHDOGGED CHILD
 process. The axon tunnel backend can wedge such that any device call blocks
 forever and the wedged process survives SIGKILL (observed whenever a client
 is killed mid-device-operation); running the whole attempt in a child whose
-liveness is judged by its progress marks means the bench always terminates:
-if the child goes silent past its idle budget (or blows the hard deadline),
-the parent abandons it and re-runs the workload on CPU. The bench therefore
-always emits its ONE JSON line:
-{"metric", "value", "unit", "vs_baseline", ...extras}.
+liveness is judged by its progress marks means the bench always terminates.
+
+Observability: every run appends an append-only JSONL ledger
+(rapid_tpu/utils/ledger.py; ``--ledger PATH``, default ``bench_ledger.jsonl``)
+— run/attempt/stage begin+end events with durations and per-stage timeouts,
+compile/persistent-cache stats and device memory from the engine-telemetry
+tier, heartbeat gaps, and provenance (git rev + code hash over the
+measurement paths) — so every number in the trajectory is attributable and a
+wedged run points at exactly the stage it died in (render with
+``tools/perfview.py``). Failure is LOUD: a wedged accelerator exits nonzero;
+replaying a committed TPU snapshot requires the explicit ``--allow-snapshot``
+flag (or RAPID_TPU_BENCH_ALLOW_SNAPSHOT=1) and is always marked in the
+ledger, and the legacy CPU re-run requires ``--cpu-fallback`` (or
+RAPID_TPU_BENCH_CPU_FALLBACK=1). On success the bench emits its ONE JSON
+line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 """
 
 from __future__ import annotations
 
+import argparse
 import glob
 import json
 import os
@@ -164,30 +175,100 @@ def _enable_persistent_compile_cache() -> None:
 
 
 # ---------------------------------------------------------------------------
-# The workload (runs inside the watchdogged child, or inline on CPU).
+# Derived bench metrics: pure functions, unit-audited and pinned by
+# tests/test_bench_snapshot.py with plausibility bounds.
 # ---------------------------------------------------------------------------
 
 
-def run_workload() -> None:
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        # sitecustomize imported jax before us; env alone is too late — and
-        # the axon plugin initializes its backend even under
-        # JAX_PLATFORMS=cpu unless the live config is overridden.
-        from rapid_tpu.utils.platform import force_platform
+def derived_metrics(*, n: int, n_join: int, n_crash: int, k_rings: int,
+                    cohorts: int, value_ms: float) -> dict:
+    """Derived throughput metrics of one churn resolution.
 
-        force_platform("cpu")
-    import jax
+    Units audit (the r03-r05 trajectory carried
+    ``alert_deliveries_per_sec ≈ 4.96e10``, a physically implausible rate):
+    the old formula multiplied every fired alert by all N members as if each
+    were an independent receiver, but the engine's delivery grain is the
+    COHORT — ``_deliver_alerts`` materializes one delivered-bit per
+    (cohort, edge), and the ~N/C members of a cohort share that delivery.
+    The honest rates are therefore:
 
-    platform = jax.devices()[0].platform
-    _mark(f"devices initialized: platform={platform} count={len(jax.devices())}")
-    _enable_persistent_compile_cache()
+    - ``alerts_per_sec``: fired (subject, ring) edge alerts per second —
+      (joins + crashes) × K rings over the resolution wall-clock;
+    - ``alert_deliveries_per_sec``: per-cohort deliveries of those alerts
+      per second — alerts × C receiver cohorts over the same wall-clock
+      (the BASELINE's alerts/sec axis at the engine's actual grain).
+    """
+    if value_ms <= 0:
+        raise ValueError(f"resolution wall-clock must be positive: {value_ms}")
+    alerts_fired = (n_crash + n_join) * k_rings
+    seconds = value_ms / 1000.0
+    return {
+        "alerts_fired": alerts_fired,
+        "alerts_per_sec": round(alerts_fired / seconds, 0),
+        "alert_deliveries_per_sec": round(alerts_fired * cohorts / seconds, 0),
+    }
+
+
+# ---------------------------------------------------------------------------
+# The workload (runs inside the watchdogged child, or inline on CPU).
+# ---------------------------------------------------------------------------
+
+#: Per-stage watchdog budgets (seconds), stamped into each stage_begin so
+#: the parent enforces them from the ledger alone. A single env override
+#: (RAPID_TPU_BENCH_STAGE_TIMEOUT_S) replaces every budget for smoke runs.
+STAGE_TIMEOUTS_S = {
+    "devices_init": 300,
+    "native_build": 300,
+    "ramp": 600,
+    "state_build": 900,
+    "warmup_compile": 1500,
+    "timed_samples": 900,
+    "rtt_probe": 120,
+    "xl_point": 1500,
+    "loss_variant": 900,
+    "profile": 600,
+}
+
+
+def _stage_timeout(name: str) -> int:
+    override = _env_int("RAPID_TPU_BENCH_STAGE_TIMEOUT_S", 0)
+    return override if override > 0 else STAGE_TIMEOUTS_S[name]
+
+
+def run_workload(ledger, profile_dir=None) -> None:
+    if _env_flag("RAPID_TPU_BENCH_SIMULATE_WEDGE") and _env_flag("RAPID_TPU_BENCH_CHILD"):
+        # Test hook for the watchdog/loud-failure path: the ACCELERATOR
+        # CHILD behaves exactly like a wedged axon client — alive but
+        # silent, forever — while a CPU fallback/continuation still runs
+        # (that is what the real wedge looks like). Before any jax import
+        # so the simulation cannot touch a real backend.
+        while True:
+            time.sleep(60)
+    from rapid_tpu.utils.ledger import LedgerEvent
+
+    with ledger.stage("devices_init", timeout_s=_stage_timeout("devices_init")):
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            # sitecustomize imported jax before us; env alone is too late —
+            # and the axon plugin initializes its backend even under
+            # JAX_PLATFORMS=cpu unless the live config is overridden.
+            from rapid_tpu.utils.platform import force_platform
+
+            force_platform("cpu")
+        import jax
+
+        platform = jax.devices()[0].platform
+        _mark(f"devices initialized: platform={platform} count={len(jax.devices())}")
+        _enable_persistent_compile_cache()
 
     import numpy as np
 
-    from rapid_tpu.utils._native import ensure_built
+    from rapid_tpu.utils import engine_telemetry
 
-    ensure_built()  # compile the native host library outside any event loop
-    _mark("native library built")
+    with ledger.stage("native_build", timeout_s=_stage_timeout("native_build")):
+        from rapid_tpu.utils._native import ensure_built
+
+        ensure_built()  # compile the native host library outside any event loop
+        _mark("native library built")
 
     from rapid_tpu.models.virtual_cluster import VirtualCluster
 
@@ -217,6 +298,37 @@ def run_workload() -> None:
     lanes_xl = _autotuned_lanes(1_000_000, "RAPID_TPU_BENCH_LANES_1M")
     if platform == "tpu" and not use_pallas:
         print("bench: pallas kernel unusable; using jnp core", file=sys.stderr)
+
+    # Staged N ramp: tiny engine convergences BEFORE committing to the
+    # multi-minute full-N state build + compile, each its own budgeted
+    # ledger stage — a wedged backend dies at a cheap, named stage instead
+    # of silently inside the 69 s warm-up. Default: one 4K step on the
+    # accelerator, none on CPU (the CPU fallback pays compile time twice
+    # for no diagnostic value there).
+    ramp_spec = os.environ.get(
+        "RAPID_TPU_BENCH_RAMP", "4096" if platform == "tpu" else ""
+    )
+    for ramp_field in ramp_spec.split(","):
+        if not ramp_field.strip():
+            continue
+        ramp_n = int(ramp_field)
+        with ledger.stage("ramp", timeout_s=_stage_timeout("ramp"), n=ramp_n), \
+                _heartbeat(f"ramp N={ramp_n}"):
+            vcr = VirtualCluster.create(
+                ramp_n, k=k_rings, h=9, l=4, cohorts=min(cohorts, ramp_n),
+                fd_threshold=fd_threshold, seed=0, use_pallas=use_pallas,
+                delivery_spread=delivery_spread, pallas_lanes=128,
+            )
+            vcr.assign_cohorts_roundrobin()
+            vcr.crash(
+                np.random.default_rng(0).choice(
+                    ramp_n, size=max(1, ramp_n // 100), replace=False
+                )
+            )
+            vcr.sync()
+            _, ramp_decided, _, _ = vcr.run_to_decision(max_steps=96)
+            _mark(f"ramp N={ramp_n}: decided={ramp_decided}")
+            del vcr
 
     def build(seed: int, spread: int = delivery_spread, prob_permille: int = 1000):
         vc = VirtualCluster.create(
@@ -265,43 +377,52 @@ def run_workload() -> None:
     # view-change application, second-cut re-entry). Heartbeat throughout:
     # state build + compile is the longest mark-silent stretch of the run
     # (~69 s cold), and the parent watchdog judges liveness by marks.
-    with _heartbeat(f"N={n} state build"):
-        vc, _ = build(seed=0)
-        vc.sync()
+    with ledger.stage("state_build", timeout_s=_stage_timeout("state_build"), n=n):
+        with _heartbeat(f"N={n} state build"):
+            vc, _ = build(seed=0)
+            vc.sync()
     _mark(f"N={n} state built and on device; compiling engine (warm-up run)")
-    with _heartbeat(f"N={n} warm-up compile"):
-        resolve_churn(vc)
+    with ledger.stage("warmup_compile", timeout_s=_stage_timeout("warmup_compile"), n=n):
+        with engine_telemetry.CompileDelta() as warmup_compiles:
+            with _heartbeat(f"N={n} warm-up compile"):
+                resolve_churn(vc)
+    ledger.emit(LedgerEvent.COMPILE_STATS, stage="warmup_compile",
+                **warmup_compiles.delta)
+    ledger.emit(LedgerEvent.DEVICE_MEMORY, stage="warmup_compile",
+                **engine_telemetry.device_memory_snapshot())
     _mark("warm-up convergence done (executables cached)")
 
     # Timed runs on fresh state (same shapes -> cached executables).
     samples = []
     cuts_per_sample = []
-    for rep in range(3):
-        vc, victims = build(seed=rep)
-        # Real barrier: state upload/init must complete before the clock
-        # starts (block_until_ready is advisory on tunnel backends).
-        vc.sync()
-        start = time.perf_counter()
-        cuts = resolve_churn(vc)
-        elapsed_ms = (time.perf_counter() - start) * 1000.0
-        # resolve_churn's membership_size reads are scalar fetches — the
-        # clock stops after real device completion.
-        assert vc.membership_size == n
-        assert not vc.alive_mask[victims].any()
-        assert vc.alive_mask[n : n + n_join].all()
-        samples.append(elapsed_ms)
-        cuts_per_sample.append(cuts)
-        _mark(f"sample {rep + 1}/3: {elapsed_ms:.1f} ms ({cuts} view changes)")
+    with ledger.stage("timed_samples", timeout_s=_stage_timeout("timed_samples"), n=n):
+        for rep in range(3):
+            vc, victims = build(seed=rep)
+            # Real barrier: state upload/init must complete before the clock
+            # starts (block_until_ready is advisory on tunnel backends).
+            vc.sync()
+            start = time.perf_counter()
+            cuts = resolve_churn(vc)
+            elapsed_ms = (time.perf_counter() - start) * 1000.0
+            # resolve_churn's membership_size reads are scalar fetches — the
+            # clock stops after real device completion.
+            assert vc.membership_size == n
+            assert not vc.alive_mask[victims].any()
+            assert vc.alive_mask[n : n + n_join].all()
+            samples.append(elapsed_ms)
+            cuts_per_sample.append(cuts)
+            _mark(f"sample {rep + 1}/3: {elapsed_ms:.1f} ms ({cuts} view changes)")
 
     # Fixed device<->host round-trip latency of this environment (the axon
     # tunnel); a co-located deployment would not pay it.
     import jax.numpy as jnp
 
-    probe = jax.jit(lambda a: a + 1)
-    int(probe(jnp.int32(1)))
-    t0 = time.perf_counter()
-    int(probe(jnp.int32(2)))
-    rtt_ms = (time.perf_counter() - t0) * 1000.0
+    with ledger.stage("rtt_probe", timeout_s=_stage_timeout("rtt_probe")):
+        probe = jax.jit(lambda a: a + 1)
+        int(probe(jnp.int32(1)))
+        t0 = time.perf_counter()
+        int(probe(jnp.int32(2)))
+        rtt_ms = (time.perf_counter() - t0) * 1000.0
 
     # The 1M-member point (1% crash, 8 cohorts), on by default on the
     # accelerator per the BASELINE scale story. On the CPU fallback it is
@@ -340,19 +461,24 @@ def run_workload() -> None:
             )
             return vcx
 
-        with _heartbeat("1M state build"):
-            vcx = build_xl(7)
+        with ledger.stage("xl_point", timeout_s=_stage_timeout("xl_point"), n=n_xl):
+            with _heartbeat("1M state build"):
+                vcx = build_xl(7)
+                vcx.sync()
+            _mark("1M state on device; compiling 1M executable (warm-up)")
+            with engine_telemetry.CompileDelta() as xl_compiles:
+                with _heartbeat("1M warm-up compile"):
+                    vcx.run_to_decision(max_steps=96)  # warm-up/compile
+            vcx = build_xl(8)
             vcx.sync()
-        _mark("1M state on device; compiling 1M executable (warm-up)")
-        with _heartbeat("1M warm-up compile"):
-            vcx.run_to_decision(max_steps=96)  # warm-up/compile
-        vcx = build_xl(8)
-        vcx.sync()
-        t0 = time.perf_counter()
-        _, decided_xl, _, _ = vcx.run_to_decision(max_steps=96)
-        xl_ms = (time.perf_counter() - t0) * 1000.0
-        assert decided_xl and vcx.membership_size == n_xl - n_xl // 100
-        _mark(f"1M point: {xl_ms:.1f} ms")
+            t0 = time.perf_counter()
+            _, decided_xl, _, _ = vcx.run_to_decision(max_steps=96)
+            xl_ms = (time.perf_counter() - t0) * 1000.0
+            assert decided_xl and vcx.membership_size == n_xl - n_xl // 100
+            _mark(f"1M point: {xl_ms:.1f} ms")
+        ledger.emit(LedgerEvent.COMPILE_STATS, stage="xl_point", **xl_compiles.delta)
+        ledger.emit(LedgerEvent.DEVICE_MEMORY, stage="xl_point",
+                    **engine_telemetry.device_memory_snapshot())
 
     # Adverse-network variant: the SAME churn resolved under the chaos
     # subsystem's churn_under_loss fault schedule (rapid_tpu/sim) — its 5%
@@ -374,34 +500,49 @@ def run_workload() -> None:
     loss_knobs = loss_as_engine_delivery(loss_permille)
     loss_budget_s = _env_int("RAPID_TPU_BENCH_XL_BUDGET_S", 1500)
     if time.monotonic() - _START <= loss_budget_s:
-        vc, _ = build(
-            seed=100,
-            spread=loss_knobs["delivery_spread"],
-            prob_permille=loss_knobs["delivery_prob_permille"],
-        )
-        vc.sync()
-        _mark(f"loss variant ({loss_permille} permille): compiling (warm-up)")
-        with _heartbeat("loss-variant warm-up compile"):
-            resolve_churn(vc)
-        loss_samples = []
-        for rep in range(2):
-            vc, victims = build(
-                seed=101 + rep,
+        with ledger.stage("loss_variant", timeout_s=_stage_timeout("loss_variant"), n=n):
+            vc, _ = build(
+                seed=100,
                 spread=loss_knobs["delivery_spread"],
                 prob_permille=loss_knobs["delivery_prob_permille"],
             )
             vc.sync()
-            t0 = time.perf_counter()
-            cuts = resolve_churn(vc)
-            loss_samples.append((time.perf_counter() - t0) * 1000.0)
-            assert vc.membership_size == n and not vc.alive_mask[victims].any()
-            _mark(
-                f"loss sample {rep + 1}/2: {loss_samples[-1]:.1f} ms ({cuts} view changes)"
-            )
-        loss_ms = min(loss_samples)
+            _mark(f"loss variant ({loss_permille} permille): compiling (warm-up)")
+            with _heartbeat("loss-variant warm-up compile"):
+                resolve_churn(vc)
+            loss_samples = []
+            for rep in range(2):
+                vc, victims = build(
+                    seed=101 + rep,
+                    spread=loss_knobs["delivery_spread"],
+                    prob_permille=loss_knobs["delivery_prob_permille"],
+                )
+                vc.sync()
+                t0 = time.perf_counter()
+                cuts = resolve_churn(vc)
+                loss_samples.append((time.perf_counter() - t0) * 1000.0)
+                assert vc.membership_size == n and not vc.alive_mask[victims].any()
+                _mark(
+                    f"loss sample {rep + 1}/2: {loss_samples[-1]:.1f} ms ({cuts} view changes)"
+                )
+            loss_ms = min(loss_samples)
     else:
         _mark("skipping churn_under_loss variant: past the XL time budget")
 
+    # Opt-in jax.profiler capture (--profile DIR): one extra resolved churn
+    # under utils/profiling.trace, as its own budgeted stage — TensorBoard/
+    # Perfetto-grade device timelines when the operator asks for them,
+    # zero cost otherwise.
+    if profile_dir:
+        from rapid_tpu.utils.profiling import trace
+
+        with ledger.stage("profile", timeout_s=_stage_timeout("profile"), n=n):
+            vc, _ = build(seed=999)
+            vc.sync()
+            with _heartbeat("profiled convergence"):
+                with trace(profile_dir):
+                    resolve_churn(vc)
+            _mark(f"profile captured into {profile_dir}")
 
     value = min(samples)
     # Bounded log-bucketed histogram of the timed samples (the same
@@ -414,56 +555,61 @@ def run_workload() -> None:
     sample_hist = LogHistogram()
     for s in samples:
         sample_hist.observe(s)
-    print(
-        json.dumps(
-            {
-                "metric": f"churn_resolution_ms_n{n}_churn{int(churn_frac * 100)}pct",
-                "value": round(value, 3),
-                "unit": "ms",
-                "vs_baseline": round(baseline_target_ms / value, 3),
-                "platform": platform,
-                "samples_ms": [round(s, 3) for s in samples],
-                "churn_resolution_hist": sample_hist.summary(),
-                "view_changes": cuts_per_sample,
-                "n_members": n,
-                "joins": n_join,
-                "crashes": n_crash,
-                "cohorts": cohorts,
-                "delivery_spread": delivery_spread,
-                # Logical alert deliveries during convergence: every fired
-                # edge alert (faults x K rings) reaches all N receivers —
-                # the BASELINE's alerts/sec axis.
-                "alert_deliveries_per_sec": round(
-                    (n_crash + n_join) * k_rings * n / (value / 1000.0), 0
-                ),
-                "device_rtt_ms": round(rtt_ms, 3),
-                # Adverse-network axis: the same churn under the sim
-                # subsystem's 5%-loss schedule (None when budget-skipped).
-                **(
-                    {
-                        "churn_under_loss_ms": round(loss_ms, 3),
-                        "loss_permille": loss_permille,
-                        "loss_delivery_spread": loss_knobs["delivery_spread"],
-                    }
-                    if loss_ms is not None
-                    else {}
-                ),
-                # Delivery-kernel tile width in effect for the main workload
-                # (autotune provenance); the 1M width only when the separate
-                # 1M point ran.
-                "pallas_lanes": lanes_main,
-                **(
-                    {
-                        "n1M_crash1pct_ms": round(xl_ms, 3),
-                        "lanes_1m": lanes_xl,
-                    }
-                    if xl_ms is not None
-                    else {}
-                ),
-            }
+    engine_compiles = engine_telemetry.compile_snapshot()
+    result = {
+        "metric": f"churn_resolution_ms_n{n}_churn{int(churn_frac * 100)}pct",
+        "value": round(value, 3),
+        "unit": "ms",
+        "vs_baseline": round(baseline_target_ms / value, 3),
+        "platform": platform,
+        "samples_ms": [round(s, 3) for s in samples],
+        "churn_resolution_hist": sample_hist.summary(),
+        "view_changes": cuts_per_sample,
+        "n_members": n,
+        "joins": n_join,
+        "crashes": n_crash,
+        "cohorts": cohorts,
+        "delivery_spread": delivery_spread,
+        # Derived throughput rates at the engine's actual delivery grain
+        # (per-cohort) — unit-audited in derived_metrics, plausibility
+        # bounds pinned by tests/test_bench_snapshot.py.
+        **derived_metrics(
+            n=n, n_join=n_join, n_crash=n_crash, k_rings=k_rings,
+            cohorts=cohorts, value_ms=value,
         ),
-        flush=True,
-    )
+        "device_rtt_ms": round(rtt_ms, 3),
+        # Engine-tier provenance for the trajectory: how much compile time
+        # this run paid and whether the persistent cache carried it.
+        "compiles": engine_compiles["compiles"],
+        "compile_ms_total": round(float(engine_compiles["compile_ms"]["sum"]), 3),
+        "persistent_cache_hits": engine_compiles["persistent_cache_hits"],
+        "persistent_cache_misses": engine_compiles["persistent_cache_misses"],
+        # Adverse-network axis: the same churn under the sim
+        # subsystem's 5%-loss schedule (None when budget-skipped).
+        **(
+            {
+                "churn_under_loss_ms": round(loss_ms, 3),
+                "loss_permille": loss_permille,
+                "loss_delivery_spread": loss_knobs["delivery_spread"],
+            }
+            if loss_ms is not None
+            else {}
+        ),
+        # Delivery-kernel tile width in effect for the main workload
+        # (autotune provenance); the 1M width only when the separate
+        # 1M point ran.
+        "pallas_lanes": lanes_main,
+        **(
+            {
+                "n1M_crash1pct_ms": round(xl_ms, 3),
+                "lanes_1m": lanes_xl,
+            }
+            if xl_ms is not None
+            else {}
+        ),
+    }
+    ledger.emit(LedgerEvent.METRIC, **result)
+    print(json.dumps(result), flush=True)
 
 
 # ---------------------------------------------------------------------------
@@ -471,20 +617,74 @@ def run_workload() -> None:
 # ---------------------------------------------------------------------------
 
 
-def _run_child_watchdogged() -> bool:
+class _LedgerTail:
+    """Incremental reader over the (shared, append-only, possibly
+    multi-run) ledger file: each ``poll()`` parses only the bytes appended
+    since the last one and keeps the events of ONE run — the watchdog's
+    1 s loop must not re-parse a file that other runs have grown, and must
+    never mistake a previous run's stages for this run's."""
+
+    def __init__(self, path: str, run_id: str) -> None:
+        self._path = path
+        self._run_id = run_id
+        self._offset = 0
+        self._buf = b""
+        self.events: list = []
+
+    def poll(self) -> list:
+        try:
+            with open(self._path, "rb") as f:
+                f.seek(self._offset)
+                chunk = f.read()
+        except OSError:
+            return self.events
+        self._offset += len(chunk)
+        self._buf += chunk
+        while b"\n" in self._buf:
+            line, self._buf = self._buf.split(b"\n", 1)
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn/foreign line: same tolerance as read_ledger
+            if (
+                isinstance(record, dict)
+                and "event" in record
+                and record.get("run_id") == self._run_id
+            ):
+                self.events.append(record)
+        return self.events
+
+
+def _run_events(path: str, run_id: str) -> list:
+    """This run's events from a ledger file that may hold many runs (the
+    default bench_ledger.jsonl accumulates across invocations)."""
+    from rapid_tpu.utils.ledger import read_ledger
+
+    events, _ = read_ledger(path)
+    return [e for e in events if e.get("run_id") == run_id]
+
+
+def _run_child_watchdogged(ledger) -> bool:
     """Run the workload in a child on the accelerator; True iff it printed
     its JSON line. Liveness = progress marks: a silent child past the idle
-    budget (or the hard deadline) is abandoned, not waited on — a wedged
-    axon client can survive SIGKILL in an uninterruptible device call, so
-    the reap itself must be abandonable."""
+    budget (or the hard deadline, or the current ledger stage's own
+    timeout) is abandoned, not waited on — a wedged axon client can survive
+    SIGKILL in an uninterruptible device call, so the reap itself must be
+    abandonable."""
+    from rapid_tpu.utils.ledger import STAGE_NAMES, LedgerEvent, open_stage
+
     first_mark_timeout = _env_int("RAPID_TPU_BENCH_INIT_TIMEOUT_S", 240)
     idle_timeout = _env_int("RAPID_TPU_BENCH_IDLE_TIMEOUT_S", 900)
     hard_deadline = _env_int("RAPID_TPU_BENCH_DEADLINE_S", 2700)
+    heartbeat_gap_floor_s = 60.0
 
     env = dict(os.environ)
     env["RAPID_TPU_BENCH_CHILD"] = "1"
+    env["RAPID_TPU_BENCH_LEDGER"] = ledger.path
+    env["RAPID_TPU_BENCH_RUN_ID"] = ledger.run_id
+    env["RAPID_TPU_BENCH_LEDGER_T0"] = repr(ledger.t0)
     child = subprocess.Popen(
-        [sys.executable, "-u", os.path.abspath(__file__)],
+        [sys.executable, "-u", os.path.abspath(__file__), *sys.argv[1:]],
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
         env=env,
@@ -499,7 +699,18 @@ def _run_child_watchdogged() -> bool:
     cpu_at_last_alive = 0.0
     buf_out = b""
     buf_err = b""
+    tail = _LedgerTail(ledger.path, ledger.run_id)
+    # Per-stage budget tracking: (begin seq, first time the parent saw it).
+    stage_seen: tuple = ()
+
+    def safe_stage(record) -> "str | None":
+        """A stage name read back from the FILE, re-emittable only if it is
+        in the current registered vocabulary (the strict emit would raise
+        on a foreign writer's stage; the file is untrusted input)."""
+        name = record.get("stage") if record else None
+        return name if name in STAGE_NAMES else None
     while True:
+        alive_before = last_alive
         for stream, is_err in ((child.stdout, False), (child.stderr, True)):
             chunk = None
             try:
@@ -536,6 +747,26 @@ def _run_child_watchdogged() -> bool:
         if cpu_s is not None and cpu_s - cpu_at_last_alive >= 1.0:
             cpu_at_last_alive = cpu_s
             last_alive = time.monotonic()
+        # The ledger is the stage-level truth: track the open stage and its
+        # own budget, and record recovered liveness gaps (a tunnel that
+        # stalled for minutes then resumed is a diagnosable event even when
+        # the run ultimately succeeds). Incremental + run-scoped: only newly
+        # appended bytes are parsed, and only THIS run's events count.
+        current = open_stage(tail.poll())
+        if current is not None:
+            key = (current.get("seq"), current.get("pid"))
+            if not stage_seen or stage_seen[0] != key:
+                stage_seen = (key, time.monotonic(), current)
+        else:
+            stage_seen = ()
+        if last_alive > alive_before:
+            gap_s = last_alive - alive_before
+            if gap_s >= heartbeat_gap_floor_s:
+                ledger.emit(
+                    LedgerEvent.HEARTBEAT_GAP,
+                    stage=safe_stage(current),
+                    gap_s=round(gap_s, 1),
+                )
         code = child.poll()
         if code is not None:
             _flush_partials(buf_out, buf_err)
@@ -547,13 +778,36 @@ def _run_child_watchdogged() -> bool:
         # Until the first mark (devices initialized), a tight budget: the
         # wedged-tunnel signature is exactly "init never completes".
         budget = idle_timeout if saw_mark else first_mark_timeout
-        if now - last_alive > budget or now - start > hard_deadline:
-            why = "hard deadline" if now - start > hard_deadline else "went silent"
+        stage_overrun = None
+        if stage_seen:
+            _, seen_at, begin = stage_seen
+            timeout_s = begin.get("timeout_s")
+            if timeout_s and now - seen_at > float(timeout_s):
+                stage_overrun = (begin.get("stage"), float(timeout_s))
+        if now - last_alive > budget or now - start > hard_deadline or stage_overrun:
+            if stage_overrun:
+                why = (f"stage {stage_overrun[0]!r} exceeded its "
+                       f"{stage_overrun[1]:.0f}s budget")
+            elif now - start > hard_deadline:
+                why = "hard deadline"
+            else:
+                why = "went silent"
             print(
                 f"bench: accelerator child {why} "
                 f"({now - start:.0f}s elapsed, {now - last_alive:.0f}s idle); abandoning",
                 file=sys.stderr,
                 flush=True,
+            )
+            overrun_name = (
+                stage_overrun[0] if stage_overrun and stage_overrun[0] in STAGE_NAMES
+                else None
+            )
+            ledger.emit(
+                LedgerEvent.WATCHDOG_KILL,
+                stage=overrun_name or safe_stage(current),
+                reason=why,
+                elapsed_s=round(now - start, 1),
+                idle_s=round(now - last_alive, 1),
             )
             child.kill()
             try:
@@ -584,20 +838,18 @@ def _child_cpu_seconds(pid: int):
 
 
 def _git_head_rev(root: str):
-    """Short HEAD rev of the repo at `root`, or None when unavailable."""
-    try:
-        out = subprocess.run(
-            ["git", "rev-parse", "--short", "HEAD"],
-            cwd=root, capture_output=True, text=True, timeout=10,
-        ).stdout.strip()
-        return out or None
-    except (OSError, subprocess.TimeoutExpired):
-        return None
+    """Short HEAD rev of the repo at ``root``, or None when unavailable —
+    THE definition lives in rapid_tpu.utils.ledger (the run ledger's
+    provenance stamp); this wrapper keeps bench's snapshot path and its
+    tests on the same one."""
+    from rapid_tpu.utils.ledger import git_head_rev
+
+    return git_head_rev(root)
 
 
 # Source paths whose content determines what bench.py measures; commits that
 # touch none of these (evidence captures, docs, tests) do not stale a
-# snapshot.
+# snapshot. These are also the run ledger's code-hash roots.
 _MEASUREMENT_PATHS = ("bench.py", "rapid_tpu", "native")
 
 
@@ -628,15 +880,18 @@ def _snapshot_is_stale(root: str, snap_rev, head_rev) -> bool:
     return rc != 0  # nonzero: paths differ, or a rev is unknown to git
 
 
-def _emit_tpu_snapshot() -> bool:
-    """When the live accelerator attempt wedges, fall back to the most recent
-    TPU measurement captured DURING a live tunnel window by
+def _emit_tpu_snapshot(ledger=None) -> bool:
+    """When the live accelerator attempt wedges AND the caller explicitly
+    allowed replay (--allow-snapshot), fall back to the most recent TPU
+    measurement captured DURING a live tunnel window by
     tools/capture_tpu_evidence.sh (committed under evidence/<round>/bench.json
-    with a `captured_at` stamp) rather than straight to CPU. The tunnel wedges
-    for hours at a time, so the driver's capture window is often dead even
-    though the hardware number exists; the snapshot is the same bench.py
-    workload, same shapes, emitted with full provenance so a reader can tell
-    a replayed measurement from a live one. True iff a snapshot was emitted.
+    with a `captured_at` stamp). The tunnel wedges for hours at a time, so
+    the driver's capture window is often dead even though the hardware number
+    exists; the snapshot is the same bench.py workload, same shapes, emitted
+    with full provenance so a reader can tell a replayed measurement from a
+    live one — and the run ledger records the replay (snapshot_replay event)
+    so the trajectory can never silently absorb it. True iff a snapshot was
+    emitted.
 
     Code provenance: the capture script stamps `git_rev` into each capture;
     the replay diffs the measurement-relevant source paths between that rev
@@ -692,6 +947,16 @@ def _emit_tpu_snapshot() -> bool:
         data["metric"] = str(data["metric"]) + "_snapshot"
         if "vs_baseline" in data:
             data["vs_baseline_at_capture"] = data.pop("vs_baseline")
+    if ledger is not None:
+        from rapid_tpu.utils.ledger import LedgerEvent
+
+        ledger.emit(
+            LedgerEvent.SNAPSHOT_REPLAY,
+            snapshot_path=data["snapshot_path"],
+            captured_at=data["captured_at"],
+            git_rev=snap_rev,
+            stale_code=stale,
+        )
     print(
         f"bench: live accelerator wedged; replaying TPU snapshot {data['snapshot_path']} "
         f"(captured_at {data['captured_at']}, git_rev {snap_rev or 'unknown'}"
@@ -704,16 +969,106 @@ def _emit_tpu_snapshot() -> bool:
     return True
 
 
-def main() -> None:
+def _parse_args(argv=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        description="rapid_tpu convergence benchmark (see module docstring)"
+    )
+    parser.add_argument(
+        "--ledger", default=None, metavar="PATH",
+        help="append-only JSONL run ledger (default: $RAPID_TPU_BENCH_LEDGER "
+             "or ./bench_ledger.jsonl); render with tools/perfview.py",
+    )
+    parser.add_argument(
+        "--allow-snapshot", action="store_true",
+        default=_env_flag("RAPID_TPU_BENCH_ALLOW_SNAPSHOT"),
+        help="permit replaying a committed TPU evidence snapshot when the "
+             "live accelerator wedges (always marked in the ledger and the "
+             "emitted JSON); without it a wedge exits nonzero",
+    )
+    parser.add_argument(
+        "--cpu-fallback", action="store_true",
+        default=_env_flag("RAPID_TPU_BENCH_CPU_FALLBACK"),
+        help="re-run the workload on CPU when the accelerator wedges (a real "
+             "measurement, clearly labeled platform=cpu)",
+    )
+    parser.add_argument(
+        "--profile", default=os.environ.get("RAPID_TPU_BENCH_PROFILE") or None,
+        metavar="DIR",
+        help="capture a jax.profiler trace of one resolved churn into DIR "
+             "(opt-in 'profile' ledger stage; view with TensorBoard/Perfetto)",
+    )
+    return parser.parse_args(argv)
+
+
+def _ledger_path(args: argparse.Namespace) -> str:
+    return (
+        args.ledger
+        or os.environ.get("RAPID_TPU_BENCH_LEDGER")
+        or "bench_ledger.jsonl"
+    )
+
+
+def main() -> int:
+    from rapid_tpu.utils.ledger import (
+        LedgerEvent,
+        RunLedger,
+        last_completed_stage,
+        provenance,
+    )
+
+    args = _parse_args()
+    root = os.path.dirname(os.path.abspath(__file__))
     if _env_flag("RAPID_TPU_BENCH_CHILD") or os.environ.get("JAX_PLATFORMS") == "cpu":
-        run_workload()
-        return
+        # Workload mode: the watchdogged accelerator child, a CPU re-exec
+        # continuation, or a direct CPU invocation. Continuations join the
+        # parent's run (its id arrives via env); a direct invocation owns
+        # the whole run and brackets it itself.
+        inherited = os.environ.get("RAPID_TPU_BENCH_RUN_ID")
+        try:
+            # The run's shared t_s epoch rides beside its id: every process
+            # of one run (parent, attempt children, fallback continuation)
+            # writes on one timeline.
+            t0 = float(os.environ["RAPID_TPU_BENCH_LEDGER_T0"])
+        except (KeyError, ValueError):
+            t0 = None
+        ledger = RunLedger(_ledger_path(args), run_id=inherited, t0=t0)
+        owns_run = inherited is None
+        if owns_run:
+            ledger.emit(LedgerEvent.RUN_BEGIN, mode="inline",
+                        argv=sys.argv[1:], **provenance(root, _MEASUREMENT_PATHS))
+        try:
+            run_workload(ledger, profile_dir=args.profile)
+        except BaseException as exc:
+            ledger.emit(LedgerEvent.RUN_FAIL, error=repr(exc),
+                        last_completed_stage=last_completed_stage(
+                            _run_events(ledger.path, ledger.run_id)))
+            raise
+        if owns_run:
+            ledger.emit(LedgerEvent.RUN_END, outcome="completed")
+        elif not _env_flag("RAPID_TPU_BENCH_CHILD"):
+            # The --cpu-fallback execve continuation: the watchdog parent
+            # that would have closed the run replaced itself with this
+            # process, so the successful fallback must close it — or the
+            # ledger ends at run_fail and the run reads as FAILED. (The
+            # watchdogged CHILD must not: its parent is still alive and
+            # owns the run's outcome.)
+            ledger.emit(LedgerEvent.RUN_END, outcome="cpu_fallback")
+        return 0
+
+    ledger = RunLedger(_ledger_path(args))
+    ledger.emit(LedgerEvent.RUN_BEGIN, mode="watchdogged", argv=sys.argv[1:],
+                **provenance(root, _MEASUREMENT_PATHS))
     # Bounded retry: transient tunnel hiccups recover between attempts
     # (observed); only a persistent wedge should cost the TPU number.
     attempts = max(1, _env_int("RAPID_TPU_BENCH_ATTEMPTS", 2))
     for attempt in range(attempts):
-        if _run_child_watchdogged():
-            return
+        ledger.emit(LedgerEvent.ATTEMPT_BEGIN, attempt=attempt + 1,
+                    attempts=attempts)
+        ok = _run_child_watchdogged(ledger)
+        ledger.emit(LedgerEvent.ATTEMPT_END, attempt=attempt + 1, got_json=ok)
+        if ok:
+            ledger.emit(LedgerEvent.RUN_END, outcome="live")
+            return 0
         if attempt + 1 < attempts:
             print(
                 f"bench: accelerator attempt {attempt + 1}/{attempts} failed; retrying",
@@ -721,8 +1076,9 @@ def main() -> None:
                 flush=True,
             )
             time.sleep(15)
-    if not _env_flag("RAPID_TPU_BENCH_NO_SNAPSHOT") and _emit_tpu_snapshot():
-        return
+    last_stage = last_completed_stage(_run_events(ledger.path, ledger.run_id))
+    ledger.emit(LedgerEvent.RUN_FAIL, outcome="wedged",
+                last_completed_stage=last_stage)
     if _env_flag("RAPID_TPU_BENCH_NO_FALLBACK"):
         # Sweep mode: a dead accelerator must be an EXPLICIT hole in the
         # curve (and cost no CPU-fallback minutes of a live window), never
@@ -732,12 +1088,43 @@ def main() -> None:
             "error": "accelerator_unavailable",
             "n_members": _env_int("RAPID_TPU_BENCH_N", 100_000),
         }), flush=True)
-        return
-    print("bench: falling back to CPU", file=sys.stderr, flush=True)
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("RAPID_TPU_BENCH_CHILD", None)
-    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+        return 0
+    if (
+        args.allow_snapshot
+        and not _env_flag("RAPID_TPU_BENCH_NO_SNAPSHOT")
+        and _emit_tpu_snapshot(ledger)
+    ):
+        # The replay closed the run (rc 0): without this, the ledger's
+        # latest terminal event stays run_fail and the run reads FAILED.
+        ledger.emit(LedgerEvent.RUN_END, outcome="snapshot_replay")
+        return 0
+    if args.cpu_fallback:
+        print("bench: falling back to CPU", file=sys.stderr, flush=True)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["RAPID_TPU_BENCH_LEDGER"] = ledger.path
+        env["RAPID_TPU_BENCH_RUN_ID"] = ledger.run_id
+        env["RAPID_TPU_BENCH_LEDGER_T0"] = repr(ledger.t0)
+        env.pop("RAPID_TPU_BENCH_CHILD", None)
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    # Loud failure, the default: the accelerator wedged, no stand-in was
+    # authorized — say exactly how far the run got and exit nonzero so no
+    # driver can mistake this round for a measurement.
+    print(
+        "bench: accelerator wedged and no fallback authorized "
+        f"(last completed stage: {last_stage or 'none'}; ledger: {ledger.path}); "
+        "pass --allow-snapshot to replay committed TPU evidence or "
+        "--cpu-fallback to re-run on CPU",
+        file=sys.stderr,
+        flush=True,
+    )
+    print(json.dumps({
+        "metric": f"churn_resolution_ms_n{_env_int('RAPID_TPU_BENCH_N', 100_000)}",
+        "error": "accelerator_wedged",
+        "last_completed_stage": last_stage,
+        "ledger": ledger.path,
+    }), flush=True)
+    return 1
 
 
 if __name__ == "__main__":

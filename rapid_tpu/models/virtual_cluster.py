@@ -17,6 +17,7 @@ reduction here is a sum/any over N, which XLA lowers to psum over ICI.
 from __future__ import annotations
 
 import time
+from contextlib import contextmanager
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -43,7 +44,7 @@ from rapid_tpu.ops.rings import (
     predecessor_of_keys,
     ring_topology_from_perm,
 )
-from rapid_tpu.utils import exposition
+from rapid_tpu.utils import engine_telemetry, exposition
 from rapid_tpu.utils.health import NodeHealth
 from rapid_tpu.utils.metrics import Metrics
 
@@ -799,8 +800,41 @@ class VirtualCluster:
         self._rng = np.random.default_rng(0)
         # Engine-level telemetry: host-side counters over device dispatches
         # (the per-node flight recorder has no device analog — the engine's
-        # observability grain is the dispatch, not the message).
+        # observability grain is the dispatch, not the message). Compile
+        # events are process-global (one XLA cache per process), captured by
+        # the engine_telemetry collector and read at snapshot time.
         self.metrics = Metrics()
+        engine_telemetry.install()
+
+    # -- telemetry seams ------------------------------------------------
+
+    def _account_h2d(self, *arrays) -> None:
+        """Charge host->device uploads (indices, masks, initial state) to
+        the transfer-byte counter. Host-side accounting at the driver seams:
+        only arrays that originate on the host are charged, which is exactly
+        the traffic a remote-tunnel deployment pays for."""
+        self.metrics.inc(
+            "engine_h2d_bytes",
+            int(sum(int(getattr(a, "nbytes", 0) or 0) for a in arrays)),
+        )
+
+    def _account_d2h(self, nbytes: int) -> None:
+        self.metrics.inc("engine_d2h_bytes", int(nbytes))
+
+    @contextmanager
+    def _dispatch(self, entry: str):
+        """Time one device dispatch+fetch pair into the bounded per-entry
+        latency histogram (``engine_dispatch_ms{phase=<entry>}``) and bump
+        the dispatch counter — the engine's per-dispatch observability grain."""
+        self.metrics.inc("engine_dispatches")
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.metrics.record_ms(
+                "engine_dispatch", (time.perf_counter() - start) * 1000.0,
+                phase=entry,
+            )
 
     # -- construction ---------------------------------------------------
 
@@ -847,6 +881,7 @@ class VirtualCluster:
         alive[:n_members] = True
         cluster = cls(cfg, initial_state(cfg, key_hi, key_lo, id_hi, id_lo, alive))
         cluster._rng = rng
+        cluster._account_h2d(key_hi, key_lo, id_hi, id_lo, alive)
         return cluster
 
     @classmethod
@@ -914,7 +949,9 @@ class VirtualCluster:
         id_lo = rng.integers(0, 2**32, size=(n,), dtype=np.uint32)
         alive = np.zeros(n, dtype=bool)
         alive[:n_members] = True
-        return cls(cfg, initial_state(cfg, key_hi, key_lo, id_hi, id_lo, alive))
+        cluster = cls(cfg, initial_state(cfg, key_hi, key_lo, id_hi, id_lo, alive))
+        cluster._account_h2d(key_hi, key_lo, id_hi, id_lo, alive)
+        return cluster
 
     # -- fault & membership injection ----------------------------------
 
@@ -929,6 +966,7 @@ class VirtualCluster:
                 f"slot indices out of range [0, {self.cfg.n}): "
                 f"{arr[(arr < 0) | (arr >= self.cfg.n)].tolist()}"
             )
+        self._account_h2d(arr)
         return jnp.asarray(arr)
 
     def crash(self, slots: Sequence[int]) -> None:
@@ -949,6 +987,12 @@ class VirtualCluster:
         the ALREADY-UPLOADED bounds-checked index array (an np.asarray here
         would round-trip it back through the host)."""
         state = self.state
+        if isinstance(edge_mask, np.ndarray):
+            # Host-originated mask: a real upload. A device-resident mask
+            # (the join wave's pred-derived bools) uploads nothing — and
+            # materializing it here just to count bytes would pay exactly
+            # the D2H round trip this path exists to avoid.
+            self._account_h2d(edge_mask)
         em = jnp.asarray(edge_mask)  # [j, k] bool
         self.state = state._replace(
             fd_fired=state.fd_fired.at[idx].set(em),
@@ -983,7 +1027,11 @@ class VirtualCluster:
     def set_flaky_edges(self, probe_fail: np.ndarray) -> None:
         """Arbitrary per-(subject, ring) probe failures — asymmetric/one-way
         link patterns."""
-        self.faults = self.faults._replace(probe_fail=jnp.asarray(probe_fail, dtype=bool))
+        # Cast on host first: what crosses the boundary (and what the byte
+        # counter charges) is the 1-byte bool array, not the caller's dtype.
+        arr = np.asarray(probe_fail, dtype=bool)
+        self._account_h2d(arr)
+        self.faults = self.faults._replace(probe_fail=jnp.asarray(arr))
 
     def stagger_fd_counts(self, rng: np.random.Generator, spread_rounds: int) -> None:
         """Randomize per-edge detection latency: failure detectors fire up to
@@ -991,6 +1039,7 @@ class VirtualCluster:
         the engine's analog of real-world detection jitter — the source of
         almost-everywhere-agreement conflicts the H/L watermarks absorb."""
         offsets = rng.integers(0, spread_rounds + 1, size=(self.cfg.n, self.cfg.k))
+        self._account_h2d(offsets.astype(np.int32))
         self.state = self.state._replace(
             fd_count=jnp.asarray(-offsets.astype(np.int32))
         )
@@ -1020,6 +1069,7 @@ class VirtualCluster:
         # first so the ONE device->host fetch (a full tunnel round trip)
         # carries [j] bools, not the whole [n] state.
         bad = np.asarray((state.alive | state.join_pending | state.retired)[idx])
+        self._account_d2h(bad.nbytes)
         if bad.any():
             raise ValueError(
                 f"slots not admissible as joiners (member/pending/retired): "
@@ -1048,7 +1098,11 @@ class VirtualCluster:
         self._stamp_fired_edges(idx, (pred >= 0).T)
 
     def assign_cohorts(self, cohort_of: np.ndarray) -> None:
-        self.state = self.state._replace(cohort_of=jnp.asarray(cohort_of, dtype=jnp.int32))
+        # Host-side cast first so the transfer counter charges the int32
+        # bytes that actually upload (an int64 input would double-count).
+        arr = np.asarray(cohort_of, dtype=np.int32)
+        self._account_h2d(arr)
+        self.state = self.state._replace(cohort_of=jnp.asarray(arr))
 
     def assign_cohorts_roundrobin(self) -> None:
         """Spread the N slots evenly over the C receiver cohorts — the
@@ -1064,7 +1118,9 @@ class VirtualCluster:
         re-open delivery or newly-hearable cohorts would never receive the
         old alerts. Re-stamped alerts redeliver within ``delivery_spread``
         rounds — a re-broadcast after the topology change."""
-        self.faults = self.faults._replace(rx_block=jnp.asarray(rx_block, dtype=bool))
+        arr = np.asarray(rx_block, dtype=bool)  # charge the uploaded width
+        self._account_h2d(arr)
+        self.faults = self.faults._replace(rx_block=jnp.asarray(arr))
         self.state = self.state._replace(
             fire_round=jnp.where(
                 self.state.fd_fired, self.state.round_idx, self.state.fire_round
@@ -1075,8 +1131,9 @@ class VirtualCluster:
 
     def step(self) -> StepEvents:
         self.metrics.inc("engine_steps")
-        self.metrics.inc("engine_dispatches")
-        self.state, events = engine_step(self.cfg, self.state, self.faults)
+        self.metrics.inc("engine_convergence_steps")
+        with self._dispatch("step"):
+            self.state, events = engine_step(self.cfg, self.state, self.faults)
         return events
 
     def sync(self) -> int:
@@ -1097,7 +1154,10 @@ class VirtualCluster:
             + jnp.sum(faults.crashed).astype(jnp.uint32)
             + jnp.sum(faults.probe_fail).astype(jnp.uint32)
         )
-        return int(total)
+        with self._dispatch("sync"):
+            checksum = int(total)
+        self._account_d2h(4)
+        return checksum
 
     def run_until_converged(self, max_steps: int = 64) -> Tuple[int, Optional[StepEvents]]:
         """Run rounds until a view change commits; returns (rounds, events)."""
@@ -1116,23 +1176,34 @@ class VirtualCluster:
         RTT per view change."""
         if max_steps > 255:  # not an assert: python -O must not skip this
             raise ValueError(f"max_steps packs into 8 bits, got {max_steps}")
-        self.metrics.inc("engine_dispatches")
-        self.state, steps, decided, winner = run_to_decision(
-            self.cfg, self.state, self.faults, jnp.int32(max_steps)
-        )
-        if self.cfg.n < (1 << 22):
-            # Layout: bits 0-7 steps, bit 8 decided, bits 9-30 membership —
-            # one scalar fetch total.
-            packed = int(
-                steps
-                | (decided.astype(jnp.int32) << 8)
-                | (self.state.n_members << 9)
+        with self._dispatch("run_to_decision"):
+            self.state, steps, decided, winner = run_to_decision(
+                self.cfg, self.state, self.faults, jnp.int32(max_steps)
             )
-            return packed & 0xFF, bool((packed >> 8) & 1), winner, packed >> 9
-        # Membership no longer fits beside the flags in a positive int32:
-        # pay a second fetch rather than return garbage.
-        packed = int(steps | (decided.astype(jnp.int32) << 8))
-        return packed & 0xFF, bool(packed >> 8), winner, int(self.state.n_members)
+            if self.cfg.n < (1 << 22):
+                # Layout: bits 0-7 steps, bit 8 decided, bits 9-30 membership
+                # — one scalar fetch total.
+                packed = int(
+                    steps
+                    | (decided.astype(jnp.int32) << 8)
+                    | (self.state.n_members << 9)
+                )
+                self._account_d2h(4)
+                rounds = packed & 0xFF
+                was_decided = bool((packed >> 8) & 1)
+                members = packed >> 9
+            else:
+                # Membership no longer fits beside the flags in a positive
+                # int32: pay a second fetch rather than return garbage.
+                packed = int(steps | (decided.astype(jnp.int32) << 8))
+                self._account_d2h(8)
+                rounds = packed & 0xFF
+                was_decided = bool(packed >> 8)
+                members = int(self.state.n_members)
+        self.metrics.inc("engine_convergence_steps", rounds)
+        if was_decided:
+            self.metrics.inc("engine_cuts_committed")
+        return rounds, was_decided, winner, members
 
     def run_until_membership(
         self, target: int, max_steps: int = 192, max_cuts: int = 8,
@@ -1154,18 +1225,21 @@ class VirtualCluster:
         if not 0 <= target <= self.cfg.n:
             # Not an assert: python -O must not skip this.
             raise ValueError(f"target must be in [0, {self.cfg.n}]: {target}")
-        self.metrics.inc("engine_dispatches")
-        self.state, steps, cuts, resolved, sizes = run_until_membership(
-            self.cfg, self.state, self.faults,
-            jnp.int32(target), jnp.int32(max_steps), int(max_cuts),
-            jnp.int32(min_cuts),
-        )
-        obs = np.asarray(
-            jnp.concatenate(
-                [jnp.stack([steps, cuts, resolved.astype(jnp.int32)]), sizes]
+        with self._dispatch("run_until_membership"):
+            self.state, steps, cuts, resolved, sizes = run_until_membership(
+                self.cfg, self.state, self.faults,
+                jnp.int32(target), jnp.int32(max_steps), int(max_cuts),
+                jnp.int32(min_cuts),
             )
-        )
+            obs = np.asarray(
+                jnp.concatenate(
+                    [jnp.stack([steps, cuts, resolved.astype(jnp.int32)]), sizes]
+                )
+            )
+        self._account_d2h(obs.nbytes)
         n_cuts = int(obs[1])
+        self.metrics.inc("engine_convergence_steps", int(obs[0]))
+        self.metrics.inc("engine_cuts_committed", n_cuts)
         return int(obs[0]), n_cuts, bool(obs[2]), tuple(obs[3 : 3 + n_cuts].tolist())
 
     def timed_convergence(self, max_steps: int = 64) -> Tuple[int, float]:
@@ -1183,18 +1257,23 @@ class VirtualCluster:
 
     @property
     def membership_size(self) -> int:
+        self._account_d2h(4)
         return int(self.state.n_members)
 
     @property
     def alive_mask(self) -> np.ndarray:
-        return np.asarray(self.state.alive)
+        mask = np.asarray(self.state.alive)
+        self._account_d2h(mask.nbytes)
+        return mask
 
     @property
     def config_epoch(self) -> int:
+        self._account_d2h(4)
         return int(self.state.config_epoch)
 
     @property
     def config_id(self) -> int:
+        self._account_d2h(8)
         return (int(self.state.config_hi) << 32) | int(self.state.config_lo)
 
     # -- observability (utils/exposition.py schema) ---------------------
@@ -1211,6 +1290,7 @@ class VirtualCluster:
             jnp.sum(self.state.alive & self.faults.crashed, dtype=jnp.int32)
             + jnp.sum(self.state.join_pending, dtype=jnp.int32)
         )
+        self._account_d2h(4)
         return NodeHealth.PROPOSING if pending else NodeHealth.STABLE
 
     def telemetry_snapshot(self) -> dict:
@@ -1218,7 +1298,10 @@ class VirtualCluster:
         ``MembershipService.telemetry_snapshot`` minus the per-message
         instruments (transport stats, flight recorder) that have no device
         analog, so one scrape pipeline serves host nodes and the engine
-        alike."""
+        alike. The ``engine`` section carries the device-tier instruments:
+        process-wide compile/persistent-cache stats (engine_telemetry) and
+        best-effort device memory gauges; dispatch latency histograms and
+        transfer-byte counters ride the ordinary ``metrics`` section."""
         return {
             "node": f"virtual-cluster/{self.cfg.n}",
             "configuration_id": self.config_id,
@@ -1226,6 +1309,13 @@ class VirtualCluster:
             "health": self.health().value,
             "config_epoch": self.config_epoch,
             "metrics": self.metrics.summary(),
+            "engine": {
+                "n": self.cfg.n,
+                "cohorts": self.cfg.c,
+                "use_pallas": self.cfg.use_pallas,
+                "compile": engine_telemetry.compile_snapshot(),
+                "memory": engine_telemetry.device_memory_snapshot(),
+            },
             "transport": {},
             "recorder": None,
         }

@@ -1,0 +1,209 @@
+"""Process-wide device-engine compile/memory telemetry.
+
+The host protocol got its observability tier in PRs 1-2 (flight recorder,
+exposition, phase SLOs); the jitted device engine had none — every XLA
+compile, persistent-cache hit, and device allocation was invisible, which is
+how the perf trajectory went blind (ROADMAP item 2). This module is the
+engine-side counterpart: a process-global collector fed by ``jax.monitoring``
+events, plus best-effort device-memory probes, consumed by
+``VirtualCluster.telemetry_snapshot()`` and the bench ledger.
+
+Compile events are inherently process-global (the XLA compilation cache and
+the persistent on-disk cache are shared by every engine instance in the
+process), so the collector is a module singleton: ``install()`` registers
+the listeners once, ``compile_snapshot()`` reads the monotonic totals, and
+callers that want per-phase attribution diff two snapshots around the work
+(``CompileDelta``).
+
+Everything degrades gracefully: a JAX build without ``jax.monitoring`` (or
+without ``memory_stats``/``live_arrays``) yields zero counters / ``None``
+gauges, never an exception — telemetry must not be able to take down the
+engine it observes.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, Optional
+
+from rapid_tpu.utils.histogram import LogHistogram
+
+logger = logging.getLogger(__name__)
+
+#: jax.monitoring point-event names -> our counter names. The persistent
+#: compilation cache emits hits/misses; ``compile_requests_use_cache``
+#: counts every compile request that consulted it (hit + miss + disabled).
+_EVENT_COUNTERS = {
+    "/jax/compilation_cache/cache_hits": "persistent_cache_hits",
+    "/jax/compilation_cache/cache_misses": "persistent_cache_misses",
+    "/jax/compilation_cache/compile_requests_use_cache": "cache_requests",
+}
+
+#: The duration event XLA records once per backend compile — its count is
+#: the process's compile count, its sum the total compile wall time.
+_COMPILE_DURATION_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class _CompileCollector:
+    """Monotonic process-wide compile/cache totals (thread-safe: monitoring
+    callbacks can fire from compile worker threads)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = {
+            name: 0 for name in _EVENT_COUNTERS.values()
+        }
+        self.compiles = 0
+        self.compile_ms_hist = LogHistogram()
+
+    def on_event(self, event: str, **_kwargs: Any) -> None:
+        name = _EVENT_COUNTERS.get(event)
+        if name is not None:
+            with self._lock:
+                self.counters[name] += 1
+
+    def on_duration(self, event: str, duration_secs: float, **_kwargs: Any) -> None:
+        if event == _COMPILE_DURATION_EVENT:
+            with self._lock:
+                self.compiles += 1
+                self.compile_ms_hist.observe(duration_secs * 1000.0)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = dict(self.counters)
+            out["compiles"] = self.compiles
+            out["compile_ms"] = self.compile_ms_hist.summary()
+        return out
+
+
+_COLLECTOR = _CompileCollector()
+_INSTALL_LOCK = threading.Lock()
+_installed: Optional[bool] = None  # None = never attempted
+
+
+def install() -> bool:
+    """Register the monitoring listeners once per process; True iff compile
+    events are being captured (False on a JAX without ``jax.monitoring``).
+    Idempotent — every ``VirtualCluster`` constructor calls it."""
+    global _installed
+    with _INSTALL_LOCK:
+        if _installed is not None:
+            return _installed
+        try:
+            from jax import monitoring
+        except ImportError:
+            logger.warning(
+                "jax.monitoring unavailable: engine compile telemetry disabled"
+            )
+            _installed = False
+            return False
+        try:
+            monitoring.register_event_listener(_COLLECTOR.on_event)
+            monitoring.register_event_duration_secs_listener(
+                _COLLECTOR.on_duration
+            )
+        except Exception as exc:  # noqa: BLE001 — a monitoring-API mismatch
+            # must degrade to "no compile telemetry", never break engine
+            # construction: the collector is strictly an observer.
+            logger.warning("engine compile telemetry disabled: %r", exc)
+            _installed = False
+            return False
+        _installed = True
+        return True
+
+
+def compile_snapshot() -> Dict[str, Any]:
+    """Monotonic process-wide compile/cache totals:
+    ``{compiles, compile_ms: <histogram summary>, persistent_cache_hits,
+    persistent_cache_misses, cache_requests}``. All zeros when capture is
+    unavailable (callers need not care)."""
+    return _COLLECTOR.snapshot()
+
+
+class CompileDelta:
+    """Attribute process-global compile activity to one phase: snapshot on
+    enter, diff on exit (``delta`` holds the scalar differences).
+
+    Only correct when nothing else compiles concurrently — true for the
+    bench (one workload per process) and the tests that use it.
+    """
+
+    def __init__(self) -> None:
+        self.delta: Dict[str, int] = {}
+        self._before: Dict[str, Any] = {}
+
+    def __enter__(self) -> "CompileDelta":
+        self._before = compile_snapshot()
+        return self
+
+    def __exit__(self, *_exc: Any) -> None:
+        after = compile_snapshot()
+        self.delta = {
+            key: after[key] - self._before[key]
+            for key in after
+            if isinstance(after[key], int)
+        }
+        self.delta["compile_ms"] = round(
+            float(after["compile_ms"]["sum"])
+            - float(self._before["compile_ms"]["sum"]),
+            3,
+        )
+
+
+def device_memory_snapshot() -> Dict[str, Any]:
+    """Best-effort device memory view: live-buffer census via
+    ``jax.live_arrays()`` plus the backend allocator's
+    ``bytes_in_use``/``peak_bytes_in_use`` where the platform reports them
+    (TPU does; CPU returns None). Missing probes yield ``None`` values, so
+    the snapshot shape is stable across platforms."""
+    out: Dict[str, Any] = {
+        "live_buffers": None,
+        "live_buffer_bytes": None,
+        "device_bytes_in_use": None,
+        "device_peak_bytes": None,
+    }
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+        out["live_buffers"] = len(arrays)
+        out["live_buffer_bytes"] = int(
+            sum(getattr(a, "nbytes", 0) or 0 for a in arrays)
+        )
+    except Exception as exc:  # noqa: BLE001 — a backend that cannot
+        # enumerate live arrays (or a deleted-buffer race mid-census) means
+        # "no census this scrape", never a failed scrape.
+        logger.debug("live-array census unavailable: %r", exc)
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+        if stats:
+            if "bytes_in_use" in stats:
+                out["device_bytes_in_use"] = int(stats["bytes_in_use"])
+            if "peak_bytes_in_use" in stats:
+                out["device_peak_bytes"] = int(stats["peak_bytes_in_use"])
+    except Exception as exc:  # noqa: BLE001 — memory_stats is
+        # platform-optional (None/absent on CPU and some plugins); the
+        # gauges stay None rather than poisoning the snapshot.
+        logger.debug("device memory_stats unavailable: %r", exc)
+    return out
+
+
+def compiled_memory_analysis(compiled: Any) -> Optional[Dict[str, int]]:
+    """The XLA ``memory_analysis()`` of one compiled executable as a plain
+    dict (argument/output/temp/generated-code bytes) — the per-config
+    memory-delta instrument. None when the backend does not expose it."""
+    try:
+        analysis = compiled.memory_analysis()
+        return {
+            "argument_bytes": int(analysis.argument_size_in_bytes),
+            "output_bytes": int(analysis.output_size_in_bytes),
+            "temp_bytes": int(analysis.temp_size_in_bytes),
+            "generated_code_bytes": int(analysis.generated_code_size_in_bytes),
+        }
+    except Exception as exc:  # noqa: BLE001 — memory analysis is a bonus
+        # diagnostic; any backend without it reports None, not a failure.
+        logger.debug("memory_analysis unavailable: %r", exc)
+        return None

@@ -70,6 +70,38 @@ KNOWN_COUNTERS = (
 _TRANSPORT_COUNTERS = ("msgs_tx", "bytes_tx", "msgs_rx", "bytes_rx")
 _TRANSPORT_GAUGES = ("kbps_tx", "kbps_rx")
 
+#: Device-engine counters zero-filled on every snapshot that carries an
+#: ``engine`` section (``VirtualCluster.telemetry_snapshot``) — the engine
+#: tier's series set must be stable from the first scrape, same rule as
+#: KNOWN_COUNTERS for host nodes.
+ENGINE_KNOWN_COUNTERS = (
+    "engine_dispatches",
+    "engine_steps",
+    "engine_convergence_steps",
+    "engine_cuts_committed",
+    "engine_h2d_bytes",
+    "engine_d2h_bytes",
+)
+
+#: ``engine.compile`` counter keys -> metric suffix (all render as
+#: ``rapid_engine_<suffix>_total``); the compile_ms histogram is rendered
+#: separately.
+_ENGINE_COMPILE_COUNTERS = (
+    ("compiles", "compiles"),
+    ("persistent_cache_hits", "persistent_cache_hits"),
+    ("persistent_cache_misses", "persistent_cache_misses"),
+    ("cache_requests", "compile_cache_requests"),
+)
+
+#: ``engine.memory`` gauge keys (``None`` probes render as NaN so the
+#: series set is identical on platforms without allocator stats).
+_ENGINE_MEMORY_GAUGES = (
+    "live_buffers",
+    "live_buffer_bytes",
+    "device_bytes_in_use",
+    "device_peak_bytes",
+)
+
 
 def _esc(value: str) -> str:
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
@@ -186,6 +218,8 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
 
     metrics: Dict[str, Any] = dict(snapshot.get("metrics", {}))
     counters = {name: 0 for name in KNOWN_COUNTERS}
+    if "engine" in snapshot:
+        counters.update({name: 0 for name in ENGINE_KNOWN_COUNTERS})
     timers: Dict[str, Dict[str, Any]] = {}
     for name, value in metrics.items():
         if isinstance(value, dict):
@@ -219,6 +253,24 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
             if key in stats:
                 out.sample(f"{_PREFIX}_transport_{key}", "gauge",
                            stats[key], node=node, side=side)
+
+    engine = snapshot.get("engine")
+    if engine:
+        # Device-engine tier: process-wide compile/cache counters, the
+        # compile-duration histogram, and the device-memory gauges (NaN for
+        # probes the platform does not expose — the series stays).
+        compile_stats = engine.get("compile") or {}
+        for key, suffix in _ENGINE_COMPILE_COUNTERS:
+            out.sample(f"{_PREFIX}_engine_{suffix}_total", "counter",
+                       compile_stats.get(key, 0), node=node)
+        compile_ms = compile_stats.get("compile_ms")
+        if isinstance(compile_ms, dict):
+            out.histogram(f"{_PREFIX}_engine_compile_ms", compile_ms, node=node)
+        memory = engine.get("memory") or {}
+        for key in _ENGINE_MEMORY_GAUGES:
+            value = memory.get(key)
+            out.sample(f"{_PREFIX}_engine_{key}", "gauge",
+                       float("nan") if value is None else value, node=node)
 
     recorder = snapshot.get("recorder")
     if recorder:

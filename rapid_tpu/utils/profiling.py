@@ -6,26 +6,98 @@ to TensorBoard-compatible traces without touching call sites:
     from rapid_tpu.utils.profiling import trace
     with trace("/tmp/rapid-trace"):
         vc.run_to_decision()
+
+Hardened for production use (bench.py wires it in as the opt-in
+``--profile`` stage):
+
+- **Graceful no-op** on platforms/builds where ``jax.profiler`` is missing
+  or ``start_trace`` fails (some plugin backends raise): the enclosed block
+  still runs, a WARNING says no trace was captured, and nothing crashes —
+  profiling must never be able to take down the run it observes.
+- **No nesting**: ``jax.profiler.start_trace`` inside an active trace is a
+  runtime error deep in XLA with an unhelpful message; this wrapper rejects
+  it eagerly with a clear one. (Module-level flag: the profiler itself is a
+  process-wide singleton, so a process-wide guard is the correct scope.)
 """
 
 from __future__ import annotations
 
-from contextlib import contextmanager
+import logging
+from contextlib import contextmanager, nullcontext
 
-import jax
+logger = logging.getLogger(__name__)
+
+#: True while a ``trace()`` block is active in this process (the underlying
+#: profiler is process-global, so the guard is too).
+_active = False
+
+
+def profiler_available() -> bool:
+    """True iff this JAX build exposes a usable ``jax.profiler``."""
+    try:
+        import jax
+
+        return hasattr(jax, "profiler") and hasattr(jax.profiler, "start_trace")
+    except ImportError:
+        return False
 
 
 @contextmanager
 def trace(log_dir: str):
     """Capture a device+host profile of the enclosed block into ``log_dir``
-    (view with TensorBoard or Perfetto)."""
-    jax.profiler.start_trace(log_dir)
+    (view with TensorBoard or Perfetto). No-ops with a WARNING when the
+    profiler is unavailable or fails to start; raises ``RuntimeError`` when
+    called inside an active ``trace()`` block (the profiler cannot nest)."""
+    global _active
+    if _active:
+        raise RuntimeError(
+            "profiling.trace() does not nest: a trace is already active in "
+            "this process — close it before starting another"
+        )
+    started = False
+    _active = True
     try:
+        if profiler_available():
+            import jax
+
+            try:
+                jax.profiler.start_trace(log_dir)
+                started = True
+            except Exception as exc:  # noqa: BLE001 — profiling is an
+                # opt-in diagnostic: a backend that cannot start a trace
+                # (plugin without profiler support, busy session) must not
+                # fail the profiled workload.
+                logger.warning(
+                    "jax.profiler.start_trace(%r) failed (%r); "
+                    "running unprofiled", log_dir, exc,
+                )
+        else:
+            logger.warning(
+                "jax.profiler unavailable on this platform; running unprofiled"
+            )
         yield
     finally:
-        jax.profiler.stop_trace()
+        _active = False
+        if started:
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+            except Exception as exc:  # noqa: BLE001 — a failed stop leaves
+                # no trace file but the profiled block already ran; log,
+                # don't mask the block's own outcome.
+                logger.warning("jax.profiler.stop_trace() failed: %r", exc)
 
 
 def annotate(name: str):
-    """Named trace span for host-side phases (shows up in the profile)."""
-    return jax.profiler.TraceAnnotation(name)
+    """Named trace span for host-side phases (shows up in the profile);
+    a no-op context manager when the profiler is unavailable."""
+    if profiler_available():
+        import jax
+
+        try:
+            return jax.profiler.TraceAnnotation(name)
+        except Exception as exc:  # noqa: BLE001 — same opt-in-diagnostic
+            # contract as trace(): degrade to a no-op span.
+            logger.warning("TraceAnnotation(%r) unavailable: %r", name, exc)
+    return nullcontext()

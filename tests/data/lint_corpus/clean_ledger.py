@@ -1,0 +1,14 @@
+"""Corpus: disciplined ledger use — registered events and stage names only.
+Also proves the import gate: an ``emit`` method on an unrelated object in a
+file NOT importing the ledger module is out of family scope (see the
+unrelated-emitter corpus note in tests/test_staticcheck.py)."""
+
+from rapid_tpu.utils.ledger import LedgerEvent, RunLedger
+
+
+def good_writer(path):
+    ledger = RunLedger(path)
+    ledger.emit(LedgerEvent.RUN_BEGIN, mode="inline")
+    with ledger.stage("state_build", timeout_s=900, n=1024):
+        pass
+    ledger.emit(LedgerEvent.RUN_END, outcome="completed")

@@ -1,0 +1,21 @@
+"""Corpus: ledger vocabulary violations — free-form event strings, unknown
+LedgerEvent members, unregistered / non-literal stage names."""
+
+from rapid_tpu.utils.ledger import LedgerEvent, RunLedger
+
+
+def bad_writer(path):
+    ledger = RunLedger(path)
+    ledger.emit("stage_begin", stage="state_build")  # expect: ledger-event-name
+    ledger.emit(LedgerEvent.NOT_A_MEMBER)  # expect: ledger-event-name
+    with ledger.stage("totally_new_stage"):  # expect: ledger-stage-name
+        pass
+    name = "state_build"
+    with ledger.stage(name):  # expect: ledger-stage-name
+        pass
+
+
+def forwarding_helper(ledger, event):
+    # Forwarding an already-validated parameter is the one allowed
+    # non-member spelling (the caller's site is checked instead).
+    ledger.emit(event, stage="state_build")

@@ -1,0 +1,254 @@
+"""bench.py end-to-end through the run ledger: a CPU run emits a COMPLETE
+JSONL ledger (every stage bracketed, provenance stamped, metric + run_end
+recorded), and a wedged accelerator fails LOUDLY — nonzero exit with the
+ledger pointing at the last completed stage — unless snapshot replay or CPU
+fallback is explicitly authorized (the acceptance surface of ROADMAP open
+item 2's "fail loudly rather than silently replaying snapshots").
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+import bench
+from rapid_tpu.utils.ledger import (
+    LedgerEvent,
+    RunLedger,
+    last_completed_stage,
+    open_stage,
+    read_ledger,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+BENCH = str(REPO / "bench.py")
+
+
+def _run_bench(tmp_path, *args, env_overrides=None, drop=(), timeout=240):
+    env = dict(os.environ)
+    for name in list(env):
+        if name.startswith("RAPID_TPU_BENCH"):
+            del env[name]
+    for name in drop:
+        env.pop(name, None)
+    env["RAPID_TPU_BENCH_LEDGER"] = str(tmp_path / "ledger.jsonl")
+    env.update(env_overrides or {})
+    proc = subprocess.run(
+        [sys.executable, BENCH, *args],
+        capture_output=True, text=True, env=env, timeout=timeout,
+        cwd=str(tmp_path),
+    )
+    events, skipped = read_ledger(str(tmp_path / "ledger.jsonl"))
+    assert skipped == 0, f"unparseable ledger lines: {skipped}"
+    return proc, events
+
+
+def _stage_pairs(events):
+    """{stage: [(begin, close)]} where close is the matching end/fail."""
+    pairs = {}
+    for record in events:
+        kind = record.get("event")
+        if kind == "stage_begin":
+            pairs.setdefault(record["stage"], []).append([record, None])
+        elif kind in ("stage_end", "stage_fail"):
+            spans = pairs.get(record["stage"], [])
+            open_spans = [s for s in spans if s[1] is None]
+            assert open_spans, f"{kind} without begin: {record}"
+            open_spans[-1][1] = record
+    return pairs
+
+
+def test_cpu_run_emits_complete_ledger(tmp_path):
+    """The acceptance criterion: a CPU-fallback bench run leaves a complete
+    ledger — every stage begin+end, provenance stamped, derived metrics
+    plausible — and its JSON line agrees with the ledger's metric event."""
+    proc, events = _run_bench(
+        tmp_path,
+        env_overrides={
+            "JAX_PLATFORMS": "cpu",
+            "RAPID_TPU_BENCH_N": "256",
+            # Budget 0: the XL/loss variants are skipped (they only matter
+            # on hardware) — the machinery under test is the ledger.
+            "RAPID_TPU_BENCH_XL_BUDGET_S": "0",
+        },
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    [metric_line] = [l for l in proc.stdout.splitlines()
+                     if l.startswith("{") and '"metric"' in l]
+    result = json.loads(metric_line)
+    assert result["platform"] == "cpu" and result["n_members"] == 256
+
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "run_begin" and kinds[-1] == "run_end"
+    begin = events[0]
+    # Provenance: attributable to the exact source that produced it.
+    assert begin["git_rev"] and begin["code_hash"]
+    assert begin["hash_roots"] == ["bench.py", "rapid_tpu", "native"]
+    # Every stage is bracketed: begin + end (or an explicit failure).
+    pairs = _stage_pairs(events)
+    for stage, spans in pairs.items():
+        for span_begin, close in spans:
+            assert close is not None, f"stage {stage} never closed"
+            assert close["event"] == "stage_end"
+            assert close["duration_ms"] >= 0
+            assert span_begin.get("timeout_s", 0) > 0
+    assert {"devices_init", "native_build", "state_build", "warmup_compile",
+            "timed_samples", "rtt_probe"} <= set(pairs)
+    assert open_stage(events) is None
+    # Engine-tier events made it into the ledger.
+    assert "compile_stats" in kinds and "device_memory" in kinds
+    # The emitted JSON is also a ledger event (the trajectory's source of
+    # truth survives even if stdout is lost).
+    [metric_event] = [e for e in events if e["event"] == "metric"]
+    assert metric_event["value"] == result["value"]
+    # Derived metrics at the engine's cohort grain (the 4.96e10 bug class).
+    assert abs(
+        result["alert_deliveries_per_sec"]
+        - result["alerts_per_sec"] * result["cohorts"]
+    ) <= result["cohorts"]
+    assert result["alert_deliveries_per_sec"] < 1e9
+    assert result["compiles"] >= 1
+
+
+_WEDGE_ENV = {
+    "RAPID_TPU_BENCH_SIMULATE_WEDGE": "1",
+    "RAPID_TPU_BENCH_INIT_TIMEOUT_S": "2",
+    "RAPID_TPU_BENCH_ATTEMPTS": "1",
+}
+
+
+def test_wedge_exits_nonzero_without_allow_snapshot(tmp_path):
+    proc, events = _run_bench(
+        tmp_path, env_overrides=_WEDGE_ENV, drop=("JAX_PLATFORMS",),
+        timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "no fallback authorized" in proc.stderr
+    # The one stdout JSON line is an explicit error, never a number.
+    [line] = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    error = json.loads(line)
+    assert error["error"] == "accelerator_wedged"
+    assert "last_completed_stage" in error
+    kinds = [e["event"] for e in events]
+    assert "watchdog_kill" in kinds
+    assert kinds[-1] == "run_fail"
+    [fail] = [e for e in events if e["event"] == "run_fail"]
+    assert fail["outcome"] == "wedged"
+    assert fail["last_completed_stage"] == last_completed_stage(events)
+    assert "snapshot_replay" not in kinds  # nothing replayed silently
+
+
+def test_wedge_failure_is_scoped_to_this_run(tmp_path):
+    # The default ledger path accumulates runs across invocations: a wedge
+    # with ZERO completed stages must report none — never a PREVIOUS run's
+    # last stage (and the watchdog must not inherit its open stages).
+    ledger_path = tmp_path / "ledger.jsonl"
+    old = RunLedger(str(ledger_path), run_id="previous-run")
+    old.emit(LedgerEvent.RUN_BEGIN, mode="inline")
+    with old.stage("state_build", timeout_s=900):
+        pass
+    old.emit(LedgerEvent.STAGE_BEGIN, stage="warmup_compile", timeout_s=900)
+    old.close()  # a previous run that died mid-warmup
+    proc, events = _run_bench(
+        tmp_path, env_overrides=_WEDGE_ENV, drop=("JAX_PLATFORMS",),
+        timeout=120,
+    )
+    assert proc.returncode == 1
+    [line] = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert json.loads(line)["last_completed_stage"] is None
+    [fail] = [e for e in events if e["event"] == "run_fail"
+              and e["run_id"] != "previous-run"]
+    assert fail["last_completed_stage"] is None
+
+
+def test_wedge_with_cpu_fallback_reruns_and_closes_the_run(tmp_path):
+    # --cpu-fallback: the watchdog parent execve's into a CPU continuation
+    # sharing the run id; the successful fallback must CLOSE the run
+    # (run_end outcome=cpu_fallback) — without it the ledger ends at
+    # run_fail and the run reads as failed despite a real measurement.
+    proc, events = _run_bench(
+        tmp_path, "--cpu-fallback",
+        env_overrides={
+            **_WEDGE_ENV,
+            "RAPID_TPU_BENCH_N": "256",
+            "RAPID_TPU_BENCH_XL_BUDGET_S": "0",
+        },
+        drop=("JAX_PLATFORMS",), timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    [line] = [l for l in proc.stdout.splitlines()
+              if l.startswith("{") and '"metric"' in l]
+    assert json.loads(line)["platform"] == "cpu"
+    kinds = [e["event"] for e in events]
+    # The wedge is on record AND the run is closed by the fallback.
+    assert "run_fail" in kinds
+    assert kinds[-1] == "run_end"
+    [end] = [e for e in events if e["event"] == "run_end"]
+    assert end["outcome"] == "cpu_fallback"
+    assert len({e["run_id"] for e in events}) == 1  # one run, one id
+
+
+def test_wedge_with_allow_snapshot_replays_and_marks_ledger(tmp_path):
+    capture = tmp_path / "capture.json"
+    capture.write_text(json.dumps({
+        "metric": "churn_resolution_ms_n100000_churn5pct", "value": 100.9,
+        "unit": "ms", "platform": "tpu", "n_members": 100_000,
+        "captured_at": "2026-07-29T14:06:21Z", "vs_baseline": 4.957,
+    }))
+    proc, events = _run_bench(
+        tmp_path, "--allow-snapshot",
+        env_overrides={**_WEDGE_ENV, "RAPID_TPU_BENCH_SNAPSHOT": str(capture)},
+        drop=("JAX_PLATFORMS",), timeout=120,
+    )
+    assert proc.returncode == 0
+    [line] = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    replayed = json.loads(line)
+    # Unstamped capture: stale, renamed, demoted — and the ledger says so.
+    assert replayed["stale_code"] is True
+    assert replayed["metric"].endswith("_snapshot")
+    [mark] = [e for e in events if e["event"] == "snapshot_replay"]
+    assert mark["stale_code"] is True
+    assert mark["snapshot_path"]
+    # run_fail precedes the replay (the wedge stays on record), and the
+    # successful replay CLOSES the run — perfview's outcome is the latest
+    # terminal event, so an rc-0 replay must not read as FAILED.
+    kinds = [e["event"] for e in events]
+    assert kinds.index("run_fail") < kinds.index("snapshot_replay")
+    assert kinds[-1] == "run_end"
+    [end] = [e for e in events if e["event"] == "run_end"]
+    assert end["outcome"] == "snapshot_replay"
+
+
+def test_ledger_event_vocabulary_is_enforced_in_bench(tmp_path):
+    # The runtime guard behind the lint rule: bench cannot invent events.
+    from rapid_tpu.utils.ledger import RunLedger
+
+    ledger = RunLedger(str(tmp_path / "l.jsonl"))
+    with pytest.raises(TypeError):
+        ledger.emit("made_up_event")
+    ledger.close()
+
+
+def test_stage_timeouts_table_covers_all_stages():
+    from rapid_tpu.utils.ledger import STAGE_NAMES
+
+    assert set(bench.STAGE_TIMEOUTS_S) == set(STAGE_NAMES)
+    assert all(v > 0 for v in bench.STAGE_TIMEOUTS_S.values())
+
+
+def test_parse_args_flags_and_env_aliases(monkeypatch):
+    for name in ("RAPID_TPU_BENCH_ALLOW_SNAPSHOT", "RAPID_TPU_BENCH_CPU_FALLBACK",
+                 "RAPID_TPU_BENCH_PROFILE"):
+        monkeypatch.delenv(name, raising=False)
+    args = bench._parse_args([])
+    assert not args.allow_snapshot and not args.cpu_fallback
+    assert args.profile is None
+    args = bench._parse_args(["--allow-snapshot", "--cpu-fallback",
+                              "--profile", "/tmp/prof", "--ledger", "x.jsonl"])
+    assert args.allow_snapshot and args.cpu_fallback
+    assert args.profile == "/tmp/prof" and args.ledger == "x.jsonl"
+    monkeypatch.setenv("RAPID_TPU_BENCH_ALLOW_SNAPSHOT", "1")
+    assert bench._parse_args([]).allow_snapshot

@@ -274,3 +274,110 @@ def test_autotuned_lanes_defaults_without_evidence(monkeypatch):
         monkeypatch.delenv(name, raising=False)
     monkeypatch.setattr(bench.glob, "glob", lambda pattern: [])
     assert bench._autotuned_lanes(100_000, "RAPID_TPU_BENCH_LANES") == 128
+
+
+# ---------------------------------------------------------------------------
+# _snapshot_is_stale edge cases: hostile / degenerate provenance
+# ---------------------------------------------------------------------------
+
+
+def test_stale_rejects_non_hex_and_non_string_revs(tmp_path):
+    # Provenance comes from a JSON file: anything that is not a plain hex
+    # rev must read as stale WITHOUT reaching the git argv (a leading-dash
+    # string would parse as a git option; a non-string would crash).
+    root = str(tmp_path)  # deliberately not a git repo
+    for snap_rev in ("--upload-pack=/bin/true", "HEAD", "main~1", "", "zzzzzzz",
+                     1234567, None, ["abc1234"], "abc123"):  # 6 hex chars: too short
+        assert bench._snapshot_is_stale(root, snap_rev, "abc1234") is True
+
+
+def test_stale_when_snapshot_rev_missing_from_repo(tmp_path):
+    # A well-formed hex rev that the repo has never seen (force-pushed away,
+    # or from another clone) cannot be verified: stale.
+    import subprocess
+
+    def git(*args):
+        return subprocess.run(
+            ["git", "-c", "user.name=t", "-c", "user.email=t@t", *args],
+            cwd=tmp_path, capture_output=True, text=True, check=True,
+        ).stdout.strip()
+
+    git("init", "-q")
+    (tmp_path / "bench.py").write_text("x = 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    head = git("rev-parse", "--short", "HEAD")
+    assert bench._snapshot_is_stale(str(tmp_path), "feedfacecafe", head) is True
+    assert bench._snapshot_is_stale(str(tmp_path), head, head) is False
+
+
+def test_hash_root_only_changes_stale_a_snapshot(tmp_path):
+    # native/ is a measurement path: a change there (and ONLY there) must
+    # stale the snapshot even though bench.py and rapid_tpu/ are untouched.
+    import subprocess
+
+    def git(*args):
+        return subprocess.run(
+            ["git", "-c", "user.name=t", "-c", "user.email=t@t", *args],
+            cwd=tmp_path, capture_output=True, text=True, check=True,
+        ).stdout.strip()
+
+    git("init", "-q")
+    (tmp_path / "bench.py").write_text("x = 1\n")
+    (tmp_path / "native").mkdir()
+    (tmp_path / "native" / "lib.c").write_text("int x = 1;\n")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    measured = git("rev-parse", "--short", "HEAD")
+    (tmp_path / "native" / "lib.c").write_text("int x = 2;\n")
+    git("add", "-A")
+    git("commit", "-qm", "native change")
+    head = git("rev-parse", "--short", "HEAD")
+    assert bench._snapshot_is_stale(str(tmp_path), measured, head) is True
+
+
+# ---------------------------------------------------------------------------
+# Derived bench metrics: units audited, plausibility bounds pinned
+# ---------------------------------------------------------------------------
+
+
+def test_derived_metrics_formulas_at_engine_grain():
+    # The default workload at the r03 snapshot's wall-clock. The engine
+    # delivers per COHORT (C delivered-bit sets per alert), not per member:
+    # the old N-multiplied formula produced the implausible 4.96e10/s figure
+    # flagged across BENCH_r03-r05.
+    d = bench.derived_metrics(
+        n=100_000, n_join=2500, n_crash=2500, k_rings=10, cohorts=64,
+        value_ms=100.875,
+    )
+    assert d["alerts_fired"] == 5000 * 10
+    assert d["alerts_per_sec"] == round(50_000 / 0.100875, 0)
+    assert d["alert_deliveries_per_sec"] == round(50_000 * 64 / 0.100875, 0)
+    # The delivery rate is alerts x cohorts — never x N (each rate rounds
+    # independently, so the identity holds to rounding slack).
+    assert abs(d["alert_deliveries_per_sec"] - 64 * d["alerts_per_sec"]) <= 64
+
+
+@pytest.mark.parametrize("value_ms", [10.0, 100.875, 500.0, 60_000.0])
+def test_derived_metrics_plausibility_bounds(value_ms):
+    # Any resolution between 10 ms (4x the r03 hardware number — far below
+    # any credible future point) and a minute at the default workload must
+    # yield physically plausible rates: alerts bounded by churn x K, and
+    # deliveries under 1e9/s (no chip or network moves more distinct alert
+    # deliveries than that at these Ns — the 4.96e10 figure could never
+    # have passed this pin).
+    d = bench.derived_metrics(
+        n=100_000, n_join=2500, n_crash=2500, k_rings=10, cohorts=64,
+        value_ms=value_ms,
+    )
+    assert 0 < d["alerts_per_sec"] <= 5_000 * 10 * 1000  # >= 1 ms resolution
+    assert d["alert_deliveries_per_sec"] < 1e9
+    assert abs(d["alert_deliveries_per_sec"] - d["alerts_per_sec"] * 64) <= 64
+
+
+def test_derived_metrics_reject_degenerate_wallclock():
+    with pytest.raises(ValueError, match="positive"):
+        bench.derived_metrics(
+            n=100, n_join=1, n_crash=1, k_rings=10, cohorts=4, value_ms=0.0
+        )
+

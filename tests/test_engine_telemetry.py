@@ -1,0 +1,317 @@
+"""Device-engine observability tier: compile-event capture, per-dispatch
+latency histograms, transfer-byte accounting, device memory stats — surfaced
+through the unified ``telemetry_snapshot()`` / ``prometheus_text()`` contract
+with the engine metric names pinned as a golden vocabulary (renaming one is
+an API break for every scrape config, same rule as the host tier's).
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import clustertop  # noqa: E402  — tools/clustertop.py, the live dashboard
+
+from rapid_tpu.models.virtual_cluster import VirtualCluster  # noqa: E402
+from rapid_tpu.utils import engine_telemetry, exposition  # noqa: E402
+from rapid_tpu.utils.histogram import NUM_BUCKETS, LogHistogram  # noqa: E402
+
+
+def _cluster(n=16, cohorts=2):
+    vc = VirtualCluster.create(
+        n, k=3, h=3, l=1, cohorts=cohorts, fd_threshold=2, seed=0
+    )
+    vc.assign_cohorts_roundrobin()
+    return vc
+
+
+#: The engine scrape's complete metric-name vocabulary (host KNOWN_COUNTERS
+#: zero-fill + the engine tier). This list is an API — see the host golden
+#: list in tests/test_observability.py for the contract.
+GOLDEN_ENGINE_METRIC_NAMES = [
+    "rapid_alert_batches_redelivered_total",
+    "rapid_alert_batches_sent_total",
+    "rapid_alerts_enqueued_total",
+    "rapid_alerts_received_total",
+    "rapid_catch_up_wedged_total",
+    "rapid_classic_rounds_started_total",
+    "rapid_config_beacons_sent_total",
+    "rapid_config_catch_ups_total",
+    "rapid_config_pull_unchanged_served_total",
+    "rapid_config_sync_unchanged_total",
+    "rapid_configuration_id",
+    "rapid_decision_missing_joiner_uuid_total",
+    "rapid_engine_compile_cache_requests_total",
+    "rapid_engine_compile_ms_bucket",
+    "rapid_engine_compile_ms_count",
+    "rapid_engine_compile_ms_sum",
+    "rapid_engine_compiles_total",
+    "rapid_engine_convergence_steps_total",
+    "rapid_engine_cuts_committed_total",
+    "rapid_engine_d2h_bytes_total",
+    "rapid_engine_device_bytes_in_use",
+    "rapid_engine_device_peak_bytes",
+    "rapid_engine_dispatch_ms_bucket",
+    "rapid_engine_dispatch_ms_count",
+    "rapid_engine_dispatch_ms_sum",
+    "rapid_engine_dispatches_total",
+    "rapid_engine_h2d_bytes_total",
+    "rapid_engine_live_buffer_bytes",
+    "rapid_engine_live_buffers",
+    "rapid_engine_persistent_cache_hits_total",
+    "rapid_engine_persistent_cache_misses_total",
+    "rapid_engine_steps_total",
+    "rapid_kicked_total",
+    "rapid_membership_size",
+    "rapid_node_health",
+    "rapid_proposals_announced_total",
+    "rapid_view_changes_total",
+]
+
+
+def test_engine_prometheus_names_are_golden():
+    vc = _cluster()
+    vc.crash([3])
+    vc.step()
+    vc.run_to_decision(max_steps=32)
+    vc.sync()
+    names = exposition.metric_names(vc.prometheus_text())
+    assert names == GOLDEN_ENGINE_METRIC_NAMES
+
+
+def test_snapshot_engine_section_shape_and_serializable():
+    vc = _cluster()
+    snap = vc.telemetry_snapshot()
+    engine = snap["engine"]
+    assert engine["n"] == 16 and engine["cohorts"] == 2
+    assert set(engine["compile"]) == {
+        "compiles", "compile_ms", "persistent_cache_hits",
+        "persistent_cache_misses", "cache_requests",
+    }
+    assert set(engine["memory"]) == {
+        "live_buffers", "live_buffer_bytes",
+        "device_bytes_in_use", "device_peak_bytes",
+    }
+    json.dumps(snap)  # the --metrics-dump / clustertop artifact
+
+
+def test_compile_events_are_captured():
+    # A never-before-seen shape forces a fresh XLA compile; the process-wide
+    # collector must see it (count + duration histogram), and CompileDelta
+    # must attribute it to the bracketed phase.
+    assert engine_telemetry.install() is True
+    probe = jax.jit(lambda x: (x * 3 + 1).sum())
+    with engine_telemetry.CompileDelta() as delta:
+        probe(jnp.arange(173))  # unusual length: not a cached executable
+    assert delta.delta["compiles"] >= 1
+    assert delta.delta["compile_ms"] > 0
+    snap = engine_telemetry.compile_snapshot()
+    assert snap["compiles"] >= 1
+    assert snap["compile_ms"]["count"] == snap["compiles"]
+
+
+def test_dispatch_histogram_is_bounded_and_per_entrypoint():
+    vc = _cluster()
+    vc.crash([3])
+    for _ in range(40):
+        vc.step()
+    vc.run_to_decision(max_steps=8)
+    family = vc.metrics.phase_timings["engine_dispatch"]
+    # Latencies land in the shared bounded instrument, keyed by entrypoint.
+    assert isinstance(family["step"], LogHistogram)
+    assert set(family) <= {"step", "run_to_decision", "run_until_membership", "sync"}
+    assert family["step"].count == 40
+    summary = family["step"].summary()
+    # Bounded memory: the summary is O(NUM_BUCKETS) however many dispatches
+    # were recorded, and conserves the sample count.
+    assert len(summary["buckets"]) <= NUM_BUCKETS + 1
+    assert sum(summary["buckets"].values()) == 40
+    assert vc.metrics.counters["engine_dispatches"] == 41
+
+
+def test_convergence_step_and_cut_counters():
+    vc = _cluster()
+    vc.crash([3])
+    rounds, decided, _, _ = vc.run_to_decision(max_steps=32)
+    assert decided
+    assert vc.metrics.counters["engine_convergence_steps"] == rounds
+    assert vc.metrics.counters["engine_cuts_committed"] == 1
+    vc2 = _cluster(n=24)
+    vc2.crash([1, 2])
+    rounds2, cuts2, resolved, _ = vc2.run_until_membership(22, min_cuts=1)
+    assert resolved
+    assert vc2.metrics.counters["engine_convergence_steps"] == rounds2
+    assert vc2.metrics.counters["engine_cuts_committed"] == cuts2
+
+
+def test_transfer_byte_accounting():
+    vc = _cluster()
+    # Initial state upload was charged at construction: 4 arrays of (k, n)
+    # u32 keys + 2 of (n,) u32 ids + the (n,) alive mask.
+    base_h2d = vc.metrics.counters["engine_h2d_bytes"]
+    assert base_h2d >= 3 * 16 * 4 * 2 + 16 * 4 * 2 + 16
+    vc.crash([1, 2, 3])
+    assert vc.metrics.counters["engine_h2d_bytes"] == base_h2d + 3 * 4
+    d2h0 = vc.metrics.counters["engine_d2h_bytes"]
+    assert vc.membership_size == 16
+    assert vc.metrics.counters["engine_d2h_bytes"] == d2h0 + 4
+    mask = vc.alive_mask
+    assert vc.metrics.counters["engine_d2h_bytes"] == d2h0 + 4 + mask.nbytes
+
+
+def test_join_wave_accounting_charges_indices_not_device_masks():
+    # The join wave's fired-edge mask is DERIVED ON DEVICE (pred >= 0):
+    # charging it would require materializing it on host — a full tunnel
+    # round trip on the bootstrap timed path. Only the uploaded slot
+    # indices (and the [j] admissibility fetch) are real transfers.
+    vc = VirtualCluster.create(
+        16, n_slots=20, k=3, h=3, l=1, cohorts=2, fd_threshold=2, seed=0
+    )
+    vc.assign_cohorts_roundrobin()
+    h2d0 = vc.metrics.counters["engine_h2d_bytes"]
+    d2h0 = vc.metrics.counters["engine_d2h_bytes"]
+    vc.inject_join_wave([16, 17])
+    assert vc.metrics.counters["engine_h2d_bytes"] == h2d0 + 2 * 4  # idx only
+    assert vc.metrics.counters["engine_d2h_bytes"] == d2h0 + 2  # [j] bools
+    # A graceful leave's mask IS host-originated (np.ones): charged.
+    h2d1 = vc.metrics.counters["engine_h2d_bytes"]
+    vc.initiate_leave([2])
+    assert vc.metrics.counters["engine_h2d_bytes"] == h2d1 + 4 + 1 * 3  # idx + [1,k] mask
+
+
+def test_device_memory_snapshot_sees_live_state():
+    vc = _cluster()
+    vc.sync()
+    memory = engine_telemetry.device_memory_snapshot()
+    # The engine state alone holds dozens of live device buffers.
+    assert memory["live_buffers"] >= 10
+    assert memory["live_buffer_bytes"] > 0
+    # Allocator stats are platform-optional (None on CPU) but the keys are
+    # always present — the scrape shape is stable across platforms.
+    assert "device_bytes_in_use" in memory and "device_peak_bytes" in memory
+
+
+def test_compiled_memory_analysis_of_engine_step():
+    from rapid_tpu.models.state import FaultInputs
+    from rapid_tpu.models.virtual_cluster import engine_step_nodonate
+
+    vc = _cluster()
+    lowered = engine_step_nodonate.lower(
+        vc.cfg, vc.state, FaultInputs.none(vc.cfg)
+    )
+    analysis = engine_telemetry.compiled_memory_analysis(lowered.compile())
+    if analysis is not None:  # backend-optional, shape pinned when present
+        assert set(analysis) == {
+            "argument_bytes", "output_bytes", "temp_bytes",
+            "generated_code_bytes",
+        }
+        assert analysis["argument_bytes"] > 0
+    # A backend object without memory_analysis degrades to None, never raises.
+    assert engine_telemetry.compiled_memory_analysis(object()) is None
+
+
+def test_install_is_idempotent():
+    first = engine_telemetry.install()
+    assert engine_telemetry.install() is first
+
+
+# ---------------------------------------------------------------------------
+# clustertop: the engine pane
+# ---------------------------------------------------------------------------
+
+
+def test_clustertop_renders_engine_pane():
+    vc = _cluster()
+    vc.crash([3])
+    vc.run_to_decision(max_steps=32)
+    host_snapshot = {
+        "node": "10.0.0.1:9001", "configuration_id": 7, "membership_size": 3,
+        "health": "stable", "metrics": {"view_changes": 1},
+        "transport": {}, "recorder": None,
+    }
+    frame = clustertop.render_frame([host_snapshot, vc.telemetry_snapshot()])
+    assert "ENGINE" in frame and "virtual-cluster/16" in frame
+    assert "COMPILES" in frame and "DISP99" in frame
+    # The host node renders in the node table, not the engine pane.
+    assert frame.index("10.0.0.1:9001") < frame.index("ENGINE")
+
+
+def test_clustertop_tolerates_pre_ledger_engine_snapshots():
+    # Snapshots written by pre-ledger code: no "engine" key at all, or a
+    # bare/partial section — dashes and omissions, never a crash.
+    legacy = {
+        "node": "virtual-cluster/64", "configuration_id": 1,
+        "membership_size": 64, "health": "stable",
+        "metrics": {}, "transport": {}, "recorder": None,
+    }
+    frame = clustertop.render_frame([legacy])
+    assert "ENGINE" not in frame  # no engine data -> no pane
+    partial = dict(legacy)
+    partial["engine"] = {"compile": {}, "memory": None}
+    frame = clustertop.render_frame([partial])
+    assert "ENGINE" in frame
+    row = _engine_pane_row(frame, "virtual-cluster/64")
+    assert "-" in row
+
+
+def _engine_pane_row(frame: str, node: str) -> str:
+    """The node's row INSIDE the engine pane (the node table above also
+    carries the node name)."""
+    lines = frame.splitlines()
+    start = next(i for i, line in enumerate(lines) if line.startswith("ENGINE"))
+    return next(line for line in lines[start:] if line.startswith(node))
+
+
+def test_engine_pane_cache_hit_rate_and_memory_formatting():
+    snapshot = {
+        "node": "virtual-cluster/1000", "configuration_id": 1,
+        "membership_size": 1000, "health": "stable",
+        "metrics": {
+            "engine_dispatches": 12,
+            "engine_h2d_bytes": 3 << 20,
+            "engine_d2h_bytes": 2048,
+            "engine_dispatch_ms": {
+                "run_to_decision": _hist_summary(5.0, 7.0, 100.0),
+            },
+        },
+        "engine": {
+            "compile": {"compiles": 9, "persistent_cache_hits": 3,
+                        "persistent_cache_misses": 1},
+            "memory": {"live_buffer_bytes": 5 << 30,
+                       "device_bytes_in_use": 1 << 30},
+        },
+        "transport": {}, "recorder": None,
+    }
+    frame = clustertop.render_frame([snapshot])
+    row = _engine_pane_row(frame, "virtual-cluster/1000")
+    assert "75%" in row  # 3 hits / 4 lookups
+    assert "3.0M" in row and "2.0K" in row
+    assert "5.00G" in row and "1.00G" in row
+    merged = LogHistogram()
+    for v in (5.0, 7.0, 100.0):
+        merged.observe(v)
+    assert f"{merged.quantile(0.99):.1f}" in row
+
+
+def _hist_summary(*values_ms):
+    hist = LogHistogram()
+    for value in values_ms:
+        hist.observe(value)
+    return hist.summary()
+
+
+def test_engine_counters_zero_filled_only_for_engine_snapshots():
+    # A host snapshot must NOT grow engine series; an engine snapshot
+    # exposes them even before the first dispatch.
+    host = {"node": "h", "metrics": {}, "transport": {}, "recorder": None}
+    host_names = exposition.metric_names(exposition.prometheus_text(host))
+    assert not any("engine" in name for name in host_names)
+    vc = _cluster()  # no dispatch at all yet
+    names = exposition.metric_names(vc.prometheus_text())
+    assert "rapid_engine_dispatches_total" in names
+    assert "rapid_engine_steps_total" in names

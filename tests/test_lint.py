@@ -136,6 +136,23 @@ def test_recorder_events_come_from_registered_enum():
     assert not offenders, "\n".join(offenders)
 
 
+def test_ledger_events_come_from_registered_vocabulary():
+    """Every run-ledger ``emit()`` call site in the library, bench.py, and
+    tools/ must name its event as ``LedgerEvent.<member>`` — the registered
+    vocabulary tools/perfview.py's timeline rendering (and the watchdog's
+    per-stage budgets) are defined over. Mirror of the flight-recorder
+    EventName rule above; the resolution-tier twin lives in
+    tools/analysis/ledger.py (check_ledger) so the CLI gate catches it too.
+    Only files importing rapid_tpu.utils.ledger are in scope — unrelated
+    ``emit`` methods are not."""
+    from staticcheck import check_ledger
+
+    offenders = []
+    for path in _py_files(("rapid_tpu", "bench.py", "tools")):
+        offenders.extend(str(f) for f in check_ledger(path))
+    assert not offenders, "\n".join(offenders)
+
+
 def test_protocol_reads_no_wall_clock():
     """The clock-disciplined packages (rapid_tpu/protocol/ and
     rapid_tpu/monitoring/ — failure detectors are timing consumers too)

@@ -1,0 +1,192 @@
+"""tools/perfview.py: stage-timeline rendering of run ledgers, the perf
+trajectory over the committed BENCH_r* rounds (with snapshot/stale/wedged
+trust flags — the acceptance surface for "no blind perf points"), and the
+Chrome trace output.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import perfview  # noqa: E402  — tools/perfview.py
+
+from rapid_tpu.utils.ledger import LedgerEvent, RunLedger  # noqa: E402
+
+
+def _complete_ledger(tmp_path, fail_in=None):
+    path = tmp_path / "run.jsonl"
+    ledger = RunLedger(str(path), run_id="r1")
+    ledger.emit(LedgerEvent.RUN_BEGIN, mode="inline", git_rev="abc1234",
+                code_hash="deadbeefdeadbeef")
+    ledger.emit(LedgerEvent.ATTEMPT_BEGIN, attempt=1, attempts=2)
+    for stage in ("devices_init", "state_build", "warmup_compile"):
+        if stage == fail_in:
+            try:
+                with ledger.stage(stage, timeout_s=60):
+                    raise RuntimeError("synthetic failure")
+            except RuntimeError:
+                pass
+            ledger.emit(LedgerEvent.RUN_FAIL, error="RuntimeError",
+                        last_completed_stage="state_build")
+            ledger.close()
+            return path
+        with ledger.stage(stage, timeout_s=60, n=256):
+            pass
+    ledger.emit(LedgerEvent.COMPILE_STATS, stage="warmup_compile",
+                compiles=4, compile_ms=4117.2)
+    ledger.emit(LedgerEvent.RUN_END, outcome="completed")
+    ledger.close()
+    return path
+
+
+def test_renders_complete_ledger_timeline(tmp_path, capsys):
+    path = _complete_ledger(tmp_path)
+    assert perfview.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "git_rev=abc1234" in out
+    for stage in ("devices_init", "state_build", "warmup_compile"):
+        assert stage in out
+    assert "compile_stats" in out
+    # Attempts are visible: a retried run must not read as one seamless run.
+    assert "attempt_begin" in out and "attempt=1" in out
+    assert "outcome: completed" in out
+
+
+def test_renders_failed_ledger_pointing_at_last_stage(tmp_path, capsys):
+    path = _complete_ledger(tmp_path, fail_in="warmup_compile")
+    assert perfview.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "FAIL" in out
+    assert "last completed stage: state_build" in out
+
+
+def test_wedged_ledger_shows_open_stage(tmp_path, capsys):
+    path = tmp_path / "run.jsonl"
+    ledger = RunLedger(str(path))
+    with ledger.stage("devices_init"):
+        pass
+    ledger.emit(LedgerEvent.STAGE_BEGIN, stage="state_build", timeout_s=900)
+    ledger.close()  # process dies here; no end event ever lands
+    assert perfview.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "OPEN" in out
+    assert "still running or killed mid-run (in 'state_build')" in out
+
+
+def test_trajectory_marks_r04_r05_snapshot_stale(capsys):
+    """The acceptance criterion: the committed BENCH_r01-r05 trajectory
+    renders without error and r04-r05 read as snapshot/stale replays."""
+    rounds = sorted(str(p) for p in REPO.glob("BENCH_r0*.json"))
+    assert len(rounds) >= 5
+    assert perfview.main(rounds) == 0
+    out = capsys.readouterr().out
+    lines = {line.split()[0]: line for line in out.splitlines()
+             if line.startswith("BENCH_")}
+    for round_name in ("BENCH_r04", "BENCH_r05"):
+        assert "snapshot" in lines[round_name]
+        assert "stale" in lines[round_name]
+    assert "wedged" in lines["BENCH_r03"]
+    # The alert_deliveries_per_sec ≈ 4.96e10 class of derived-metric bug is
+    # visible at a glance on every historical point that carries it.
+    assert "suspect-rate" in lines["BENCH_r05"]
+
+
+def test_trajectory_accepts_bare_metric_json(tmp_path, capsys):
+    point = tmp_path / "round.json"
+    point.write_text(json.dumps({
+        "metric": "churn_resolution_ms_n256_churn5pct", "value": 15.0,
+        "unit": "ms", "vs_baseline": 33.3, "platform": "cpu",
+        "alert_deliveries_per_sec": 511515.0,
+    }))
+    hole = tmp_path / "hole.json"
+    hole.write_text(json.dumps({
+        "metric": "churn_resolution_ms_n100000",
+        "error": "accelerator_unavailable",
+    }))
+    assert perfview.main([str(point), str(hole)]) == 0
+    out = capsys.readouterr().out
+    row = next(line for line in out.splitlines() if line.startswith("round"))
+    assert "live" in row and "suspect-rate" not in row
+    assert "hole" in next(line for line in out.splitlines()
+                          if line.startswith("hole"))
+
+
+def test_chrome_trace_envelope(tmp_path, capsys):
+    path = _complete_ledger(tmp_path)
+    chrome_path = tmp_path / "trace.json"
+    assert perfview.main([str(path), "--chrome", str(chrome_path)]) == 0
+    with open(chrome_path) as f:
+        chrome = json.load(f)
+    # Same envelope traceview emits (Perfetto/chrome://tracing load it).
+    assert set(chrome) == {"traceEvents", "displayTimeUnit"}
+    assert chrome["displayTimeUnit"] == "ms"
+    stages = [e for e in chrome["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in stages} == {
+        "devices_init", "state_build", "warmup_compile",
+    }
+    for event in stages:
+        assert event["dur"] >= 0 and isinstance(event["ts"], (int, float))
+    instants = [e for e in chrome["traceEvents"] if e["ph"] == "i"]
+    assert any(e["name"] == "compile_stats" for e in instants)
+
+
+def test_multi_run_ledger_renders_one_section_per_run(tmp_path, capsys):
+    # The default bench_ledger.jsonl accumulates runs across invocations;
+    # each run must render as its own timeline with its own outcome, never
+    # one merged timeline under the first run's provenance.
+    path = tmp_path / "run.jsonl"
+    first = RunLedger(str(path), run_id="run-one")
+    first.emit(LedgerEvent.RUN_BEGIN, mode="inline", git_rev="aaa1111")
+    with first.stage("devices_init"):
+        pass
+    first.emit(LedgerEvent.RUN_END, outcome="completed")
+    first.close()
+    second = RunLedger(str(path), run_id="run-two")
+    second.emit(LedgerEvent.RUN_BEGIN, mode="watchdogged", git_rev="bbb2222")
+    second.emit(LedgerEvent.RUN_FAIL, outcome="wedged",
+                last_completed_stage=None)
+    second.close()
+    assert perfview.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "[run-one]" in out and "[run-two]" in out
+    one, two = out.split("[run-two]")
+    assert "outcome: completed" in one and "FAILED" not in one
+    assert "outcome: FAILED (wedged)" in two
+    runs = perfview.split_runs(perfview.read_ledger(str(path))[0])
+    assert [run_id for run_id, _ in runs] == ["run-one", "run-two"]
+
+
+def test_outcome_is_latest_terminal_event_not_first_fail(tmp_path, capsys):
+    # A --cpu-fallback/--allow-snapshot run records the wedge (run_fail)
+    # and THEN closes successfully (run_end): the latest terminal event
+    # decides the outcome, with the earlier wedge still on display.
+    path = tmp_path / "run.jsonl"
+    ledger = RunLedger(str(path), run_id="r")
+    ledger.emit(LedgerEvent.RUN_BEGIN, mode="watchdogged")
+    ledger.emit(LedgerEvent.RUN_FAIL, outcome="wedged",
+                last_completed_stage=None)
+    with ledger.stage("timed_samples"):
+        pass
+    ledger.emit(LedgerEvent.RUN_END, outcome="cpu_fallback")
+    ledger.close()
+    assert perfview.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "outcome: cpu_fallback (after run_fail: wedged)" in out
+    assert "outcome: FAILED" not in out
+
+
+def test_errors_cleanly_on_bad_inputs(tmp_path, capsys):
+    missing = tmp_path / "missing.jsonl"
+    assert perfview.main([str(missing)]) == 2
+    assert "perfview:" in capsys.readouterr().err
+    scalar = tmp_path / "scalar.json"
+    scalar.write_text("42")
+    assert perfview.main([str(scalar)]) == 2
+    assert "not a bench metric artifact" in capsys.readouterr().err
+    bad = tmp_path / "bad.json"
+    bad.write_text("{nope")
+    assert perfview.main([str(bad)]) == 2
+    assert "invalid JSON" in capsys.readouterr().err

@@ -352,6 +352,8 @@ _CORPUS_CHECKERS = {
     "clean_taskflow.py": ("rapid_tpu/messaging/_corpus.py", "check_taskflow"),
     "unseeded_random.py": ("rapid_tpu/messaging/_corpus.py", "check_determinism"),
     "clean_determinism.py": ("rapid_tpu/messaging/_corpus.py", "check_determinism"),
+    "ledger_event_name.py": ("rapid_tpu/models/_corpus.py", "check_ledger"),
+    "clean_ledger.py": ("rapid_tpu/models/_corpus.py", "check_ledger"),
 }
 
 
@@ -784,7 +786,7 @@ def test_cli_json_select_ignore_and_exit_codes(tmp_path):
 
 
 def test_cli_families_lists_all_families():
-    assert len(staticcheck.FAMILIES) == 10
+    assert len(staticcheck.FAMILIES) == 11
     result = _run_cli("--families")
     assert result.returncode == 0
     for name, _description in staticcheck.FAMILIES:

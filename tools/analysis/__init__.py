@@ -52,6 +52,7 @@ from .core import (
 from .deadcode import check_dead_definitions
 from .determinism import DETERMINISM_PREFIXES, check_determinism
 from .dispatch import DISPATCH_PREFIXES, check_dispatch
+from .ledger import LEDGER_PREFIXES, check_ledger
 from .names import check_undefined_names
 from .signatures import check_call_signatures
 from .taskflow import TASKFLOW_PREFIXES, check_taskflow
@@ -73,6 +74,7 @@ __all__ = [
     "DISPATCH_PREFIXES",
     "FAMILIES",
     "Finding",
+    "LEDGER_PREFIXES",
     "LOCK_REL",
     "TASKFLOW_PREFIXES",
     "TRACE_SAFETY_PREFIXES",
@@ -83,6 +85,7 @@ __all__ = [
     "check_dead_definitions",
     "check_determinism",
     "check_dispatch",
+    "check_ledger",
     "check_taskflow",
     "check_trace_safety",
     "check_undefined_names",

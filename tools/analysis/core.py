@@ -81,6 +81,9 @@ ALL_CHECK_NAMES = frozenset({
     "unawaited-coroutine",
     # determinism family
     "unseeded-random",
+    # ledger family
+    "ledger-event-name",
+    "ledger-stage-name",
 })
 
 #: The check families, in documentation order — one (name, description)
@@ -102,6 +105,8 @@ FAMILIES = (
                  "cancellation, unawaited coroutines"),
     ("determinism", "no unseeded randomness in the library: simulated runs "
                     "are pure functions of their seed"),
+    ("ledger", "run-ledger vocabulary discipline: emit() events from "
+               "LedgerEvent, stage() names from STAGE_NAMES"),
 )
 
 
@@ -167,7 +172,7 @@ def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
     # The per-file check imports live here (not module top level) so the
     # CLI shim can import this module before sys.path is fully arranged.
     from . import (
-        clocks, concurrency, deadcode, determinism, dispatch, names,
+        clocks, concurrency, deadcode, determinism, dispatch, ledger, names,
         signatures, taskflow, trace_safety, wire_schema,
     )
 
@@ -180,6 +185,7 @@ def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
         dispatch.check_dispatch,
         taskflow.check_taskflow,
         determinism.check_determinism,
+        ledger.check_ledger,
     ]
     full_tree = tuple(roots) == DEFAULT_ROOTS
     if not full_tree:

@@ -28,8 +28,13 @@ run_stage() { # name timeout_s command...
 # Keep (accelerator attempt deadline) + (CPU fallback, ~10 min at N=100K)
 # safely inside the stage timeout, or a wedged-tunnel day kills the fallback
 # before its JSON line: one 1500s attempt + fallback < 3300s.
+# CPU_FALLBACK=1 is this script's EXPLICIT authorization (bench.py's new
+# default is loud failure): a wedged-tunnel day still yields a labeled
+# platform=cpu measurement instead of an error artifact.
 run_stage bench 3300 env RAPID_TPU_BENCH_DEADLINE_S=1500 RAPID_TPU_BENCH_ATTEMPTS=1 \
-  RAPID_TPU_BENCH_NO_SNAPSHOT=1 python -u bench.py
+  RAPID_TPU_BENCH_NO_SNAPSHOT=1 RAPID_TPU_BENCH_CPU_FALLBACK=1 \
+  RAPID_TPU_BENCH_LEDGER="$OUT/bench_ledger.jsonl" \
+  python -u bench.py
 grep -h '"metric"' "$OUT/bench.log" | tail -1 > "$OUT/bench.json"
 # Stamp provenance into a capture so bench.py's snapshot fallback (and any
 # reader) can tell when/what a measurement was taken from. One definition —
@@ -89,7 +94,9 @@ EOF
 echo "autotuned lanes: 100K=$LANES_100K 1M=$LANES_1M"
 run_stage bench_tuned 3300 env RAPID_TPU_BENCH_DEADLINE_S=1500 \
   RAPID_TPU_BENCH_ATTEMPTS=1 RAPID_TPU_BENCH_NO_SNAPSHOT=1 \
+  RAPID_TPU_BENCH_CPU_FALLBACK=1 \
   RAPID_TPU_BENCH_LANES="$LANES_100K" RAPID_TPU_BENCH_LANES_1M="$LANES_1M" \
+  RAPID_TPU_BENCH_LEDGER="$OUT/bench_tuned_ledger.jsonl" \
   python -u bench.py
 grep -h '"metric"' "$OUT/bench_tuned.log" | tail -1 > "$OUT/bench_tuned.json"
 stamp_json "$OUT/bench_tuned.json"

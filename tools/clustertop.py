@@ -110,6 +110,81 @@ def _quantile_cell(hist: Optional[LogHistogram], q: float) -> str:
     return _fmt_ms(hist.quantile(q))
 
 
+def _render_table(header: Tuple[str, ...],
+                  rows: List[Tuple[str, ...]]) -> List[str]:
+    """Fixed-width text table — shared by the node table and engine pane."""
+    widths = [
+        max(len(header[i]), *(len(r[i]) for r in rows)) if rows else len(header[i])
+        for i in range(len(header))
+    ]
+    return [
+        "  ".join(cell.ljust(widths[i]) for i, cell in enumerate(row))
+        for row in (header, *rows)
+    ]
+
+
+def _fmt_bytes(value: Any) -> str:
+    if not isinstance(value, (int, float)):
+        return "-"
+    if value >= 1 << 30:
+        return f"{value / (1 << 30):.2f}G"
+    if value >= 1 << 20:
+        return f"{value / (1 << 20):.1f}M"
+    if value >= 1 << 10:
+        return f"{value / (1 << 10):.1f}K"
+    return str(int(value))
+
+
+def _dispatch_histogram(snapshot: Dict[str, Any]) -> Optional[LogHistogram]:
+    """All engine dispatch latencies of one snapshot, entrypoint phases
+    merged (merge is associative — same folding rule as the phase SLOs)."""
+    family = (snapshot.get("metrics") or {}).get("engine_dispatch_ms") or {}
+    merged = LogHistogram()
+    for summary in family.values():
+        if isinstance(summary, dict) and "count" in summary:
+            merged.merge(LogHistogram.from_summary(summary))
+    return merged if merged.count else None
+
+
+def render_engine_pane(snapshots: List[Dict[str, Any]]) -> List[str]:
+    """The device-engine rows: one line per snapshot carrying an ``engine``
+    section (VirtualCluster scrapes) — compile count, persistent-cache hit
+    rate, dispatch p99, transfer bytes, device memory. Snapshots from
+    pre-ledger code (no ``engine`` key, or partial sections) contribute
+    nothing / dashes, never a crash."""
+    engines = [s for s in snapshots if isinstance(s.get("engine"), dict)]
+    if not engines:
+        return []
+    header = (
+        "ENGINE", "COMPILES", "CACHEHIT", "DISP99", "DISPATCHES",
+        "H2D", "D2H", "LIVEBUF", "DEVMEM",
+    )
+    rows: List[Tuple[str, ...]] = []
+    for snapshot in sorted(engines, key=lambda s: str(s.get("node", ""))):
+        engine = snapshot["engine"]
+        compile_stats = engine.get("compile") or {}
+        memory = engine.get("memory") or {}
+        metrics = snapshot.get("metrics") or {}
+        hits = compile_stats.get("persistent_cache_hits")
+        misses = compile_stats.get("persistent_cache_misses")
+        if isinstance(hits, int) and isinstance(misses, int) and hits + misses:
+            cache = f"{100.0 * hits / (hits + misses):.0f}%"
+        else:
+            cache = "-"
+        rows.append((
+            str(snapshot.get("node", "?")),
+            str(compile_stats.get("compiles", "-")),
+            cache,
+            _quantile_cell(_dispatch_histogram(snapshot), 0.99),
+            str(metrics.get("engine_dispatches", "-")),
+            _fmt_bytes(metrics.get("engine_h2d_bytes")),
+            _fmt_bytes(metrics.get("engine_d2h_bytes")),
+            _fmt_bytes(memory.get("live_buffer_bytes")),
+            _fmt_bytes(memory.get("device_bytes_in_use")),
+        ))
+    return ["", *_render_table(header, rows)]
+
+
 def render_frame(
     snapshots: List[Dict[str, Any]], errors: Optional[List[str]] = None
 ) -> str:
@@ -172,12 +247,8 @@ def render_frame(
             _quantile_cell(phases.get("delivery"), 0.99),
             _quantile_cell(_convergence_histogram(snapshot), 0.99),
         ))
-    widths = [
-        max(len(header[i]), *(len(r[i]) for r in rows)) if rows else len(header[i])
-        for i in range(len(header))
-    ]
-    for row in (header, *rows):
-        lines.append("  ".join(cell.ljust(widths[i]) for i, cell in enumerate(row)))
+    lines.extend(_render_table(header, rows))
+    lines.extend(render_engine_pane(snapshots))
     for error in errors or ():
         lines.append(f"! {error}")
     return "\n".join(lines) + "\n"

@@ -1,0 +1,407 @@
+"""Render bench run ledgers and the BENCH_r* perf trajectory.
+
+``tools/traceview.py`` answers "show me this one view change";
+``tools/clustertop.py`` answers "how is the cluster doing right now"; this
+tool answers the third operator question — "what happened to my benchmark
+runs, and can I trust the numbers". Two input kinds, freely mixed:
+
+- **Run ledgers** (``*.jsonl``, what ``bench.py --ledger`` appends — see
+  rapid_tpu/utils/ledger.py): rendered as a stage timeline — every stage's
+  begin/duration/status, compile + device-memory stats, heartbeat gaps,
+  watchdog kills, snapshot replays, and the run outcome with the last
+  completed stage. A wedged run reads as "died in <stage>", not a mystery.
+
+- **Bench metric JSON** (``*.json``, the one-line artifact each bench round
+  emits — BENCH_r01.json ...): rendered as a perf trajectory table, one row
+  per round, flagging every point that is NOT a live measurement of the
+  code it claims to measure: ``snapshot`` (replayed evidence), ``stale``
+  (snapshot measured different code than HEAD), ``wedged`` (live attempt
+  died), ``hole`` (explicit accelerator-unavailable marker), and
+  ``suspect-rate`` (a derived rate outside plausibility bounds — the
+  alert_deliveries_per_sec ≈ 5e10 class of bug).
+
+``--chrome out.json`` additionally writes Chrome trace-event JSON (the same
+envelope tools/traceview.py emits — Perfetto/chrome://tracing load it):
+ledger stages as duration events, point events as instants, one process
+lane per ledger.
+
+Usage:
+
+    python tools/perfview.py bench_ledger.jsonl
+    python tools/perfview.py BENCH_r0*.json
+    python tools/perfview.py bench_ledger.jsonl BENCH_r0*.json --chrome t.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from rapid_tpu.utils.ledger import (  # noqa: E402
+    LedgerEvent,
+    last_completed_stage,
+    open_stage,
+    read_ledger,
+)
+
+#: A derived per-second rate above this is treated as implausible for this
+#: workload class and flagged ``suspect-rate`` (no network or chip moves
+#: 1e9+ distinct alert deliveries a second at these Ns — the historical
+#: 4.96e10 figure came from multiplying by all N members instead of the
+#: engine's C-cohort delivery grain).
+SUSPECT_RATE_PER_SEC = 1e9
+
+_POINT_EVENTS = (
+    LedgerEvent.ATTEMPT_BEGIN.value,
+    LedgerEvent.ATTEMPT_END.value,
+    LedgerEvent.HEARTBEAT_GAP.value,
+    LedgerEvent.WATCHDOG_KILL.value,
+    LedgerEvent.SNAPSHOT_REPLAY.value,
+    LedgerEvent.COMPILE_STATS.value,
+    LedgerEvent.DEVICE_MEMORY.value,
+)
+
+
+class PerfviewError(RuntimeError):
+    """An input could not be read/parsed; the CLI exits 2 with the message."""
+
+
+def split_runs(events: List[Dict[str, Any]]) -> List[Tuple[str, List[Dict[str, Any]]]]:
+    """Group a ledger file's events by ``run_id``, in order of first
+    appearance — the default bench_ledger.jsonl is append-only across
+    invocations, and mixing two runs into one timeline would pin the wrong
+    provenance (and the wrong outcome) on both."""
+    order: List[str] = []
+    groups: Dict[str, List[Dict[str, Any]]] = {}
+    for record in events:
+        run_id = str(record.get("run_id", "?"))
+        if run_id not in groups:
+            order.append(run_id)
+            groups[run_id] = []
+        groups[run_id].append(record)
+    return [(run_id, groups[run_id]) for run_id in order]
+
+
+# ---------------------------------------------------------------------------
+# Ledger rendering
+# ---------------------------------------------------------------------------
+
+
+def render_table(header: Tuple[str, ...],
+                 rows: List[Tuple[str, ...]]) -> List[str]:
+    """Fixed-width text table (header + rows, columns padded to the widest
+    cell) — the one table renderer both report kinds share."""
+    widths = [
+        max(len(header[i]), *(len(r[i]) for r in rows)) if rows else len(header[i])
+        for i in range(len(header))
+    ]
+    return [
+        "  ".join(cell.ljust(widths[i]) for i, cell in enumerate(row))
+        for row in (header, *rows)
+    ]
+
+
+def stage_rows(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Pair stage begin/end(or fail) events into timeline rows, in begin
+    order. An unpaired begin renders as OPEN — exactly what a wedged run
+    looks like."""
+    rows: List[Dict[str, Any]] = []
+    open_rows: List[Dict[str, Any]] = []
+    for record in events:
+        kind = record.get("event")
+        if kind == LedgerEvent.STAGE_BEGIN.value:
+            row = {
+                "stage": record.get("stage", "?"),
+                "n": record.get("n"),
+                "begin_s": record.get("t_s", 0.0),
+                "pid": record.get("pid"),
+                "timeout_s": record.get("timeout_s"),
+                "duration_ms": None,
+                "status": "OPEN",
+                "error": None,
+            }
+            rows.append(row)
+            open_rows.append(row)
+        elif kind in (LedgerEvent.STAGE_END.value, LedgerEvent.STAGE_FAIL.value):
+            match = next(
+                (r for r in reversed(open_rows)
+                 if r["stage"] == record.get("stage")
+                 and r["pid"] == record.get("pid")),
+                None,
+            )
+            if match is None:
+                continue  # end without begin (pre-ledger writer): skip
+            open_rows.remove(match)
+            match["duration_ms"] = record.get("duration_ms")
+            match["status"] = (
+                "ok" if kind == LedgerEvent.STAGE_END.value else "FAIL"
+            )
+            match["error"] = record.get("error")
+    return rows
+
+
+def _fmt_duration(ms: Optional[float]) -> str:
+    if ms is None:
+        return "-"
+    if ms >= 60_000:
+        return f"{ms / 60_000.0:.1f}m"
+    if ms >= 1000:
+        return f"{ms / 1000.0:.2f}s"
+    return f"{ms:.0f}ms"
+
+
+def render_ledger(path: str, events: List[Dict[str, Any]], skipped: int) -> str:
+    lines: List[str] = []
+    begin = next(
+        (e for e in events if e.get("event") == LedgerEvent.RUN_BEGIN.value), None
+    )
+    lines.append(f"== run ledger {path} ==")
+    if begin:
+        lines.append(
+            f"run {begin.get('run_id', '?')} mode={begin.get('mode', '?')}"
+            f" git_rev={begin.get('git_rev')} code_hash={begin.get('code_hash')}"
+        )
+    header = ("T+", "STAGE", "N", "DURATION", "BUDGET", "STATUS")
+    rows: List[Tuple[str, ...]] = []
+    for row in stage_rows(events):
+        rows.append((
+            f"{row['begin_s']:.1f}s",
+            str(row["stage"]),
+            "-" if row["n"] is None else str(row["n"]),
+            _fmt_duration(row["duration_ms"]),
+            "-" if row["timeout_s"] is None else f"{row['timeout_s']:.0f}s",
+            row["status"] + (f" ({row['error']})" if row["error"] else ""),
+        ))
+    lines.extend(render_table(header, rows))
+    if not rows:
+        lines.append("(no stage events)")
+
+    for record in events:
+        kind = record.get("event")
+        if kind not in _POINT_EVENTS:
+            continue
+        fields = {
+            k: v for k, v in record.items()
+            if k not in ("event", "seq", "pid", "t_s", "wall", "run_id")
+        }
+        detail = " ".join(f"{k}={v}" for k, v in fields.items())
+        lines.append(f"! {record.get('t_s', 0.0):.1f}s {kind}: {detail}")
+
+    terminal = [
+        e for e in events
+        if e.get("event") in (LedgerEvent.RUN_FAIL.value, LedgerEvent.RUN_END.value)
+    ]
+    fails = [e for e in terminal if e.get("event") == LedgerEvent.RUN_FAIL.value]
+    stuck = open_stage(events)
+    # The LATEST terminal event wins: a --cpu-fallback/--allow-snapshot run
+    # records the wedge (run_fail) and THEN closes successfully (run_end) —
+    # event order, not event kind, decides the outcome.
+    if terminal and terminal[-1]["event"] == LedgerEvent.RUN_FAIL.value:
+        last = terminal[-1].get("last_completed_stage") or last_completed_stage(events)
+        where = f"; wedged in {stuck['stage']!r}" if stuck else ""
+        lines.append(
+            f"outcome: FAILED ({terminal[-1].get('outcome') or terminal[-1].get('error')})"
+            f" — last completed stage: {last or 'none'}{where}"
+        )
+    elif terminal:
+        note = (
+            f" (after run_fail: {fails[-1].get('outcome') or fails[-1].get('error')})"
+            if fails else ""
+        )
+        lines.append(f"outcome: {terminal[-1].get('outcome', 'completed')}{note}")
+    else:
+        where = f" (in {stuck['stage']!r})" if stuck else ""
+        lines.append(f"outcome: still running or killed mid-run{where}")
+    if skipped:
+        lines.append(f"({skipped} unparseable line(s) skipped)")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Trajectory rendering
+# ---------------------------------------------------------------------------
+
+
+def point_flags(data: Dict[str, Any]) -> List[str]:
+    """The trust flags of one bench-round JSON artifact."""
+    flags: List[str] = []
+    if "error" in data:
+        flags.append("hole")
+        return flags
+    if data.get("live_attempt") == "wedged":
+        flags.append("wedged")
+    if data.get("capture") == "session_snapshot":
+        flags.append("snapshot")
+    if data.get("stale_code"):
+        flags.append("stale")
+    for key, value in data.items():
+        if key.endswith("_per_sec") and isinstance(value, (int, float)):
+            if value > SUSPECT_RATE_PER_SEC:
+                flags.append("suspect-rate")
+                break
+    if not flags:
+        flags.append("live")
+    return flags
+
+
+def load_trajectory_point(path: str) -> Dict[str, Any]:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except OSError as exc:
+        raise PerfviewError(f"{path}: cannot read: {exc}") from exc
+    except json.JSONDecodeError as exc:
+        raise PerfviewError(f"{path}: invalid JSON: {exc}") from exc
+    if isinstance(data, dict) and isinstance(data.get("parsed"), dict):
+        # Driver round artifact (BENCH_rNN.json): the bench's emitted JSON
+        # line lives under "parsed", beside the harness's cmd/rc/tail.
+        data = data["parsed"]
+    if not isinstance(data, dict) or ("metric" not in data):
+        raise PerfviewError(
+            f"{path}: not a bench metric artifact (expected a JSON object "
+            "with a 'metric' key, or a driver round file with 'parsed')"
+        )
+    return data
+
+
+def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
+    lines = ["== perf trajectory =="]
+    header = ("ROUND", "METRIC", "VALUE", "PLATFORM", "VSBASE", "FLAGS")
+    rows: List[Tuple[str, ...]] = []
+    for path, data in sorted(points, key=lambda p: p[0]):
+        value = data.get("value")
+        vs = data.get("vs_baseline", data.get("vs_baseline_at_capture"))
+        rows.append((
+            Path(path).stem,
+            str(data.get("metric", "?")),
+            "-" if value is None else f"{float(value):.1f}ms",
+            str(data.get("platform", "-")),
+            "-" if vs is None else f"{float(vs):.2f}x"
+            + ("@capture" if "vs_baseline_at_capture" in data else ""),
+            ",".join(point_flags(data)),
+        ))
+    lines.extend(render_table(header, rows))
+    flagged = [
+        (Path(p).stem, flags) for p, d in sorted(points)
+        if (flags := [f for f in point_flags(d) if f != "live"])
+    ]
+    if flagged:
+        lines.append(
+            "untrusted points: "
+            + "; ".join(f"{name} ({','.join(flags)})" for name, flags in flagged)
+        )
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace output (same envelope as tools/traceview.py)
+# ---------------------------------------------------------------------------
+
+
+def chrome_trace(ledgers: List[Tuple[str, List[Dict[str, Any]]]]) -> Dict[str, Any]:
+    """Ledger stages as complete ('X') duration events and point events as
+    thread-scoped instants, one process lane per ledger — the trace-event
+    envelope Perfetto and chrome://tracing load (identical to
+    traceview.chrome_trace's)."""
+    trace_events: List[Dict[str, Any]] = []
+    for pid, (path, events) in enumerate(ledgers, start=1):
+        trace_events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": str(path)},
+        })
+        for row in stage_rows(events):
+            duration_ms = row["duration_ms"] or 0.0
+            trace_events.append({
+                "name": row["stage"],
+                "ph": "X",
+                "ts": row["begin_s"] * 1e6,  # trace-event ts is µs
+                "dur": duration_ms * 1000.0,
+                "pid": pid,
+                "tid": 1,
+                "args": {
+                    "n": row["n"], "status": row["status"],
+                    "timeout_s": row["timeout_s"],
+                },
+            })
+        for record in events:
+            if record.get("event") not in _POINT_EVENTS:
+                continue
+            trace_events.append({
+                "name": record["event"],
+                "ph": "i",
+                "s": "t",
+                "ts": record.get("t_s", 0.0) * 1e6,
+                "pid": pid,
+                "tid": 1,
+                "args": {
+                    k: v for k, v in record.items()
+                    if k not in ("event", "seq", "pid", "t_s", "wall", "run_id")
+                },
+            })
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="render bench run ledgers (stage timelines) and the "
+                    "BENCH_r* perf trajectory with trust flags"
+    )
+    parser.add_argument(
+        "inputs", nargs="+",
+        help="run-ledger .jsonl files (bench.py --ledger) and/or bench "
+             "metric .json artifacts (BENCH_rNN.json)",
+    )
+    parser.add_argument(
+        "--chrome", metavar="OUT.json", default=None,
+        help="also write Chrome trace-event JSON of the ledger stages "
+             "(open in Perfetto)",
+    )
+    args = parser.parse_args(argv)
+
+    ledgers: List[Tuple[str, List[Dict[str, Any]], int]] = []
+    points: List[Tuple[str, Dict[str, Any]]] = []
+    try:
+        for arg in args.inputs:
+            if arg.endswith(".jsonl"):
+                events, skipped = read_ledger(arg)
+                if not events:
+                    raise PerfviewError(
+                        f"{arg}: no ledger events (missing file or not a "
+                        "bench run ledger)"
+                    )
+                ledgers.append((arg, events, skipped))
+            else:
+                points.append((arg, load_trajectory_point(arg)))
+    except PerfviewError as exc:
+        print(f"perfview: {exc}", file=sys.stderr)
+        return 2
+
+    lanes: List[Tuple[str, List[Dict[str, Any]]]] = []
+    for path, events, skipped in ledgers:
+        runs = split_runs(events)
+        for run_id, run_events in runs:
+            label = path if len(runs) == 1 else f"{path} [{run_id}]"
+            sys.stdout.write(render_ledger(label, run_events, skipped))
+            sys.stdout.write("\n")
+            skipped = 0  # unparseable-line count reported once per file
+            lanes.append((label, run_events))
+    if points:
+        sys.stdout.write(render_trajectory(points))
+    if args.chrome:
+        trace = chrome_trace(lanes)
+        with open(args.chrome, "w") as f:
+            json.dump(trace, f, indent=1)
+            f.write("\n")
+        sys.stdout.write(
+            f"wrote {args.chrome} ({len(trace['traceEvents'])} events)\n"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

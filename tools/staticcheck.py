@@ -34,6 +34,7 @@ from analysis import (  # noqa: E402,F401 — re-exported API surface
     DISPATCH_PREFIXES,
     FAMILIES,
     Finding,
+    LEDGER_PREFIXES,
     LOCK_REL,
     TASKFLOW_PREFIXES,
     TRACE_SAFETY_PREFIXES,
@@ -44,6 +45,7 @@ from analysis import (  # noqa: E402,F401 — re-exported API surface
     check_dead_definitions,
     check_determinism,
     check_dispatch,
+    check_ledger,
     check_taskflow,
     check_trace_safety,
     check_undefined_names,
@@ -68,6 +70,7 @@ __all__ = [
     "DISPATCH_PREFIXES",
     "FAMILIES",
     "Finding",
+    "LEDGER_PREFIXES",
     "LOCK_REL",
     "REPO",
     "TASKFLOW_PREFIXES",
@@ -79,6 +82,7 @@ __all__ = [
     "check_dead_definitions",
     "check_determinism",
     "check_dispatch",
+    "check_ledger",
     "check_taskflow",
     "check_trace_safety",
     "check_undefined_names",
