@@ -209,6 +209,48 @@ def derived_metrics(*, n: int, n_join: int, n_crash: int, k_rings: int,
     }
 
 
+def hlo_audit_summary() -> dict:
+    """Per-entrypoint compiled-program facts at the fixed audit shapes
+    (tools/analysis/device_program.py, session-cached): collective counts
+    split hot-loop vs total, payload bytes, temp memory, and donation
+    outcomes — the communication-budget companion to the latency metrics,
+    diffable across BENCH_r* rounds by tools/perfview.py. Any failure
+    (too few devices, an import gap) degrades to ``{"error": ...}`` —
+    the audit must never take down the bench that embeds it."""
+    tools_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    if tools_dir not in sys.path:
+        sys.path.append(tools_dir)
+    try:
+        from analysis import device_program
+
+        # Observational mode: on a single-chip backend (the TPU v5 lite0,
+        # or un-forced CPU) the four single-device entrypoints still audit;
+        # the sharded pair joins whenever >= 8 devices exist. The strict
+        # full-registry requirement belongs to the lockfile GATE, not here.
+        facts = device_program.collect_facts(require_mesh=False)
+    except Exception as exc:  # noqa: BLE001 — strictly observational: any
+        # compile/import failure reports the reason in-line instead of
+        # wedging the run.
+        return {"error": str(exc)}
+    summary = {}
+    for name, entry in sorted(facts.items()):
+        colls = entry["collectives"]
+        # "hot-loop/" precisely: "hot-loop-cond/*" ops are GATED (they run
+        # on view changes, not every round), and lumping them in would hide
+        # exactly the cond->unconditional migration the gate exists to
+        # catch from perfview's drift diff.
+        hot = {k: v for k, v in colls.items() if k.startswith("hot-loop/")}
+        summary[name] = {
+            "collectives": sum(v["count"] for v in colls.values()),
+            "collective_bytes": sum(v["bytes"] for v in colls.values()),
+            "hot_loop_collectives": sum(v["count"] for v in hot.values()),
+            "hot_loop_bytes": sum(v["bytes"] for v in hot.values()),
+            "temp_bytes": entry["memory"].get("temp_bytes"),
+            "donation_dropped": entry["donation"]["dropped"],
+        }
+    return summary
+
+
 # ---------------------------------------------------------------------------
 # The workload (runs inside the watchdogged child, or inline on CPU).
 # ---------------------------------------------------------------------------
@@ -226,6 +268,7 @@ STAGE_TIMEOUTS_S = {
     "rtt_probe": 120,
     "xl_point": 1500,
     "loss_variant": 900,
+    "hlo_audit": 600,
     "profile": 600,
 }
 
@@ -529,6 +572,22 @@ def run_workload(ledger, profile_dir=None) -> None:
     else:
         _mark("skipping churn_under_loss variant: past the XL time budget")
 
+    # Compiled-program audit (ISSUE 8, analysis family 12): compile the
+    # registered engine entrypoints at the fixed audit shapes ON THIS
+    # PLATFORM and embed the per-entrypoint collective/memory table, so the
+    # BENCH_r* trajectory carries the communication budget alongside the
+    # latency numbers and tools/perfview.py can flag collective-count
+    # drift between rounds. On TPU this is the first compiled-collective
+    # evidence per round; the lockfile GATE (CPU-pinned) stays in the test
+    # session — here the facts are recorded, not judged.
+    with ledger.stage("hlo_audit", timeout_s=_stage_timeout("hlo_audit")):
+        with _heartbeat("hlo audit compile"):
+            hlo_audit = hlo_audit_summary()
+        if "error" in hlo_audit:
+            _mark(f"hlo audit unavailable: {hlo_audit['error']}")
+        else:
+            _mark(f"hlo audit: {len(hlo_audit)} entrypoints compiled")
+
     # Opt-in jax.profiler capture (--profile DIR): one extra resolved churn
     # under utils/profiling.trace, as its own budgeted stage — TensorBoard/
     # Perfetto-grade device timelines when the operator asks for them,
@@ -578,6 +637,11 @@ def run_workload(ledger, profile_dir=None) -> None:
             cohorts=cohorts, value_ms=value,
         ),
         "device_rtt_ms": round(rtt_ms, 3),
+        # Compiled-program audit table (per-entrypoint collective/memory
+        # facts at the fixed audit shapes, or {"error": ...}): the
+        # trajectory's communication-budget axis — perfview flags
+        # collective-count drift between rounds from this.
+        "hlo_audit": hlo_audit,
         # Engine-tier provenance for the trajectory: how much compile time
         # this run paid and whether the persistent cache carried it.
         "compiles": engine_compiles["compiles"],

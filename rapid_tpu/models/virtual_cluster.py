@@ -649,7 +649,30 @@ def engine_step_impl(
 # Donating step for the long-running driver loop (state buffers reused in
 # place) and a non-donating variant for compile checks / sharded dry-runs.
 engine_step = jax.jit(engine_step_impl, static_argnums=(0,), donate_argnums=(1,))
-engine_step_nodonate = jax.jit(engine_step_impl, static_argnums=(0,))
+engine_step_nodonate = jax.jit(engine_step_impl, static_argnums=(0,))  # donate-ok: compile-check / dry-run variant; callers keep their state buffers
+
+
+def sync_checksum_impl(state: EngineState, faults: FaultInputs):
+    """Scalar checksum depending on every state/fault array — the barrier
+    ``VirtualCluster.sync`` fetches (``jax.block_until_ready`` does not
+    round-trip on remote-tunnel backends; a dependent scalar fetch does).
+    Module-level and jitted so the compiled-program gate audits the sync
+    dispatch like every other registered entrypoint."""
+    return (
+        jnp.sum(state.key_hi, dtype=jnp.uint32)
+        + jnp.sum(state.key_lo, dtype=jnp.uint32)
+        + jnp.sum(state.id_hi, dtype=jnp.uint32)
+        + jnp.sum(state.id_lo, dtype=jnp.uint32)
+        + jnp.sum(state.obs_idx).astype(jnp.uint32)
+        + jnp.sum(state.fd_count).astype(jnp.uint32)
+        + jnp.sum(state.report_bits).astype(jnp.uint32)
+        + jnp.sum(state.alive).astype(jnp.uint32)
+        + jnp.sum(faults.crashed).astype(jnp.uint32)
+        + jnp.sum(faults.probe_fail).astype(jnp.uint32)
+    )
+
+
+sync_checksum = jax.jit(sync_checksum_impl)  # donate-ok: read-only barrier; the state stays live
 
 
 def run_to_decision_impl(cfg: EngineConfig, state: EngineState, faults: FaultInputs, max_steps):
@@ -1138,24 +1161,10 @@ class VirtualCluster:
 
     def sync(self) -> int:
         """Force completion of all pending uploads/compute on the cluster
-        state and return a cheap checksum. ``jax.block_until_ready`` does not
-        round-trip on remote-tunnel backends; a scalar fetch that depends on
-        every state array does."""
-        state, faults = self.state, self.faults
-        total = (
-            jnp.sum(state.key_hi, dtype=jnp.uint32)
-            + jnp.sum(state.key_lo, dtype=jnp.uint32)
-            + jnp.sum(state.id_hi, dtype=jnp.uint32)
-            + jnp.sum(state.id_lo, dtype=jnp.uint32)
-            + jnp.sum(state.obs_idx).astype(jnp.uint32)
-            + jnp.sum(state.fd_count).astype(jnp.uint32)
-            + jnp.sum(state.report_bits).astype(jnp.uint32)
-            + jnp.sum(state.alive).astype(jnp.uint32)
-            + jnp.sum(faults.crashed).astype(jnp.uint32)
-            + jnp.sum(faults.probe_fail).astype(jnp.uint32)
-        )
+        state and return a cheap checksum (``sync_checksum_impl`` — one
+        compiled dispatch, audited by the device_program gate)."""
         with self._dispatch("sync"):
-            checksum = int(total)
+            checksum = int(sync_checksum(self.state, self.faults))
         self._account_d2h(4)
         return checksum
 

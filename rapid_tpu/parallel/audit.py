@@ -1,129 +1,36 @@
-"""Compiled-HLO collective audit for sharded engine programs.
+"""Compiled-HLO collective audit — thin re-export.
 
-parallel/mesh.py's communication story ("all of the engine's global
-reductions lower to psum; the ring argsort is the one collective-heavy op,
-and only at view changes") is a claim about what XLA's SPMD partitioner
-emits — so it is checked against the compiled artifact itself: parse every
-cross-device collective out of ``compiled.as_text()`` and classify it by the
-op_name metadata jax records ("…/while/body/…" = convergence hot loop,
-"…/cond/…" = lax.cond branch). ``tools/collective_audit.py`` builds the
-evidence table with this; ``tests/test_parallel.py`` pins the invariants.
+The classifier that lived here (collective-kind matching, payload
+accounting, the hot-loop/cond/prologue location attribution) grew into
+``rapid_tpu.parallel.hlo_facts`` when the ``device_program`` analyzer
+family (tools/analysis/device_program.py) started freezing its facts into
+``tools/analysis/hlo.lock.json``. This module stays as the compatible
+import surface for the existing consumers (``tests/test_parallel.py``,
+``tools/collective_audit.py``): same names, one definition, and a plain
+package-relative import — no path games, so an installed distribution of
+``rapid_tpu`` keeps working without the repo checkout.
 """
 
 from __future__ import annotations
 
-import re
-from typing import Dict, List
-
-COLLECTIVE_KINDS = (
-    "all-reduce",
-    "all-gather",
-    "reduce-scatter",
-    "collective-permute",
-    "all-to-all",
+from rapid_tpu.parallel.hlo_facts import (  # noqa: F401 — re-exported
+    COLLECTIVE_KINDS,
+    DTYPE_BITS,
+    audit_collectives,
+    classify_location,
+    collective_violations,
+    payload_class,
+    shape_bytes,
+    source_of,
 )
 
-_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
-                "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
-                "f64": 8}
-
-
-def _shape_bytes(shape_str: str) -> int:
-    """'(u32[64]{0}, …)' or 'u32[2,1024]{0,1}' -> total payload bytes."""
-    total = 0
-    for dtype, dims in re.findall(r"(\w+)\[([\d,]*)\]", shape_str):
-        elems = 1
-        for d in dims.split(","):
-            if d:
-                elems *= int(d)
-        total += elems * _DTYPE_BYTES.get(dtype, 4)
-    return total
-
-
-def classify_location(op_name: str) -> str:
-    """hot-loop / hot-loop-cond / cond / prologue, from op_name metadata."""
-    if "/while/body" in op_name:
-        if "/cond/" in op_name.split("/while/body", 1)[1]:
-            return "hot-loop-cond"
-        return "hot-loop"
-    if "/while/cond" in op_name:
-        # The while PREDICATE runs unconditionally every round — it is hot
-        # loop, not a gated branch (a generic '/cond/' test would exempt it
-        # from the invariants).
-        return "hot-loop"
-    if "/cond/" in op_name:
-        return "cond"
-    return "prologue"
-
-
-def source_of(op_name: str) -> str:
-    """Human label for the jax op a collective lowered from."""
-    markers = (
-        ("ring_topology", "view-change topology rebuild"),
-        ("classic_attempt", "classic-fallback attempt"),
-        ("tally_candidates", "fast-round vote tally"),
-        ("cumsum", "classic-fallback attempt"),
-        ("reduce_or", "round-body reduction"),
-        ("reduce_sum", "round-body reduction"),
-        ("reduce_max", "round-body reduction"),
-        ("gather", "cross-slot gather"),
-        ("sort", "sort"),
-        ("reduce", "reduction"),
-    )
-    for needle, label in markers:
-        if needle in op_name:
-            return label
-    return "other"
-
-
-def audit_collectives(compiled_text: str, n: int, c: int) -> List[Dict]:
-    """One row per collective op in the HLO text: kind, global shape,
-    payload bytes, location, source, and scale flags (n_scale = at least
-    [n]-proportional payload, cn_scale = at least [c,n]).
-
-    Matches both synchronous ops and the async ``-start`` halves TPU
-    compiles emit (``all-reduce-start``/``all-reduce-done`` pairs — the
-    ``-done`` half is skipped so pairs are not double-counted)."""
-    rows = []
-    for line in compiled_text.splitlines():
-        m = re.search(
-            r"= (\([^)]*\)|\S+?) ("
-            + "|".join(COLLECTIVE_KINDS)
-            + r")(-start)?\(",
-            line,
-        )
-        if not m:
-            continue
-        shape, kind = m.group(1), m.group(2)
-        op_name_m = re.search(r'op_name="([^"]*)"', line)
-        op_name = op_name_m.group(1) if op_name_m else ""
-        payload = _shape_bytes(shape)
-        rows.append({
-            "kind": kind,
-            "shape": shape.split("{")[0],
-            "bytes": payload,
-            "location": classify_location(op_name),
-            "source": source_of(op_name),
-            "cn_scale": payload >= c * n,
-            "n_scale": payload >= n,
-        })
-    return rows
-
-
-def collective_violations(rows: List[Dict]) -> Dict[str, List[Dict]]:
-    """The two invariants the sharded design guarantees."""
-    return {
-        # Every round, unconditionally: reductions only — an unconditional
-        # gather here would ship O(n)+ bytes per round for no reason.
-        "hot_loop_non_reduce": [
-            r for r in rows
-            if r["location"] == "hot-loop" and r["kind"] != "all-reduce"
-        ],
-        # [c,n]-sized traffic must be cond-gated (implicit invalidation,
-        # classic attempt, view-change re-sort) — never unconditional. The
-        # prologue may hold the hoisted [n]-scale edge gathers, nothing
-        # [c,n]-scale.
-        "unconditional_cn_anywhere": [
-            r for r in rows if r["cn_scale"] and "cond" not in r["location"]
-        ],
-    }
+__all__ = [
+    "COLLECTIVE_KINDS",
+    "DTYPE_BITS",
+    "audit_collectives",
+    "classify_location",
+    "collective_violations",
+    "payload_class",
+    "shape_bytes",
+    "source_of",
+]

@@ -1,0 +1,227 @@
+"""Compiled-HLO fact extraction: collectives, transfers, donation aliases.
+
+The canonical home of the classifier that started life as
+``rapid_tpu/parallel/audit.py`` (now a thin re-export): pure text parsing
+over ``compiled.as_text()``, no jax import, stdlib only — which is why it
+lives IN the packaged library (an installed wheel must be able to import
+it) while the ``device_program`` analyzer family
+(tools/analysis/device_program.py), the evidence-table CLI
+(tools/collective_audit.py), and the sharded-engine invariants test
+(tests/test_parallel.py) all consume it from here (tools depends on the
+library, never the reverse).
+
+Everything here is derived from two pieces of metadata XLA records in the
+compiled artifact: the shape string of each op (payload accounting) and the
+``op_name`` jax attaches (location attribution — "…/while/body/…" is the
+convergence hot loop, "…/cond/…" a lax.cond branch). The module header's
+``input_output_alias`` table is the compiled truth about buffer donation:
+a ``donate_argnums`` argument either appears there or was dropped.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+COLLECTIVE_KINDS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+#: Host<->device transfer ops: a compiled engine program must not smuggle
+#: host round-trips into the dispatch (the whole point of the fused-engine
+#: design); any of these appearing is budget-checked against the lock.
+TRANSFER_OPS = (
+    "infeed",
+    "outfeed",
+    "send",
+    "send-done",
+    "recv",
+    "recv-done",
+)
+
+#: Bits per element by HLO dtype token. Bits, not bytes: the sub-byte
+#: dtypes (s4/u4) pack two elements per byte and a byte table would have to
+#: lie about them.
+DTYPE_BITS = {
+    "pred": 8,
+    "s4": 4, "u4": 4,
+    "s8": 8, "u8": 8, "f8e4m3": 8, "f8e5m2": 8, "f8e4m3fn": 8,
+    "f8e4m3b11fnuz": 8, "f8e5m2fnuz": 8, "f8e4m3fnuz": 8,
+    "s16": 16, "u16": 16, "bf16": 16, "f16": 16,
+    "s32": 32, "u32": 32, "f32": 32,
+    "s64": 64, "u64": 64, "f64": 64, "c64": 64,
+    "c128": 128,
+}
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def shape_bytes(shape_str: str, unknown: Optional[List[str]] = None) -> int:
+    """'(u32[64]{0}, …)' or 'u32[2,1024]{0,1}' -> total payload bytes.
+
+    Handles tuple shapes with nested layout annotations (the ``{0,1}``
+    suffixes are not shape tokens and are ignored). A dtype missing from
+    ``DTYPE_BITS`` is never silently guessed: it is appended to ``unknown``
+    when a list is passed, else raises ``ValueError`` — the analyzer turns
+    collected unknowns into findings (``hlo-unknown-dtype``)."""
+    total_bits = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        bits = DTYPE_BITS.get(dtype)
+        if bits is None:
+            if unknown is None:
+                raise ValueError(f"unknown HLO dtype {dtype!r} in {shape_str!r}")
+            unknown.append(dtype)
+            continue
+        elems = 1
+        for d in dims.split(","):
+            if d:
+                elems *= int(d)
+        total_bits += elems * bits
+    return (total_bits + 7) // 8
+
+
+def classify_location(op_name: str) -> str:
+    """hot-loop / hot-loop-cond / cond / prologue, from op_name metadata."""
+    if "/while/body" in op_name:
+        if "/cond/" in op_name.split("/while/body", 1)[1]:
+            return "hot-loop-cond"
+        return "hot-loop"
+    if "/while/cond" in op_name:
+        # The while PREDICATE runs unconditionally every round — it is hot
+        # loop, not a gated branch (a generic '/cond/' test would exempt it
+        # from the invariants).
+        return "hot-loop"
+    if "/cond/" in op_name:
+        return "cond"
+    return "prologue"
+
+
+def source_of(op_name: str) -> str:
+    """Human label for the jax op a collective lowered from."""
+    markers = (
+        ("ring_topology", "view-change topology rebuild"),
+        ("classic_attempt", "classic-fallback attempt"),
+        ("tally_candidates", "fast-round vote tally"),
+        ("cumsum", "classic-fallback attempt"),
+        ("reduce_or", "round-body reduction"),
+        ("reduce_sum", "round-body reduction"),
+        ("reduce_max", "round-body reduction"),
+        ("gather", "cross-slot gather"),
+        ("sort", "sort"),
+        ("reduce", "reduction"),
+    )
+    for needle, label in markers:
+        if needle in op_name:
+            return label
+    return "other"
+
+
+def payload_class(nbytes: int, n: int, c: int) -> str:
+    """Scale class of a collective payload at engine shapes: ``cn`` ([c,n]
+    or larger), ``n`` (at least [n]-proportional), ``scalar`` otherwise.
+    The lockfile freezes the CLASS, not raw bytes, so a benign constant
+    tweak does not drift the gate while a scale-class jump always does."""
+    if nbytes >= c * n:
+        return "cn"
+    if nbytes >= n:
+        return "n"
+    return "scalar"
+
+
+PAYLOAD_CLASS_RANK = {"scalar": 0, "n": 1, "cn": 2}
+
+
+def audit_collectives(compiled_text: str, n: int, c: int) -> List[Dict]:
+    """One row per collective op in the HLO text: kind, global shape,
+    payload bytes, location, source, scale flags (n_scale = at least
+    [n]-proportional payload, cn_scale = at least [c,n]), and any unknown
+    dtype tokens the payload accounting could not size.
+
+    Matches both synchronous ops and the async ``-start`` halves TPU
+    compiles emit (``all-reduce-start``/``all-reduce-done`` pairs — the
+    ``-done`` half is skipped so pairs are not double-counted)."""
+    rows = []
+    for line in compiled_text.splitlines():
+        m = re.search(
+            r"= (\([^)]*\)|\S+?) ("
+            + "|".join(COLLECTIVE_KINDS)
+            + r")(-start)?\(",
+            line,
+        )
+        if not m:
+            continue
+        shape, kind = m.group(1), m.group(2)
+        op_name_m = re.search(r'op_name="([^"]*)"', line)
+        op_name = op_name_m.group(1) if op_name_m else ""
+        unknown: List[str] = []
+        payload = shape_bytes(shape, unknown=unknown)
+        rows.append({
+            "kind": kind,
+            "shape": shape.split("{")[0],
+            "bytes": payload,
+            "location": classify_location(op_name),
+            "source": source_of(op_name),
+            "cn_scale": payload >= c * n,
+            "n_scale": payload >= n,
+            "unknown_dtypes": sorted(set(unknown)),
+        })
+    return rows
+
+
+def collective_violations(rows: List[Dict]) -> Dict[str, List[Dict]]:
+    """The two invariants the sharded design guarantees."""
+    return {
+        # Every round, unconditionally: reductions only — an unconditional
+        # gather here would ship O(n)+ bytes per round for no reason.
+        "hot_loop_non_reduce": [
+            r for r in rows
+            if r["location"] == "hot-loop" and r["kind"] != "all-reduce"
+        ],
+        # [c,n]-sized traffic must be cond-gated (implicit invalidation,
+        # classic attempt, view-change re-sort) — never unconditional. The
+        # prologue may hold the hoisted [n]-scale edge gathers, nothing
+        # [c,n]-scale.
+        "unconditional_cn_anywhere": [
+            r for r in rows if r["cn_scale"] and "cond" not in r["location"]
+        ],
+    }
+
+
+def count_transfer_ops(compiled_text: str) -> Dict[str, int]:
+    """Host<->device transfer ops per kind (zero entries omitted)."""
+    counts: Dict[str, int] = {}
+    pattern = re.compile(
+        r"= (?:\([^)]*\)|\S+?) (" + "|".join(TRANSFER_OPS) + r")\("
+    )
+    for line in compiled_text.splitlines():
+        m = pattern.search(line)
+        if m:
+            counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+    return counts
+
+
+#: One alias-table entry: ``{output_index}: (param, {param_index}, kind)``.
+#: Parsed straight off the ``HloModule`` header line — the entry shape is
+#: specific enough that no other header field matches it, which sidesteps
+#: brace-balancing the ``input_output_alias={...}`` table (its entries
+#: contain ``}, `` themselves).
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{[\d,\s]*\}:\s*\((\d+),\s*\{[\d,\s]*\},\s*(may-alias|must-alias)\)"
+)
+
+
+def input_output_aliases(compiled_text: str) -> List[Tuple[int, str]]:
+    """The module header's donation outcomes: one ``(parameter_number,
+    alias_kind)`` per output buffer XLA agreed to alias onto an input.
+    Empty when nothing was donated — or when every donation was dropped."""
+    header = compiled_text.splitlines()[0] if compiled_text else ""
+    if "input_output_alias=" not in header:
+        return []
+    return [
+        (int(param), kind)
+        for param, kind in _ALIAS_ENTRY_RE.findall(header)
+    ]

@@ -58,10 +58,10 @@ def state_shardings(mesh: Mesh) -> EngineState:
         obs_idx=sh(None, NODE_AXIS),
         subj_idx=sh(None, NODE_AXIS),
         inval_obs=sh(None, NODE_AXIS),
-        config_epoch=sh(),
-        config_hi=sh(),
-        config_lo=sh(),
-        n_members=sh(),
+        config_epoch=sh(),  # replicated-ok: per-configuration scalar
+        config_hi=sh(),  # replicated-ok: config-id scalar lane
+        config_lo=sh(),  # replicated-ok: config-id scalar lane
+        n_members=sh(),  # replicated-ok: membership-size scalar
         fd_count=sh(NODE_AXIS, None),
         fd_hist=sh(NODE_AXIS, None),
         fd_fired=sh(NODE_AXIS, None),
@@ -69,23 +69,23 @@ def state_shardings(mesh: Mesh) -> EngineState:
         join_pending=sh(NODE_AXIS),
         cohort_of=sh(NODE_AXIS),
         report_bits=sh(None, NODE_AXIS),
-        seen_down=sh(),
+        seen_down=sh(),  # replicated-ok: [c] cohort flags; the cohort axis is not meshed
         released=sh(None, NODE_AXIS),
-        announced=sh(),
+        announced=sh(),  # replicated-ok: [c] cohort flags; the cohort axis is not meshed
         prop_mask=sh(None, NODE_AXIS),
-        prop_hi=sh(),
-        prop_lo=sh(),
+        prop_hi=sh(),  # replicated-ok: [c] proposal-id lanes; cohort axis not meshed
+        prop_lo=sh(),  # replicated-ok: [c] proposal-id lanes; cohort axis not meshed
         vote_hi=sh(NODE_AXIS),
         vote_lo=sh(NODE_AXIS),
         vote_valid=sh(NODE_AXIS),
-        rounds_undecided=sh(),
+        rounds_undecided=sh(),  # replicated-ok: fallback-timer scalar
         cp_rnd_r=sh(NODE_AXIS),
         cp_rnd_i=sh(NODE_AXIS),
         cp_vrnd_r=sh(NODE_AXIS),
         cp_vrnd_i=sh(NODE_AXIS),
         cp_vval_src=sh(NODE_AXIS),
-        classic_epoch=sh(),
-        round_idx=sh(),
+        classic_epoch=sh(),  # replicated-ok: classic-attempt scalar
+        round_idx=sh(),  # replicated-ok: round-counter scalar
         retired=sh(NODE_AXIS),
     )
 

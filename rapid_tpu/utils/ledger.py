@@ -71,6 +71,7 @@ STAGE_NAMES = frozenset({
     "rtt_probe",
     "xl_point",
     "loss_variant",
+    "hlo_audit",
     "profile",
 })
 

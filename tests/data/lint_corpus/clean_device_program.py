@@ -1,0 +1,84 @@
+"""Lint corpus (clean): compiled programs whose inline locks match.
+
+Three shapes the ``device_program`` family must stay silent on: a sharded
+hot loop whose lock records its (reduce-class) collective exactly, an
+elementwise program whose donation genuinely aliases, and a reduction whose
+dropped donation carries an explicit waiver.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+AUDIT_N = 64
+AUDIT_C = 8
+
+
+def _hot_loop_psum():
+    mesh = Mesh(np.array(jax.devices()[:8]), ("nodes",))
+
+    def per_shard(xs):
+        def cond(carry):
+            return carry[1] < 8
+
+        def body(carry):
+            xs, i = carry
+            total = jax.lax.psum(jnp.sum(xs), "nodes")  # scalar all-reduce
+            return xs + total / AUDIT_N, i + 1
+
+        out, _ = jax.lax.while_loop(cond, body, (xs, jnp.int32(0)))
+        return out
+
+    fn = shard_map(
+        per_shard, mesh=mesh, in_specs=P("nodes"), out_specs=P("nodes"),
+        check_rep=False,
+    )
+    return {"jit": jax.jit(fn), "args": (jnp.arange(AUDIT_N, dtype=jnp.float32),)}
+
+
+def _elementwise_donating():
+    return {
+        "jit": jax.jit(lambda x: x + 1.0, donate_argnums=(0,)),
+        "args": (jnp.arange(AUDIT_N, dtype=jnp.float32),),
+        "donated_leaves": 1,
+    }
+
+
+def _sum_with_waiver():
+    return {
+        "jit": jax.jit(lambda x: jnp.sum(x), donate_argnums=(0,)),
+        "args": (jnp.arange(AUDIT_N, dtype=jnp.float32),),
+        "donated_leaves": 1,
+        "waiver": "scalar reduction: no output buffer can reuse the input",
+    }
+
+
+HLO_AUDIT_PROGRAMS = {
+    "hot_loop_psum": _hot_loop_psum,
+    "elementwise_donating": _elementwise_donating,
+    "sum_waived": _sum_with_waiver,
+}
+
+HLO_LOCK = {
+    "hot_loop_psum": {
+        "collectives": {
+            "hot-loop/all-reduce": {
+                "count": 1, "bytes": 4, "max_bytes": 4, "class": "scalar",
+            },
+        },
+        "transfers": {},
+    },
+    "elementwise_donating": {
+        "collectives": {},
+        "donation": {"donated_leaves": 1, "aliased": 1, "dropped": 0},
+    },
+    "sum_waived": {
+        "donation": {
+            "donated_leaves": 1, "aliased": 0, "dropped": 1,
+            "waiver": "scalar reduction: no output buffer can reuse the input",
+        },
+    },
+}

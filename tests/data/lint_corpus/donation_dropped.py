@@ -1,0 +1,34 @@
+"""Lint corpus: a ``donate_argnums`` buffer XLA silently refuses to alias.
+
+The program donates its [64] input but returns only a scalar reduction —
+no output buffer can reuse the donated storage, so the donation is dropped
+(XLA reports the unusable buffer at compile time). The inline lock claims
+the donation lands; the gate must fail with ``hlo-donation-dropped``
+carrying XLA's reason, never freeze the drop silently.
+"""
+
+import jax
+import jax.numpy as jnp
+
+AUDIT_N = 64
+AUDIT_C = 8
+
+
+def _sum_with_dropped_donation():
+    return {
+        "jit": jax.jit(lambda x: jnp.sum(x), donate_argnums=(0,)),
+        "args": (jnp.arange(AUDIT_N, dtype=jnp.float32),),
+        "donated_leaves": 1,
+    }
+
+
+HLO_AUDIT_PROGRAMS = {
+    "sum_donating": _sum_with_dropped_donation,  # expect: hlo-donation-dropped
+}
+
+#: What this program CLAIMS: the donated buffer is reused for the output.
+HLO_LOCK = {
+    "sum_donating": {
+        "donation": {"donated_leaves": 1, "aliased": 1, "dropped": 0},
+    },
+}

@@ -1,0 +1,55 @@
+"""Lint corpus: an all-gather smuggled into the convergence hot loop.
+
+The miniature program shards a [64] vector over the 8-device mesh and
+gathers the FULL vector inside the while body — exactly the regression the
+compiled-program gate exists to catch (an unconditional O(n) gather per
+round). The inline ``HLO_LOCK`` freezes the budget this program claims
+(reductions only, i.e. no collectives recorded), so the compiled artifact
+drifts from it and the gate must fail naming the entrypoint, the hot-loop
+location class, and the payload delta.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+AUDIT_N = 64
+AUDIT_C = 8
+
+
+def _hot_loop_gather():
+    mesh = Mesh(np.array(jax.devices()[:8]), ("nodes",))
+
+    def per_shard(xs):
+        def cond(carry):
+            return carry[1] < 8
+
+        def body(carry):
+            xs, i = carry
+            # THE defect: the full [n] vector crosses the mesh every round.
+            full = jax.lax.all_gather(xs, "nodes", tiled=True)
+            return xs + jnp.sum(full) / full.size, i + 1
+
+        out, _ = jax.lax.while_loop(cond, body, (xs, jnp.int32(0)))
+        return out
+
+    fn = shard_map(
+        per_shard, mesh=mesh, in_specs=P("nodes"), out_specs=P("nodes"),
+        check_rep=False,
+    )
+    return {"jit": jax.jit(fn), "args": (jnp.arange(AUDIT_N, dtype=jnp.float32),)}
+
+
+HLO_AUDIT_PROGRAMS = {
+    "hot_loop_gather": _hot_loop_gather,  # expect: hlo-collective-budget
+}
+
+#: What this program CLAIMS: a collective-free hot loop.
+HLO_LOCK = {
+    "hot_loop_gather": {
+        "collectives": {},
+    },
+}

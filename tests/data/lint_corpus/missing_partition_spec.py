@@ -1,0 +1,35 @@
+"""Lint corpus: sharding-table holes for the engine state pytree.
+
+A miniature ``EngineState`` + ``state_shardings`` pair in one module (the
+real pair is split across models/state.py and parallel/mesh.py; tree sweeps
+merge those the way wire sweeps merge the schema mirrors): one array leaf
+has no declared spec at all, one is silently fully replicated without a
+``# replicated-ok:`` reason, and one table entry names a field that does
+not exist.
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NODE_AXIS = "nodes"
+
+
+class EngineState(NamedTuple):
+    alive: jnp.ndarray  # [n]
+    votes: jnp.ndarray  # [n] — MISSING from the table below
+    round_idx: jnp.ndarray  # scalar
+    epoch: jnp.ndarray  # scalar
+
+
+def state_shardings(mesh: Mesh) -> EngineState:
+    def sh(*spec) -> NamedSharding:
+        return NamedSharding(mesh, P(*spec))
+
+    return EngineState(  # expect: missing-partition-spec
+        alive=sh(NODE_AXIS),
+        round_idx=sh(),  # expect: missing-partition-spec
+        epoch=sh(),  # replicated-ok: round-counter scalar
+        ghost=sh(NODE_AXIS),  # expect: missing-partition-spec
+    )

@@ -1,0 +1,23 @@
+"""Lint corpus: a bare Python literal in a traced jit position.
+
+``run(cfg, state, 96)`` traces with ``weak_type=True``; the wrapped
+``jnp.int32(96)`` call next to it traces AGAIN — one silent recompile per
+spelling of the same value.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def run_impl(cfg, values, max_steps):
+    del cfg
+    return values * max_steps
+
+
+run = jax.jit(run_impl, static_argnums=(0,))
+
+
+def drive(cfg, values):
+    bare = run(cfg, values, 96)  # expect: retrace-hazard
+    wrapped = run(cfg, values, jnp.int32(96))
+    return bare, wrapped

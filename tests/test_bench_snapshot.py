@@ -381,3 +381,24 @@ def test_derived_metrics_reject_degenerate_wallclock():
             n=100, n_join=1, n_crash=1, k_rings=10, cohorts=4, value_ms=0.0
         )
 
+
+
+def test_hlo_audit_summary_embeds_per_entrypoint_budget_table():
+    # The bench's hlo_audit stage embeds this table in the metric JSON:
+    # one row per registered entrypoint with the collective counts the
+    # perfview trajectory diffs (hlo-drift), plus temp memory and donation
+    # outcomes. Compiles ride the process-wide session cache shared with
+    # the staticcheck gate, so this costs nothing extra in a full session.
+    table = bench.hlo_audit_summary()
+    assert "error" not in table, table
+    assert {"step", "run_to_decision", "run_until_membership", "sync",
+            "sharded_step", "sharded_wave"} == set(table)
+    for name, row in table.items():
+        assert set(row) == {
+            "collectives", "collective_bytes", "hot_loop_collectives",
+            "hot_loop_bytes", "temp_bytes", "donation_dropped",
+        }, name
+        assert row["donation_dropped"] == 0, name
+    # Sharded programs communicate; single-device ones must not.
+    assert table["sharded_wave"]["hot_loop_collectives"] > 0
+    assert table["step"]["collectives"] == 0

@@ -190,3 +190,40 @@ def test_errors_cleanly_on_bad_inputs(tmp_path, capsys):
     bad.write_text("{nope")
     assert perfview.main([str(bad)]) == 2
     assert "invalid JSON" in capsys.readouterr().err
+
+
+def test_trajectory_flags_collective_count_drift(tmp_path, capsys):
+    # Rounds carrying bench.py's hlo_audit table are diffed pairwise: a
+    # collective-count change between audited rounds flags the LATER point
+    # hlo-drift; un-audited (or errored) rounds in between neither flag
+    # nor reset the comparison baseline.
+    def audit(hot):
+        return {"sharded_wave": {"collectives": 10 + hot,
+                                 "hot_loop_collectives": hot,
+                                 "temp_bytes": 1000, "donation_dropped": 0}}
+
+    points = {
+        "BENCH_r11.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit(hot=2)},
+        "BENCH_r12.json": {"metric": "m", "value": 1.0, "platform": "cpu"},
+        "BENCH_r13.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": {"error": "no devices"}},
+        "BENCH_r14.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit(hot=3)},
+        "BENCH_r15.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit(hot=3)},
+    }
+    paths = []
+    for name, data in points.items():
+        p = tmp_path / name
+        p.write_text(json.dumps(data))
+        paths.append(str(p))
+    assert perfview.main(paths) == 0
+    out = capsys.readouterr().out
+    lines = {line.split()[0]: line for line in out.splitlines()
+             if line.startswith("BENCH_r1")}
+    assert "hlo-drift" not in lines["BENCH_r11"]  # nothing earlier to diff
+    assert "live" in lines["BENCH_r12"]  # un-audited round: no flag
+    assert "live" in lines["BENCH_r13"]  # errored audit: no flag
+    assert "hlo-drift" in lines["BENCH_r14"]  # 2 -> 3 vs r11's baseline
+    assert "hlo-drift" not in lines["BENCH_r15"]  # stable vs r14

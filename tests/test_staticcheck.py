@@ -284,19 +284,23 @@ def test_narrowed_roots_skip_liveness(tmp_path, monkeypatch):
 
 def test_whole_tree_is_finding_free():
     # The gate itself: resolution-tier findings fail the build exactly the
-    # way error-prone fails the reference's. All nine check families run
-    # (names, signatures, clock, dead-defs, concurrency, trace-safety,
-    # wire-schema + lockfile, dispatch, taskflow) — and the full sweep must
-    # stay fast enough to live in the ordinary test session (<15 s of CPU;
-    # it uses ~8 s today). Process CPU time, not wall-clock: a loaded CI
-    # machine must not fail the gate — only an analyzer going superlinear.
+    # way error-prone fails the reference's. All thirteen check families
+    # run — including the compiled-program gate (device_program), whose
+    # entrypoint compiles are collected ONCE per process; pre-warm that
+    # session cache here so this budget pins the ANALYSIS cost, not the
+    # compile cost (tests/test_lint.py budgets the compile-inclusive sweep
+    # separately). Process CPU time, not wall-clock: a loaded CI machine
+    # must not fail the gate — only an analyzer going superlinear.
     import time
 
+    staticcheck.collect_facts()  # session-shared; test_hlo_gate.py pins it
     started = time.process_time()
     findings = staticcheck.run()
     elapsed = time.process_time() - started
     assert not findings, "\n".join(str(f) for f in findings)
-    assert elapsed < 15.0, f"nine-family tree sweep used {elapsed:.1f}s CPU (budget 15s)"
+    assert elapsed < 15.0, (
+        f"thirteen-family tree sweep used {elapsed:.1f}s CPU (budget 15s)"
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -354,6 +358,16 @@ _CORPUS_CHECKERS = {
     "clean_determinism.py": ("rapid_tpu/messaging/_corpus.py", "check_determinism"),
     "ledger_event_name.py": ("rapid_tpu/models/_corpus.py", "check_ledger"),
     "clean_ledger.py": ("rapid_tpu/models/_corpus.py", "check_ledger"),
+    # device_program corpus files COMPILE their miniature programs (on the
+    # session's 8-device CPU mesh) and compare against the inline HLO_LOCK
+    # each carries — the compiled-artifact twin of the AST corpus.
+    "hot_loop_collective.py": ("rapid_tpu/models/_corpus.py", "check_device_program"),
+    "donation_dropped.py": ("rapid_tpu/models/_corpus.py", "check_device_program"),
+    "clean_device_program.py": ("rapid_tpu/models/_corpus.py", "check_device_program"),
+    "host_sync_in_hot_path.py": ("rapid_tpu/ops/_corpus.py", "check_sharding"),
+    "missing_partition_spec.py": ("rapid_tpu/parallel/_corpus.py", "check_sharding"),
+    "retrace_hazard.py": ("rapid_tpu/models/_corpus.py", "check_sharding"),
+    "clean_sharding.py": ("rapid_tpu/parallel/_corpus.py", "check_sharding"),
 }
 
 
@@ -786,7 +800,7 @@ def test_cli_json_select_ignore_and_exit_codes(tmp_path):
 
 
 def test_cli_families_lists_all_families():
-    assert len(staticcheck.FAMILIES) == 11
+    assert len(staticcheck.FAMILIES) == 13
     result = _run_cli("--families")
     assert result.returncode == 0
     for name, _description in staticcheck.FAMILIES:
@@ -811,3 +825,58 @@ def test_cli_update_wire_lock_is_a_deterministic_round_trip(
     assert rc == 0
     assert "wrote" in capsys.readouterr().out
     assert target.read_text() == committed
+
+
+# ---------------------------------------------------------------------------
+# Sharding analyzer: *_argnames spellings must resolve, not false-positive
+# ---------------------------------------------------------------------------
+
+
+def _sharding(source: str, rel: str = "rapid_tpu/models/_probe.py"):
+    return staticcheck.check_sharding(
+        staticcheck.core.REPO / rel, source=textwrap.dedent(source)
+    )
+
+
+def test_donate_argnames_spelling_is_recognized_not_flagged():
+    # donate_argnames=("state",) donates the pytree just as argnums would —
+    # flagging it (and demanding a bogus # donate-ok:) violates
+    # skip-don't-guess.
+    findings = _sharding(
+        """
+        import jax
+
+        def step_impl(cfg, state, faults):
+            del cfg
+            return state + faults
+
+        step = jax.jit(step_impl, static_argnums=(0,),
+                       donate_argnames=("state",))
+        """
+    )
+    assert findings == [], findings
+
+
+def test_static_argnames_pins_the_position_for_retrace_check():
+    # jax maps static_argnames onto positions for positional calls, so a
+    # bare literal there never retraces; an unpinned traced position next
+    # to it must still flag.
+    findings = _sharding(
+        """
+        import jax
+
+        def run_impl(cfg, values, max_steps, rounds):
+            del cfg
+            return values * max_steps * rounds
+
+        run = jax.jit(run_impl, static_argnums=(0,),
+                      static_argnames=("max_steps",))
+
+        def drive(cfg, values):
+            ok = run(cfg, values, 96, jax.numpy.int32(4))
+            bad = run(cfg, values, 96, 4)
+            return ok, bad
+        """
+    )
+    assert [f.check for f in findings] == ["retrace-hazard"], findings
+    assert "position 3" in findings[0].message, findings[0].message

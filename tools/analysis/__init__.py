@@ -26,9 +26,21 @@ Check families (one module each; ``core`` owns the driver/CLI/Finding):
 10. ``determinism`` — no unseeded randomness in the library: every rng is
                       injectable or identity-seeded, so simulated chaos
                       runs (rapid_tpu/sim) are pure functions of one seed
+11. ``ledger``      — run-ledger vocabulary discipline (LedgerEvent /
+                      STAGE_NAMES)
+12. ``device_program`` — the compiled artifact itself: every registered
+                      jitted engine entrypoint compiled on a forced
+                      8-device CPU mesh, its collectives/transfers/
+                      donation/memory facts frozen in ``hlo.lock.json``
+13. ``sharding``    — source seams that produce bad compiled programs:
+                      partition-spec coverage of the engine state pytree,
+                      host syncs inside traced hot paths, jit callsites
+                      that forget buffer donation or invite retraces
+                      (ops/models/parallel)
 
-``staticcheck --families`` prints this catalog; ``--update-wire-lock``
-regenerates the wire lockfile after an intentional schema change.
+``staticcheck --families`` prints this catalog; ``--update-wire-lock`` /
+``--update-hlo-lock`` regenerate the lockfiles after an intentional
+schema / compiled-budget change.
 
 Shared philosophy: conservative resolution, zero-false-positive findings,
 skip-don't-guess. Run via ``python tools/staticcheck.py`` (the compatible
@@ -51,9 +63,17 @@ from .core import (
 )
 from .deadcode import check_dead_definitions
 from .determinism import DETERMINISM_PREFIXES, check_determinism
+from .device_program import (
+    HLO_LOCK_REL,
+    check_device_program,
+    check_hlo_lock,
+    collect_facts,
+    update_hlo_lock,
+)
 from .dispatch import DISPATCH_PREFIXES, check_dispatch
 from .ledger import LEDGER_PREFIXES, check_ledger
 from .names import check_undefined_names
+from .sharding import SHARDING_PREFIXES, check_partition_specs, check_sharding
 from .signatures import check_call_signatures
 from .taskflow import TASKFLOW_PREFIXES, check_taskflow
 from .trace_safety import TRACE_SAFETY_PREFIXES, check_trace_safety
@@ -74,8 +94,10 @@ __all__ = [
     "DISPATCH_PREFIXES",
     "FAMILIES",
     "Finding",
+    "HLO_LOCK_REL",
     "LEDGER_PREFIXES",
     "LOCK_REL",
+    "SHARDING_PREFIXES",
     "TASKFLOW_PREFIXES",
     "TRACE_SAFETY_PREFIXES",
     "WIRE_FILES",
@@ -84,16 +106,22 @@ __all__ = [
     "check_concurrency",
     "check_dead_definitions",
     "check_determinism",
+    "check_device_program",
     "check_dispatch",
+    "check_hlo_lock",
     "check_ledger",
+    "check_partition_specs",
+    "check_sharding",
     "check_taskflow",
     "check_trace_safety",
     "check_undefined_names",
     "check_wire_lock",
     "check_wire_schema",
+    "collect_facts",
     "core",
     "iter_files",
     "main",
     "run",
+    "update_hlo_lock",
     "update_wire_lock",
 ]

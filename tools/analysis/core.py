@@ -84,6 +84,18 @@ ALL_CHECK_NAMES = frozenset({
     # ledger family
     "ledger-event-name",
     "ledger-stage-name",
+    # device_program family (compiled-HLO budgets vs hlo.lock.json)
+    "hlo-collective-budget",
+    "hlo-transfer-budget",
+    "hlo-donation-dropped",
+    "hlo-memory-budget",
+    "hlo-unknown-dtype",
+    "hlo-lock-drift",
+    # sharding family
+    "missing-partition-spec",
+    "host-sync-in-hot-path",
+    "donation-mismatch",
+    "retrace-hazard",
 })
 
 #: The check families, in documentation order — one (name, description)
@@ -107,6 +119,12 @@ FAMILIES = (
                     "are pure functions of their seed"),
     ("ledger", "run-ledger vocabulary discipline: emit() events from "
                "LedgerEvent, stage() names from STAGE_NAMES"),
+    ("device_program", "compiled-HLO budgets for the registered engine "
+                       "entrypoints (collectives, transfers, donation, "
+                       "memory) frozen in hlo.lock.json"),
+    ("sharding", "engine sharding discipline: partition-spec coverage, "
+                 "host syncs in the hot path, donation/static-argnames at "
+                 "jit seams (ops/models/parallel)"),
 )
 
 
@@ -172,8 +190,9 @@ def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
     # The per-file check imports live here (not module top level) so the
     # CLI shim can import this module before sys.path is fully arranged.
     from . import (
-        clocks, concurrency, deadcode, determinism, dispatch, ledger, names,
-        signatures, taskflow, trace_safety, wire_schema,
+        clocks, concurrency, deadcode, determinism, device_program, dispatch,
+        ledger, names, sharding, signatures, taskflow, trace_safety,
+        wire_schema,
     )
 
     per_file_checks = [
@@ -186,6 +205,7 @@ def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
         taskflow.check_taskflow,
         determinism.check_determinism,
         ledger.check_ledger,
+        sharding.check_sharding,
     ]
     full_tree = tuple(roots) == DEFAULT_ROOTS
     if not full_tree:
@@ -229,6 +249,12 @@ def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
         # mirror files, which a narrowed root set may not all contain.
         findings.extend(deadcode.check_dead_definitions(trees))
         findings.extend(wire_schema.check_wire_lock(trees))
+        # The compiled-program and merged partition-spec gates are likewise
+        # whole-surface: both presence-gate on this repo's real engine
+        # files, so retargeted test trees skip them (and never pay the
+        # device_program family's session-cached compiles).
+        findings.extend(sharding.check_partition_specs(trees))
+        findings.extend(device_program.check_hlo_lock(trees))
     return findings
 
 
@@ -262,6 +288,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="regenerate tools/analysis/wire.lock.json from "
                              "the live schema mirrors (refuses while the "
                              "mirrors disagree with each other)")
+    parser.add_argument("--update-hlo-lock", action="store_true",
+                        dest="update_hlo_lock",
+                        help="recompile the registered engine entrypoints "
+                             "and regenerate tools/analysis/hlo.lock.json "
+                             "(refuses while an unknown dtype or an "
+                             "unwaived dropped donation is present)")
     args = parser.parse_args(argv)
     if args.families:
         for name, description in FAMILIES:
@@ -276,6 +308,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f)
             print("staticcheck: refusing to lock an inconsistent wire "
                   "surface — fix the mirror disagreements above first")
+            return 1
+        print(f"wrote {lock_path}")
+        return 0
+    if args.update_hlo_lock:
+        from . import device_program
+
+        findings, lock_path = device_program.update_hlo_lock()
+        if findings:
+            for f in findings:
+                print(f)
+            print("staticcheck: refusing to lock a compiled-program surface "
+                  "the gate would immediately fail — fix the findings above "
+                  "first")
             return 1
         print(f"wrote {lock_path}")
         return 0

@@ -1,0 +1,650 @@
+"""Check family 12: compiled-program conformance (the HLO budget gate).
+
+The engine's communication story is a claim about what XLA emits, so this
+family checks the compiled artifact itself: every registered jitted engine
+entrypoint (the ``VirtualCluster`` dispatch surface plus the
+``parallel/mesh.py`` sharded variants under a forced 8-device CPU mesh) is
+compiled via ``jax.jit(...).lower().compile()`` and its facts extracted
+from ``as_text()`` + ``memory_analysis()``:
+
+- every cross-device collective, classified by kind, payload bytes/class,
+  and location (hot-loop / hot-loop-cond / cond / prologue — the
+  ``hlo_facts`` classifier that absorbed ``rapid_tpu/parallel/audit.py``);
+- host<->device transfer ops (infeed/outfeed/send/recv);
+- donation outcomes: each ``donate_argnums`` leaf either aliased in the
+  compiled output (``input_output_alias``) or dropped — a drop without an
+  explicit registry waiver is a finding, never a frozen fact;
+- argument/output/temp/generated-code memory bytes.
+
+The facts freeze into the committed lockfile
+``tools/analysis/hlo.lock.json``. Drift — a new hot-loop collective, a
+payload-class increase, a lost donation, temp-memory growth beyond
+tolerance — fails the gate naming the entrypoint and the delta, until the
+developer regenerates via ``python tools/staticcheck.py --update-hlo-lock``
+and reviews the diff (the ``wire.lock.json`` workflow, applied to the
+compiled program instead of the wire schema).
+
+Compiling is expensive relative to AST checks (~15 s for the six
+entrypoints), so facts are collected ONCE per process and cached: the
+tree-sweep gate, the lock regenerator, the bench's ``hlo_audit`` stage and
+every test share one collection. ``check_device_program`` is the per-file
+mode for the seeded lint corpus: a module defining ``HLO_AUDIT_PROGRAMS``
+(name -> zero-arg builder returning ``{"jit": jitted, "args": (...),
+"donated_leaves": int}``) and ``HLO_LOCK`` is compiled and compared against
+its own inline lock — the corpus way to pin an injected hot-loop
+all-gather or a dropped donation, finding by finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import warnings
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import core, hlo_facts
+from .core import Finding
+
+#: The committed freeze of the compiled-program facts, repo-relative.
+HLO_LOCK_REL = "tools/analysis/hlo.lock.json"
+
+#: The source files the registry compiles — the tree-mode gate only runs
+#: when a sweep actually covers this repo's engine (tests that retarget
+#: ``core.REPO`` at a temporary tree must not trigger 15 s of compiles).
+REGISTRY_SOURCES = (
+    "rapid_tpu/models/virtual_cluster.py",
+    "rapid_tpu/parallel/mesh.py",
+)
+
+#: Audit shapes: small enough to compile in seconds, large enough that the
+#: payload classes ([n]-scale vs [c,n]-scale) are unambiguous. The mesh
+#: axis needs AUDIT_DEVICES to divide AUDIT_N.
+AUDIT_N = 256
+AUDIT_C = 8
+AUDIT_K = 4
+AUDIT_DEVICES = 8
+
+#: Relative tolerance + absolute slack for the temp/codegen memory
+#: comparison: XLA's buffer assignment may legitimately wobble a little
+#: between versions; growth beyond this is a real regression.
+MEMORY_REL_TOL = 0.10
+MEMORY_ABS_SLACK = 4096
+
+#: Memory keys compared exactly (shape-determined) vs under tolerance
+#: (scheduler-determined).
+_EXACT_MEMORY_KEYS = ("argument_bytes", "output_bytes")
+_TOLERANT_MEMORY_KEYS = ("temp_bytes", "generated_code_bytes")
+
+_REGEN_HINT = (
+    "if this compiled-program change is intentional, regenerate via "
+    "`python tools/staticcheck.py --update-hlo-lock` and review the diff"
+)
+
+
+# -- program registry -------------------------------------------------------
+
+
+def _build_registry() -> "Dict[str, Dict[str, Any]]":
+    """name -> {"jit": jitted, "args": tuple, "donated_leaves": int,
+    "waiver": Optional[str]} for every registered engine entrypoint, at the
+    audit shapes. Imports jax and the engine lazily: the rest of the
+    analysis package stays importable without a backend."""
+    import jax
+    import jax.numpy as jnp
+
+    from rapid_tpu.models.virtual_cluster import (
+        VirtualCluster,
+        engine_step_impl,
+        run_to_decision_impl,
+        run_until_membership_impl,
+        sync_checksum_impl,
+    )
+    from rapid_tpu.parallel.mesh import (
+        make_mesh,
+        make_sharded_step,
+        make_sharded_wave,
+        shard_faults,
+        shard_state,
+    )
+
+    vc = VirtualCluster.create(
+        AUDIT_N - AUDIT_DEVICES, n_slots=AUDIT_N, k=AUDIT_K, h=3, l=1,
+        fd_threshold=2, cohorts=AUDIT_C, delivery_spread=2, seed=0,
+    )
+    vc.assign_cohorts_roundrobin()
+    cfg = vc.cfg
+    state, faults = vc.state, vc.faults
+    state_leaves = len(jax.tree_util.tree_leaves(state))
+
+    registry: Dict[str, Dict[str, Any]] = {
+        "step": {
+            "jit": jax.jit(
+                lambda s, f: engine_step_impl(cfg, s, f), donate_argnums=(0,)
+            ),
+            "args": (state, faults),
+            "donated_leaves": state_leaves,
+        },
+        "run_to_decision": {
+            "jit": jax.jit(
+                lambda s, f: run_to_decision_impl(cfg, s, f, jnp.int32(96)),
+                donate_argnums=(0,),
+            ),
+            "args": (state, faults),
+            "donated_leaves": state_leaves,
+        },
+        "run_until_membership": {
+            "jit": jax.jit(
+                lambda s, f: run_until_membership_impl(
+                    cfg, s, f, jnp.int32(AUDIT_N - AUDIT_DEVICES),
+                    jnp.int32(192), 8, jnp.int32(0),
+                ),
+                donate_argnums=(0,),
+            ),
+            "args": (state, faults),
+            "donated_leaves": state_leaves,
+        },
+        "sync": {
+            "jit": jax.jit(sync_checksum_impl),
+            "args": (state, faults),
+            "donated_leaves": 0,
+        },
+    }
+    if jax.device_count() >= AUDIT_DEVICES:
+        mesh = make_mesh(jax.devices()[:AUDIT_DEVICES])
+        sh_state = shard_state(state, mesh)
+        sh_faults = shard_faults(faults, mesh)
+        registry["sharded_step"] = {
+            "jit": make_sharded_step(cfg, mesh),
+            "args": (sh_state, sh_faults),
+            "donated_leaves": state_leaves,
+        }
+        registry["sharded_wave"] = {
+            "jit": make_sharded_wave(cfg, mesh),
+            "args": (
+                sh_state, sh_faults, jnp.int32(AUDIT_N - AUDIT_DEVICES),
+                jnp.int32(192), jnp.int32(0),
+            ),
+            "donated_leaves": state_leaves,
+        }
+    return registry
+
+
+# -- fact extraction --------------------------------------------------------
+
+
+def extract_facts(
+    compiled: Any,
+    donated_leaves: int,
+    n: int,
+    c: int,
+    donation_reasons: Optional[List[str]] = None,
+) -> Dict[str, Any]:
+    """All budget-relevant facts of one compiled executable. ``rows`` holds
+    the per-collective detail (the evidence-table grain); everything else
+    is the lock grain."""
+    text = compiled.as_text()
+    rows = hlo_facts.audit_collectives(text, n, c)
+    collectives: Dict[str, Dict[str, Any]] = {}
+    unknown: List[str] = []
+    for row in rows:
+        key = f"{row['location']}/{row['kind']}"
+        entry = collectives.setdefault(key, {"count": 0, "bytes": 0, "max_bytes": 0})
+        entry["count"] += 1
+        entry["bytes"] += row["bytes"]
+        entry["max_bytes"] = max(entry["max_bytes"], row["bytes"])
+        unknown.extend(row["unknown_dtypes"])
+    for entry in collectives.values():
+        # Scale class of the LARGEST single payload in the group: "class
+        # increase" means one collective jumped a scale tier ([n] -> [c,n]),
+        # not that a count bump nudged the aggregate over a threshold.
+        entry["class"] = hlo_facts.payload_class(entry["max_bytes"], n, c)
+    aliased = len(hlo_facts.input_output_aliases(text))
+    memory = {}
+    analysis = None
+    try:
+        analysis = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — memory analysis is platform-optional
+        # (mirrors engine_telemetry.compiled_memory_analysis); the lock
+        # simply omits the section and the comparison is presence-gated.
+        analysis = None
+    if analysis is not None:
+        memory = {
+            "argument_bytes": int(analysis.argument_size_in_bytes),
+            "output_bytes": int(analysis.output_size_in_bytes),
+            "temp_bytes": int(analysis.temp_size_in_bytes),
+            "generated_code_bytes": int(analysis.generated_code_size_in_bytes),
+        }
+    return {
+        "collectives": collectives,
+        "transfers": hlo_facts.count_transfer_ops(text),
+        "donation": {
+            "donated_leaves": donated_leaves,
+            "aliased": aliased,
+            "dropped": max(donated_leaves - aliased, 0),
+            "reasons": sorted(set(donation_reasons or [])),
+        },
+        "memory": memory,
+        "unknown_dtypes": sorted(set(unknown)),
+        "rows": rows,
+    }
+
+
+def _compile_program(spec: Dict[str, Any]) -> Tuple[Any, List[str]]:
+    """Lower+compile one registry entry, capturing XLA/jax donation
+    complaints (the "Some donated buffers were not usable" class) as the
+    drop reasons the findings report."""
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        compiled = spec["jit"].lower(*spec["args"]).compile()
+    reasons = [
+        str(w.message).splitlines()[0]
+        for w in caught
+        if "donat" in str(w.message).lower()
+    ]
+    return compiled, reasons
+
+
+#: (facts, complete) — ``complete`` records whether the sharded mesh
+#: entrypoints were included, so a partial (observational) collection can
+#: never satisfy the lockfile gate's full-registry requirement.
+_FACTS_CACHE: Optional[Tuple[Dict[str, Any], bool]] = None
+
+
+class _scoped_disable_persistent_cache:
+    """SCOPED: turn jax's persistent compilation cache OFF for the audit
+    compiles, restoring the previous config after.
+
+    Hard-won (root-caused via a reproducible segfault): on this jaxlib's
+    CPU backend, SHARDED executables deserialized from the persistent
+    cache poison the process — later sharded+donated executions (the
+    test_parallel equivalence runs) die in native code. The audit compiles
+    the sharded step/wave every process, so with a warm cache it would hit
+    exactly that deserialize path. Fresh compiles cost ~15 s once per
+    process (the session cache absorbs every later consumer) and keep the
+    gate's facts coming from a REAL backend compile — also true inside
+    bench.py, which deliberately enables the cache process-wide for its
+    own single-device workload (single-device deserialization is fine and
+    has been exercised since the cache landed)."""
+
+    def __enter__(self) -> None:
+        import jax
+
+        self._restore = False
+        try:
+            self._prev = jax.config.jax_compilation_cache_dir
+            jax.config.update("jax_compilation_cache_dir", None)
+            self._restore = True
+        except Exception:  # noqa: BLE001 — a jax without the knob has no
+            # persistent cache to disable; compile proceeds as before.
+            pass
+
+    def __exit__(self, *_exc: Any) -> None:
+        import jax
+
+        if not self._restore:
+            return
+        try:
+            jax.config.update("jax_compilation_cache_dir", self._prev)
+        except Exception:  # noqa: BLE001 — restoring a knob that could not
+            # be set back is the same no-op as never having touched it.
+            pass
+
+
+def collect_facts(
+    force: bool = False, require_mesh: bool = True
+) -> Dict[str, Any]:
+    """Compile every registered entrypoint and extract its facts — once per
+    process (compiles dominate the gate's cost; every consumer shares this
+    cache).
+
+    ``require_mesh=True`` (the lockfile gate): raises RuntimeError when the
+    process cannot provide the 8-device mesh — the gate turns that into a
+    loud finding rather than silently passing with sharded entrypoints
+    unaudited. ``require_mesh=False`` (observational consumers, e.g. the
+    bench's ``hlo_audit`` stage on a single-chip backend): audits whatever
+    the registry can build — the four single-device entrypoints always,
+    the sharded pair when devices allow. A partial collection never
+    satisfies a later full-gate call."""
+    global _FACTS_CACHE
+    import jax
+
+    have_mesh = jax.device_count() >= AUDIT_DEVICES
+    if _FACTS_CACHE is not None and not force:
+        facts, complete = _FACTS_CACHE
+        if complete or not require_mesh:
+            return facts
+    if require_mesh and not have_mesh:
+        raise RuntimeError(
+            f"device_program audit needs {AUDIT_DEVICES} devices, have "
+            f"{jax.device_count()} — force them before jax initializes "
+            f"(XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{AUDIT_DEVICES}, as tests/conftest.py and the staticcheck "
+            f"CLI do)"
+        )
+    with _scoped_disable_persistent_cache():
+        registry = _build_registry()
+        facts = {}
+        for name, spec in registry.items():
+            compiled, reasons = _compile_program(spec)
+            entry = extract_facts(
+                compiled, spec["donated_leaves"], AUDIT_N, AUDIT_C,
+                donation_reasons=reasons,
+            )
+            if spec.get("waiver"):
+                entry["donation"]["waiver"] = spec["waiver"]
+            facts[name] = entry
+    _FACTS_CACHE = (facts, have_mesh)
+    return facts
+
+
+# -- lock construction + comparison -----------------------------------------
+
+
+def facts_to_lock(facts: Dict[str, Any]) -> Dict[str, Any]:
+    """The canonical freeze: per-entrypoint collectives/transfers/donation/
+    memory, minus the per-row detail (evidence grain, not budget grain)."""
+    lock: Dict[str, Any] = {
+        "audit_config": {
+            "n": AUDIT_N, "c": AUDIT_C, "k": AUDIT_K,
+            "devices": AUDIT_DEVICES,
+        },
+        "entrypoints": {},
+    }
+    for name, entry in sorted(facts.items()):
+        donation = {
+            k: v for k, v in entry["donation"].items() if k != "reasons"
+        }
+        lock["entrypoints"][name] = {
+            "collectives": entry["collectives"],
+            "transfers": entry["transfers"],
+            "donation": donation,
+            "memory": entry["memory"],
+        }
+    return lock
+
+
+def _within_tolerance(locked: int, current: int) -> bool:
+    slack = max(int(locked * MEMORY_REL_TOL), MEMORY_ABS_SLACK)
+    return abs(current - locked) <= slack
+
+
+def compare_facts(
+    name: str,
+    entry: Dict[str, Any],
+    locked: Dict[str, Any],
+    loc: Tuple[str, int],
+) -> List[Finding]:
+    """Budget-drift report for ONE entrypoint against its locked facts,
+    each finding naming the entrypoint and the delta. Sections present in
+    the lock are enforced; absent sections are skipped (the corpus locks
+    pin only the facts each defect class is about)."""
+    path, lineno = loc
+    findings: List[Finding] = []
+
+    def fail(check: str, message: str) -> None:
+        findings.append(Finding(path, lineno, check, f"{message} — {_REGEN_HINT}"))
+
+    if entry["unknown_dtypes"]:
+        findings.append(Finding(
+            path, lineno, "hlo-unknown-dtype",
+            f"{name}: collective payload uses HLO dtype(s) "
+            f"{entry['unknown_dtypes']} missing from hlo_facts.DTYPE_BITS — "
+            f"payload accounting cannot size them; add the dtype, do not "
+            f"guess",
+        ))
+
+    if "collectives" in locked:
+        cur = entry["collectives"]
+        old = locked["collectives"]
+        for key in sorted(set(cur) | set(old)):
+            location, kind = key.split("/", 1)
+            if key not in old:
+                hot = "NEW HOT-LOOP collective" if location.startswith(
+                    "hot-loop") else "new collective"
+                fail("hlo-collective-budget",
+                     f"{name}: {hot} {kind} in location {location} "
+                     f"({cur[key]['count']} op(s), {cur[key]['bytes']} bytes, "
+                     f"class {cur[key]['class']}) not in the HLO lock")
+            elif key not in cur:
+                fail("hlo-collective-budget",
+                     f"{name}: collective {kind} in location {location} "
+                     f"vanished since the HLO lock (was "
+                     f"{old[key]['count']} op(s), {old[key]['bytes']} bytes)")
+            else:
+                rank_old = hlo_facts.PAYLOAD_CLASS_RANK[old[key]["class"]]
+                rank_cur = hlo_facts.PAYLOAD_CLASS_RANK[cur[key]["class"]]
+                if rank_cur > rank_old:
+                    fail("hlo-collective-budget",
+                         f"{name}: payload-class INCREASE for {kind} in "
+                         f"{location}: {old[key]['class']} -> "
+                         f"{cur[key]['class']} (largest payload "
+                         f"{old[key].get('max_bytes', old[key]['bytes'])} -> "
+                         f"{cur[key]['max_bytes']} bytes)")
+                elif (cur[key]["count"], cur[key]["bytes"]) != (
+                    old[key]["count"], old[key]["bytes"]
+                ):
+                    fail("hlo-collective-budget",
+                         f"{name}: collective budget drift for {kind} in "
+                         f"{location}: {old[key]['count']} op(s)/"
+                         f"{old[key]['bytes']} bytes -> "
+                         f"{cur[key]['count']} op(s)/{cur[key]['bytes']} "
+                         f"bytes")
+
+    if "transfers" in locked:
+        cur_t = entry["transfers"]
+        old_t = locked["transfers"]
+        for op in sorted(set(cur_t) | set(old_t)):
+            if cur_t.get(op, 0) != old_t.get(op, 0):
+                fail("hlo-transfer-budget",
+                     f"{name}: host<->device transfer op {op}: "
+                     f"{old_t.get(op, 0)} -> {cur_t.get(op, 0)}")
+
+    if "donation" in locked:
+        cur_d = entry["donation"]
+        old_d = locked["donation"]
+        waiver = cur_d.get("waiver") or old_d.get("waiver")
+        if cur_d["dropped"] > 0 and not waiver:
+            reasons = "; ".join(cur_d.get("reasons", [])) or "no XLA reason captured"
+            findings.append(Finding(
+                path, lineno, "hlo-donation-dropped",
+                f"{name}: {cur_d['dropped']} of {cur_d['donated_leaves']} "
+                f"donated buffer(s) NOT aliased in the compiled output "
+                f"({reasons}) — donation silently dropped; fix the "
+                f"entrypoint or add an explicit registry waiver",
+            ))
+        elif (cur_d["donated_leaves"], cur_d["aliased"]) != (
+            old_d.get("donated_leaves"), old_d.get("aliased")
+        ):
+            fail("hlo-lock-drift",
+                 f"{name}: donation outcome drift: "
+                 f"{old_d.get('aliased')}/{old_d.get('donated_leaves')} "
+                 f"aliased in the lock, "
+                 f"{cur_d['aliased']}/{cur_d['donated_leaves']} now")
+
+    if "memory" in locked and locked["memory"] and entry["memory"]:
+        cur_m = entry["memory"]
+        old_m = locked["memory"]
+        for key in _EXACT_MEMORY_KEYS:
+            if key in old_m and cur_m.get(key) != old_m[key]:
+                fail("hlo-memory-budget",
+                     f"{name}: {key} {old_m[key]} -> {cur_m.get(key)}")
+        for key in _TOLERANT_MEMORY_KEYS:
+            if key in old_m and not _within_tolerance(
+                old_m[key], cur_m.get(key, 0)
+            ):
+                direction = (
+                    "GREW" if cur_m.get(key, 0) > old_m[key] else "shrank"
+                )
+                fail("hlo-memory-budget",
+                     f"{name}: {key} {direction} beyond tolerance: "
+                     f"{old_m[key]} -> {cur_m.get(key)} (allowed ±"
+                     f"{max(int(old_m[key] * MEMORY_REL_TOL), MEMORY_ABS_SLACK)}"
+                     f" bytes)")
+    return findings
+
+
+def compare_lock(
+    facts: Dict[str, Any], locked: Dict[str, Any], lock_path: str
+) -> List[Finding]:
+    findings: List[Finding] = []
+    locked_eps: Dict[str, Any] = locked.get("entrypoints", {})
+    for name in sorted(set(facts) | set(locked_eps)):
+        if name not in locked_eps:
+            findings.append(Finding(
+                lock_path, 1, "hlo-lock-drift",
+                f"entrypoint {name} compiled but has no entry in the HLO "
+                f"lock — {_REGEN_HINT}",
+            ))
+        elif name not in facts:
+            findings.append(Finding(
+                lock_path, 1, "hlo-lock-drift",
+                f"entrypoint {name} is in the HLO lock but no longer "
+                f"registered — {_REGEN_HINT}",
+            ))
+        else:
+            findings.extend(
+                compare_facts(name, facts[name], locked_eps[name], (lock_path, 1))
+            )
+    return findings
+
+
+# -- tree-mode gate ----------------------------------------------------------
+
+
+def check_hlo_lock(trees: Sequence[Tuple[ast.AST, str]]) -> List[Finding]:
+    """Tree-mode gate the driver runs on full sweeps: compile the registered
+    entrypoints (session-cached) and compare against the committed lock.
+    Presence-gated on the engine sources being part of the sweep, so tests
+    that retarget ``core.REPO`` at temporary trees never pay a compile."""
+    rels = {rel.replace("\\", "/") for _, rel in trees}
+    if not all(src in rels for src in REGISTRY_SOURCES):
+        return []
+    try:
+        facts = collect_facts()
+    except RuntimeError as exc:
+        return [Finding(HLO_LOCK_REL, 1, "hlo-lock-drift",
+                        f"cannot audit compiled programs: {exc}")]
+    lock_path = core.REPO / HLO_LOCK_REL
+    if not lock_path.exists():
+        return [Finding(
+            HLO_LOCK_REL, 1, "hlo-lock-drift",
+            "HLO lockfile missing — generate it via "
+            "`python tools/staticcheck.py --update-hlo-lock`",
+        )]
+    try:
+        locked = json.loads(lock_path.read_text())
+    except json.JSONDecodeError as exc:
+        return [Finding(
+            HLO_LOCK_REL, 1, "hlo-lock-drift",
+            f"HLO lockfile is not valid JSON ({exc.msg}) — regenerate via "
+            f"`python tools/staticcheck.py --update-hlo-lock`",
+        )]
+    audit_cfg = {"n": AUDIT_N, "c": AUDIT_C, "k": AUDIT_K,
+                 "devices": AUDIT_DEVICES}
+    if locked.get("audit_config") != audit_cfg:
+        return [Finding(
+            HLO_LOCK_REL, 1, "hlo-lock-drift",
+            f"HLO lock audit_config {locked.get('audit_config')} does not "
+            f"match the registry's {audit_cfg} — {_REGEN_HINT}",
+        )]
+    return compare_lock(facts, locked, HLO_LOCK_REL)
+
+
+def update_hlo_lock() -> Tuple[List[Finding], Optional[Path]]:
+    """Regenerate the lockfile from freshly-collected facts. Refuses while
+    an unknown dtype or an unwaived dropped donation is present — a budget
+    the gate would immediately fail must be fixed, not frozen."""
+    try:
+        facts = collect_facts()
+    except RuntimeError as exc:
+        return [Finding(HLO_LOCK_REL, 1, "hlo-lock-drift", str(exc))], None
+    blocking: List[Finding] = []
+    for name, entry in sorted(facts.items()):
+        blocking.extend(
+            f for f in compare_facts(name, entry, {"donation": {}}, (HLO_LOCK_REL, 1))
+            if f.check in ("hlo-unknown-dtype", "hlo-donation-dropped")
+        )
+    if blocking:
+        return blocking, None
+    lock_path = core.REPO / HLO_LOCK_REL
+    payload = {
+        "_comment": (
+            "Frozen compiled-program facts for the registered engine "
+            "entrypoints on the forced 8-device CPU mesh: collectives by "
+            "location/kind (count, payload bytes, scale class), "
+            "host<->device transfer ops, donation outcomes, and XLA memory "
+            "analysis. Generated by `python tools/staticcheck.py "
+            "--update-hlo-lock`; do not edit by hand — any drift from the "
+            "live compiled artifacts fails the staticcheck gate."
+        ),
+        **facts_to_lock(facts),
+    }
+    lock_path.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
+    return [], lock_path
+
+
+# -- per-file mode (the seeded lint corpus) ---------------------------------
+
+
+def _program_key_linenos(tree: ast.AST) -> Dict[str, int]:
+    """lineno of each string key in the module's HLO_AUDIT_PROGRAMS dict
+    literal — where corpus findings anchor (the `# expect:` markers sit on
+    these lines)."""
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "HLO_AUDIT_PROGRAMS"
+            and isinstance(node.value, ast.Dict)
+        ):
+            return {
+                key.value: key.lineno
+                for key in node.value.keys
+                if isinstance(key, ast.Constant) and isinstance(key.value, str)
+            }
+    return {}
+
+
+def check_device_program(
+    path: Path,
+    source: Optional[str] = None,
+    tree: "Optional[ast.AST]" = None,
+) -> List[Finding]:
+    """Corpus mode: compile the module's own miniature programs and compare
+    them against its inline ``HLO_LOCK``. Modules without an
+    ``HLO_AUDIT_PROGRAMS`` registry are skipped outright (this check never
+    executes ordinary library files)."""
+    src = source if source is not None else path.read_text()
+    if "HLO_AUDIT_PROGRAMS" not in src:
+        return []
+    if tree is None:
+        tree = ast.parse(src, filename=str(path))
+    linenos = _program_key_linenos(tree)
+    if not linenos:
+        return []
+    rel = core.rel(path)
+    namespace: Dict[str, Any] = {"__name__": f"_hlo_corpus_{path.stem}"}
+    exec(compile(src, str(path), "exec"), namespace)  # noqa: S102 — the
+    # corpus is this repo's own fixture tree; per-file mode only ever runs
+    # on explicitly-named files, never on sweeps.
+    programs = namespace["HLO_AUDIT_PROGRAMS"]
+    locked = namespace.get("HLO_LOCK", {})
+    n = namespace.get("AUDIT_N", AUDIT_N)
+    c = namespace.get("AUDIT_C", AUDIT_C)
+    findings: List[Finding] = []
+    for name, builder in programs.items():
+        spec = builder()
+        compiled, reasons = _compile_program(spec)
+        entry = extract_facts(
+            compiled, spec.get("donated_leaves", 0), n, c,
+            donation_reasons=reasons,
+        )
+        if spec.get("waiver"):
+            entry["donation"]["waiver"] = spec["waiver"]
+        findings.extend(compare_facts(
+            name, entry, locked.get(name, {}),
+            (rel, linenos.get(name, 1)),
+        ))
+    return sorted(set(findings), key=lambda f: (f.lineno, f.check, f.message))

@@ -1,0 +1,489 @@
+"""Check family 13: engine sharding discipline (source-level lint).
+
+The compiled-artifact gate (family 12, ``device_program``) catches what XLA
+actually emitted; this family catches the source patterns that PRODUCE bad
+compiled programs, over ``rapid_tpu/ops/``, ``rapid_tpu/models/``, and
+``rapid_tpu/parallel/``:
+
+- ``missing-partition-spec`` — every array leaf of the engine state pytree
+  (``EngineState``/``FaultInputs`` in models/state.py) must have a declared
+  ``PartitionSpec`` in ``parallel/mesh.py``'s sharding tables
+  (``state_shardings``/``fault_shardings``), and a leaf declared fully
+  replicated (``sh()`` with no axes) must justify it with
+  ``# replicated-ok: <reason>`` on the line — an undeclared leaf silently
+  replicates [n]-scale state onto every device.
+- ``host-sync-in-hot-path`` — ``jax.device_get`` / ``.block_until_ready()``
+  / ``.item()`` / ``float(...)`` / ``np.asarray(...)`` inside the traced
+  convergence seams (jitted functions, the ``*_impl`` engine convention,
+  and callables handed to ``lax.while_loop``/``lax.cond``/``lax.scan``):
+  each is a device->host round trip the fused-dispatch design exists to
+  avoid. Escape hatch ``# host-sync-ok: <reason>``.
+- ``donation-mismatch`` — a ``jax.jit`` application whose wrapped callable
+  takes the engine ``state`` pytree but whose ``donate_argnums`` does not
+  cover it: the long-running driver loop then holds two copies of the
+  state between steps. Deliberate non-donating variants carry
+  ``# donate-ok: <reason>``.
+- ``retrace-hazard`` — a bare Python numeric literal passed in a traced
+  position of a same-file jitted entrypoint: the first such call traces
+  with ``weak_type=True``, a later ``jnp.int32(...)``-wrapped call traces
+  again — one silent recompile per spelling. Wrap the constant
+  (``jnp.int32(x)``) or pin the parameter static. Escape hatch
+  ``# retrace-ok: <reason>``.
+
+Resolution is conservative (skip-don't-guess), matching the rest of the
+package: only same-file jit applications are resolved, only direct
+parameter/keyword shapes convict.
+
+``check_sharding`` is the per-file entry (prefix-gated; the lint corpus
+keeps miniature state+table pairs in one module);
+``check_partition_specs`` is the tree-mode entry that merges the real
+state.py/mesh.py pair on full sweeps.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import core
+from .core import Finding
+from .trace_safety import _dotted, _import_aliases, _jitted_functions
+
+SHARDING_PREFIXES = (
+    "rapid_tpu/ops/",
+    "rapid_tpu/models/",
+    "rapid_tpu/parallel/",
+)
+
+#: The real files the tree-mode partition-spec check merges.
+STATE_FILE = "rapid_tpu/models/state.py"
+MESH_FILE = "rapid_tpu/parallel/mesh.py"
+
+#: State-pytree classes and the sharding-table functions that must cover
+#: their array leaves, by name (the engine convention).
+_PYTREE_TABLES = {
+    "EngineState": "state_shardings",
+    "FaultInputs": "fault_shardings",
+}
+
+_LAX_LOOP_FNS = frozenset({
+    "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.cond", "lax.cond",
+    "jax.lax.scan", "lax.scan",
+    "jax.lax.fori_loop", "lax.fori_loop",
+})
+
+_HOST_SYNC_METHODS = frozenset({"block_until_ready", "item"})
+
+
+def _comment_ok(source_lines: List[str], lineno: int, marker: str) -> bool:
+    if 1 <= lineno <= len(source_lines):
+        return marker in source_lines[lineno - 1]
+    return False
+
+
+# -- host-sync-in-hot-path ---------------------------------------------------
+
+
+def _traced_functions(tree: ast.AST, aliases: Dict[str, str]) -> List[ast.AST]:
+    """Every function node the engine traces: jit-applied (trace_safety's
+    resolution), ``*_impl``-named (the repo's traced-impl convention), and
+    callables handed to the lax control-flow combinators."""
+    traced: Dict[int, ast.AST] = {}
+    for fn, _static in _jitted_functions(tree, aliases):
+        traced[id(fn)] = fn
+    by_name: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name[node.name] = node
+            if node.name.endswith("_impl"):
+                traced[id(node)] = node
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _dotted(node.func, aliases) in _LAX_LOOP_FNS):
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Lambda):
+                traced[id(arg)] = arg
+            elif isinstance(arg, ast.Name) and arg.id in by_name:
+                fn = by_name[arg.id]
+                traced[id(fn)] = fn
+    return list(traced.values())
+
+
+def _check_host_sync(
+    tree: ast.AST,
+    aliases: Dict[str, str],
+    rel: str,
+    source_lines: List[str],
+    findings: List[Finding],
+) -> None:
+    seen: Set[int] = set()
+    for fn in _traced_functions(tree, aliases):
+        label = getattr(fn, "name", "<lambda>")
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            what = None
+            dotted = _dotted(node.func, aliases)
+            if dotted == "jax.device_get":
+                what = "jax.device_get"
+            elif dotted in ("numpy.asarray", "np.asarray"):
+                what = f"{dotted} (implicit device fetch)"
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HOST_SYNC_METHODS
+            ):
+                what = f".{node.func.attr}()"
+            elif (
+                isinstance(node.func, ast.Name)
+                and node.func.id == "float"
+                and node.args
+                and not isinstance(node.args[0], ast.Constant)
+            ):
+                what = "float(...) (scalar fetch)"
+            if what is None:
+                continue
+            seen.add(id(node))
+            if _comment_ok(source_lines, node.lineno, "# host-sync-ok:"):
+                continue
+            findings.append(Finding(
+                rel, node.lineno, "host-sync-in-hot-path",
+                f"{what} inside traced {label!r}: a device->host sync in "
+                f"the convergence hot path — keep the value on device "
+                f"(jnp ops / lax.cond), or justify with "
+                f"`# host-sync-ok: <reason>`",
+            ))
+
+
+# -- donation-mismatch -------------------------------------------------------
+
+
+def _callable_params(
+    target: ast.AST, by_name: Dict[str, ast.AST]
+) -> Optional[List[str]]:
+    """Positional parameter names of a jit-wrapped callable: a same-file
+    def referenced by name, or an inline lambda. None = unresolvable."""
+    if isinstance(target, ast.Lambda):
+        return [a.arg for a in (*target.args.posonlyargs, *target.args.args)]
+    if isinstance(target, ast.Name) and target.id in by_name:
+        fn = by_name[target.id]
+        return [a.arg for a in (*fn.args.posonlyargs, *fn.args.args)]
+    return None
+
+
+def _int_tuple(node: Optional[ast.AST]) -> Optional[Tuple[int, ...]]:
+    """A donate_argnums/static_argnums value as ints; None = unresolvable
+    (dynamic spec: skip, don't guess). Missing keyword -> empty tuple is
+    the CALLER's choice (pass a Constant sentinel)."""
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _str_tuple(node: Optional[ast.AST]) -> Optional[Tuple[str, ...]]:
+    """A *_argnames value as strings; None = unresolvable, () = absent."""
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _jit_keyword(call: ast.Call, name: str) -> Optional[ast.AST]:
+    return next((kw.value for kw in call.keywords if kw.arg == name), None)
+
+
+def _check_donation(
+    tree: ast.AST,
+    aliases: Dict[str, str],
+    rel: str,
+    source_lines: List[str],
+    findings: List[Finding],
+) -> None:
+    by_name = {
+        n.name: n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _dotted(node.func, aliases) == "jax.jit"):
+            continue
+        if not node.args:
+            continue
+        params = _callable_params(node.args[0], by_name)
+        if params is None or "state" not in params:
+            continue
+        state_idx = params.index("state")
+        donate = _int_tuple(_jit_keyword(node, "donate_argnums"))
+        donate_names = _str_tuple(_jit_keyword(node, "donate_argnames"))
+        if donate is None or donate_names is None:
+            continue  # dynamic spec: skip, don't guess
+        if state_idx in donate or "state" in donate_names:
+            continue
+        if _comment_ok(source_lines, node.lineno, "# donate-ok:"):
+            continue
+        findings.append(Finding(
+            rel, node.lineno, "donation-mismatch",
+            f"jax.jit application does not donate the engine state pytree "
+            f"(param 'state' at index {state_idx}, donate_argnums="
+            f"{donate}): the driver loop holds two state copies between "
+            f"steps — add donate_argnums=({state_idx},) or justify with "
+            f"`# donate-ok: <reason>`",
+        ))
+
+
+# -- retrace-hazard ----------------------------------------------------------
+
+
+def _jitted_bindings(
+    tree: ast.AST, aliases: Dict[str, str], by_name: Dict[str, ast.AST]
+) -> Dict[str, Tuple[int, Tuple[int, ...]]]:
+    """Module-level ``name = jax.jit(fn, ...)`` bindings: name ->
+    (positional arity of the wrapped callable, static argnums). Only
+    statically-resolvable specs are included."""
+    out: Dict[str, Tuple[int, Tuple[int, ...]]] = {}
+    for node in tree.body:
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Call)
+            and _dotted(node.value.func, aliases) == "jax.jit"
+            and node.value.args
+        ):
+            continue
+        params = _callable_params(node.value.args[0], by_name)
+        if params is None:
+            continue
+        static = _int_tuple(_jit_keyword(node.value, "static_argnums"))
+        static_names = _str_tuple(_jit_keyword(node.value, "static_argnames"))
+        if static is None or static_names is None:
+            continue  # dynamic spec: skip, don't guess
+        # static_argnames pins by NAME; jax maps positional calls onto the
+        # named parameters, so a bare literal at that position never
+        # retraces — resolve the names to indices and merge.
+        name_idx = tuple(
+            params.index(n) for n in static_names if n in params
+        )
+        out[node.targets[0].id] = (len(params), tuple(set(static) | set(name_idx)))
+    return out
+
+
+def _check_retrace(
+    tree: ast.AST,
+    aliases: Dict[str, str],
+    rel: str,
+    source_lines: List[str],
+    findings: List[Finding],
+) -> None:
+    by_name = {
+        n.name: n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    jitted = _jitted_bindings(tree, aliases, by_name)
+    if not jitted:
+        return
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in jitted
+        ):
+            continue
+        _arity, static = jitted[node.func.id]
+        for idx, arg in enumerate(node.args):
+            if idx in static:
+                continue
+            if not (
+                isinstance(arg, ast.Constant)
+                and isinstance(arg.value, (int, float))
+                and not isinstance(arg.value, bool)
+            ):
+                continue
+            if _comment_ok(source_lines, arg.lineno, "# retrace-ok:"):
+                continue
+            findings.append(Finding(
+                rel, arg.lineno, "retrace-hazard",
+                f"bare Python literal {arg.value!r} passed in traced "
+                f"position {idx} of jitted {node.func.id!r}: mixing bare "
+                f"and wrapped spellings retraces per weak-type — wrap it "
+                f"(jnp.int32({arg.value!r})) or pin the parameter in "
+                f"static_argnums/static_argnames",
+            ))
+
+
+# -- missing-partition-spec --------------------------------------------------
+
+
+def _pytree_array_fields(tree: ast.AST) -> Dict[str, List[str]]:
+    """Array-leaf field names of each state-pytree NamedTuple present in
+    the module (annotation mentions ``ndarray``)."""
+    out: Dict[str, List[str]] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef) and node.name in _PYTREE_TABLES):
+            continue
+        fields = []
+        for stmt in node.body:
+            if not (
+                isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            ):
+                continue
+            ann = ast.dump(stmt.annotation)
+            if "ndarray" in ann or "Array" in ann:
+                fields.append(stmt.target.id)
+        if fields:
+            out[node.name] = fields
+    return out
+
+
+def _table_constructor_calls(
+    tree: ast.AST,
+) -> Dict[str, Tuple[ast.Call, int]]:
+    """class name -> (the pytree constructor Call inside its sharding-table
+    function, the function's lineno)."""
+    out: Dict[str, Tuple[ast.Call, int]] = {}
+    fn_for = {fn: cls for cls, fn in _PYTREE_TABLES.items()}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.FunctionDef) and node.name in fn_for):
+            continue
+        cls = fn_for[node.name]
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Name)
+                and sub.func.id == cls
+                and sub.keywords
+            ):
+                out[cls] = (sub, node.lineno)
+                break
+    return out
+
+
+def _partition_spec_findings(
+    fields_by_class: Dict[str, List[str]],
+    tables_tree: ast.AST,
+    tables_rel: str,
+    tables_source: str,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    source_lines = tables_source.splitlines()
+    calls = _table_constructor_calls(tables_tree)
+    for cls, fields in sorted(fields_by_class.items()):
+        if cls not in calls:
+            continue  # presence-gated: no table for this pytree here
+        call, fn_lineno = calls[cls]
+        declared = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+        table_fn = _PYTREE_TABLES[cls]
+        for field in fields:
+            if field not in declared:
+                findings.append(Finding(
+                    tables_rel, call.lineno, "missing-partition-spec",
+                    f"{cls} array leaf {field!r} has no declared "
+                    f"PartitionSpec in {table_fn}() — an undeclared leaf "
+                    f"silently replicates onto every device",
+                ))
+                continue
+            value = declared[field]
+            if not (
+                isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Name)
+                and value.func.id == "sh"
+            ):
+                continue  # a non-sh() spec: skip, don't guess
+            has_axis = any(
+                not (isinstance(a, ast.Constant) and a.value is None)
+                for a in value.args
+            )
+            if not has_axis and not _comment_ok(
+                source_lines, value.lineno, "# replicated-ok:"
+            ):
+                findings.append(Finding(
+                    tables_rel, value.lineno, "missing-partition-spec",
+                    f"{cls} leaf {field!r} is declared fully replicated "
+                    f"(sh() with no axes) without a `# replicated-ok: "
+                    f"<reason>` justification",
+                ))
+        for kw in call.keywords:
+            if kw.arg and kw.arg not in fields:
+                findings.append(Finding(
+                    tables_rel, kw.value.lineno, "missing-partition-spec",
+                    f"{table_fn}() declares a spec for {kw.arg!r}, which is "
+                    f"not an array leaf of {cls} — dead table entry",
+                ))
+    return findings
+
+
+# -- entry points ------------------------------------------------------------
+
+
+def check_sharding(
+    path: Path,
+    source: Optional[str] = None,
+    tree: "Optional[ast.AST]" = None,
+) -> List[Finding]:
+    """Per-file sharding lint (prefix-gated). The partition-spec section
+    runs only when the file holds BOTH a state pytree and its sharding
+    table (the corpus miniatures); the real split pair is merged by the
+    tree-mode check."""
+    rel = core.rel(path)
+    posix = rel.replace("\\", "/")
+    if not any(posix.startswith(p) for p in SHARDING_PREFIXES):
+        return []
+    src = source if source is not None else path.read_text()
+    if tree is None:
+        tree = ast.parse(src, filename=str(path))
+    aliases = _import_aliases(tree)
+    source_lines = src.splitlines()
+    findings: List[Finding] = []
+    _check_host_sync(tree, aliases, rel, source_lines, findings)
+    _check_donation(tree, aliases, rel, source_lines, findings)
+    _check_retrace(tree, aliases, rel, source_lines, findings)
+    fields = _pytree_array_fields(tree)
+    if fields and _table_constructor_calls(tree):
+        findings.extend(_partition_spec_findings(fields, tree, rel, src))
+    return sorted(set(findings), key=lambda f: (f.lineno, f.check, f.message))
+
+
+def check_partition_specs(
+    trees: Sequence[Tuple[ast.AST, str]]
+) -> List[Finding]:
+    """Tree-mode entry: merge the real state.py/mesh.py pair. Presence-
+    gated on both files being part of the sweep (tests retargeting
+    ``core.REPO`` at temporary trees skip silently)."""
+    state_tree = mesh_tree = None
+    for tree, rel in trees:
+        posix = rel.replace("\\", "/")
+        if posix == STATE_FILE:
+            state_tree = tree
+        elif posix == MESH_FILE:
+            mesh_tree = tree
+    if state_tree is None or mesh_tree is None:
+        return []
+    fields = _pytree_array_fields(state_tree)
+    if not fields:
+        return []
+    mesh_path = core.REPO / MESH_FILE
+    return _partition_spec_findings(
+        fields, mesh_tree, MESH_FILE, mesh_path.read_text()
+    )

@@ -6,14 +6,18 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== static checks (AST lint + resolution tier) =="
-python -m pytest tests/test_lint.py tests/test_staticcheck.py -q
+echo "== static checks (AST lint + resolution tier + compiled-program gate) =="
+# test_hlo_gate.py first: it compiles the registered engine entrypoints
+# ONCE per session, so the lint/staticcheck tree sweeps in the same
+# session reuse the facts instead of recompiling.
+python -m pytest tests/test_hlo_gate.py tests/test_lint.py tests/test_staticcheck.py -q -p no:randomly
 
 echo "== full suite (CPU, 8 virtual devices) =="
 # The static gates just ran above; the resolution tier re-imports and
 # re-analyzes the whole tree, so don't pay it twice in one invocation.
 python -m pytest tests/ -q \
-  --ignore=tests/test_lint.py --ignore=tests/test_staticcheck.py
+  --ignore=tests/test_lint.py --ignore=tests/test_staticcheck.py \
+  --ignore=tests/test_hlo_gate.py
 
 echo "== driver gates =="
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \

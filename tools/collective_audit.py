@@ -13,8 +13,14 @@ with compiled evidence rather than docstring assertion:
     execute on view changes (sort-free topology rebuild), classic-fallback attempts, or
     the implicit-invalidation pass.
 
-Classification logic lives in rapid_tpu/parallel/audit.py (pinned by
-tests/test_parallel.py); this tool builds the committed evidence table.
+This CLI is the evidence-table front end of the ``device_program`` check
+family (tools/analysis/device_program.py): classification lives in
+``rapid_tpu/parallel/hlo_facts.py`` (re-exported by rapid_tpu/parallel/audit.py,
+pinned by tests/test_parallel.py), fact extraction — including donation
+outcomes and XLA memory analysis — in ``device_program.extract_facts``. The
+difference from the committed gate: the gate compiles at fixed small audit
+shapes and freezes the facts into ``hlo.lock.json``; this tool compiles at
+evidence scale (10K+ slots) and writes the full table.
 
     python tools/collective_audit.py [--n 10240] [--devices 8] [--out FILE]
 
@@ -30,6 +36,7 @@ import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 
 def main() -> None:
@@ -45,11 +52,12 @@ def main() -> None:
     force_platform("cpu", n_host_devices=args.devices)
     import jax
 
+    from analysis.device_program import _compile_program, extract_facts
+    from analysis.hlo_facts import collective_violations
     from rapid_tpu.models.virtual_cluster import (
         VirtualCluster,
         run_to_decision_impl,
     )
-    from rapid_tpu.parallel.audit import audit_collectives, collective_violations
     from rapid_tpu.parallel.mesh import (
         fault_shardings,
         make_mesh,
@@ -69,30 +77,34 @@ def main() -> None:
     mesh = make_mesh(jax.devices()[: args.devices])
     state = shard_state(vc.state, mesh)
     faults = shard_faults(vc.faults, mesh)
+    n_leaves = len(jax.tree_util.tree_leaves(state))
 
     report = {"n_slots": n_slots, "cohorts": args.cohorts,
-              "devices": args.devices, "programs": {}}
+              "devices": args.devices, "programs": {}, "facts": {}}
+    cfg = vc.cfg
 
     # Program 1: the single-dispatch CONVERGENCE loop (the product path for
     # run_to_decision) — while_loop around the round body, edge gathers
-    # hoisted into the prologue.
-    cfg = vc.cfg
+    # hoisted into the prologue. Donating, like the product entrypoint.
     conv = jax.jit(
-        lambda s, f: run_to_decision_impl(cfg, s, f, 96),
+        lambda state, faults: run_to_decision_impl(cfg, state, faults, 96),
         in_shardings=(state_shardings(mesh), fault_shardings(mesh)),
+        donate_argnums=(0,),
     )
-    conv_txt = conv.lower(state, faults).compile().as_text()
-    report["programs"]["convergence_loop"] = audit_collectives(
-        conv_txt, n_slots, args.cohorts
-    )
-
     # Program 2: one engine step (the per-round driver used by the sharded
     # dry run / host-driven stepping) — pays the prologue gathers per call.
     step = make_sharded_step(cfg, mesh)
-    step_txt = step.lower(state, faults).compile().as_text()
-    report["programs"]["engine_step"] = audit_collectives(
-        step_txt, n_slots, args.cohorts
-    )
+
+    for name, jitted, spec_args in (
+        ("convergence_loop", conv, (state, faults)),
+        ("engine_step", step, (state, faults)),
+    ):
+        compiled, reasons = _compile_program({"jit": jitted, "args": spec_args})
+        facts = extract_facts(
+            compiled, n_leaves, n_slots, args.cohorts, donation_reasons=reasons
+        )
+        report["programs"][name] = facts.pop("rows")
+        report["facts"][name] = facts
 
     violations = collective_violations(report["programs"]["convergence_loop"])
     report["violations"] = violations
@@ -113,6 +125,14 @@ def main() -> None:
     for prog, rows in report["programs"].items():
         for (loc, kind, src), v in sorted(summarize(rows).items()):
             print(f"| {prog} | {loc} | {kind} | {src} | {v['count']} | {v['bytes']} |")
+    for prog, facts in report["facts"].items():
+        d = facts["donation"]
+        m = facts["memory"] or {}
+        print(
+            f"\n{prog}: donation {d['aliased']}/{d['donated_leaves']} aliased"
+            f" ({d['dropped']} dropped), temp {m.get('temp_bytes', '?')} B,"
+            f" args {m.get('argument_bytes', '?')} B"
+        )
     print(f"\nok={report['ok']} violations=" + json.dumps(
         {k: len(v) for k, v in violations.items()}))
 

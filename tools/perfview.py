@@ -227,8 +227,37 @@ def render_ledger(path: str, events: List[Dict[str, Any]], skipped: int) -> str:
 # ---------------------------------------------------------------------------
 
 
-def point_flags(data: Dict[str, Any]) -> List[str]:
-    """The trust flags of one bench-round JSON artifact."""
+def hlo_audit_table(data: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The point's per-entrypoint compiled-program audit (bench.py's
+    ``hlo_audit`` key), or None when the round predates the audit or it
+    errored — absence never flags, only a measured difference does."""
+    table = data.get("hlo_audit")
+    if not isinstance(table, dict) or "error" in table:
+        return None
+    return table
+
+
+def hlo_drift(prev: Optional[Dict[str, Any]],
+              cur: Optional[Dict[str, Any]]) -> bool:
+    """True when two audited rounds disagree on any shared entrypoint's
+    collective counts — the compiled communication budget moved between
+    rounds (intentionally or not: the trajectory must show it either way)."""
+    if not prev or not cur:
+        return False
+    for name in set(prev) & set(cur):
+        for key in ("collectives", "hot_loop_collectives"):
+            if prev[name].get(key) != cur[name].get(key):
+                return True
+    return False
+
+
+def point_flags(
+    data: Dict[str, Any], prev: Optional[Dict[str, Any]] = None
+) -> List[str]:
+    """The trust flags of one bench-round JSON artifact. ``prev`` is the
+    nearest EARLIER round that carried an hlo_audit table (trajectory
+    rendering threads it); a collective-count difference against it flags
+    ``hlo-drift``."""
     flags: List[str] = []
     if "error" in data:
         flags.append("hole")
@@ -244,6 +273,8 @@ def point_flags(data: Dict[str, Any]) -> List[str]:
             if value > SUSPECT_RATE_PER_SEC:
                 flags.append("suspect-rate")
                 break
+    if hlo_drift(prev, hlo_audit_table(data)):
+        flags.append("hlo-drift")
     if not flags:
         flags.append("live")
     return flags
@@ -273,9 +304,15 @@ def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
     lines = ["== perf trajectory =="]
     header = ("ROUND", "METRIC", "VALUE", "PLATFORM", "VSBASE", "FLAGS")
     rows: List[Tuple[str, ...]] = []
+    flag_rows: List[Tuple[str, List[str]]] = []
+    prev_audit: Optional[Dict[str, Any]] = None
     for path, data in sorted(points, key=lambda p: p[0]):
         value = data.get("value")
         vs = data.get("vs_baseline", data.get("vs_baseline_at_capture"))
+        flags = point_flags(data, prev=prev_audit)
+        # The drift baseline is the nearest earlier AUDITED round: a hole
+        # or pre-audit round in between must not reset the comparison.
+        prev_audit = hlo_audit_table(data) or prev_audit
         rows.append((
             Path(path).stem,
             str(data.get("metric", "?")),
@@ -283,12 +320,13 @@ def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
             str(data.get("platform", "-")),
             "-" if vs is None else f"{float(vs):.2f}x"
             + ("@capture" if "vs_baseline_at_capture" in data else ""),
-            ",".join(point_flags(data)),
+            ",".join(flags),
         ))
+        flag_rows.append((Path(path).stem, flags))
     lines.extend(render_table(header, rows))
     flagged = [
-        (Path(p).stem, flags) for p, d in sorted(points)
-        if (flags := [f for f in point_flags(d) if f != "live"])
+        (name, kept) for name, flags in flag_rows
+        if (kept := [f for f in flags if f != "live"])
     ]
     if flagged:
         lines.append(

@@ -34,8 +34,10 @@ from analysis import (  # noqa: E402,F401 — re-exported API surface
     DISPATCH_PREFIXES,
     FAMILIES,
     Finding,
+    HLO_LOCK_REL,
     LEDGER_PREFIXES,
     LOCK_REL,
+    SHARDING_PREFIXES,
     TASKFLOW_PREFIXES,
     TRACE_SAFETY_PREFIXES,
     WIRE_FILES,
@@ -44,16 +46,22 @@ from analysis import (  # noqa: E402,F401 — re-exported API surface
     check_concurrency,
     check_dead_definitions,
     check_determinism,
+    check_device_program,
     check_dispatch,
+    check_hlo_lock,
     check_ledger,
+    check_partition_specs,
+    check_sharding,
     check_taskflow,
     check_trace_safety,
     check_undefined_names,
     check_wire_lock,
     check_wire_schema,
+    collect_facts,
     iter_files,
     main,
     run,
+    update_hlo_lock,
     update_wire_lock,
 )
 
@@ -70,9 +78,11 @@ __all__ = [
     "DISPATCH_PREFIXES",
     "FAMILIES",
     "Finding",
+    "HLO_LOCK_REL",
     "LEDGER_PREFIXES",
     "LOCK_REL",
     "REPO",
+    "SHARDING_PREFIXES",
     "TASKFLOW_PREFIXES",
     "TRACE_SAFETY_PREFIXES",
     "WIRE_FILES",
@@ -81,17 +91,23 @@ __all__ = [
     "check_concurrency",
     "check_dead_definitions",
     "check_determinism",
+    "check_device_program",
     "check_dispatch",
+    "check_hlo_lock",
     "check_ledger",
+    "check_partition_specs",
+    "check_sharding",
     "check_taskflow",
     "check_trace_safety",
     "check_undefined_names",
     "check_wire_lock",
     "check_wire_schema",
+    "collect_facts",
     "core",
     "iter_files",
     "main",
     "run",
+    "update_hlo_lock",
     "update_wire_lock",
 ]
 
@@ -99,5 +115,8 @@ if __name__ == "__main__":
     sys.path.insert(0, str(core.REPO))
     from rapid_tpu.utils.platform import force_platform
 
-    force_platform("cpu")  # imports must never touch a (possibly wedged) tunnel
+    # Imports must never touch a (possibly wedged) tunnel — and the
+    # device_program family compiles the registered engine entrypoints
+    # under the same forced 8-device CPU mesh the test session uses.
+    force_platform("cpu", n_host_devices=8)
     sys.exit(main(sys.argv[1:]))
