@@ -33,11 +33,11 @@ from rapid_tpu.models.state import (
     initial_state,
 )
 from rapid_tpu.ops.consensus import tally_candidates
+from rapid_tpu.ops.cut_detection import cohort_watermark_pass
 from rapid_tpu.ops.hashing import masked_set_hash, mix32
 from rapid_tpu.ops.pallas_kernels import (
     _popcount32,
     delivery_new_bits_pallas,
-    watermark_merge_classify,
 )
 from rapid_tpu.ops.rings import (
     endpoint_ring_keys,
@@ -200,69 +200,23 @@ def _deliver_alerts(cfg: EngineConfig, state: EngineState, fire_round, blocked_r
 
 
 def _cohort_cut_detection(cfg: EngineConfig, state: EngineState, new_bits, heard_down):
-    """Batched per-cohort watermark pass over uint32 ring-report bitmasks
-    (rapid_tpu.ops.pallas_kernels semantics over a leading cohort axis, gated
-    by the per-configuration announced-proposal flag,
-    MembershipService.java:318-348).
-
-    The merge + popcount + H/L classification is plain elementwise jnp: XLA's
-    own fusion measured faster than a hand-written Mosaic version at engine
-    shapes (ops/pallas_kernels.py module docstring). The
-    implicit-invalidation gather only runs when some cohort actually has
-    subjects in flux after a DOWN event (lax.cond): in pure crash/join rounds
-    every subject jumps straight past H, so the expensive gather is skipped.
-    """
-    n, c = cfg.n, cfg.c
-    subject_mask = state.alive | state.join_pending  # [n]
-    # [c, n] stays intact: the core is elementwise (no resharding of the
-    # node-sharded axis).
-    report_bits, cls = watermark_merge_classify(
+    """The engine's cut-detection seam: C independent watermark detectors
+    batched over the (mesh-sharded) cohort axis. The pass itself lives in
+    ``rapid_tpu.ops.cut_detection.cohort_watermark_pass`` (the cohort-grain
+    twin of ``process_alert_batch``, with the sharding discipline documented
+    there); this wrapper only adapts the state pytree."""
+    return cohort_watermark_pass(
         state.report_bits,
         new_bits,
-        jnp.broadcast_to(subject_mask[None, :], (c, n)),
+        state.seen_down,
+        state.released,
+        state.announced,
+        state.alive | state.join_pending,
+        state.inval_obs,
+        heard_down,
         cfg.h,
         cfg.l,
-    )
-    seen_down = state.seen_down | heard_down  # [c]
-    stable = cls == 2
-    flux = cls == 1
-
-    def with_implicit(report_bits):
-        # Implicit edge invalidation (MultiNodeCutDetector.java:137-164): the
-        # union (pending-stable | flux) is invariant under the pass, so one
-        # masked OR is the fixpoint. Already-released subjects left the
-        # pending set (MultiNodeCutDetector.java:120-121) and no longer
-        # legitimize implicit edges. Per-ring loop: [c, n] gathers, never a
-        # [c, n, k] materialization (C can be in the hundreds).
-        in_union = (stable & ~state.released) | flux  # [c, n]
-        obs = state.inval_obs  # [k, n]
-        implicit_bits = jnp.zeros((cfg.c, n), dtype=jnp.uint32)
-        for ring in range(cfg.k):
-            obs_r = obs[ring]  # [n]
-            gathered = in_union[:, jnp.clip(obs_r, 0, n - 1)]  # [c, n]
-            implicit_r = flux & gathered & (obs_r >= 0)[None, :] & seen_down[:, None]
-            implicit_bits = implicit_bits | (
-                implicit_r.astype(jnp.uint32) << jnp.uint32(ring)
-            )
-        merged = report_bits | implicit_bits
-        return jnp.where(subject_mask[None, :], merged, jnp.uint32(0))
-
-    need_invalidation = jnp.any(flux & seen_down[:, None])
-    report_bits = jax.lax.cond(need_invalidation, with_implicit, lambda r: r, report_bits)
-
-    tally2 = _popcount32(report_bits)
-    stable2 = tally2 >= cfg.h
-    flux2 = (tally2 >= cfg.l) & (tally2 < cfg.h)
-    fresh_stable = stable2 & ~state.released
-    propose = ~state.announced & jnp.any(fresh_stable, axis=1) & ~jnp.any(flux2, axis=1)
-    proposal_mask = fresh_stable & propose[:, None]
-    return (
-        report_bits,
-        state.released | proposal_mask,
-        state.announced | propose,
-        seen_down,
-        propose,
-        proposal_mask,
+        cfg.k,
     )
 
 
@@ -315,7 +269,11 @@ def _compute_round(
     )
     # Proposal identity = commutative set-hash of the cut's member identities
     # (the canonical-sort-free equivalent of the ring-0-sorted endpoint list,
-    # MembershipService.java:346-348).
+    # MembershipService.java:346-348). Per-cohort hash reductions over N —
+    # node-axis psums on the mesh, cohort-local otherwise (deliberately NOT
+    # cond-gated: an extra lax.cond in the round body costs more compile
+    # time across every engine program than the masked reductions cost to
+    # run).
     prop_hi_new, prop_lo_new = jax.vmap(
         lambda mask: masked_set_hash(state.id_hi, state.id_lo, mask)
     )(prop_masks)
@@ -514,7 +472,15 @@ def _compute_round(
         jnp.argmax(announced & (prop_hi == tally.winner_hi) & (prop_lo == tally.winner_lo)),
         jnp.maximum(chosen, 0),
     )
-    winner_mask = jnp.where(decided, prop_mask[winner_cohort], jnp.zeros((n,), dtype=bool))
+    # Materialize the decided cut as a one-hot masked reduction over the
+    # cohort axis — on the cohort-meshed state this lowers to a reduce-class
+    # psum of [n] bools, where the old dynamic row gather
+    # (prop_mask[winner_cohort]) would redistribute across the cohort axis
+    # as gather/permute traffic in every round of the hot loop.
+    winner_mask = decided & jnp.any(
+        prop_mask & (jnp.arange(c, dtype=jnp.int32) == winner_cohort)[:, None],
+        axis=0,
+    )
 
     round_state = state._replace(
         fd_count=fd_count,
@@ -731,15 +697,18 @@ def run_until_membership_impl(
     reaches ``target`` — one device dispatch for a whole churn/bootstrap
     wave instead of one per cut.
 
-    Structure: an outer loop of convergences, each of which (a) re-derives
-    the hoisted per-edge masks (topology and the implicit-alert stamps
-    change at every view change, so the prologue gather must re-run per
-    cut — still once per CUT, not per round), (b) runs the same sort-free
-    inner round loop as ``run_to_decision_impl``, and (c) applies the view
-    change. On a tunnel/remote backend each dispatch+fetch pair costs a
-    full RTT, so resolving a 2-cut churn or a bootstrap admission wave in
-    one dispatch removes that many round trips from the measured wall
-    clock (EVALUATION.md §1's device_rtt_ms).
+    Structure: an outer loop of convergences, each of which (a) runs the
+    same sort-free inner round loop as ``run_to_decision_impl`` over the
+    hoisted per-edge masks, and (b) applies the view change WITH the
+    per-edge mask rebuild inside the same lax.cond (topology and the
+    implicit-alert stamps change only when a cut commits, so the mask
+    pack + permutation gathers are per-CUT work in a gated branch — the
+    compiled hot loop stays reduce-class on every mesh, which the
+    device_program gate freezes). On a tunnel/remote backend each
+    dispatch+fetch pair costs a full RTT, so resolving a 2-cut churn or a
+    bootstrap admission wave in one dispatch removes that many round
+    trips from the measured wall clock (EVALUATION.md §1's
+    device_rtt_ms).
 
     Returns (state, total_steps, cuts_committed, resolved, sizes) where
     ``sizes[i]`` is the membership after the i-th committed cut (-1 beyond
@@ -753,13 +722,12 @@ def run_until_membership_impl(
     n = cfg.n
 
     def outer_cond(carry):
-        state, steps, cuts, stalled, _ = carry
+        state, steps, cuts, stalled, _, _ = carry
         resolved = (state.n_members == target) & (cuts >= min_cuts)
         return (~resolved) & (~stalled) & (steps < max_steps) & (cuts < max_cuts)
 
     def outer_body(carry):
-        state, steps, cuts, _, sizes = carry
-        edge_masks = _edge_masks(cfg, state, faults)
+        state, steps, cuts, _, sizes, edge_masks = carry
 
         def inner_cond(carry):
             _, steps, decided, _ = carry
@@ -776,18 +744,26 @@ def run_until_membership_impl(
         state, steps, decided, winner = jax.lax.while_loop(
             inner_cond, inner_body, init
         )
-        state = jax.lax.cond(
-            decided,
-            lambda s: apply_view_change_impl(cfg, s, winner),
-            lambda s: s,
-            state,
+        # The view change AND the per-edge mask rebuild ride one cond:
+        # topology (and with it the observer-active/delivery masks) changes
+        # ONLY when a cut commits, so the mask rebuild's pack + permutation
+        # gathers are per-CUT work, gated exactly like the ring rebuild —
+        # never unconditional hot-loop traffic (the compiled-program gate
+        # pins this: the wave's hot loop stays reduce-class on both the 1-D
+        # and the 2-D mesh).
+        def commit(s):
+            s2 = apply_view_change_impl(cfg, s, winner)
+            return s2, _edge_masks(cfg, s2, faults)
+
+        state, edge_masks = jax.lax.cond(
+            decided, commit, lambda s: (s, edge_masks), state
         )
         sizes = jnp.where(
             decided, sizes.at[cuts].set(state.n_members), sizes
         )
         # A convergence that ran out of budget undecided cannot make further
         # progress (the outer loop would spin): latch and exit.
-        return (state, steps, cuts + decided.astype(jnp.int32), ~decided, sizes)
+        return (state, steps, cuts + decided.astype(jnp.int32), ~decided, sizes, edge_masks)
 
     init = (
         state,
@@ -795,8 +771,9 @@ def run_until_membership_impl(
         jnp.int32(0),
         jnp.bool_(False),
         jnp.full((max_cuts,), -1, dtype=jnp.int32),
+        _edge_masks(cfg, state, faults),
     )
-    state, steps, cuts, stalled, sizes = jax.lax.while_loop(
+    state, steps, cuts, stalled, sizes, _ = jax.lax.while_loop(
         outer_cond, outer_body, init
     )
     resolved = (state.n_members == target) & (cuts >= min_cuts)

@@ -52,22 +52,30 @@ def tally_candidates(
     vote_*: [N] per-slot vote hash lanes + validity (has this member voted).
     cand_*: [C] candidate proposal hashes (C small; from cohort proposals).
     """
+    c = cand_hi.shape[0]
     matches = (
         vote_valid[None, :]
         & cand_valid[:, None]
         & (vote_hi[None, :] == cand_hi[:, None])
         & (vote_lo[None, :] == cand_lo[:, None])
     )
-    counts = jnp.sum(matches, axis=1, dtype=jnp.int32)  # [C]
+    counts = jnp.sum(matches, axis=1, dtype=jnp.int32)  # [C], per-candidate
     total = jnp.sum(vote_valid, dtype=jnp.int32)
-    best = jnp.argmax(counts)
-    max_count = counts[best]
+    # The cross-cohort decision test as pure reductions over C: on the
+    # cohort-meshed engine the candidate lanes are sharded over the cohort
+    # axis, and an argmax+gather (counts[best], cand_hi[best]) would
+    # all-gather them — max + first-max one-hot select lowers to psums
+    # instead, and is bit-identical to argmax's first-max tie-break.
+    max_count = jnp.max(counts)
+    cand_ids = jnp.arange(c, dtype=jnp.int32)
+    best = jnp.min(jnp.where(counts == max_count, cand_ids, jnp.int32(c)))
+    sel = cand_ids == best  # one-hot: the lowest-index max candidate
     quorum = fast_paxos_quorum_size(n_members)
     decided = (total >= quorum) & (max_count >= quorum)
     return TallyResult(
         decided=decided,
-        winner_hi=jnp.where(decided, cand_hi[best], jnp.uint32(0)),
-        winner_lo=jnp.where(decided, cand_lo[best], jnp.uint32(0)),
+        winner_hi=jnp.max(jnp.where(decided & sel, cand_hi, jnp.uint32(0))),
+        winner_lo=jnp.max(jnp.where(decided & sel, cand_lo, jnp.uint32(0))),
         max_count=max_count,
         total_votes=total,
     )

@@ -1,28 +1,43 @@
 """Device-mesh sharding for the virtual-cluster engine.
 
-Scale axis = N (virtual members), sharded over a 1-D mesh axis ``nodes``:
-every per-slot array partitions on its N dimension; ring/cohort axes and
-scalars replicate. All of the engine's global reductions (watermark tallies,
-vote counts, set hashes) are sums/anys over N, which XLA lowers to psum over
-ICI; ring topology is re-derived only on view changes — sort-free O(N)
-scans over the static key-order perms (``ring_topology_from_perm``; the
-one argsort runs at state creation) — and its cross-shard permutation
-gathers are the one collective-heavy op (XLA inserts what it needs). This is
-not just a docstring claim: ``tools/collective_audit.py`` classifies every
-collective in the compiled HLO (EVALUATION.md §3c), and
-``tests/test_parallel.py::test_round_body_collectives_are_reductions_only``
-pins the invariants — the convergence hot loop's unconditional traffic is
-~1.2 KB of all-reduces per round, with [c,n]-scale gathers confined to
-lax.cond branches.
+Two scale axes, one rule table. The engine's state is data-parallel over N
+(virtual members) AND over C (receiver cohorts): every per-slot array
+partitions on its N dimension over the ``nodes`` mesh axis, and — since the
+cohort-meshed refactor — every cohort-dimensioned array partitions on its C
+dimension over the ``cohort`` mesh axis. ``make_mesh()`` builds the classic
+1-D ``('nodes',)`` mesh; ``make_mesh(shape=(dc, dn))`` builds the 2-D
+``('cohort', 'nodes')`` mesh the 1M+ headline benchmark targets. One
+regex-driven rule table (:data:`PARTITION_RULES`, the SNIPPETS [1]
+``match_partition_rules`` pattern keyed on pytree field names) produces the
+sharding tables for EITHER mesh: an axis name absent from the target mesh
+drops to replicated on that axis, so the 1-D mesh keeps its exact
+historical layout and a new ``EngineState`` leaf that matches no rule is a
+hard error — it can never silently replicate.
+
+All of the engine's global reductions (watermark tallies, vote counts, set
+hashes) are sums/anys over N or cross-cohort decision reductions over C,
+which XLA lowers to psum over ICI; ring topology is re-derived only on view
+changes — sort-free O(N) scans over the static key-order perms
+(``ring_topology_from_perm``; the one argsort runs at state creation) — and
+its cross-shard permutation gathers are the one collective-heavy op (XLA
+inserts what it needs). This is not just a docstring claim:
+``tools/collective_audit.py`` classifies every collective in the compiled
+HLO (EVALUATION.md §3c), ``tests/test_parallel.py`` pins the invariants,
+and the ``device_program`` gate freezes both the 1-D and the 2-D compiled
+programs' collective/donation budgets into ``tools/analysis/hlo.lock.json``
+— the convergence hot loop's unconditional traffic stays reduce-class, with
+[c,n]-scale gathers confined to lax.cond branches.
 
 This is the TPU equivalent of the reference's scale story (§ SURVEY 5.7):
 the reference keeps per-node load O(K) as N grows; here the whole cluster's
-protocol state is data-parallel over N.
+protocol state is data-parallel over the mesh, and per-device cohort state
+shrinks by the cohort-axis size instead of replicating.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import re
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -35,74 +50,210 @@ from rapid_tpu.models.virtual_cluster import (
 )
 
 NODE_AXIS = "nodes"
+COHORT_AXIS = "cohort"
+
+#: Spec tuples are PartitionSpec entries by position: an axis name, or None
+#: (that array dimension is not meshed). Empty tuple = fully replicated.
+Spec = Tuple[Optional[str], ...]
 
 
-def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
+class ShardingShapeError(ValueError):
+    """A pytree leaf's shape does not divide the mesh axes it shards over
+    (or its sharding targets a different mesh). Raised by
+    :func:`shard_pytree` with the leaf and axis named — XLA's own error for
+    the same condition is an opaque HLO sharding failure deep inside
+    ``make_array_from_callback``."""
+
+
+#: Regex-driven partition rules over the engine pytree field names
+#: (``EngineState`` + ``FaultInputs`` share one namespace — no field name
+#: collides). First match wins; matching is ``re.fullmatch`` so a rule can
+#: never accidentally claim a superstring field. The ``sharding`` analyzer
+#: family lint-checks this table: every state/fault array leaf must match a
+#: rule, a rule matching no leaf is dead, and a fully-replicating rule must
+#: justify itself with ``# replicated-ok: <reason>`` on its line.
+PARTITION_RULES: Tuple[Tuple[str, Spec], ...] = (
+    # [k, n] ring/key/topology tables: slots on the last axis.
+    (r"key_hi|key_lo|ring_perm|obs_idx|subj_idx|inval_obs", (None, NODE_AXIS)),
+    # [n, k] per-edge failure-detector state: slots on the first axis.
+    (r"fd_count|fd_hist|fd_fired|fire_round|probe_fail", (NODE_AXIS, None)),
+    # [c] cohort lanes (watermark flags + proposal-id lanes): sharded over
+    # the cohort mesh axis — these replicated on every device before the
+    # cohort axis was meshed.
+    (r"seen_down|announced|prop_hi|prop_lo", (COHORT_AXIS,)),
+    # [c, n] cohort-by-slot watermark/delivery state: both axes meshed.
+    (r"report_bits|released|prop_mask|rx_block", (COHORT_AXIS, NODE_AXIS)),
+    # [n] per-slot lanes (identity, membership, votes, classic-Paxos
+    # acceptor state, fault masks).
+    (
+        r"id_hi|id_lo|alive|join_pending|cohort_of|vote_hi|vote_lo"
+        r"|vote_valid|cp_rnd_r|cp_rnd_i|cp_vrnd_r|cp_vrnd_i|cp_vval_src"
+        r"|retired|crashed",
+        (NODE_AXIS,),
+    ),
+    (
+        r"config_epoch|config_hi|config_lo|n_members|rounds_undecided"
+        r"|classic_epoch|round_idx",
+        (),  # replicated-ok: per-configuration scalar lanes
+    ),
+)
+
+
+def make_mesh(
+    devices: Optional[Sequence] = None,
+    shape: Optional[Tuple[int, int]] = None,
+) -> Mesh:
+    """The engine device mesh: 1-D ``('nodes',)`` by default, or the 2-D
+    ``('cohort', 'nodes')`` mesh when ``shape=(cohort_devices,
+    node_devices)`` is given (``cohort_devices * node_devices`` must equal
+    the device count)."""
     devices = list(devices) if devices is not None else jax.devices()
-    return Mesh(np.array(devices), (NODE_AXIS,))
+    if shape is None:
+        return Mesh(np.array(devices), (NODE_AXIS,))
+    cohort_devices, node_devices = shape
+    if cohort_devices < 1 or node_devices < 1:
+        raise ValueError(f"mesh shape must be positive, got {shape}")
+    if cohort_devices * node_devices != len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {cohort_devices * node_devices} "
+            f"devices, got {len(devices)}"
+        )
+    return Mesh(
+        np.array(devices).reshape(cohort_devices, node_devices),
+        (COHORT_AXIS, NODE_AXIS),
+    )
+
+
+def match_partition_rules(
+    rules: Sequence[Tuple[str, Spec]], fields: Sequence[str]
+) -> Dict[str, Spec]:
+    """field name -> spec via the first rule whose regex fullmatches — the
+    SNIPPETS [1] ``match_partition_rules`` pattern, keyed on NamedTuple
+    field names instead of flax parameter paths. Raises on an uncovered
+    field: a new engine-state leaf must be placed in the table before it
+    can shard (silent replication of [n]- or [c,n]-scale state is exactly
+    the failure mode this table exists to prevent)."""
+    out: Dict[str, Spec] = {}
+    for name in fields:
+        for pattern, spec in rules:
+            if re.fullmatch(pattern, name):
+                out[name] = spec
+                break
+        else:
+            raise ValueError(
+                f"no partition rule matches engine leaf {name!r} — add it "
+                f"to rapid_tpu.parallel.mesh.PARTITION_RULES"
+            )
+    return out
+
+
+def _resolve_spec(spec: Spec, mesh: Mesh) -> P:
+    """A rule spec as a PartitionSpec on ``mesh``: axis names the mesh does
+    not carry drop to None (the 1-D ``('nodes',)`` mesh replicates the
+    cohort dimension, exactly the pre-2-D layout)."""
+    return P(*(ax if ax is None or ax in mesh.axis_names else None for ax in spec))
+
+
+def _shardings_for(cls, mesh: Mesh):
+    specs = match_partition_rules(PARTITION_RULES, cls._fields)
+    return cls(
+        **{
+            field: NamedSharding(mesh, _resolve_spec(specs[field], mesh))
+            for field in cls._fields
+        }
+    )
 
 
 def state_shardings(mesh: Mesh) -> EngineState:
-    """A NamedSharding pytree matching EngineState: shard every N axis."""
-
-    def sh(*spec) -> NamedSharding:
-        return NamedSharding(mesh, P(*spec))
-
-    return EngineState(
-        key_hi=sh(None, NODE_AXIS),
-        key_lo=sh(None, NODE_AXIS),
-        ring_perm=sh(None, NODE_AXIS),
-        id_hi=sh(NODE_AXIS),
-        id_lo=sh(NODE_AXIS),
-        alive=sh(NODE_AXIS),
-        obs_idx=sh(None, NODE_AXIS),
-        subj_idx=sh(None, NODE_AXIS),
-        inval_obs=sh(None, NODE_AXIS),
-        config_epoch=sh(),  # replicated-ok: per-configuration scalar
-        config_hi=sh(),  # replicated-ok: config-id scalar lane
-        config_lo=sh(),  # replicated-ok: config-id scalar lane
-        n_members=sh(),  # replicated-ok: membership-size scalar
-        fd_count=sh(NODE_AXIS, None),
-        fd_hist=sh(NODE_AXIS, None),
-        fd_fired=sh(NODE_AXIS, None),
-        fire_round=sh(NODE_AXIS, None),
-        join_pending=sh(NODE_AXIS),
-        cohort_of=sh(NODE_AXIS),
-        report_bits=sh(None, NODE_AXIS),
-        seen_down=sh(),  # replicated-ok: [c] cohort flags; the cohort axis is not meshed
-        released=sh(None, NODE_AXIS),
-        announced=sh(),  # replicated-ok: [c] cohort flags; the cohort axis is not meshed
-        prop_mask=sh(None, NODE_AXIS),
-        prop_hi=sh(),  # replicated-ok: [c] proposal-id lanes; cohort axis not meshed
-        prop_lo=sh(),  # replicated-ok: [c] proposal-id lanes; cohort axis not meshed
-        vote_hi=sh(NODE_AXIS),
-        vote_lo=sh(NODE_AXIS),
-        vote_valid=sh(NODE_AXIS),
-        rounds_undecided=sh(),  # replicated-ok: fallback-timer scalar
-        cp_rnd_r=sh(NODE_AXIS),
-        cp_rnd_i=sh(NODE_AXIS),
-        cp_vrnd_r=sh(NODE_AXIS),
-        cp_vrnd_i=sh(NODE_AXIS),
-        cp_vval_src=sh(NODE_AXIS),
-        classic_epoch=sh(),  # replicated-ok: classic-attempt scalar
-        round_idx=sh(),  # replicated-ok: round-counter scalar
-        retired=sh(NODE_AXIS),
-    )
+    """A NamedSharding pytree matching EngineState, built from
+    :data:`PARTITION_RULES` for the given 1-D or 2-D mesh."""
+    return _shardings_for(EngineState, mesh)
 
 
 def fault_shardings(mesh: Mesh) -> FaultInputs:
-    def sh(*spec) -> NamedSharding:
-        return NamedSharding(mesh, P(*spec))
+    return _shardings_for(FaultInputs, mesh)
 
-    return FaultInputs(
-        crashed=sh(NODE_AXIS),
-        probe_fail=sh(NODE_AXIS, None),
-        rx_block=sh(None, NODE_AXIS),
-    )
+
+def pad_to_multiple(value: int, multiple: int) -> int:
+    """Smallest count >= ``value`` divisible by ``multiple`` — size N slots
+    (or C cohorts) so they divide a mesh axis: ``n_slots=pad_to_multiple(n,
+    mesh.shape[NODE_AXIS])`` (spare slots stay dead until a join wave uses
+    them; spare cohorts simply receive no members)."""
+    if multiple < 1 or value < 0:
+        raise ValueError(f"pad_to_multiple({value}, {multiple})")
+    return ((value + multiple - 1) // multiple) * multiple
+
+
+def _validate_leaf(label: str, shape: Tuple[int, ...], sharding: NamedSharding) -> None:
+    spec = sharding.spec
+    for dim, axes in enumerate(spec):
+        if axes is None:
+            continue
+        names = (axes,) if isinstance(axes, str) else tuple(axes)
+        size = 1
+        for name in names:
+            size *= dict(zip(sharding.mesh.axis_names, sharding.mesh.devices.shape))[
+                name
+            ]
+        if dim >= len(shape) or shape[dim] % size:
+            got = shape[dim] if dim < len(shape) else "<missing>"
+            raise ShardingShapeError(
+                f"leaf {label} shape {tuple(shape)}: dimension {dim} "
+                f"(= {got}) does not divide mesh axis {'*'.join(names)} "
+                f"(size {size}) — pad it to "
+                f"pad_to_multiple({got}, {size}) slots (see "
+                f"rapid_tpu.parallel.mesh.pad_to_multiple)"
+            )
+
+
+def shard_pytree(tree, shardings, mesh: Optional[Mesh] = None):
+    """Place host-computed arrays onto a mesh — single-process OR global
+    (multi-controller). ``jax.device_put`` only targets addressable devices,
+    so every leaf is assembled via ``jax.make_array_from_callback``: each
+    process supplies exactly its addressable shards. In a multi-controller
+    job this requires every process to have computed identical host values
+    (deterministic seeds) — the standard multi-controller contract.
+
+    ``shardings`` leaves are NamedShardings, or bare PartitionSpecs when an
+    explicit ``mesh`` is passed. Every leaf is validated up front: its
+    shape must divide the mesh axes it shards over, and (when ``mesh`` is
+    given) its sharding must live on that mesh — violations raise
+    :class:`ShardingShapeError` naming the leaf and the axis instead of
+    XLA's opaque per-shard shape mismatch."""
+
+    def place(path, x, sharding):
+        x = np.asarray(x)
+        if isinstance(sharding, P):
+            if mesh is None:
+                raise ShardingShapeError(
+                    f"leaf {jax.tree_util.keystr(path)}: a bare "
+                    f"PartitionSpec needs an explicit mesh= argument"
+                )
+            sharding = NamedSharding(mesh, sharding)
+        if mesh is not None and sharding.mesh != mesh:
+            raise ShardingShapeError(
+                f"leaf {jax.tree_util.keystr(path)}: sharding targets mesh "
+                f"{sharding.mesh.axis_names}{sharding.mesh.devices.shape}, "
+                f"not the requested {mesh.axis_names}{mesh.devices.shape}"
+            )
+        _validate_leaf(jax.tree_util.keystr(path), x.shape, sharding)
+        return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+    return jax.tree_util.tree_map_with_path(place, tree, shardings)
+
+
+def shard_state(state: EngineState, mesh: Mesh) -> EngineState:
+    """Place an existing (host/single-device) state onto the mesh."""
+    return shard_pytree(state, state_shardings(mesh), mesh=mesh)
+
+
+def shard_faults(faults: FaultInputs, mesh: Mesh) -> FaultInputs:
+    return shard_pytree(faults, fault_shardings(mesh), mesh=mesh)
 
 
 def make_sharded_step(cfg: EngineConfig, mesh: Mesh):
-    """jit the engine step with explicit in/out shardings over ``mesh``.
+    """jit the engine step with explicit in/out shardings over ``mesh``
+    (1-D or 2-D).
 
     Output events replicate (they are scalars plus the [n] winner mask, which
     stays sharded).
@@ -113,15 +264,16 @@ def make_sharded_step(cfg: EngineConfig, mesh: Mesh):
     return jax.jit(
         lambda state, faults: engine_step_impl(cfg, state, faults),
         in_shardings=(st_sh, ft_sh),
-        out_shardings=None,  # let XLA propagate; state stays node-sharded
+        out_shardings=None,  # let XLA propagate; state stays mesh-sharded
         donate_argnums=(0,),
     )
 
 
 def make_sharded_wave(cfg: EngineConfig, mesh: Mesh, max_cuts: int = 8):
     """jit the whole-wave convergence loop (``run_until_membership_impl`` —
-    multiple view changes in one dispatch) with node-axis shardings: the
-    multi-chip twin of the single-chip bench hot path. Returns
+    multiple view changes in one dispatch) with the mesh's shardings: the
+    multi-chip twin of the single-chip bench hot path, and — on the 2-D
+    ``('cohort', 'nodes')`` mesh — the 1M+ headline configuration. Returns
     ``wave(state, faults, target, max_steps, min_cuts) ->
     (state, steps, cuts, resolved, sizes)``; the scalar observations and
     the [max_cuts] sizes vector replicate."""
@@ -135,30 +287,6 @@ def make_sharded_wave(cfg: EngineConfig, mesh: Mesh, max_cuts: int = 8):
             )
         ),
         in_shardings=(st_sh, ft_sh, None, None, None),
-        out_shardings=None,  # XLA propagates; state stays node-sharded
+        out_shardings=None,  # XLA propagates; state stays mesh-sharded
         donate_argnums=(0,),
     )
-
-
-def shard_pytree(tree, shardings):
-    """Place host-computed arrays onto a mesh — single-process OR global
-    (multi-controller). ``jax.device_put`` only targets addressable devices,
-    so every leaf is assembled via ``jax.make_array_from_callback``: each
-    process supplies exactly its addressable shards. In a multi-controller
-    job this requires every process to have computed identical host values
-    (deterministic seeds) — the standard multi-controller contract."""
-
-    def place(x, sharding):
-        x = np.asarray(x)
-        return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
-
-    return jax.tree.map(place, tree, shardings)
-
-
-def shard_state(state: EngineState, mesh: Mesh) -> EngineState:
-    """Place an existing (host/single-device) state onto the mesh."""
-    return shard_pytree(state, state_shardings(mesh))
-
-
-def shard_faults(faults: FaultInputs, mesh: Mesh) -> FaultInputs:
-    return shard_pytree(faults, fault_shardings(mesh))

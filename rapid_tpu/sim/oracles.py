@@ -166,6 +166,42 @@ def check_bounded_convergence(result: RunResult) -> List[Violation]:
 # ---------------------------------------------------------------------------
 
 
+def cuts_refine(fine_cuts: Sequence[Set], coarse_groups: Sequence[Sequence[frozenset]]):
+    """None when ``fine_cuts`` is a refinement of ``coarse_groups``, else a
+    human-readable mismatch description.
+
+    Refinement: the fine sequence partitions each coarse group's union into
+    consecutive sub-cuts — it may split a cut the coarser observer commits
+    whole (sub-interval alert timing), but may never produce an element
+    outside the current group's union, reorder across groups, or leave a
+    group's union unreached. Strict equality is the degenerate refinement
+    (each group one cut, each fine cut the whole union) — which is how the
+    2-D mesh parity tests reuse this as their comparator: a bit-identical
+    engine must refine in BOTH directions. THE definition shared by
+    ``check_differential`` (host run vs engine replay) and
+    ``tests/test_parallel_2d.py`` (sharded engine vs single-device engine).
+    """
+    fine = [set(c) for c in fine_cuts]
+    i = 0
+    for group in coarse_groups:
+        target = set().union(*group) if group else set()
+        acc: set = set()
+        while acc != target:
+            if i >= len(fine) or not fine[i] <= target:
+                return (
+                    f"cut sequence does not refine the reference: "
+                    f"fine={fine_cuts} coarse={coarse_groups}"
+                )
+            acc |= fine[i]
+            i += 1
+    if i != len(fine):
+        return (
+            f"cut sequence has cuts beyond the reference's: "
+            f"fine={fine_cuts} coarse={coarse_groups}"
+        )
+    return None
+
+
 def replay_through_engine(
     schedule: FaultSchedule, endpoints: Sequence[Endpoint]
 ) -> Tuple[List[List[frozenset]], Set[Endpoint]]:
@@ -253,26 +289,9 @@ def check_differential(result: RunResult) -> List[Violation]:
             f"{sorted(map(str, result.final_membership))} vs engine "
             f"{sorted(map(str, engine_final))}",
         )]
-    host_cuts = [set(c) for c in result.cuts]
-    i = 0
-    for cuts in engine_groups:
-        target = set().union(*cuts) if cuts else set()
-        acc: set = set()
-        while acc != target:
-            if i >= len(host_cuts) or not host_cuts[i] <= target:
-                return [Violation(
-                    "differential",
-                    f"host cuts do not refine engine cuts: host={result.cuts} "
-                    f"engine={engine_groups}",
-                )]
-            acc |= host_cuts[i]
-            i += 1
-    if i != len(host_cuts):
-        return [Violation(
-            "differential",
-            f"host produced cuts beyond the engine's: host={result.cuts} "
-            f"engine={engine_groups}",
-        )]
+    mismatch = cuts_refine(result.cuts, engine_groups)
+    if mismatch is not None:
+        return [Violation("differential", f"host vs engine: {mismatch}")]
     return []
 
 

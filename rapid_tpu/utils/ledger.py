@@ -70,6 +70,7 @@ STAGE_NAMES = frozenset({
     "timed_samples",
     "rtt_probe",
     "xl_point",
+    "stretch_point",
     "loss_variant",
     "hlo_audit",
     "profile",

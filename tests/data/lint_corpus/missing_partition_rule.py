@@ -1,0 +1,46 @@
+"""Lint corpus: rule-table holes for the cohort-meshed engine pytree.
+
+A miniature ``EngineState`` + ``PARTITION_RULES`` pair in the current
+(regex rule table) declaration style: one [c, n] leaf is matched by a rule
+that leaves it UNMESHED (empty spec) without a ``# replicated-ok:``
+justification, one leaf matches no rule at all, one rule matches no leaf
+(dead entry), and one replication justification survives from the 1-D era
+whose premise — that the cohort axis is not a mesh axis — is now false.
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from rapid_tpu.parallel.mesh import match_partition_rules
+
+NODE_AXIS = "nodes"
+COHORT_AXIS = "cohort"
+
+PARTITION_RULES = (  # expect: missing-partition-spec
+    (r"alive", (NODE_AXIS,)),
+    (r"report_bits",
+     ()),  # expect: missing-partition-spec
+    (r"round_idx", ()),  # replicated-ok: round-counter scalar
+    (r"seen_down", ()),  # replicated-ok: [c] flags; cohort axis is not meshed  # expect: missing-partition-spec
+    (r"ghost_lanes", (COHORT_AXIS,)),  # expect: missing-partition-spec
+)
+
+
+class EngineState(NamedTuple):
+    alive: jnp.ndarray  # [n]
+    report_bits: jnp.ndarray  # [c, n] — unmeshed by its rule above
+    seen_down: jnp.ndarray  # [c]
+    round_idx: jnp.ndarray  # scalar
+    vote_bits: jnp.ndarray  # [n] — covered by NO rule
+
+
+def state_shardings(mesh: Mesh) -> EngineState:
+    specs = match_partition_rules(PARTITION_RULES, EngineState._fields)
+    return EngineState(
+        **{
+            field: NamedSharding(mesh, P(*specs[field]))
+            for field in EngineState._fields
+        }
+    )

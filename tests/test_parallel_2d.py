@@ -1,0 +1,248 @@
+"""2-D ('cohort', 'nodes') mesh parity: the cohort-meshed engine must be
+bit-identical to the single-device engine.
+
+A pinned grid of churn scenarios drives the 2-D sharded ``step`` (2x4 over
+the forced 8-device CPU mesh) and the single-device path side by side: the
+cut sequences, configuration ids, and decision rounds must match exactly,
+and the whole-wave entrypoint must commit the same multi-cut resolution in
+one dispatch. The cut-sequence comparison reuses the sim oracle battery's
+refinement checker (``sim/oracles.cuts_refine`` — the same relation the
+host<->device differential oracle uses): bit-identical engines must refine
+each other in BOTH directions, which degenerates to equality.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from rapid_tpu.models.virtual_cluster import VirtualCluster
+from rapid_tpu.parallel.mesh import (
+    COHORT_AXIS,
+    NODE_AXIS,
+    ShardingShapeError,
+    make_mesh,
+    make_sharded_step,
+    make_sharded_wave,
+    pad_to_multiple,
+    shard_faults,
+    shard_state,
+    state_shardings,
+)
+from rapid_tpu.sim.oracles import cuts_refine
+
+MESH_SHAPE = (2, 4)  # ('cohort', 'nodes') over the 8 virtual CPU devices
+
+
+def make_mesh_2d():
+    return make_mesh(jax.devices()[:8], shape=MESH_SHAPE)
+
+
+#: Pinned scenario grid: (name, builder). Shapes divide the 2x4 mesh
+#: (n % 4 == 0, cohorts % 2 == 0). Each builder returns (vc, target, the
+#: max steps to drive).
+def _crash_only():
+    vc = VirtualCluster.create(248, n_slots=256, fd_threshold=2, seed=0, cohorts=8)
+    vc.assign_cohorts_roundrobin()
+    vc.crash([3, 77, 130])
+    return vc, 245, 12
+
+
+def _join_wave():
+    vc = VirtualCluster.create(
+        192, n_slots=256, fd_threshold=2, seed=1, delivery_spread=1, cohorts=4
+    )
+    vc.assign_cohorts_roundrobin()
+    vc.inject_join_wave(list(range(192, 240)))
+    return vc, 240, 12
+
+
+def _staggered_multi_cut():
+    vc = VirtualCluster.create(
+        60, n_slots=72, cohorts=16, fd_threshold=2, seed=11, delivery_spread=1
+    )
+    vc.assign_cohorts_roundrobin()
+    vc.crash([7, 31])
+    # Staggered detection pushes the crash cut behind the join cut: the
+    # scenario genuinely resolves through >= 2 view changes.
+    vc.stagger_fd_counts(np.random.default_rng(5), spread_rounds=8)
+    vc.inject_join_wave(list(range(60, 72)))
+    return vc, 70, 40
+
+
+def _leave_and_crash_jittered():
+    vc = VirtualCluster.create(
+        120, n_slots=128, cohorts=8, fd_threshold=2, seed=3, delivery_spread=2,
+        concurrent_coordinators=2,
+    )
+    vc.assign_cohorts_roundrobin()
+    vc.stagger_fd_counts(np.random.default_rng(9), spread_rounds=2)
+    vc.initiate_leave([5, 44])
+    vc.crash([90])
+    return vc, 117, 40
+
+
+#: The tier-1 half of the grid runs on every test session; the heavier
+#: half rides the unfiltered full-suite pass (tools/check.sh) as ``slow``
+#: — each scenario costs two engine compiles (single-device + 2-D).
+SCENARIOS = {
+    "crash_only": _crash_only,
+    "staggered_multi_cut": _staggered_multi_cut,
+}
+SLOW_SCENARIOS = {
+    "join_wave": _join_wave,
+    "leave_and_crash_jittered": _leave_and_crash_jittered,
+}
+
+
+def _drive(step_fn, state, faults, max_steps):
+    """(cuts, config_ids, decision_rounds) of a per-step drive: one cut per
+    decided round, labeled (slot, up/down) like the sim oracles' cuts."""
+    cuts, config_ids, rounds = [], [], []
+    for i in range(max_steps):
+        was_alive = np.asarray(state.alive)
+        state, events = step_fn(state, faults)
+        if bool(events.decided):
+            mask = np.asarray(events.winner_mask)
+            cuts.append(frozenset(
+                (s, "down" if was_alive[s] else "up")
+                for s in np.nonzero(mask)[0].tolist()
+            ))
+            config_ids.append(
+                (int(state.config_hi) << 32) | int(state.config_lo)
+            )
+            rounds.append(i)
+    return state, cuts, config_ids, rounds
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_2d_step_parity_against_single_device(name):
+    _assert_step_parity(SCENARIOS[name], name)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(SLOW_SCENARIOS))
+def test_2d_step_parity_against_single_device_slow(name):
+    _assert_step_parity(SLOW_SCENARIOS[name], name)
+
+
+def _assert_step_parity(build, name):
+    single, target, max_steps = build()
+
+    def single_step(state, faults):
+        del state, faults
+        events = single.step()
+        return single.state, events
+
+    _, cuts_1, ids_1, rounds_1 = _drive(
+        single_step, single.state, single.faults, max_steps
+    )
+
+    vc, _, _ = build()
+    mesh = make_mesh_2d()
+    step = make_sharded_step(vc.cfg, mesh)
+    state = shard_state(vc.state, mesh)
+    faults = shard_faults(vc.faults, mesh)
+    state, cuts_2, ids_2, rounds_2 = _drive(step, state, faults, max_steps)
+
+    assert cuts_1, f"{name}: scenario produced no cuts — not a parity case"
+    # Bit-identical: same cuts at the same rounds committing the same
+    # configuration ids, and the same final state.
+    assert rounds_2 == rounds_1
+    assert ids_2 == ids_1
+    assert cuts_2 == cuts_1
+    assert int(state.n_members) == single.membership_size == target
+    np.testing.assert_array_equal(np.asarray(state.alive), single.alive_mask)
+    # The sim battery's refinement relation as the comparator: identical
+    # sequences refine each other in both directions (each cut its own
+    # group).
+    assert cuts_refine(cuts_2, [[c] for c in cuts_1]) is None
+    assert cuts_refine(cuts_1, [[c] for c in cuts_2]) is None
+
+
+@pytest.mark.slow
+def test_2d_wave_parity_multi_cut_single_dispatch():
+    """The whole-wave entrypoint on the 2-D mesh: a churn resolving through
+    MULTIPLE cohort-meshed view changes in one dispatch matches the
+    single-device fused loop exactly — rounds, cuts, per-cut sizes, final
+    configuration."""
+    single, target, _ = _staggered_multi_cut()
+    r1, c1, resolved1, sizes1 = single.run_until_membership(target, min_cuts=1)
+    assert resolved1 and c1 >= 2  # genuinely multi-cuts
+
+    vc, _, _ = _staggered_multi_cut()
+    mesh = make_mesh_2d()
+    wave = make_sharded_wave(vc.cfg, mesh, max_cuts=8)
+    state, steps, cuts, resolved, sizes = wave(
+        shard_state(vc.state, mesh), shard_faults(vc.faults, mesh),
+        jnp.int32(target), jnp.int32(192), jnp.int32(1),
+    )
+    assert bool(resolved)
+    assert (int(steps), int(cuts)) == (r1, c1)
+    assert tuple(np.asarray(sizes)[: int(cuts)].tolist()) == sizes1
+    assert int(state.n_members) == target == single.membership_size
+    np.testing.assert_array_equal(np.asarray(state.alive), single.alive_mask)
+    assert int(state.config_hi) == int(single.state.config_hi)
+    assert int(state.config_lo) == int(single.state.config_lo)
+
+
+def test_2d_state_shards_cohort_and_node_axes():
+    """[c] lanes shard over 'cohort', [c, n] over both axes, [n] over
+    'nodes' — and per-device cohort-state bytes are 1/8 of global (the
+    whole point of meshing the cohort axis)."""
+    vc, _, _ = _crash_only()
+    mesh = make_mesh_2d()
+    state = shard_state(vc.state, mesh)
+    shardings = state_shardings(mesh)
+    assert shardings.seen_down.spec == jax.sharding.PartitionSpec(COHORT_AXIS)
+    assert shardings.report_bits.spec == jax.sharding.PartitionSpec(
+        COHORT_AXIS, NODE_AXIS
+    )
+    assert shardings.alive.spec == jax.sharding.PartitionSpec(NODE_AXIS)
+    for leaf in (state.report_bits, state.released, state.prop_mask):
+        shard = leaf.addressable_shards[0].data
+        assert shard.nbytes * 8 == leaf.nbytes, leaf.shape
+    for leaf in (state.seen_down, state.announced, state.prop_hi):
+        shard = leaf.addressable_shards[0].data
+        assert shard.nbytes * 2 == leaf.nbytes, leaf.shape
+
+
+def test_shard_pytree_names_the_indivisible_leaf():
+    """Satellite: a shape that does not divide the mesh axes raises the
+    named error (leaf + axis + pad hint), not XLA's opaque one — and
+    pad_to_multiple names the fix."""
+    vc = VirtualCluster.create(50, n_slots=50, fd_threshold=2, seed=0, cohorts=6)
+    mesh = make_mesh_2d()
+    with pytest.raises(ShardingShapeError) as err:
+        shard_state(vc.state, mesh)
+    msg = str(err.value)
+    assert "does not divide" in msg and "pad_to_multiple" in msg
+    assert pad_to_multiple(50, 4) == 52
+    assert pad_to_multiple(52, 4) == 52
+    assert pad_to_multiple(0, 8) == 0
+    # A padded build shards cleanly.
+    vc2 = VirtualCluster.create(
+        50, n_slots=pad_to_multiple(50, 4), fd_threshold=2, seed=0,
+        cohorts=pad_to_multiple(6, 2),
+    )
+    shard_state(vc2.state, mesh)
+
+
+def test_shard_pytree_rejects_wrong_mesh_and_accepts_bare_specs():
+    vc, _, _ = _crash_only()
+    mesh = make_mesh_2d()
+    mesh_1d = make_mesh(jax.devices()[:8])
+    from rapid_tpu.parallel.mesh import shard_pytree
+
+    with pytest.raises(ShardingShapeError, match="targets mesh"):
+        shard_pytree(vc.state, state_shardings(mesh_1d), mesh=mesh)
+    # Bare PartitionSpec leaves resolve against the explicit mesh.
+    specs = jax.tree.map(
+        lambda sh: sh.spec, state_shardings(mesh),
+        is_leaf=lambda x: isinstance(x, jax.sharding.NamedSharding),
+    )
+    placed = shard_pytree(vc.state, specs, mesh=mesh)
+    assert placed.report_bits.sharding.mesh.axis_names == (COHORT_AXIS, NODE_AXIS)
+    with pytest.raises(ShardingShapeError, match="explicit mesh"):
+        shard_pytree(vc.state, specs)

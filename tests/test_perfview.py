@@ -114,6 +114,46 @@ def test_trajectory_accepts_bare_metric_json(tmp_path, capsys):
                           if line.startswith("hole"))
 
 
+def test_trajectory_renders_headline_column_and_flags_missing(tmp_path, capsys):
+    """ISSUE 9: the n1M_crash1pct_ms headline renders as its own trajectory
+    column; an AUDITED round (carries hlo_audit) that omits both the value
+    and its explicit n1M_status marker flags headline-missing; pre-audit
+    historical rounds are exempt."""
+    audit = {"sharded2d_wave": {"collectives": 5, "hot_loop_collectives": 1,
+                                "temp_bytes": 10, "donation_dropped": 0}}
+    points = {
+        # Pre-audit historical round: exempt (sorts first).
+        "BENCH_r20.json": {"metric": "m", "value": 1.0, "platform": "cpu"},
+        # Audited + measured headline: value in the N1M column, no flag.
+        "BENCH_r21.json": {"metric": "m", "value": 100.0, "platform": "tpu",
+                           "hlo_audit": audit, "n1M_status": "live",
+                           "n1M_crash1pct_ms": 709.2},
+        # Audited + explicit ramped marker (CPU stage-path run): no flag.
+        "BENCH_r22.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, "n1M_status": "ramped:4096",
+                           "xl_point_ms": 40.0, "xl_n": 4096},
+        # Audited round that silently dropped the headline: flagged.
+        "BENCH_r23.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit},
+    }
+    paths = []
+    for name, data in points.items():
+        p = tmp_path / name
+        p.write_text(json.dumps(data))
+        paths.append(str(p))
+    assert perfview.main(paths) == 0
+    out = capsys.readouterr().out
+    assert "N1M" in out.splitlines()[1]  # the trajectory header row
+    lines = {line.split()[0]: line for line in out.splitlines()
+             if line.startswith("BENCH_r2")}
+    assert "709.2ms" in lines["BENCH_r21"]
+    assert "headline-missing" not in lines["BENCH_r21"]
+    assert "ramped:4096" in lines["BENCH_r22"]
+    assert "headline-missing" not in lines["BENCH_r22"]
+    assert "headline-missing" in lines["BENCH_r23"]
+    assert "headline-missing" not in lines["BENCH_r20"]  # pre-audit history
+
+
 def test_chrome_trace_envelope(tmp_path, capsys):
     path = _complete_ledger(tmp_path)
     chrome_path = tmp_path / "trace.json"

@@ -366,6 +366,7 @@ _CORPUS_CHECKERS = {
     "clean_device_program.py": ("rapid_tpu/models/_corpus.py", "check_device_program"),
     "host_sync_in_hot_path.py": ("rapid_tpu/ops/_corpus.py", "check_sharding"),
     "missing_partition_spec.py": ("rapid_tpu/parallel/_corpus.py", "check_sharding"),
+    "missing_partition_rule.py": ("rapid_tpu/parallel/_corpus.py", "check_sharding"),
     "retrace_hazard.py": ("rapid_tpu/models/_corpus.py", "check_sharding"),
     "clean_sharding.py": ("rapid_tpu/parallel/_corpus.py", "check_sharding"),
 }

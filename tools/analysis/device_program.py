@@ -59,11 +59,15 @@ REGISTRY_SOURCES = (
 
 #: Audit shapes: small enough to compile in seconds, large enough that the
 #: payload classes ([n]-scale vs [c,n]-scale) are unambiguous. The mesh
-#: axis needs AUDIT_DEVICES to divide AUDIT_N.
+#: axis needs AUDIT_DEVICES to divide AUDIT_N; the 2-D ``('cohort',
+#: 'nodes')`` variant reshapes the same devices to (AUDIT_COHORT_DEVICES,
+#: AUDIT_DEVICES // AUDIT_COHORT_DEVICES), which must divide AUDIT_C and
+#: AUDIT_N respectively.
 AUDIT_N = 256
 AUDIT_C = 8
 AUDIT_K = 4
 AUDIT_DEVICES = 8
+AUDIT_COHORT_DEVICES = 2
 
 #: Relative tolerance + absolute slack for the temp/codegen memory
 #: comparison: XLA's buffer assignment may legitimately wobble a little
@@ -163,6 +167,31 @@ def _build_registry() -> "Dict[str, Dict[str, Any]]":
             "jit": make_sharded_wave(cfg, mesh),
             "args": (
                 sh_state, sh_faults, jnp.int32(AUDIT_N - AUDIT_DEVICES),
+                jnp.int32(192), jnp.int32(0),
+            ),
+            "donated_leaves": state_leaves,
+        }
+        # The 2-D ('cohort', 'nodes') variant — the 1M+ headline bench
+        # configuration: same devices, reshaped so the cohort lanes and the
+        # [c, n] watermark state genuinely shard over the cohort axis. The
+        # 1-D entries above stay registered as the hot-loop baseline the
+        # 2-D program is budget-compared against (test_hlo_gate.py). Only
+        # the WAVE is registered: it contains the step's entire compiled
+        # surface (round body + cond-gated view change + per-cut prologue)
+        # and every extra two-axis GSPMD compile costs ~10 s of the tier-1
+        # session — the step variant is still differentially driven against
+        # the single-device engine in tests/test_parallel_2d.py and by the
+        # multichip dry run.
+        mesh2d = make_mesh(
+            jax.devices()[:AUDIT_DEVICES],
+            shape=(AUDIT_COHORT_DEVICES, AUDIT_DEVICES // AUDIT_COHORT_DEVICES),
+        )
+        sh2_state = shard_state(state, mesh2d)
+        sh2_faults = shard_faults(faults, mesh2d)
+        registry["sharded2d_wave"] = {
+            "jit": make_sharded_wave(cfg, mesh2d),
+            "args": (
+                sh2_state, sh2_faults, jnp.int32(AUDIT_N - AUDIT_DEVICES),
                 jnp.int32(192), jnp.int32(0),
             ),
             "donated_leaves": state_leaves,
@@ -348,6 +377,7 @@ def facts_to_lock(facts: Dict[str, Any]) -> Dict[str, Any]:
         "audit_config": {
             "n": AUDIT_N, "c": AUDIT_C, "k": AUDIT_K,
             "devices": AUDIT_DEVICES,
+            "cohort_devices": AUDIT_COHORT_DEVICES,
         },
         "entrypoints": {},
     }
@@ -541,7 +571,8 @@ def check_hlo_lock(trees: Sequence[Tuple[ast.AST, str]]) -> List[Finding]:
             f"`python tools/staticcheck.py --update-hlo-lock`",
         )]
     audit_cfg = {"n": AUDIT_N, "c": AUDIT_C, "k": AUDIT_K,
-                 "devices": AUDIT_DEVICES}
+                 "devices": AUDIT_DEVICES,
+                 "cohort_devices": AUDIT_COHORT_DEVICES}
     if locked.get("audit_config") != audit_cfg:
         return [Finding(
             HLO_LOCK_REL, 1, "hlo-lock-drift",

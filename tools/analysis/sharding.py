@@ -6,12 +6,19 @@ compiled programs, over ``rapid_tpu/ops/``, ``rapid_tpu/models/``, and
 ``rapid_tpu/parallel/``:
 
 - ``missing-partition-spec`` — every array leaf of the engine state pytree
-  (``EngineState``/``FaultInputs`` in models/state.py) must have a declared
-  ``PartitionSpec`` in ``parallel/mesh.py``'s sharding tables
-  (``state_shardings``/``fault_shardings``), and a leaf declared fully
-  replicated (``sh()`` with no axes) must justify it with
-  ``# replicated-ok: <reason>`` on the line — an undeclared leaf silently
-  replicates [n]-scale state onto every device.
+  (``EngineState``/``FaultInputs`` in models/state.py) must be covered by
+  ``parallel/mesh.py``'s partition declarations. Two declaration styles are
+  understood: the regex rule table (``PARTITION_RULES`` — the current
+  engine style: every leaf must fullmatch a rule, a rule matching no leaf
+  is a dead entry, and a rule whose spec names no mesh axis must justify
+  the replication with ``# replicated-ok: <reason>`` on its spec line) and
+  the legacy explicit constructor table (``state_shardings`` /
+  ``fault_shardings`` keyword-per-leaf — same leaf coverage + justified
+  ``sh()`` discipline). An uncovered leaf silently replicates [n]- or
+  [c,n]-scale state onto every device. Since the cohort axis became a real
+  mesh axis (the 2-D ``('cohort', 'nodes')`` mesh), any surviving
+  ``cohort axis is not meshed`` replication justification is itself a
+  finding — the annotation's premise is false.
 - ``host-sync-in-hot-path`` — ``jax.device_get`` / ``.block_until_ready()``
   / ``.item()`` / ``float(...)`` / ``np.asarray(...)`` inside the traced
   convergence seams (jitted functions, the ``*_impl`` engine convention,
@@ -43,8 +50,9 @@ state.py/mesh.py pair on full sweeps.
 from __future__ import annotations
 
 import ast
+import re
 from pathlib import Path
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 from . import core
 from .core import Finding
@@ -334,6 +342,135 @@ def _check_retrace(
 
 # -- missing-partition-spec --------------------------------------------------
 
+#: The regex rule table's module-level name (parallel/mesh.py).
+RULES_NAME = "PARTITION_RULES"
+
+#: A replication justification whose premise died with the 1-D mesh: the
+#: cohort axis IS meshed now, so any surviving instance is a finding.
+STALE_REPLICATION_REASON = "cohort axis is not meshed"
+
+
+def _partition_rules(tree: ast.AST) -> Optional[Tuple[int, List[Dict[str, Any]]]]:
+    """The module-level ``PARTITION_RULES`` tuple literal, parsed to
+    (assignment lineno, [{pattern, meshed_axes, lineno, spec_lineno}]).
+    None when the module declares no rule table. Only statically-resolvable
+    (pattern-Constant, spec-Tuple) rules are kept — skip, don't guess."""
+    for node in tree.body:
+        value = None
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == RULES_NAME
+        ):
+            value = node.value
+        elif (
+            isinstance(node, ast.AnnAssign)
+            and isinstance(node.target, ast.Name)
+            and node.target.id == RULES_NAME
+        ):
+            value = node.value
+        if not isinstance(value, ast.Tuple):
+            continue
+        rules: List[Dict[str, Any]] = []
+        for elt in value.elts:
+            if not (isinstance(elt, ast.Tuple) and len(elt.elts) == 2):
+                continue
+            pat, spec = elt.elts
+            if not (isinstance(pat, ast.Constant) and isinstance(pat.value, str)):
+                continue
+            if not isinstance(spec, ast.Tuple):
+                continue  # a computed spec: skip, don't guess
+            meshed = sum(
+                1
+                for a in spec.elts
+                if not (isinstance(a, ast.Constant) and a.value is None)
+            )
+            rules.append({
+                "pattern": pat.value,
+                "meshed_axes": meshed,
+                "lineno": pat.lineno,
+                "spec_lineno": spec.lineno,
+            })
+        return node.lineno, rules
+    return None
+
+
+def _stale_annotation_findings(rel: str, source_lines: List[str]) -> List[Finding]:
+    return [
+        Finding(
+            rel, lineno, "missing-partition-spec",
+            f"stale replication justification {STALE_REPLICATION_REASON!r}: "
+            f"the cohort axis IS a mesh axis (2-D ('cohort', 'nodes') mesh) "
+            f"— shard the leaf over it or state the real reason",
+        )
+        for lineno, line in enumerate(source_lines, 1)
+        if STALE_REPLICATION_REASON in line
+    ]
+
+
+def _rule_findings(
+    fields_by_class: Dict[str, List[str]],
+    assign_lineno: int,
+    rules: List[Dict[str, Any]],
+    rel: str,
+    source_lines: List[str],
+) -> List[Finding]:
+    """Coverage of the engine pytree leaves by the regex rule table: every
+    leaf fullmatches a rule (first match wins, mirroring
+    ``mesh.match_partition_rules``), no rule is dead, and a rule that
+    replicates (names no mesh axis) justifies itself on its spec line."""
+    findings: List[Finding] = []
+    compiled: List[Optional["re.Pattern"]] = []
+    for rule in rules:
+        try:
+            compiled.append(re.compile(rule["pattern"]))
+        except re.error as exc:
+            compiled.append(None)
+            findings.append(Finding(
+                rel, rule["lineno"], "missing-partition-spec",
+                f"{RULES_NAME} rule {rule['pattern']!r} is not a valid "
+                f"regex ({exc}) — it can cover nothing",
+            ))
+    all_fields = sorted({f for fields in fields_by_class.values() for f in fields})
+    matched_fields: Dict[int, List[str]] = {}
+    for field in all_fields:
+        hit = None
+        for idx, pattern in enumerate(compiled):
+            if pattern is not None and pattern.fullmatch(field):
+                hit = idx
+                break
+        if hit is None:
+            findings.append(Finding(
+                rel, assign_lineno, "missing-partition-spec",
+                f"engine pytree leaf {field!r} matches no rule in "
+                f"{RULES_NAME} — an uncovered leaf silently replicates "
+                f"onto every device",
+            ))
+        else:
+            matched_fields.setdefault(hit, []).append(field)
+    for idx, rule in enumerate(rules):
+        if compiled[idx] is None:
+            continue
+        fields = matched_fields.get(idx, [])
+        if not fields:
+            findings.append(Finding(
+                rel, rule["lineno"], "missing-partition-spec",
+                f"{RULES_NAME} rule {rule['pattern']!r} matches no engine "
+                f"pytree leaf — dead table entry",
+            ))
+        elif rule["meshed_axes"] == 0 and not _comment_ok(
+            source_lines, rule["spec_lineno"], "# replicated-ok:"
+        ):
+            findings.append(Finding(
+                rel, rule["spec_lineno"], "missing-partition-spec",
+                f"{RULES_NAME} rule {rule['pattern']!r} fully replicates "
+                f"leaves {fields} without a `# replicated-ok: <reason>` "
+                f"justification",
+            ))
+    findings.extend(_stale_annotation_findings(rel, source_lines))
+    return findings
+
 
 def _pytree_array_fields(tree: ast.AST) -> Dict[str, List[str]]:
     """Array-leaf field names of each state-pytree NamedTuple present in
@@ -431,6 +568,7 @@ def _partition_spec_findings(
                     f"{table_fn}() declares a spec for {kw.arg!r}, which is "
                     f"not an array leaf of {cls} — dead table entry",
                 ))
+    findings.extend(_stale_annotation_findings(tables_rel, source_lines))
     return findings
 
 
@@ -460,7 +598,10 @@ def check_sharding(
     _check_donation(tree, aliases, rel, source_lines, findings)
     _check_retrace(tree, aliases, rel, source_lines, findings)
     fields = _pytree_array_fields(tree)
-    if fields and _table_constructor_calls(tree):
+    rules = _partition_rules(tree)
+    if fields and rules is not None:
+        findings.extend(_rule_findings(fields, rules[0], rules[1], rel, source_lines))
+    elif fields and _table_constructor_calls(tree):
         findings.extend(_partition_spec_findings(fields, tree, rel, src))
     return sorted(set(findings), key=lambda f: (f.lineno, f.check, f.message))
 
@@ -484,6 +625,10 @@ def check_partition_specs(
     if not fields:
         return []
     mesh_path = core.REPO / MESH_FILE
-    return _partition_spec_findings(
-        fields, mesh_tree, MESH_FILE, mesh_path.read_text()
-    )
+    mesh_source = mesh_path.read_text()
+    rules = _partition_rules(mesh_tree)
+    if rules is not None:
+        return _rule_findings(
+            fields, rules[0], rules[1], MESH_FILE, mesh_source.splitlines()
+        )
+    return _partition_spec_findings(fields, mesh_tree, MESH_FILE, mesh_source)

@@ -8,8 +8,10 @@ cd "$(dirname "$0")/.."
 
 echo "== static checks (AST lint + resolution tier + compiled-program gate) =="
 # test_hlo_gate.py first: it compiles the registered engine entrypoints
-# ONCE per session, so the lint/staticcheck tree sweeps in the same
-# session reuse the facts instead of recompiling.
+# ONCE per session — including the 2-D ('cohort','nodes') mesh wave
+# (sharded2d_wave; the 2-D step is deliberately unregistered, see
+# device_program._build_registry) — so the lint/staticcheck tree sweeps in
+# the same session reuse the facts instead of recompiling.
 python -m pytest tests/test_hlo_gate.py tests/test_lint.py tests/test_staticcheck.py -q -p no:randomly
 
 echo "== full suite (CPU, 8 virtual devices) =="

@@ -22,7 +22,12 @@ difference from the committed gate: the gate compiles at fixed small audit
 shapes and freezes the facts into ``hlo.lock.json``; this tool compiles at
 evidence scale (10K+ slots) and writes the full table.
 
-    python tools/collective_audit.py [--n 10240] [--devices 8] [--out FILE]
+    python tools/collective_audit.py [--n 10240] [--devices 8] \
+        [--cohort-devices 2] [--out FILE]
+
+``--cohort-devices D`` audits the 2-D ``('cohort', 'nodes')`` mesh (D rows
+by devices/D columns — the 1M+ headline configuration's layout) instead of
+the default 1-D ``('nodes',)`` mesh.
 
 Writes a JSON table and prints a markdown summary (EVALUATION.md
 §collectives is generated from this).
@@ -44,8 +49,17 @@ def main() -> None:
     parser.add_argument("--n", type=int, default=10240)
     parser.add_argument("--devices", type=int, default=8)
     parser.add_argument("--cohorts", type=int, default=64)
+    parser.add_argument(
+        "--cohort-devices", type=int, default=0, metavar="D",
+        help="audit the 2-D ('cohort','nodes') mesh with D cohort rows "
+             "(must divide --devices and --cohorts); 0 = the 1-D mesh",
+    )
     parser.add_argument("--out", default=None)
     args = parser.parse_args()
+    if args.cohort_devices and (
+        args.devices % args.cohort_devices or args.cohorts % args.cohort_devices
+    ):
+        parser.error("--cohort-devices must divide --devices and --cohorts")
 
     from rapid_tpu.utils.platform import force_platform
 
@@ -74,13 +88,21 @@ def main() -> None:
         cohorts=args.cohorts, delivery_spread=2, seed=0,
     )
     vc.assign_cohorts_roundrobin()
-    mesh = make_mesh(jax.devices()[: args.devices])
+    if args.cohort_devices:
+        mesh = make_mesh(
+            jax.devices()[: args.devices],
+            shape=(args.cohort_devices, args.devices // args.cohort_devices),
+        )
+    else:
+        mesh = make_mesh(jax.devices()[: args.devices])
     state = shard_state(vc.state, mesh)
     faults = shard_faults(vc.faults, mesh)
     n_leaves = len(jax.tree_util.tree_leaves(state))
 
     report = {"n_slots": n_slots, "cohorts": args.cohorts,
-              "devices": args.devices, "programs": {}, "facts": {}}
+              "devices": args.devices,
+              "mesh": dict(zip(mesh.axis_names, mesh.devices.shape)),
+              "programs": {}, "facts": {}}
     cfg = vc.cfg
 
     # Program 1: the single-dispatch CONVERGENCE loop (the product path for

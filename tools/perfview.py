@@ -16,9 +16,13 @@ runs, and can I trust the numbers". Two input kinds, freely mixed:
   per round, flagging every point that is NOT a live measurement of the
   code it claims to measure: ``snapshot`` (replayed evidence), ``stale``
   (snapshot measured different code than HEAD), ``wedged`` (live attempt
-  died), ``hole`` (explicit accelerator-unavailable marker), and
+  died), ``hole`` (explicit accelerator-unavailable marker),
   ``suspect-rate`` (a derived rate outside plausibility bounds — the
-  alert_deliveries_per_sec ≈ 5e10 class of bug).
+  alert_deliveries_per_sec ≈ 5e10 class of bug), and ``headline-missing``
+  (an audited round that carries neither the ``n1M_crash1pct_ms``
+  headline nor its explicit ``n1M_status`` marker — the 1M scale number
+  must never be silently absent). The N1M column renders the headline
+  value (or its status marker) per round.
 
 ``--chrome out.json`` additionally writes Chrome trace-event JSON (the same
 envelope tools/traceview.py emits — Perfetto/chrome://tracing load it):
@@ -273,6 +277,17 @@ def point_flags(
             if value > SUSPECT_RATE_PER_SEC:
                 flags.append("suspect-rate")
                 break
+    # Headline discipline (ISSUE 9): an AUDITED round (it carries the
+    # hlo_audit table, i.e. post-promotion bench code produced it) must
+    # carry the 1M headline value or its explicit n1M_status marker.
+    # Pre-audit historical rounds are exempt — absence there is history,
+    # not a silent drop.
+    if (
+        hlo_audit_table(data) is not None
+        and not isinstance(data.get("n1M_crash1pct_ms"), (int, float))
+        and not data.get("n1M_status")
+    ):
+        flags.append("headline-missing")
     if hlo_drift(prev, hlo_audit_table(data)):
         flags.append("hlo-drift")
     if not flags:
@@ -300,9 +315,19 @@ def load_trajectory_point(path: str) -> Dict[str, Any]:
     return data
 
 
+def headline_cell(data: Dict[str, Any]) -> str:
+    """The N1M column: the measured 1M headline, else its explicit status
+    marker, else '-' (pre-promotion rounds)."""
+    value = data.get("n1M_crash1pct_ms")
+    if isinstance(value, (int, float)):
+        return f"{float(value):.1f}ms"
+    status = data.get("n1M_status")
+    return str(status) if status else "-"
+
+
 def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
     lines = ["== perf trajectory =="]
-    header = ("ROUND", "METRIC", "VALUE", "PLATFORM", "VSBASE", "FLAGS")
+    header = ("ROUND", "METRIC", "VALUE", "N1M", "PLATFORM", "VSBASE", "FLAGS")
     rows: List[Tuple[str, ...]] = []
     flag_rows: List[Tuple[str, List[str]]] = []
     prev_audit: Optional[Dict[str, Any]] = None
@@ -317,6 +342,7 @@ def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
             Path(path).stem,
             str(data.get("metric", "?")),
             "-" if value is None else f"{float(value):.1f}ms",
+            headline_cell(data),
             str(data.get("platform", "-")),
             "-" if vs is None else f"{float(vs):.2f}x"
             + ("@capture" if "vs_baseline_at_capture" in data else ""),
