@@ -278,6 +278,7 @@ STAGE_TIMEOUTS_S = {
     "xl_point": 1500,
     "stretch_point": 3000,
     "loss_variant": 900,
+    "tenant_fleet": 900,
     "hlo_audit": 600,
     "profile": 600,
 }
@@ -309,6 +310,38 @@ def headline_plan(platform: str, elapsed_s: float) -> "tuple[int, str]":
         return n_headline, "live"
     n_ramped = _env_int("RAPID_TPU_BENCH_XL_N", 4096)
     return n_ramped, f"ramped:{n_ramped}"
+
+
+def fleet_plan(platform: str, elapsed_s: float) -> "tuple[int, int, str]":
+    """The multi-tenant fleet decision, pure over (platform, elapsed
+    seconds) + env: returns (tenant count B, members per tenant N,
+    tenant_fleet_status). B == 0 means the stage is skipped — but the
+    status STILL lands in the emitted JSON, so the fleet metric is never
+    silently absent (the n1M_status discipline, ISSUE 10). On the
+    accelerator (or RAPID_TPU_BENCH_FLEET=1) the fleet runs at 256 tenants
+    x 1024 members; a CPU run exercises the full stage path ramped down
+    (RAPID_TPU_BENCH_FLEET_B/_N, default 8 x 64); past the budget
+    (RAPID_TPU_BENCH_FLEET_BUDGET_S, defaulting to the XL budget) it is
+    skipped-budget; RAPID_TPU_BENCH_NO_FLEET=1 suppresses it everywhere.
+    Unit-pinned in tests/test_bench_ledger.py."""
+    if _env_flag("RAPID_TPU_BENCH_NO_FLEET"):
+        return 0, 0, "suppressed"
+    forced = _env_flag("RAPID_TPU_BENCH_FLEET")
+    budget_s = _env_int(
+        "RAPID_TPU_BENCH_FLEET_BUDGET_S",
+        _env_int("RAPID_TPU_BENCH_XL_BUDGET_S", 1500),
+    )
+    if elapsed_s > budget_s and not forced:
+        return 0, 0, "skipped-budget"
+    if platform == "tpu" or forced:
+        return (
+            _env_int("RAPID_TPU_BENCH_FLEET_B", 256),
+            _env_int("RAPID_TPU_BENCH_FLEET_N", 1024),
+            "live",
+        )
+    b = _env_int("RAPID_TPU_BENCH_FLEET_B", 8)
+    n_t = _env_int("RAPID_TPU_BENCH_FLEET_N", 64)
+    return b, n_t, f"ramped:{b}x{n_t}"
 
 
 def _parse_scale(spec: str) -> int:
@@ -665,6 +698,100 @@ def run_workload(ledger, profile_dir=None) -> None:
     else:
         _mark("skipping churn_under_loss variant: past the XL time budget")
 
+    # Multi-tenant fleet point (ISSUE 10 / ROADMAP item 4): B independent
+    # clusters — a MIXED bag of scenario families (crash wave, join wave,
+    # equal-churn) with independent seeds and per-tenant H/L knobs —
+    # resolved in ONE lockstep fleet-wave dispatch (rapid_tpu/tenancy).
+    # The metric is tenant_view_changes_per_sec: total view changes
+    # committed across the fleet over the wall clock of the single
+    # dispatch. Never silently absent: tenant_fleet_status always lands in
+    # the emitted JSON (the n1M_status discipline); CPU runs exercise the
+    # stage ramped-down.
+    fleet_b, fleet_n, fleet_status = fleet_plan(
+        platform, time.monotonic() - _START
+    )
+    fleet_vcps = None
+    fleet_cuts_total = None
+    fleet_wall_ms = None
+    fleet_memory = None
+    if fleet_b == 0:
+        _mark(f"tenant fleet stage not run: {fleet_status}")
+    else:
+        from rapid_tpu.tenancy import TenantFleet
+
+        fleet_max_steps = 96  # fixed lockstep recipe: the metric divides by
+        # the wall clock of exactly this many batched rounds
+
+        def build_fleet(seed0: int):
+            """B tenants cycling three scenario families, per-tenant knob
+            mix, independent seeds; returns (fleet, targets, min_cuts)."""
+            n_extra = max(2, fleet_n // 50)
+            clusters, targets = [], []
+            for i in range(fleet_b):
+                h, l = ((9, 4), (8, 3))[i % 2]
+                vc = VirtualCluster.create(
+                    fleet_n, n_slots=fleet_n + n_extra, k=k_rings, h=h, l=l,
+                    cohorts=min(8, fleet_n), fd_threshold=fd_threshold,
+                    seed=seed0 + i, delivery_spread=delivery_spread,
+                )
+                vc.assign_cohorts_roundrobin()
+                rng = np.random.default_rng(seed0 + 10_000 + i)
+                vc.stagger_fd_counts(rng, spread_rounds=3)
+                family = i % 3
+                if family == 0:  # crash wave
+                    vc.crash(rng.choice(fleet_n, size=n_extra, replace=False))
+                    targets.append(fleet_n - n_extra)
+                elif family == 1:  # join wave
+                    vc.inject_join_wave(
+                        np.arange(fleet_n, fleet_n + n_extra)
+                    )
+                    targets.append(fleet_n + n_extra)
+                else:  # equal churn: joins == crashes, target == start —
+                    # min_cuts=1 below is what distinguishes "resolved"
+                    # from "never started" for these tenants
+                    vc.crash(rng.choice(fleet_n, size=n_extra, replace=False))
+                    vc.inject_join_wave(
+                        np.arange(fleet_n, fleet_n + n_extra)
+                    )
+                    targets.append(fleet_n)
+                clusters.append(vc)
+            return TenantFleet.from_clusters(clusters), targets
+
+        with ledger.stage(
+            "tenant_fleet", timeout_s=_stage_timeout("tenant_fleet"),
+            n=fleet_b * fleet_n,
+        ):
+            with _heartbeat(f"tenant_fleet B={fleet_b} N={fleet_n} warm-up"):
+                with engine_telemetry.CompileDelta() as fleet_compiles:
+                    fleet, targets = build_fleet(seed0=50_000)
+                    fleet.sync()
+                    fleet.run_until_membership(
+                        targets, max_steps=fleet_max_steps, max_cuts=4,
+                        min_cuts=1,
+                    )
+            fleet, targets = build_fleet(seed0=60_000)
+            fleet.sync()
+            t0 = time.perf_counter()
+            _, cuts, resolved, _ = fleet.run_until_membership(
+                targets, max_steps=fleet_max_steps, max_cuts=4, min_cuts=1,
+            )
+            fleet_wall_ms = (time.perf_counter() - t0) * 1000.0
+            assert resolved.all(), (
+                f"fleet tenants unresolved: {np.nonzero(~resolved)[0].tolist()}"
+            )
+            fleet_cuts_total = int(cuts.sum())
+            fleet_vcps = fleet_cuts_total / (fleet_wall_ms / 1000.0)
+            fleet_memory = engine_telemetry.device_memory_snapshot()
+            _mark(
+                f"tenant_fleet: {fleet_b} tenants x {fleet_n} members, "
+                f"{fleet_cuts_total} view changes in {fleet_wall_ms:.1f} ms "
+                f"({fleet_vcps:.1f}/s)"
+            )
+        ledger.emit(LedgerEvent.COMPILE_STATS, stage="tenant_fleet",
+                    **fleet_compiles.delta)
+        ledger.emit(LedgerEvent.DEVICE_MEMORY, stage="tenant_fleet",
+                    **fleet_memory)
+
     # Compiled-program audit (ISSUE 8, analysis family 12): compile the
     # registered engine entrypoints at the fixed audit shapes ON THIS
     # PLATFORM and embed the per-entrypoint collective/memory table, so the
@@ -741,6 +868,25 @@ def run_workload(ledger, profile_dir=None) -> None:
             if stretch_ms is not None
             else {}
         ),
+        # Multi-tenant fleet point (ISSUE 10): total view changes committed
+        # across B independent clusters per second of the ONE lockstep
+        # dispatch. Never silently absent — tenant_fleet_status says
+        # exactly what the point is when the value itself is missing
+        # ("ramped:BxN" = CPU stage-path exercise; "skipped-budget";
+        # "suppressed").
+        "tenant_fleet_status": fleet_status,
+        **(
+            {
+                "tenant_view_changes_per_sec": round(fleet_vcps, 1),
+                "fleet_tenants": fleet_b,
+                "fleet_tenant_members": fleet_n,
+                "fleet_view_changes": fleet_cuts_total,
+                "fleet_wall_ms": round(fleet_wall_ms, 3),
+            }
+            if fleet_vcps is not None
+            else {}
+        ),
+        **({"fleet_device_memory": fleet_memory} if fleet_memory is not None else {}),
         "samples_ms": [round(s, 3) for s in samples],
         "churn_resolution_hist": sample_hist.summary(),
         "view_changes": cuts_per_sample,

@@ -36,7 +36,10 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from rapid_tpu.ops.pallas_kernels import _popcount32, watermark_merge_classify
+from rapid_tpu.ops.pallas_kernels import (
+    _popcount32,
+    watermark_merge_classify_impl,
+)
 
 
 class CutState(NamedTuple):
@@ -135,8 +138,8 @@ def cohort_watermark_pass(
     subject_mask: jnp.ndarray,
     inval_obs: jnp.ndarray,
     heard_down: jnp.ndarray,
-    h: int,
-    l: int,
+    h,  # Python int or traced int32 (per-tenant fleet watermarks)
+    l,
     k: int,
 ):
     """Batched per-cohort watermark pass over uint32 ring-report bitmasks
@@ -162,7 +165,11 @@ def cohort_watermark_pass(
     skipped — and on the mesh the gathered traffic stays cond-gated.
     """
     c, n = report_bits.shape
-    report_bits, cls = watermark_merge_classify(
+    # The impl, not the jitted wrapper: the tenant fleet vmaps this pass
+    # with TRACED per-tenant h/l, which a static-argnames jit would reject;
+    # inside the engine's traces the wrapper was inlined anyway, so the
+    # compiled program is unchanged.
+    report_bits, cls = watermark_merge_classify_impl(
         report_bits,
         new_bits,
         jnp.broadcast_to(subject_mask[None, :], (c, n)),

@@ -48,13 +48,12 @@ def _popcount32(v):
     return ((v * jnp.uint32(0x01010101)) >> 24).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("h", "l"))
-def watermark_merge_classify(
+def watermark_merge_classify_impl(
     old_bits: jnp.ndarray,
     new_bits: jnp.ndarray,
     subject_mask: jnp.ndarray,
-    h: int,
-    l: int,
+    h,
+    l,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Merge per-subject report bitmasks and classify against H/L.
 
@@ -64,6 +63,10 @@ def watermark_merge_classify(
     elementwise, shape-preserving (no resharding of distributed inputs); XLA
     fuses the whole sweep (see module docstring for why there is deliberately
     no Mosaic version).
+    ``h``/``l`` may be Python ints (the classic static engine config) or
+    traced int32 scalars — the tenant fleet (rapid_tpu/tenancy) vmaps this
+    pass with PER-TENANT watermarks, so the comparisons must trace; both
+    spellings lower to the identical compare ops.
     Returns (merged_bits uint32, cls int32: 0 none / 1 flux / 2 stable),
     shaped like the inputs.
     """
@@ -73,6 +76,13 @@ def watermark_merge_classify(
     flux = (tally >= l) & (tally < h)
     cls = jnp.where(stable, jnp.int32(2), jnp.where(flux, jnp.int32(1), jnp.int32(0)))
     return merged, cls
+
+
+#: The standalone jitted entry (host twins / tests); the engine's round body
+#: calls the impl directly so traced per-tenant h/l stay legal.
+watermark_merge_classify = jax.jit(
+    watermark_merge_classify_impl, static_argnames=()
+)
 
 
 def _delivery_kernel(k, w, spread, permille, lanes, blocked_ref, age_ref, epoch_ref, out_ref):

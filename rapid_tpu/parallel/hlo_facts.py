@@ -85,12 +85,18 @@ def shape_bytes(shape_str: str, unknown: Optional[List[str]] = None) -> int:
 
 
 def classify_location(op_name: str) -> str:
-    """hot-loop / hot-loop-cond / cond / prologue, from op_name metadata."""
-    if "/while/body" in op_name:
-        if "/cond/" in op_name.split("/while/body", 1)[1]:
-            return "hot-loop-cond"
-        return "hot-loop"
-    if "/while/cond" in op_name:
+    """hot-loop / hot-loop-cond / cond / prologue, from op_name metadata.
+
+    Both loop spellings count: the plain ``…/while/body/…`` scope and the
+    batched ``…vmap(while)/body/…`` scope the tenant fleet's vmapped loops
+    trace under — a fleet hot-loop collective must never pass as prologue.
+    """
+    for marker in ("/while/body", "vmap(while)/body"):
+        if marker in op_name:
+            if "/cond/" in op_name.split(marker, 1)[1]:
+                return "hot-loop-cond"
+            return "hot-loop"
+    if "/while/cond" in op_name or "vmap(while)/cond" in op_name:
         # The while PREDICATE runs unconditionally every round — it is hot
         # loop, not a gated branch (a generic '/cond/' test would exempt it
         # from the invariants).
@@ -167,9 +173,98 @@ def audit_collectives(compiled_text: str, n: int, c: int) -> List[Dict]:
             "source": source_of(op_name),
             "cn_scale": payload >= c * n,
             "n_scale": payload >= n,
+            "groups": collective_groups(line),
             "unknown_dtypes": sorted(set(unknown)),
         })
     return rows
+
+
+#: replica_groups in the explicit list form: {{0,1},{2,3}}.
+_RG_LIST_RE = re.compile(r"replica_groups=\{((?:\{[\d,]*\},?)*)\}")
+_RG_GROUP_RE = re.compile(r"\{([\d,]*)\}")
+#: replica_groups in the iota (v2) form: [4,2]<=[2,2,2]T(0,2,1) — groups =
+#: transpose(iota(prod).reshape(reshape_dims), perm).reshape(G, S) rows.
+_RG_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?"
+)
+#: collective-permute carries (source, target) device pairs instead.
+_STP_RE = re.compile(r"source_target_pairs=\{((?:\{\d+,\d+\},?)*)\}")
+
+
+def _iota_groups(g: int, s: int, rdims: List[int], perm: List[int]) -> List[List[int]]:
+    """Expand the iota replica-group form without numpy (this module is
+    stdlib-only): devices = transpose(arange(prod).reshape(rdims), perm)
+    flattened row-major, chunked into G groups of S."""
+    strides = [0] * len(rdims)
+    acc = 1
+    for d in range(len(rdims) - 1, -1, -1):
+        strides[d] = acc
+        acc *= rdims[d]
+    shape_t = [rdims[p] for p in perm]
+    devices: List[int] = []
+    idx_t = [0] * len(shape_t)
+    total = acc
+    for _ in range(total):
+        devices.append(
+            sum(idx_t[j] * strides[perm[j]] for j in range(len(perm)))
+        )
+        for j in range(len(shape_t) - 1, -1, -1):
+            idx_t[j] += 1
+            if idx_t[j] < shape_t[j]:
+                break
+            idx_t[j] = 0
+    return [devices[i * s : (i + 1) * s] for i in range(g)]
+
+
+def collective_groups(line: str) -> Optional[List[List[int]]]:
+    """The device groups one collective HLO line communicates within:
+    ``replica_groups`` (explicit-list or iota form) as group lists, or
+    ``source_target_pairs`` (collective-permute) as one two-device group
+    per pair. None when the line names neither — which for a partitioned
+    module means ALL devices participate (callers must treat None as one
+    all-device group, never as "no communication")."""
+    m = _RG_IOTA_RE.search(line)
+    if m:
+        g, s = int(m.group(1)), int(m.group(2))
+        rdims = [int(x) for x in m.group(3).split(",")]
+        perm = (
+            [int(x) for x in m.group(4).split(",")]
+            if m.group(4) else list(range(len(rdims)))
+        )
+        return _iota_groups(g, s, rdims, perm)
+    m = _RG_LIST_RE.search(line)
+    if m:
+        groups = [
+            [int(x) for x in body.split(",") if x]
+            for body in _RG_GROUP_RE.findall(m.group(1))
+        ]
+        # ``replica_groups={}`` is XLA's spelling for ONE group containing
+        # every participant — fold it into the None (all-devices) case so
+        # it can never read as "no communication".
+        return groups or None
+    m = _STP_RE.search(line)
+    if m:
+        return [
+            [int(x) for x in body.split(",")]
+            for body in _RG_GROUP_RE.findall(m.group(0))
+        ]
+    return None
+
+
+def groups_cross_blocks(
+    groups: Optional[List[List[int]]], block: int
+) -> bool:
+    """True when any group spans two device blocks of size ``block`` —
+    with the tenant axis leading the mesh, device ids are contiguous per
+    tenant slice, so a group containing ids from two blocks is a
+    cross-tenant collective. ``None`` groups (all-participants) cross by
+    definition whenever more than one block exists."""
+    if groups is None:
+        return True
+    for group in groups:
+        if len({device // block for device in group}) > 1:
+            return True
+    return False
 
 
 def collective_violations(rows: List[Dict]) -> Dict[str, List[Dict]]:

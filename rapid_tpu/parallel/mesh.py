@@ -51,6 +51,11 @@ from rapid_tpu.models.virtual_cluster import (
 
 NODE_AXIS = "nodes"
 COHORT_AXIS = "cohort"
+#: The multi-tenant batch axis (rapid_tpu/tenancy): a LEADING [t] dimension
+#: stacked over the whole engine pytree, sharded fully parallel — tenants
+#: never communicate, so no collective may ever carry the tenant axis in
+#: its replica groups (the device_program gate freezes that budget).
+TENANT_AXIS = "tenant"
 
 #: Spec tuples are PartitionSpec entries by position: an axis name, or None
 #: (that array dimension is not meshed). Empty tuple = fully replicated.
@@ -101,27 +106,36 @@ PARTITION_RULES: Tuple[Tuple[str, Spec], ...] = (
 
 def make_mesh(
     devices: Optional[Sequence] = None,
-    shape: Optional[Tuple[int, int]] = None,
+    shape: Optional[Tuple[int, ...]] = None,
 ) -> Mesh:
-    """The engine device mesh: 1-D ``('nodes',)`` by default, or the 2-D
-    ``('cohort', 'nodes')`` mesh when ``shape=(cohort_devices,
-    node_devices)`` is given (``cohort_devices * node_devices`` must equal
-    the device count)."""
+    """The engine device mesh: 1-D ``('nodes',)`` by default, 2-D
+    ``('cohort', 'nodes')`` when ``shape=(cohort_devices, node_devices)`` is
+    given, or 3-D ``('tenant', 'cohort', 'nodes')`` when
+    ``shape=(tenant_devices, cohort_devices, node_devices)`` is given (the
+    multi-tenant fleet mesh — rapid_tpu/tenancy). The shape product must
+    equal the device count."""
     devices = list(devices) if devices is not None else jax.devices()
     if shape is None:
         return Mesh(np.array(devices), (NODE_AXIS,))
-    cohort_devices, node_devices = shape
-    if cohort_devices < 1 or node_devices < 1:
-        raise ValueError(f"mesh shape must be positive, got {shape}")
-    if cohort_devices * node_devices != len(devices):
+    if len(shape) == 2:
+        axis_names: Tuple[str, ...] = (COHORT_AXIS, NODE_AXIS)
+    elif len(shape) == 3:
+        axis_names = (TENANT_AXIS, COHORT_AXIS, NODE_AXIS)
+    else:
         raise ValueError(
-            f"mesh shape {shape} needs {cohort_devices * node_devices} "
-            f"devices, got {len(devices)}"
+            f"mesh shape must be (cohort, nodes) or (tenant, cohort, "
+            f"nodes), got {shape}"
         )
-    return Mesh(
-        np.array(devices).reshape(cohort_devices, node_devices),
-        (COHORT_AXIS, NODE_AXIS),
-    )
+    if any(d < 1 for d in shape):
+        raise ValueError(f"mesh shape must be positive, got {shape}")
+    total = 1
+    for d in shape:
+        total *= d
+    if total != len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {total} devices, got {len(devices)}"
+        )
+    return Mesh(np.array(devices).reshape(shape), axis_names)
 
 
 def match_partition_rules(
@@ -172,6 +186,47 @@ def state_shardings(mesh: Mesh) -> EngineState:
 
 def fault_shardings(mesh: Mesh) -> FaultInputs:
     return _shardings_for(FaultInputs, mesh)
+
+
+def _fleet_shardings_for(cls, mesh: Mesh):
+    """The tenant-stacked sharding table: the SAME rule table, with the
+    leading ``[t]`` axis of every stacked leaf sharded on ``'tenant'`` and
+    the existing rules unchanged underneath — a scalar lane becomes a [t]
+    array on 'tenant', a [c, n] leaf becomes [t, c, n] on ('tenant',
+    'cohort', 'nodes'). There is deliberately NO second rule table: a leaf
+    uncovered by :data:`PARTITION_RULES` is exactly as hard an error for
+    the fleet as for a single cluster."""
+    specs = match_partition_rules(PARTITION_RULES, cls._fields)
+    return cls(
+        **{
+            field: NamedSharding(
+                mesh, _resolve_spec((TENANT_AXIS, *specs[field]), mesh)
+            )
+            for field in cls._fields
+        }
+    )
+
+
+def fleet_state_shardings(mesh: Mesh) -> EngineState:
+    """NamedShardings for a tenant-STACKED EngineState ([t, ...] leaves)."""
+    return _fleet_shardings_for(EngineState, mesh)
+
+
+def fleet_fault_shardings(mesh: Mesh) -> FaultInputs:
+    return _fleet_shardings_for(FaultInputs, mesh)
+
+
+def shard_fleet_state(state: EngineState, mesh: Mesh) -> EngineState:
+    """Place a tenant-stacked state onto a ``('tenant', 'cohort', 'nodes')``
+    mesh. A tenant count that does not divide the tenant axis raises
+    :class:`ShardingShapeError` naming the leaf and ``pad_to_multiple``
+    (pad the fleet with idle tenants — an all-dead spare cluster steps for
+    free)."""
+    return shard_pytree(state, fleet_state_shardings(mesh), mesh=mesh)
+
+
+def shard_fleet_faults(faults: FaultInputs, mesh: Mesh) -> FaultInputs:
+    return shard_pytree(faults, fleet_fault_shardings(mesh), mesh=mesh)
 
 
 def pad_to_multiple(value: int, multiple: int) -> int:

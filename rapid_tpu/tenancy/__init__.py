@@ -1,0 +1,13 @@
+"""Multi-tenant batched serving: step hundreds of independent clusters per
+dispatch (ROADMAP item 4).
+
+``fleet`` holds the batched engine — :class:`~rapid_tpu.tenancy.fleet.TenantFleet`
+vmaps the existing engine impls over a leading tenant axis; ``chaos`` compiles
+``sim/fuzz.py`` scenario families per tenant into one stacked fleet and checks
+the oracle battery tenant by tenant; ``autotune`` sweeps per-tenant K/H/L
+knobs online with the khl_sensitivity conflict metric as the objective.
+"""
+
+from rapid_tpu.tenancy.fleet import TenantFleet, TenantKnobs  # noqa: F401
+
+__all__ = ["TenantFleet", "TenantKnobs"]

@@ -1,0 +1,266 @@
+"""Batched chaos harness: sim scenario families compiled per tenant, the
+oracle battery checked tenant by tenant.
+
+The single-cluster differential oracle (``sim/oracles.replay_through_engine``)
+compiles ONE fault schedule's membership phases onto ONE engine; this module
+is its fleet twin: B ``(family, seed)`` pairs from ``sim/fuzz.py`` — each an
+independent seeded scenario — compile onto B per-tenant clusters with
+independent fault inputs, stack into one :class:`~rapid_tpu.tenancy.fleet.TenantFleet`,
+and resolve phase group by phase group with ONE fleet-wave dispatch per
+group (B scenarios' convergences per dispatch, however differently they
+churn). Scenario diversity and throughput in one workload — the shape
+``bench.py``'s ``tenant_fleet`` stage measures.
+
+The per-tenant verdicts mirror the sim battery's oracle vocabulary at the
+engine grain, every violation naming its tenant index (no cross-tenant
+bleed — one tenant's broken chain must never taint its neighbors' verdicts,
+pinned in tests/test_tenancy_chaos.py):
+
+- ``fleet-convergence`` — every phase group resolved within its budget;
+- ``fleet-membership`` — final alive slots are exactly the schedule's
+  surviving slots;
+- ``fleet-chain-consistency`` — the tenant's configuration chain only
+  advances: per-phase config ids all distinct, epochs strictly increasing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from rapid_tpu.models.virtual_cluster import VirtualCluster
+from rapid_tpu.sim.faults import MEMBER_DELTA, FaultSchedule
+from rapid_tpu.sim.fuzz import scenario_family
+from rapid_tpu.sim.oracles import Violation
+from rapid_tpu.sim.scenario import endpoints_for
+from rapid_tpu.tenancy.fleet import TenantFleet
+
+#: Engine-replayable flat families (the hier families run the two-level host
+#: protocol; restart-bearing schedules are excluded by engine_compatible).
+ENGINE_FAMILIES = (
+    "partition_heal",
+    "asymmetric_link",
+    "crash_during_join",
+    "churn_under_loss",
+)
+
+
+@dataclass
+class TenantScenario:
+    """One tenant's compiled scenario: the schedule, its engine cluster, and
+    the host-side expectations the oracles check against."""
+
+    family: str
+    seed: int
+    schedule: FaultSchedule
+    vc: VirtualCluster
+    groups: List[List[Tuple[str, Tuple[int, ...]]]]
+    expected_slots: frozenset  # surviving slot indices at the end
+
+    @property
+    def name(self) -> str:
+        return f"{self.family}/{self.seed}"
+
+
+@dataclass
+class PhaseRecord:
+    resolved: bool
+    cuts: int
+    config_id: int
+    config_epoch: int
+    members: int
+
+
+@dataclass
+class FleetRunResult:
+    """What one batched chaos run observed, per tenant — the oracle input."""
+
+    scenarios: List[TenantScenario]
+    phases: List[List[PhaseRecord]] = field(default_factory=list)
+    final_slots: List[frozenset] = field(default_factory=list)
+    dispatches: int = 0
+    total_rounds: int = 0
+    total_cuts: int = 0
+
+
+def compile_tenant(
+    family: str,
+    seed: int,
+    knobs: Tuple[int, int, int] = (9, 4, 1),
+) -> TenantScenario:
+    """Compile one ``(family, seed)`` scenario onto a per-tenant engine
+    cluster — the same mapping the differential oracle uses (matched FD /
+    delivery semantics: fd_threshold=1 for the host's static detector,
+    delivery_spread=0 for same-window delivery), with the tenant's
+    ``(h, l, fd_threshold)`` knobs on top."""
+    schedule = scenario_family(family, seed)
+    if not schedule.engine_compatible:
+        raise ValueError(
+            f"{family}/{seed}: schedule is not engine-replayable (restarts "
+            f"spend engine slots forever)"
+        )
+    endpoints = endpoints_for(seed, schedule.n_slots)
+    h, l, fd_threshold = knobs
+    vc = VirtualCluster.from_endpoints(
+        endpoints, n_slots=len(endpoints), n_members=schedule.n0,
+        k=10, h=h, l=l, fd_threshold=fd_threshold, delivery_spread=0,
+    )
+    joined = set(range(schedule.n0))
+    for event in schedule.events:
+        if event.kind in ("join", "restart"):
+            joined |= set(event.slots)
+    expected = frozenset(joined - schedule.expected_removed_slots())
+    return TenantScenario(
+        family=family,
+        seed=seed,
+        schedule=schedule,
+        vc=vc,
+        groups=schedule.membership_phases(),
+        expected_slots=expected,
+    )
+
+
+def compile_fleet(
+    specs: Sequence[Tuple[str, int]],
+    knobs: Optional[Sequence[Tuple[int, int, int]]] = None,
+) -> List[TenantScenario]:
+    """One compiled scenario per ``(family, seed)`` spec. All flat families
+    share the fuzz geometry (``N0``/``N_SLOTS``), so the B clusters stack
+    into one fleet; ``knobs`` optionally varies (h, l, fd_threshold) per
+    tenant."""
+    if knobs is not None and len(knobs) != len(specs):
+        raise ValueError(f"need {len(specs)} knob triples, got {len(knobs)}")
+    return [
+        compile_tenant(family, seed, knobs[i] if knobs else (9, 4, 1))
+        for i, (family, seed) in enumerate(specs)
+    ]
+
+
+def _inject_group(
+    vc: VirtualCluster, group: List[Tuple[str, Tuple[int, ...]]]
+) -> int:
+    """Apply one membership phase group's events to a tenant's cluster
+    (the differential oracle's event mapping: a one-way ingress partition
+    is detector-identical to a crash). Returns the membership delta."""
+    delta = 0
+    for kind, slots in group:
+        if kind == "join":
+            vc.inject_join_wave(list(slots))
+        elif kind == "leave":
+            vc.initiate_leave(list(slots))
+        else:  # crash / partition_oneway
+            vc.crash(list(slots))
+        delta += MEMBER_DELTA[kind] * len(slots)
+    return delta
+
+
+def run_fleet(
+    scenarios: Sequence[TenantScenario],
+    max_steps: int = 64,
+    max_cuts: int = 8,
+) -> FleetRunResult:
+    """Resolve every tenant's scenario, phase group by phase group: inject
+    group ``g`` into each tenant that still has one, stack, and resolve the
+    whole fleet in ONE wave dispatch per group (tenants whose schedule ran
+    out of groups idle for free — already at target, zero cuts demanded).
+    Per-tenant observations land in a :class:`FleetRunResult` for
+    :func:`check_fleet`."""
+    scenarios = list(scenarios)
+    result = FleetRunResult(scenarios=scenarios)
+    result.phases = [[] for _ in scenarios]
+    expected = [s.schedule.n0 for s in scenarios]
+    n_groups = max((len(s.groups) for s in scenarios), default=0)
+    for g in range(n_groups):
+        min_cuts = []
+        for i, scenario in enumerate(scenarios):
+            if g < len(scenario.groups):
+                expected[i] += _inject_group(scenario.vc, scenario.groups[g])
+                min_cuts.append(1)
+            else:
+                min_cuts.append(0)
+        fleet = TenantFleet.from_clusters([s.vc for s in scenarios])
+        rounds, cuts, resolved, _sizes = fleet.run_until_membership(
+            expected, max_steps=max_steps, max_cuts=max_cuts,
+            min_cuts=min_cuts,
+        )
+        config_ids = fleet.config_ids()
+        epochs = fleet.config_epochs()
+        members = fleet.membership_sizes()
+        result.dispatches += 1
+        result.total_rounds += int(rounds.sum())
+        result.total_cuts += int(cuts.sum())
+        for i, scenario in enumerate(scenarios):
+            scenario.vc.state = fleet.tenant_state(i)
+            result.phases[i].append(PhaseRecord(
+                resolved=bool(resolved[i]),
+                cuts=int(cuts[i]),
+                config_id=config_ids[i],
+                config_epoch=int(epochs[i]),
+                members=int(members[i]),
+            ))
+        alive = np.asarray(fleet.state.alive)
+    if n_groups == 0:
+        alive = np.stack([np.asarray(s.vc.state.alive) for s in scenarios])
+    result.final_slots = [
+        frozenset(np.nonzero(alive[i])[0].tolist())
+        for i in range(len(scenarios))
+    ]
+    return result
+
+
+# ---------------------------------------------------------------------------
+# The per-tenant oracle battery
+# ---------------------------------------------------------------------------
+
+
+def check_fleet(result: FleetRunResult) -> List[Violation]:
+    """Run every fleet oracle over every tenant's record; each violation
+    names its tenant index and scenario. One tenant's defect must never
+    leak into another's verdict — the checks below consult ONLY tenant
+    ``i``'s record when judging tenant ``i``."""
+    violations: List[Violation] = []
+    for i, scenario in enumerate(result.scenarios):
+        label = f"tenant {i} ({scenario.name})"
+        records = result.phases[i]
+        for g, record in enumerate(records):
+            if not record.resolved:
+                violations.append(Violation(
+                    "fleet-convergence",
+                    f"{label}: phase group {g} unresolved after "
+                    f"{record.cuts} cut(s)",
+                ))
+        if result.final_slots and result.final_slots[i] != scenario.expected_slots:
+            violations.append(Violation(
+                "fleet-membership",
+                f"{label}: final membership slots "
+                f"{sorted(result.final_slots[i])} != schedule's surviving "
+                f"slots {sorted(scenario.expected_slots)}",
+            ))
+        chain = [r.config_id for r in records if r.cuts > 0]
+        if len(set(chain)) != len(chain):
+            repeated = sorted({f"{c:#x}" for c in chain if chain.count(c) > 1})
+            violations.append(Violation(
+                "fleet-chain-consistency",
+                f"{label}: configuration id(s) {repeated} re-delivered — "
+                f"the chain must only advance",
+            ))
+        epochs = [r.config_epoch for r in records]
+        if any(b < a for a, b in zip(epochs, epochs[1:])):
+            violations.append(Violation(
+                "fleet-chain-consistency",
+                f"{label}: config epochs regressed across phases: {epochs}",
+            ))
+    return violations
+
+
+def violating_tenants(violations: Sequence[Violation]) -> Dict[int, List[str]]:
+    """tenant index -> the oracle names that flagged it (the no-bleed
+    assertion's grain)."""
+    out: Dict[int, List[str]] = {}
+    for violation in violations:
+        prefix = violation.detail.split(":", 1)[0]  # "tenant <i> (<name>)"
+        idx = int(prefix.split()[1])
+        out.setdefault(idx, []).append(violation.oracle)
+    return out

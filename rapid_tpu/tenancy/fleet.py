@@ -1,0 +1,614 @@
+"""The tenant fleet: B independent virtual clusters stepped as ONE compiled
+program.
+
+"Millions of users" means fleets of independent membership clusters, not one
+giant one (ROADMAP item 4; the Rapid paper evaluates thousands of *single*
+clusters' stability under churn — arXiv:1803.03620 §5). The TPU analog of
+serving that fleet is batching whole clusters into one dispatch: every
+engine impl (``engine_step_impl`` / ``run_to_decision_impl`` / the
+whole-wave convergence loop) vmaps over a leading tenant axis of the
+existing ``EngineState``/``FaultInputs`` pytrees, with independent seeds,
+fault inputs, and PER-TENANT protocol knobs (H/L watermarks, failure
+threshold, classic-fallback delay — :class:`TenantKnobs`, traced int32
+lanes, so one executable serves every knob mix). Per-tenant results are
+bit-identical to B separate ``VirtualCluster`` runs — the non-negotiable
+parity bar, proved by the pinned differential grid in
+``tests/test_tenancy.py`` exactly the way ``tests/test_parallel_2d.py``
+pinned the 2-D mesh.
+
+Sharding: the leading ``[t]`` axis shards on the ``'tenant'`` axis of the
+3-D ``('tenant', 'cohort', 'nodes')`` mesh (``parallel/mesh.py``:
+``fleet_state_shardings`` prepends the tenant axis to the SAME rule table —
+an uncovered leaf stays a hard error). Tenants never communicate: no
+collective in the compiled fleet program may carry the tenant axis in its
+replica groups, and the ``device_program`` gate freezes that budget
+(``fleet3d_step``/``fleet3d_wave`` in ``hlo.lock.json``,
+``cross_tenant_collectives: 0`` — drift fails the build).
+
+Batched-control-flow tradeoffs, stated plainly:
+
+- vmap turns the per-cluster ``lax.cond`` view-change gate into a select —
+  the commit math (sort-free ring rebuild, O(N) scans) runs every round and
+  is masked away for undecided tenants. For fleet deployments (hundreds of
+  SMALL clusters, ~1K members each) that is a constant factor on a round
+  body of the same order, not a scale break; the 1M-member single-cluster
+  path keeps its gated commit untouched.
+- the fleet wave runs LOCKSTEP: a ``fori_loop`` over the step budget with
+  per-tenant freeze masking, instead of a batched while. A batched while's
+  predicate is an any() across tenants — a cross-tenant collective in the
+  hottest location of the program, which the zero-cross-tenant budget
+  forbids. The loop predicate here is a replicated counter; finished
+  tenants coast. (``fleet_run_to_decision`` keeps the dynamic batched
+  while for single-device driver use, where there is no mesh and the any()
+  is free.)
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rapid_tpu.models.state import EngineConfig, EngineState, FaultInputs, StepEvents
+from rapid_tpu.models.virtual_cluster import (
+    VirtualCluster,
+    _compute_round,
+    apply_view_change_impl,
+    engine_step_impl,
+    run_to_decision_impl,
+)
+from rapid_tpu.parallel.mesh import (
+    TENANT_AXIS,
+    Mesh,
+    NamedSharding,
+    _resolve_spec,
+    fleet_fault_shardings,
+    fleet_state_shardings,
+    match_partition_rules,
+)
+from rapid_tpu.utils import engine_telemetry, exposition
+from rapid_tpu.utils.health import NodeHealth
+from rapid_tpu.utils.metrics import Metrics
+
+#: The EngineConfig fields that vary per tenant, as traced
+#: :class:`TenantKnobs` lanes. EVERY other config field must be IDENTICAL
+#: across a fleet's tenants (they pin array shapes or Python-level trace
+#: structure — static branches, unrolled loops), so the static set is
+#: DERIVED, not enumerated: a field appended to EngineConfig later is
+#: fleet-static by default and fails closed in :meth:`TenantFleet.from_clusters`
+#: rather than silently running every tenant with tenant 0's value.
+KNOB_FIELDS = ("h", "l", "fd_threshold", "fallback_rounds")
+
+FLEET_STATIC_FIELDS = tuple(
+    f for f in EngineConfig._fields if f not in KNOB_FIELDS
+)
+
+#: Partition rules for the fleet-level knob pytree, in the exact
+#: ``parallel/mesh.py`` table style (the ``sharding`` analyzer parses this
+#: table too): every knob lane is a [t] array sharded on the tenant axis.
+PARTITION_RULES: Tuple[Tuple[str, Tuple[Optional[str], ...]], ...] = (
+    (r"h|l|fd_threshold|fallback_rounds", (TENANT_AXIS,)),
+)
+
+
+class TenantKnobs(NamedTuple):
+    """Per-tenant protocol knobs as traced int32 lanes — the K/H/L settings
+    the reference would spread across B separate JVM configs, batched so one
+    executable serves every mix (and the online autotuner can sweep them,
+    rapid_tpu/tenancy/autotune.py)."""
+
+    h: jnp.ndarray  # [t] int32 — high watermark
+    l: jnp.ndarray  # [t] int32 — low watermark
+    fd_threshold: jnp.ndarray  # [t] int32 — failed windows before alerting
+    fallback_rounds: jnp.ndarray  # [t] int32 — classic-Paxos recovery delay
+
+    @staticmethod
+    def from_configs(cfgs: Sequence[EngineConfig]) -> "TenantKnobs":
+        return TenantKnobs(
+            h=jnp.asarray([c.h for c in cfgs], dtype=jnp.int32),
+            l=jnp.asarray([c.l for c in cfgs], dtype=jnp.int32),
+            fd_threshold=jnp.asarray(
+                [c.fd_threshold for c in cfgs], dtype=jnp.int32
+            ),
+            fallback_rounds=jnp.asarray(
+                [c.fallback_rounds for c in cfgs], dtype=jnp.int32
+            ),
+        )
+
+
+def knob_shardings(mesh: Mesh) -> TenantKnobs:
+    """NamedShardings for the knob pytree from :data:`PARTITION_RULES` (the
+    [t] lanes shard on 'tenant'; on a mesh without the axis they
+    replicate)."""
+    specs = match_partition_rules(PARTITION_RULES, TenantKnobs._fields)
+    return TenantKnobs(
+        **{
+            field: NamedSharding(mesh, _resolve_spec(specs[field], mesh))
+            for field in TenantKnobs._fields
+        }
+    )
+
+
+def _tenant_cfg(cfg: EngineConfig, knobs: TenantKnobs) -> EngineConfig:
+    """The per-tenant engine config inside the vmapped trace: the shared
+    static geometry with this tenant's traced knob scalars woven in. Every
+    knob field is used only in jnp comparisons inside the round body, so a
+    tracer is as good as the Python int a single cluster compiles with —
+    and lowers to the identical arithmetic."""
+    return cfg._replace(
+        h=knobs.h,
+        l=knobs.l,
+        fd_threshold=knobs.fd_threshold,
+        fallback_rounds=knobs.fallback_rounds,
+    )
+
+
+def fleet_step_impl(
+    cfg: EngineConfig,
+    state: EngineState,
+    faults: FaultInputs,
+    knobs: TenantKnobs,
+) -> Tuple[EngineState, StepEvents]:
+    """One protocol round for EVERY tenant: ``engine_step_impl`` vmapped
+    over the leading tenant axis. Events come back stacked ([t] scalars,
+    [t, n] winner masks)."""
+
+    def one(state, faults, kn):
+        return engine_step_impl(_tenant_cfg(cfg, kn), state, faults)
+
+    return jax.vmap(one)(state, faults, knobs)
+
+
+def fleet_run_to_decision_impl(
+    cfg: EngineConfig,
+    state: EngineState,
+    faults: FaultInputs,
+    knobs: TenantKnobs,
+    max_steps,
+):
+    """Per-tenant single-dispatch convergence: ``run_to_decision_impl``
+    vmapped. The batched while's predicate reduces across tenants (vmap's
+    any()), so this entrypoint is for SINGLE-DEVICE driver dispatch — the
+    mesh-audited fleet entrypoints are the step and the lockstep wave."""
+
+    def one(state, faults, kn):
+        return run_to_decision_impl(_tenant_cfg(cfg, kn), state, faults, max_steps)
+
+    return jax.vmap(one)(state, faults, knobs)
+
+
+def fleet_wave_impl(
+    cfg: EngineConfig,
+    state: EngineState,
+    faults: FaultInputs,
+    knobs: TenantKnobs,
+    target,
+    max_steps,
+    max_cuts: int,
+    min_cuts,
+):
+    """The fleet's whole-wave loop: every tenant runs convergences through
+    MULTIPLE view changes until its own ``target`` membership (at least its
+    own ``min_cuts`` cuts), all in one dispatch — the batched twin of
+    ``run_until_membership_impl``, restructured LOCKSTEP (module docstring):
+    one flat ``fori_loop`` over the shared step budget, each iteration one
+    engine round per tenant with the view change select-applied and
+    finished tenants frozen in place. Per-tenant results are bit-identical
+    to the nested per-cluster loop — the same ``_compute_round`` /
+    ``apply_view_change_impl`` sequence on the same values, only the loop
+    skeleton differs (pinned by tests/test_tenancy.py's differential grid).
+
+    Returns ``(state, steps[t], cuts[t], resolved[t], sizes[t, max_cuts])``.
+    """
+
+    def one(state, faults, kn, tgt, mc):
+        tcfg = _tenant_cfg(cfg, kn)
+
+        def body(_i, carry):
+            state, steps, cuts, sizes, done = carry
+            active = ~done & (steps < max_steps)
+            round_state, decided, winner, _ = _compute_round(tcfg, state, faults)
+            committed = apply_view_change_impl(tcfg, round_state, winner)
+            commit = active & decided
+            picked = jax.tree_util.tree_map(
+                lambda old, rnd, com: jnp.where(
+                    active, jnp.where(commit, com, rnd), old
+                ),
+                state, round_state, committed,
+            )
+            steps = jnp.where(active, steps + 1, steps)
+            sizes = jnp.where(
+                commit, sizes.at[cuts].set(committed.n_members), sizes
+            )
+            cuts = cuts + commit.astype(jnp.int32)
+            resolved = (picked.n_members == tgt) & (cuts >= mc)
+            done = done | (commit & resolved) | (cuts >= max_cuts)
+            return (picked, steps, cuts, sizes, done)
+
+        init = (
+            state,
+            jnp.int32(0),
+            jnp.int32(0),
+            jnp.full((max_cuts,), -1, dtype=jnp.int32),
+            # The equal-churn trap guard, same as the nested loop's
+            # entry condition: already-at-target only resolves vacuously
+            # when no cuts are demanded.
+            (state.n_members == tgt) & (mc <= jnp.int32(0)),
+        )
+        state, steps, cuts, sizes, _ = jax.lax.fori_loop(
+            0, max_steps, body, init
+        )
+        resolved = (state.n_members == tgt) & (cuts >= mc)
+        return (state, steps, cuts, resolved, sizes)
+
+    return jax.vmap(one)(state, faults, knobs, target, min_cuts)
+
+
+fleet_step = jax.jit(fleet_step_impl, static_argnums=(0,), donate_argnums=(1,))
+fleet_run_to_decision = jax.jit(
+    fleet_run_to_decision_impl, static_argnums=(0,), donate_argnums=(1,)
+)
+fleet_wave = jax.jit(
+    fleet_wave_impl, static_argnums=(0, 6), donate_argnums=(1,)
+)
+
+
+def make_fleet_step(cfg: EngineConfig, mesh: Mesh):
+    """jit the fleet step with explicit in-shardings over a
+    ``('tenant', 'cohort', 'nodes')`` mesh — the audited batched-step
+    entrypoint (``fleet3d_step`` in the HLO lock: zero cross-tenant
+    collectives, donation fully aliased)."""
+    st_sh = fleet_state_shardings(mesh)
+    ft_sh = fleet_fault_shardings(mesh)
+    kn_sh = knob_shardings(mesh)
+
+    return jax.jit(
+        lambda state, faults, knobs: fleet_step_impl(cfg, state, faults, knobs),
+        in_shardings=(st_sh, ft_sh, kn_sh),
+        # The state output is pinned to the input table so a driver loop can
+        # feed it straight back (XLA propagation is free to "improve" a
+        # replicated dimension onto an idle axis, which would then mismatch
+        # the declared in_shardings on the next dispatch); events propagate.
+        out_shardings=(st_sh, None),
+        donate_argnums=(0,),
+    )
+
+
+def make_fleet_wave(cfg: EngineConfig, mesh: Mesh, max_cuts: int = 8):
+    """jit the lockstep fleet wave with the mesh's shardings — the audited
+    batched-wave entrypoint (``fleet3d_wave``). ``target``/``min_cuts`` are
+    [t] lanes sharded on 'tenant'; ``max_steps`` is a replicated scalar (it
+    is the lockstep loop's only predicate input — the reason the compiled
+    hot loop carries no cross-tenant collective)."""
+    st_sh = fleet_state_shardings(mesh)
+    ft_sh = fleet_fault_shardings(mesh)
+    kn_sh = knob_shardings(mesh)
+    lane = NamedSharding(mesh, _resolve_spec((TENANT_AXIS,), mesh))
+
+    return jax.jit(
+        lambda state, faults, knobs, target, max_steps, min_cuts: (
+            fleet_wave_impl(
+                cfg, state, faults, knobs, target, max_steps, max_cuts,
+                min_cuts,
+            )
+        ),
+        in_shardings=(st_sh, ft_sh, kn_sh, lane, None, lane),
+        # State pinned to the input table (round-trippable, donation-exact);
+        # the [t] observation lanes propagate.
+        out_shardings=(st_sh, None, None, None, None),
+        donate_argnums=(0,),
+    )
+
+
+def stack_pytrees(trees: Sequence):
+    """Stack B same-shape pytrees along a new leading tenant axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+class TenantFleet:
+    """Host driver over the batched engine: owns the stacked state, the
+    per-tenant knobs, and the dispatch telemetry.
+
+    Construction is by stacking ordinary per-tenant ``VirtualCluster``
+    builds (:meth:`from_clusters`) — every injection seam (crash, join
+    wave, rx-block, cohort assignment) stays the single-cluster API, run
+    per tenant BEFORE stacking; the fleet then steps all of them per
+    dispatch. ``tests/test_tenancy.py`` pins that this round-trip is
+    bit-identical to driving the B clusters separately."""
+
+    def __init__(
+        self,
+        cfg: EngineConfig,
+        state: EngineState,
+        faults: FaultInputs,
+        knobs: TenantKnobs,
+    ) -> None:
+        b = int(knobs.h.shape[0])
+        for leaf in jax.tree_util.tree_leaves((state, faults, knobs)):
+            if leaf.shape[:1] != (b,):
+                raise ValueError(
+                    f"fleet pytrees must share the leading tenant axis "
+                    f"({b}); got a leaf of shape {leaf.shape}"
+                )
+        self.cfg = cfg
+        self.state = state
+        self.faults = faults
+        self.knobs = knobs
+        self.b = b
+        self.metrics = Metrics()
+        engine_telemetry.install()
+
+    # -- construction ---------------------------------------------------
+
+    @classmethod
+    def from_clusters(cls, clusters: Sequence[VirtualCluster]) -> "TenantFleet":
+        """Stack B prepared single-tenant clusters into one fleet. The
+        static geometry (slot count, rings, cohorts, delivery model) must
+        match across tenants — it pins the one compiled program; the
+        per-tenant knobs (H/L, fd_threshold, fallback delay) may differ
+        freely and ride :class:`TenantKnobs`."""
+        if not clusters:
+            raise ValueError("a fleet needs at least one tenant")
+        cfgs = [vc.cfg for vc in clusters]
+        base = cfgs[0]
+        for i, cfg in enumerate(cfgs[1:], start=1):
+            diffs = [
+                f"{f}: {getattr(base, f)!r} != {getattr(cfg, f)!r}"
+                for f in FLEET_STATIC_FIELDS
+                if getattr(base, f) != getattr(cfg, f)
+            ]
+            if diffs:
+                raise ValueError(
+                    f"tenant {i} differs from tenant 0 in fleet-static "
+                    f"config fields ({'; '.join(diffs)}) — these pin the "
+                    f"one compiled program; only the TenantKnobs fields "
+                    f"may vary per tenant"
+                )
+        for i, cfg in enumerate(cfgs):
+            if not 1 <= cfg.l <= cfg.h <= cfg.k:
+                raise ValueError(
+                    f"tenant {i}: watermarks must satisfy 1 <= L <= H <= K, "
+                    f"got L={cfg.l} H={cfg.h} K={cfg.k}"
+                )
+            if cfg.fd_window and cfg.fd_threshold > cfg.fd_window:
+                raise ValueError(
+                    f"tenant {i}: fd_threshold ({cfg.fd_threshold}) cannot "
+                    f"exceed fd_window ({cfg.fd_window})"
+                )
+        fleet = cls(
+            base,
+            stack_pytrees([vc.state for vc in clusters]),
+            stack_pytrees([vc.faults for vc in clusters]),
+            TenantKnobs.from_configs(cfgs),
+        )
+        # The stack re-uploads every tenant's state: charge it once here
+        # (the per-cluster builders already charged their own uploads to
+        # their own metrics registries, which the fleet does not inherit).
+        fleet._account_h2d(*jax.tree_util.tree_leaves(fleet.state))
+        return fleet
+
+    @classmethod
+    def create(
+        cls,
+        tenants: int,
+        n_members: int,
+        n_slots: Optional[int] = None,
+        k: int = 10,
+        cohorts: int = 2,
+        seeds: Optional[Sequence[int]] = None,
+        knobs: Optional[Sequence[Tuple[int, int, int]]] = None,
+        **engine_kwargs,
+    ) -> "TenantFleet":
+        """Synthetic fleet: B independent synthetic clusters (independent
+        identity seeds), round-robin cohorts, optional per-tenant
+        ``(h, l, fd_threshold)`` knob triples."""
+        if seeds is None:
+            seeds = list(range(tenants))
+        if len(seeds) != tenants:
+            raise ValueError(f"need {tenants} seeds, got {len(seeds)}")
+        if knobs is not None and len(knobs) != tenants:
+            raise ValueError(f"need {tenants} knob triples, got {len(knobs)}")
+        clusters = []
+        for i in range(tenants):
+            h, l, fd = knobs[i] if knobs is not None else (9, 4, 3)
+            vc = VirtualCluster.create(
+                n_members, n_slots=n_slots, k=k, h=h, l=l, cohorts=cohorts,
+                fd_threshold=fd, seed=seeds[i], **engine_kwargs,
+            )
+            vc.assign_cohorts_roundrobin()
+            clusters.append(vc)
+        return cls.from_clusters(clusters)
+
+    # -- telemetry seams (the VirtualCluster discipline, fleet-labeled) --
+
+    def _account_h2d(self, *arrays) -> None:
+        self.metrics.inc(
+            "engine_h2d_bytes",
+            int(sum(int(getattr(a, "nbytes", 0) or 0) for a in arrays)),
+        )
+
+    def _account_d2h(self, nbytes: int) -> None:
+        self.metrics.inc("engine_d2h_bytes", int(nbytes))
+
+    @contextmanager
+    def _dispatch(self, entry: str):
+        """Time one device dispatch+fetch pair into the bounded per-entry
+        latency histogram (``engine_dispatch_ms{phase=<entry>}``) and bump
+        the dispatch counter — the VirtualCluster seam, fleet-labeled."""
+        self.metrics.inc("engine_dispatches")
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.metrics.record_ms(
+                "engine_dispatch",
+                (time.perf_counter() - start) * 1000.0,
+                phase=entry,
+            )
+
+    # -- execution ------------------------------------------------------
+
+    def step(self) -> StepEvents:
+        """One protocol round for every tenant — one dispatch, B clusters
+        (``engine_dispatch_ms{phase="fleet_step"}``).
+
+        Events come back DEVICE-resident, so ``engine_tenant_cuts`` is
+        deliberately not bumped here: reading ``events.decided`` would put
+        a host sync on the hot path. The fetching entrypoints
+        (:meth:`run_to_decision` / :meth:`run_until_membership`) do the cut
+        accounting; a step-driven loop that fetches events itself (the
+        autotune sweep) observes its cuts in its own results."""
+        self.metrics.inc("engine_tenant_rounds", self.b)
+        with self._dispatch("fleet_step"):
+            self.state, events = fleet_step(
+                self.cfg, self.state, self.faults, self.knobs
+            )
+        return events
+
+    def run_to_decision(self, max_steps: int = 64):
+        """Every tenant runs to its own first view change in one dispatch;
+        returns ``(rounds[t], decided[t], winner[t, n] on device,
+        members[t])`` with one packed observation fetch."""
+        with self._dispatch("fleet_decision"):
+            self.state, steps, decided, winner = fleet_run_to_decision(
+                self.cfg, self.state, self.faults, self.knobs,
+                jnp.int32(max_steps),
+            )
+            obs = np.asarray(
+                jnp.stack(
+                    [steps, decided.astype(jnp.int32), self.state.n_members]
+                )
+            )
+        self._account_d2h(obs.nbytes)
+        rounds = obs[0]
+        was_decided = obs[1].astype(bool)
+        self.metrics.inc("engine_tenant_rounds", int(rounds.sum()))
+        self.metrics.inc("engine_tenant_cuts", int(was_decided.sum()))
+        return rounds, was_decided, winner, obs[2]
+
+    def run_until_membership(
+        self,
+        targets,
+        max_steps: int = 192,
+        max_cuts: int = 8,
+        min_cuts=0,
+    ):
+        """The fleet wave: every tenant resolves its own churn — through
+        its own number of view changes — to its own target membership, in
+        ONE lockstep dispatch. ``targets``/``min_cuts`` broadcast from
+        scalars or give one value per tenant. Returns ``(rounds[t],
+        cuts[t], resolved[t], sizes[t, max_cuts])`` as host arrays."""
+        targets = np.broadcast_to(
+            np.asarray(targets, dtype=np.int32), (self.b,)
+        ).copy()
+        min_cuts = np.broadcast_to(
+            np.asarray(min_cuts, dtype=np.int32), (self.b,)
+        ).copy()
+        if targets.min() < 0 or targets.max() > self.cfg.n:
+            raise ValueError(
+                f"targets must be in [0, {self.cfg.n}]: {targets.tolist()}"
+            )
+        self._account_h2d(targets, min_cuts)
+        with self._dispatch("fleet_wave"):
+            self.state, steps, cuts, resolved, sizes = fleet_wave(
+                self.cfg, self.state, self.faults, self.knobs,
+                jnp.asarray(targets), jnp.int32(max_steps), int(max_cuts),
+                jnp.asarray(min_cuts),
+            )
+            obs = np.asarray(
+                jnp.concatenate(
+                    [steps, cuts, resolved.astype(jnp.int32), sizes.reshape(-1)]
+                )
+            )
+        self._account_d2h(obs.nbytes)
+        b = self.b
+        rounds, n_cuts = obs[:b], obs[b : 2 * b]
+        resolved_h = obs[2 * b : 3 * b].astype(bool)
+        sizes_h = obs[3 * b :].reshape(b, max_cuts)
+        self.metrics.inc("engine_tenant_rounds", int(rounds.sum()))
+        self.metrics.inc("engine_tenant_cuts", int(n_cuts.sum()))
+        return rounds, n_cuts, resolved_h, sizes_h
+
+    def sync(self) -> None:
+        """Complete all pending uploads/compute on the fleet state."""
+        jax.block_until_ready(self.state)
+
+    # -- observers ------------------------------------------------------
+
+    def tenant_state(self, i: int) -> EngineState:
+        """Tenant ``i``'s state slice (device-resident views)."""
+        if not 0 <= i < self.b:
+            raise IndexError(f"tenant index {i} out of range [0, {self.b})")
+        return jax.tree_util.tree_map(lambda x: x[i], self.state)
+
+    def membership_sizes(self) -> np.ndarray:
+        out = np.asarray(self.state.n_members)
+        self._account_d2h(out.nbytes)
+        return out
+
+    def config_ids(self) -> List[int]:
+        """Per-tenant 64-bit configuration ids, one packed fetch."""
+        obs = np.asarray(jnp.stack([self.state.config_hi, self.state.config_lo]))
+        self._account_d2h(obs.nbytes)
+        return [
+            (int(hi) << 32) | int(lo) for hi, lo in zip(obs[0], obs[1])
+        ]
+
+    def config_epochs(self) -> np.ndarray:
+        out = np.asarray(self.state.config_epoch)
+        self._account_d2h(out.nbytes)
+        return out
+
+    def health(self) -> NodeHealth:
+        """Fleet-wide health in the host vocabulary: PROPOSING while any
+        tenant has churn in flight, STABLE otherwise (one scalar fetch)."""
+        pending = int(
+            jnp.sum(self.state.alive & self.faults.crashed, dtype=jnp.int32)
+            + jnp.sum(self.state.join_pending, dtype=jnp.int32)
+        )
+        self._account_d2h(4)
+        return NodeHealth.PROPOSING if pending else NodeHealth.STABLE
+
+    # -- observability (utils/exposition.py schema) ---------------------
+
+    def telemetry_snapshot(self) -> dict:
+        """The fleet's unified telemetry snapshot — the engine schema plus
+        a ``tenancy`` section (tenant count, per-dispatch tenant
+        throughput), so one scrape pipeline serves host nodes, single
+        clusters, and fleets alike (golden names pinned in
+        tests/test_engine_telemetry.py)."""
+        counters = self.metrics.counters
+        dispatches = counters.get("engine_dispatches", 0)
+        tenant_rounds = counters.get("engine_tenant_rounds", 0)
+        return {
+            "node": f"tenant-fleet/{self.b}x{self.cfg.n}",
+            "membership_size": int(self.membership_sizes().sum()),
+            "health": self.health().value,
+            "metrics": self.metrics.summary(),
+            "engine": {
+                "n": self.cfg.n,
+                "cohorts": self.cfg.c,
+                "use_pallas": self.cfg.use_pallas,
+                "compile": engine_telemetry.compile_snapshot(),
+                "memory": engine_telemetry.device_memory_snapshot(),
+                "tenancy": {
+                    "tenants": self.b,
+                    "tenant_rounds_total": int(tenant_rounds),
+                    "tenant_cuts_total": int(
+                        counters.get("engine_tenant_cuts", 0)
+                    ),
+                    "tenant_rounds_per_dispatch": round(
+                        tenant_rounds / dispatches, 3
+                    ) if dispatches else 0.0,
+                },
+            },
+            "transport": {},
+            "recorder": None,
+        }
+
+    def prometheus_text(self) -> str:
+        return exposition.prometheus_text(self.telemetry_snapshot())

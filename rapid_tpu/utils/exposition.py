@@ -83,6 +83,15 @@ ENGINE_KNOWN_COUNTERS = (
     "engine_d2h_bytes",
 )
 
+#: Tenant-fleet counters zero-filled on snapshots whose ``engine`` section
+#: carries a ``tenancy`` block (``TenantFleet.telemetry_snapshot``) — the
+#: fleet tier's series set is stable from the first scrape, and a
+#: single-cluster scrape never grows them.
+TENANCY_KNOWN_COUNTERS = (
+    "engine_tenant_rounds",
+    "engine_tenant_cuts",
+)
+
 #: ``engine.compile`` counter keys -> metric suffix (all render as
 #: ``rapid_engine_<suffix>_total``); the compile_ms histogram is rendered
 #: separately.
@@ -218,8 +227,11 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
 
     metrics: Dict[str, Any] = dict(snapshot.get("metrics", {}))
     counters = {name: 0 for name in KNOWN_COUNTERS}
+    engine_section = snapshot.get("engine")
     if "engine" in snapshot:
         counters.update({name: 0 for name in ENGINE_KNOWN_COUNTERS})
+    if isinstance(engine_section, dict) and "tenancy" in engine_section:
+        counters.update({name: 0 for name in TENANCY_KNOWN_COUNTERS})
     timers: Dict[str, Dict[str, Any]] = {}
     for name, value in metrics.items():
         if isinstance(value, dict):
@@ -271,6 +283,17 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
             value = memory.get(key)
             out.sample(f"{_PREFIX}_engine_{key}", "gauge",
                        float("nan") if value is None else value, node=node)
+        tenancy = engine.get("tenancy")
+        if isinstance(tenancy, dict):
+            # The fleet tier: tenant count and per-dispatch tenant
+            # throughput as gauges (the cumulative counters ride the
+            # ordinary metrics section, zero-filled above).
+            out.sample(f"{_PREFIX}_engine_tenants", "gauge",
+                       tenancy.get("tenants", 0), node=node)
+            out.sample(f"{_PREFIX}_engine_tenant_rounds_per_dispatch",
+                       "gauge",
+                       tenancy.get("tenant_rounds_per_dispatch", 0.0),
+                       node=node)
 
     recorder = snapshot.get("recorder")
     if recorder:

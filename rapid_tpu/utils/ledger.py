@@ -72,6 +72,7 @@ STAGE_NAMES = frozenset({
     "xl_point",
     "stretch_point",
     "loss_variant",
+    "tenant_fleet",
     "hlo_audit",
     "profile",
 })

@@ -1,0 +1,40 @@
+"""Lint corpus: tenant-axis holes in a fleet rule table.
+
+A miniature tenant-knob pytree + ``PARTITION_RULES`` pair in the
+rapid_tpu/tenancy declaration style: one ``[t, n]`` tenant-stacked leaf is
+matched by a rule whose spec leaves dimension 0 UNMESHED on the tenant axis
+(the whole-fleet replication hazard), and one tenant rule matches no leaf
+at all (dead entry). The clean ``[t]`` knob lane shows the correct form.
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from rapid_tpu.parallel.mesh import match_partition_rules
+
+NODE_AXIS = "nodes"
+TENANT_AXIS = "tenant"
+
+PARTITION_RULES = (
+    (r"knob_h", (TENANT_AXIS,)),
+    (r"fleet_alive",
+     (None, NODE_AXIS)),  # expect: missing-partition-spec
+    (r"ghost_knob", (TENANT_AXIS,)),  # expect: missing-partition-spec
+)
+
+
+class TenantKnobs(NamedTuple):
+    knob_h: jnp.ndarray  # [t] int32 — the clean tenant lane
+    fleet_alive: jnp.ndarray  # [t, n] — tenant axis unmeshed by its rule
+
+
+def knob_shardings(mesh: Mesh) -> TenantKnobs:
+    specs = match_partition_rules(PARTITION_RULES, TenantKnobs._fields)
+    return TenantKnobs(
+        **{
+            field: NamedSharding(mesh, P(*specs[field]))
+            for field in TenantKnobs._fields
+        }
+    )

@@ -84,6 +84,11 @@ def test_cpu_run_emits_complete_ledger(tmp_path):
             "RAPID_TPU_BENCH_STRETCH": "256",
             "RAPID_TPU_BENCH_XL_BUDGET_S": "100000",
             "RAPID_TPU_BENCH_NO_LOSS": "1",
+            # Tiny tenant fleet: the FULL stage path runs (ramped) — one
+            # warm-up + one timed lockstep wave over 4 mixed-scenario
+            # tenants.
+            "RAPID_TPU_BENCH_FLEET_B": "4",
+            "RAPID_TPU_BENCH_FLEET_N": "48",
         },
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
@@ -141,6 +146,22 @@ def test_cpu_run_emits_complete_ledger(tmp_path):
         e["event"] == "device_memory" and e.get("stage") == "xl_point"
         for e in events
     )
+    # ISSUE 10 fleet path, same run: the tenant_fleet stage ran ramped-down
+    # in its own bracketed, budgeted stage with an explicit status marker —
+    # the fleet metric is never silently absent.
+    assert result["tenant_fleet_status"] == "ramped:4x48"
+    assert result["fleet_tenants"] == 4
+    assert result["fleet_view_changes"] >= 4  # every tenant cut at least once
+    assert result["tenant_view_changes_per_sec"] > 0
+    assert "live_buffers" in result["fleet_device_memory"]
+    [(fleet_begin, fleet_close)] = pairs["tenant_fleet"]
+    assert fleet_close["event"] == "stage_end"
+    assert fleet_begin["timeout_s"] > 0
+    assert fleet_begin["n"] == 4 * 48  # total fleet slots under test
+    assert any(
+        e["event"] == "device_memory" and e.get("stage") == "tenant_fleet"
+        for e in events
+    )
 
 
 def test_headline_plan_is_never_silently_absent(monkeypatch):
@@ -161,6 +182,34 @@ def test_headline_plan_is_never_silently_absent(monkeypatch):
     assert bench.headline_plan("cpu", 2000.0) == (1_000_000, "live")
     monkeypatch.setenv("RAPID_TPU_BENCH_NO_XL", "1")
     assert bench.headline_plan("tpu", 0.0) == (0, "suppressed")
+
+
+def test_fleet_plan_is_never_silently_absent(monkeypatch):
+    """ISSUE 10: every branch of the tenant-fleet policy yields an explicit
+    status (the headline_plan discipline) — live at 256x1024 on the
+    accelerator, ramped on CPU, skipped-budget past the (shared-default)
+    budget, suppressed on request, forced when asked."""
+    for name in ("RAPID_TPU_BENCH_NO_FLEET", "RAPID_TPU_BENCH_FLEET",
+                 "RAPID_TPU_BENCH_FLEET_B", "RAPID_TPU_BENCH_FLEET_N",
+                 "RAPID_TPU_BENCH_FLEET_BUDGET_S",
+                 "RAPID_TPU_BENCH_XL_BUDGET_S"):
+        monkeypatch.delenv(name, raising=False)
+    assert bench.fleet_plan("tpu", 0.0) == (256, 1024, "live")
+    assert bench.fleet_plan("cpu", 0.0) == (8, 64, "ramped:8x64")
+    monkeypatch.setenv("RAPID_TPU_BENCH_FLEET_B", "4")
+    monkeypatch.setenv("RAPID_TPU_BENCH_FLEET_N", "48")
+    assert bench.fleet_plan("cpu", 0.0) == (4, 48, "ramped:4x48")
+    # Past the budget the point is skipped — but NAMED; the fleet budget
+    # defaults to the XL budget so one env override governs both tails.
+    assert bench.fleet_plan("tpu", 2000.0) == (0, 0, "skipped-budget")
+    monkeypatch.setenv("RAPID_TPU_BENCH_FLEET_BUDGET_S", "3000")
+    assert bench.fleet_plan("tpu", 2000.0)[2] == "live"
+    # ...and forcing runs it anywhere, at the live scale.
+    monkeypatch.setenv("RAPID_TPU_BENCH_FLEET_BUDGET_S", "1")
+    monkeypatch.setenv("RAPID_TPU_BENCH_FLEET", "1")
+    assert bench.fleet_plan("cpu", 2000.0) == (4, 48, "live")
+    monkeypatch.setenv("RAPID_TPU_BENCH_NO_FLEET", "1")
+    assert bench.fleet_plan("tpu", 0.0) == (0, 0, "suppressed")
 
 
 def test_parse_scale_spellings():
@@ -222,11 +271,15 @@ def test_wedge_failure_is_scoped_to_this_run(tmp_path):
     assert fail["last_completed_stage"] is None
 
 
+@pytest.mark.slow
 def test_wedge_with_cpu_fallback_reruns_and_closes_the_run(tmp_path):
     # --cpu-fallback: the watchdog parent execve's into a CPU continuation
     # sharing the run id; the successful fallback must CLOSE the run
     # (run_end outcome=cpu_fallback) — without it the ledger ends at
     # run_fail and the run reads as failed despite a real measurement.
+    # Rides the unfiltered check.sh pass (~20 s wall: a second full bench
+    # subprocess); the wedge-exits-nonzero and snapshot-replay wedge tests
+    # keep the LOUD-failure contract in tier-1.
     proc, events = _run_bench(
         tmp_path, "--cpu-fallback",
         env_overrides={

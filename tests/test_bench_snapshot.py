@@ -392,7 +392,8 @@ def test_hlo_audit_summary_embeds_per_entrypoint_budget_table():
     table = bench.hlo_audit_summary()
     assert "error" not in table, table
     assert {"step", "run_to_decision", "run_until_membership", "sync",
-            "sharded_step", "sharded_wave", "sharded2d_wave"} == set(table)
+            "sharded_step", "sharded_wave", "sharded2d_wave",
+            "fleet3d_step", "fleet3d_wave"} == set(table)
     for name, row in table.items():
         assert set(row) == {
             "collectives", "collective_bytes", "hot_loop_collectives",

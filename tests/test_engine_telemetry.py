@@ -305,6 +305,96 @@ def _hist_summary(*values_ms):
     return hist.summary()
 
 
+# ---------------------------------------------------------------------------
+# Tenant-fleet tier (rapid_tpu/tenancy): per-tenant dispatch accounting
+# ---------------------------------------------------------------------------
+
+#: The fleet scrape's complete metric-name vocabulary — the single-cluster
+#: golden list plus the tenancy tier (tenant counters zero-filled, tenant
+#: count + per-dispatch throughput gauges) minus the per-cluster
+#: configuration-id gauge (a fleet has B configuration chains, observed via
+#: TenantFleet.config_ids()). Same API rule: renaming one breaks scrape
+#: configs.
+GOLDEN_FLEET_METRIC_NAMES = sorted(
+    set(GOLDEN_ENGINE_METRIC_NAMES)
+    - {"rapid_configuration_id"}
+    | {
+        "rapid_engine_tenant_cuts_total",
+        "rapid_engine_tenant_rounds_total",
+        "rapid_engine_tenant_rounds_per_dispatch",
+        "rapid_engine_tenants",
+    }
+)
+
+
+def _fleet(b=4):
+    from rapid_tpu.tenancy import TenantFleet
+
+    fleet = TenantFleet.create(
+        b, 12, n_slots=16, k=3, cohorts=2, knobs=[(3, 1, 2)] * b
+    )
+    fleet.faults = fleet.faults._replace(
+        crashed=fleet.faults.crashed.at[:, 3].set(True)
+    )
+    return fleet
+
+
+def test_fleet_prometheus_names_are_golden():
+    fleet = _fleet()
+    fleet.step()
+    fleet.run_to_decision(max_steps=32)
+    names = exposition.metric_names(fleet.prometheus_text())
+    assert names == GOLDEN_FLEET_METRIC_NAMES
+
+
+def test_fleet_dispatch_histogram_carries_fleet_step_phase():
+    # Satellite (ISSUE 10): engine_dispatch_ms gains the fleet phase labels
+    # — per-tenant dispatch accounting rides the same bounded instrument,
+    # keyed fleet_step / fleet_decision / fleet_wave.
+    fleet = _fleet()
+    for _ in range(5):
+        fleet.step()
+    fleet.run_to_decision(max_steps=8)
+    fleet.run_until_membership(fleet.membership_sizes(), max_steps=8)
+    family = fleet.metrics.phase_timings["engine_dispatch"]
+    assert set(family) == {"fleet_step", "fleet_decision", "fleet_wave"}
+    assert isinstance(family["fleet_step"], LogHistogram)
+    assert family["fleet_step"].count == 5
+    assert fleet.metrics.counters["engine_dispatches"] == 7
+
+
+def test_fleet_snapshot_tenancy_section():
+    fleet = _fleet()
+    fleet.step()  # 4 tenants, 1 round each, one dispatch
+    rounds, decided, _, _ = fleet.run_to_decision(max_steps=32)
+    snap = fleet.telemetry_snapshot()
+    tenancy = snap["engine"]["tenancy"]
+    assert tenancy["tenants"] == 4
+    assert tenancy["tenant_rounds_total"] == 4 + int(rounds.sum())
+    assert tenancy["tenant_cuts_total"] == int(decided.sum()) == 4
+    # Per-dispatch tenant throughput: tenant-rounds over dispatches.
+    assert tenancy["tenant_rounds_per_dispatch"] == round(
+        tenancy["tenant_rounds_total"] / 2, 3
+    )
+    json.dumps(snap)  # the --metrics-dump / clustertop artifact
+
+
+def test_clustertop_engine_pane_shows_tenants():
+    fleet = _fleet()
+    fleet.step()
+    vc = _cluster()
+    vc.run_to_decision(max_steps=8)
+    frame = clustertop.render_frame(
+        [vc.telemetry_snapshot(), fleet.telemetry_snapshot()]
+    )
+    assert "TENANTS" in frame
+    fleet_row = _engine_pane_row(frame, "tenant-fleet/4x16")
+    assert fleet_row.split()[1] == "4"
+    # A single-cluster snapshot dashes the column, never crashes.
+    vc_row = _engine_pane_row(frame, "virtual-cluster/16")
+    assert vc_row.split()[1] == "-"
+
+
 def test_engine_counters_zero_filled_only_for_engine_snapshots():
     # A host snapshot must NOT grow engine series; an engine snapshot
     # exposes them even before the first dispatch.

@@ -90,8 +90,10 @@ def test_registered_entrypoints_audit_clean_against_committed_lock():
     # both tier-1 and check.sh ordering), it pays the fresh backend
     # compiles — budget them here, where the cost is guaranteed to be
     # real (test_lint's sweep budget would otherwise measure a cache hit).
-    # 60 s since the 2-D cohort-mesh pair joined the registry (eight
-    # entrypoints; two-axis GSPMD partitioning costs real compile time).
+    # 90 s since the tenant-fleet pair joined the registry (nine
+    # entrypoints; two- and three-axis GSPMD partitioning costs real
+    # compile time — the compile-inclusive budget may grow, the
+    # analysis-only sweep budget in test_lint.py must not).
     import time
 
     fresh = device_program._FACTS_CACHE is None
@@ -99,13 +101,14 @@ def test_registered_entrypoints_audit_clean_against_committed_lock():
     facts = staticcheck.collect_facts()
     elapsed = time.process_time() - started
     if fresh:
-        assert elapsed < 60.0, (
+        assert elapsed < 90.0, (
             f"fresh entrypoint compile collection used {elapsed:.1f}s CPU "
-            f"(budget 60s)"
+            f"(budget 90s)"
         )
     assert set(facts) == {
         "step", "run_to_decision", "run_until_membership", "sync",
         "sharded_step", "sharded_wave", "sharded2d_wave",
+        "fleet3d_step", "fleet3d_wave",
     }
     trees = [(None, rel) for rel in device_program.REGISTRY_SOURCES]
     assert device_program.check_hlo_lock(trees) == []
@@ -114,7 +117,8 @@ def test_registered_entrypoints_audit_clean_against_committed_lock():
 
 def test_sharded_entrypoints_have_collectives_single_device_do_not():
     facts = staticcheck.collect_facts()
-    for name in ("sharded_step", "sharded_wave", "sharded2d_wave"):
+    for name in ("sharded_step", "sharded_wave", "sharded2d_wave",
+                 "fleet3d_step", "fleet3d_wave"):
         assert facts[name]["collectives"], name
     for name in ("step", "run_to_decision", "run_until_membership", "sync"):
         assert facts[name]["collectives"] == {}, name
@@ -228,6 +232,92 @@ def test_2d_cohort_state_memory_is_sharded_not_replicated():
     assert saved >= 0.9 * expected_saving, (
         saved, expected_saving, repl_args, rules_args,
     )
+
+
+def test_fleet_entrypoints_have_zero_cross_tenant_collectives():
+    """ISSUE 10 acceptance: the batched step/wave compile with the tenant
+    axis FULLY parallel — no collective's replica groups span tenant device
+    blocks (cross_tenant_collectives == 0, frozen in the lock), and every
+    donated fleet buffer is aliased. The fleet wave's hot loop may carry
+    within-tenant gathers (vmap select-applies the view change — the
+    batched-serving tradeoff fleet.py documents) but never cross-tenant
+    traffic of ANY class."""
+    facts = staticcheck.collect_facts()
+    locked = json.loads((REPO / staticcheck.HLO_LOCK_REL).read_text())
+    for name in ("fleet3d_step", "fleet3d_wave"):
+        assert facts[name]["cross_tenant_collectives"] == 0, name
+        assert locked["entrypoints"][name]["cross_tenant_collectives"] == 0
+        donation = facts[name]["donation"]
+        assert donation["dropped"] == 0
+        assert donation["aliased"] == donation["donated_leaves"] > 0
+    # The step is straight-line (no loop): all its collectives are
+    # prologue-class; the wave's ride the vmapped hot loop and must be
+    # classified there (a vmap(while) scope must never pass as prologue).
+    assert all(
+        key.startswith("prologue/")
+        for key in facts["fleet3d_step"]["collectives"]
+    )
+    assert facts["fleet3d_wave"]["collectives"]
+    assert all(
+        key.startswith("hot-loop")
+        for key in facts["fleet3d_wave"]["collectives"]
+    )
+
+
+def test_cross_tenant_collective_is_a_blocking_finding():
+    """A fleet program with a tenant-spanning collective must fail the gate
+    with its own check name — and can never be frozen (update refuses it,
+    the dropped-donation discipline)."""
+    entry = {
+        "collectives": {}, "transfers": {}, "memory": {},
+        "donation": {"donated_leaves": 0, "aliased": 0, "dropped": 0},
+        "unknown_dtypes": [], "cross_tenant_collectives": 2,
+    }
+    findings = device_program.compare_facts(
+        "fleet3d_step", entry, {"cross_tenant_collectives": 0}, ("hlo.lock", 1)
+    )
+    assert [f.check for f in findings] == ["hlo-cross-tenant-collective"]
+    assert "2 collective(s)" in findings[0].message
+    assert "never communicate" in findings[0].message
+    # Zero-vs-locked drift (a lock claiming nonzero) is ordinary drift.
+    entry["cross_tenant_collectives"] = 0
+    findings = device_program.compare_facts(
+        "fleet3d_step", entry, {"cross_tenant_collectives": 1}, ("hlo.lock", 1)
+    )
+    assert [f.check for f in findings] == ["hlo-lock-drift"]
+
+
+def test_replica_group_parsing_covers_all_hlo_spellings():
+    """The cross-tenant check's parser: explicit-list replica_groups, the
+    iota v2 form (with and without transpose), collective-permute
+    source_target_pairs, and the all-participants default."""
+    groups = hlo_facts.collective_groups(
+        'x = u32[8] all-reduce(y), replica_groups={{0,1},{2,3},{4,5},{6,7}}'
+    )
+    assert groups == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    assert hlo_facts.collective_groups(
+        'x = u32[8] all-gather(y), replica_groups=[4,2]<=[8], dimensions={0}'
+    ) == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    # Transposed iota: arange(8).reshape(2,2,2).transpose(0,2,1) rows.
+    assert hlo_facts.collective_groups(
+        'x = u32[8] all-gather(y), replica_groups=[4,2]<=[2,2,2]T(0,2,1)'
+    ) == [[0, 2], [1, 3], [4, 6], [5, 7]]
+    assert hlo_facts.collective_groups(
+        'x = pred[2] collective-permute(y), source_target_pairs={{0,1},{5,4}}'
+    ) == [[0, 1], [5, 4]]
+    assert hlo_facts.collective_groups('x = u32[8] all-reduce(y)') is None
+    # replica_groups={} is XLA's ONE-group-of-everyone spelling — it must
+    # fold into the all-participants None, never parse as "no groups" (an
+    # empty list would read as no communication and slip the cross-tenant
+    # budget).
+    assert hlo_facts.collective_groups(
+        'x = u32[8] all-reduce(y), replica_groups={}'
+    ) is None
+
+    block = device_program.AUDIT_TENANT_BLOCK
+    assert not hlo_facts.groups_cross_blocks([[0, 1], [4, 5]], block)
+    assert hlo_facts.groups_cross_blocks([[0, 4]], block)  # spans tenants
+    assert hlo_facts.groups_cross_blocks(None, block)  # all-participants
 
 
 def test_every_donation_is_aliased_or_waived():

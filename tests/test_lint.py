@@ -198,10 +198,11 @@ def test_clock_injection_check_catches_both_spellings():
 def test_full_sweep_with_compiled_gate_stays_under_budget():
     """The whole-tree sweep INCLUDING both ISSUE-8 families — the sharding
     AST lint and the device_program compiled-artifact gate — must fit the
-    ordinary test session: <60 s of process CPU for the entrypoint compile
-    collection (eight entrypoints since the 2-D cohort-mesh pair joined the
-    registry: the GSPMD partitioning of two mesh axes costs real compile
-    time) and <30 s for the family sweep itself, budgeted separately so
+    ordinary test session: <90 s of process CPU for the entrypoint compile
+    collection (nine entrypoints since the tenant-fleet pair joined the
+    registry: two- and three-axis GSPMD partitioning costs real compile
+    time; the compile-inclusive budget may grow, the analysis-only budget
+    must not) and <30 s for the family sweep itself, budgeted separately so
     neither can hide the other going superlinear. Compile results are
     cached per session, so only the FIRST sweep in a process pays them
     (the persistent XLA cache is deliberately NOT used for the audit — see
@@ -218,8 +219,8 @@ def test_full_sweep_with_compiled_gate_stays_under_budget():
     # when test_hlo_gate.py ran first (its gate test budgets the
     # guaranteed-fresh collection, so the cost is pinned in BOTH
     # orderings).
-    assert compile_s < 60.0, (
-        f"entrypoint compile collection used {compile_s:.1f}s CPU (budget 60s)"
+    assert compile_s < 90.0, (
+        f"entrypoint compile collection used {compile_s:.1f}s CPU (budget 90s)"
     )
     started = time.process_time()
     findings = staticcheck.run()

@@ -71,8 +71,11 @@ def test_watermark_boundaries():
     (2, 0, 1000, 128),     # no jitter
     (32, 1, 1000, 128),    # one cohort word, legacy uniform draw
     (64, 2, 1000, 128),    # two words
-    (96, 3, 300, 128),     # three words, sub-round gate
-    (33, 1, 250, 128),     # ragged cohort count past a word boundary
+    # The two largest grids ride the unfiltered check.sh pass (~21 s wall
+    # combined); the word-boundary/sub-round/wide-lane properties they add
+    # stay covered at the smaller shapes above and below.
+    pytest.param(96, 3, 300, 128, marks=pytest.mark.slow),
+    pytest.param(33, 1, 250, 128, marks=pytest.mark.slow),
     (64, 2, 1000, 256),    # wide lane tile: bit-identical across widths
     (8, 2, 1000, 512),     # the 1M-point cohort shape, wider still
 ])
@@ -124,9 +127,13 @@ def test_delivery_kernel_matches_engine_jnp_path(c, spread, permille, lanes):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+@pytest.mark.slow
 def test_profiling_trace_captures_convergence(tmp_path):
     # Exercise utils/profiling end-to-end: trace a real (tiny) convergence
     # and assert a TensorBoard-compatible trace landed on disk.
+    # Rides the unfiltered check.sh pass (~33 s wall: the profiler wraps a
+    # full compile); tests/test_profiling.py keeps every utils/profiling
+    # seam (no-op fallback, nested rejection, failed stop) in tier-1.
     from rapid_tpu.models.virtual_cluster import VirtualCluster
     from rapid_tpu.utils.profiling import annotate, trace
 

@@ -154,6 +154,48 @@ def test_trajectory_renders_headline_column_and_flags_missing(tmp_path, capsys):
     assert "headline-missing" not in lines["BENCH_r20"]  # pre-audit history
 
 
+def test_trajectory_renders_fleet_column_and_flags_missing(tmp_path, capsys):
+    """ISSUE 10: tenant_view_changes_per_sec renders as its own trajectory
+    column with the existing trust flags; an AUDITED round that omits both
+    the value and its explicit tenant_fleet_status marker flags
+    fleet-missing; pre-audit historical rounds are exempt."""
+    audit = {"fleet3d_wave": {"collectives": 74, "hot_loop_collectives": 74,
+                              "temp_bytes": 10, "donation_dropped": 0}}
+    points = {
+        # Pre-audit historical round: exempt (sorts first).
+        "BENCH_r30.json": {"metric": "m", "value": 1.0, "platform": "cpu"},
+        # Audited + measured fleet point: value in the FLEET column.
+        "BENCH_r31.json": {"metric": "m", "value": 100.0, "platform": "tpu",
+                           "hlo_audit": audit, "n1M_status": "live",
+                           "tenant_fleet_status": "live",
+                           "tenant_view_changes_per_sec": 5120.0,
+                           "fleet_tenants": 256},
+        # Audited + explicit ramped marker (CPU stage-path run): no flag.
+        "BENCH_r32.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, "n1M_status": "ramped:256",
+                           "tenant_fleet_status": "ramped:8x64"},
+        # Audited round that silently dropped the fleet point: flagged.
+        "BENCH_r33.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, "n1M_status": "ramped:256"},
+    }
+    paths = []
+    for name, data in points.items():
+        p = tmp_path / name
+        p.write_text(json.dumps(data))
+        paths.append(str(p))
+    assert perfview.main(paths) == 0
+    out = capsys.readouterr().out
+    assert "FLEET" in out.splitlines()[1]  # the trajectory header row
+    lines = {line.split()[0]: line for line in out.splitlines()
+             if line.startswith("BENCH_r3")}
+    assert "5120.0/s" in lines["BENCH_r31"]
+    assert "fleet-missing" not in lines["BENCH_r31"]
+    assert "ramped:8x64" in lines["BENCH_r32"]
+    assert "fleet-missing" not in lines["BENCH_r32"]
+    assert "fleet-missing" in lines["BENCH_r33"]
+    assert "fleet-missing" not in lines["BENCH_r30"]  # pre-audit history
+
+
 def test_chrome_trace_envelope(tmp_path, capsys):
     path = _complete_ledger(tmp_path)
     chrome_path = tmp_path / "trace.json"

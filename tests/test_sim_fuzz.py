@@ -78,10 +78,14 @@ def test_shrink_refuses_a_passing_schedule():
         shrink(schedule, [])
 
 
+@pytest.mark.slow
 def test_fuzz_writes_repro_for_violating_seed(tmp_path, monkeypatch):
     # Drive the fuzz loop's failure path deterministically: patch the
     # generator to return the known-violating schedule, and verify the loop
     # shrinks it and writes a replayable repro directory.
+    # Rides the unfiltered check.sh pass (~10 s wall: a full fuzz round +
+    # shrink + replay); the shrinker-regression test above keeps the
+    # shrink/replay contract in tier-1.
     import rapid_tpu.sim.fuzz as simfuzz
 
     monkeypatch.setattr(

@@ -367,6 +367,7 @@ _CORPUS_CHECKERS = {
     "host_sync_in_hot_path.py": ("rapid_tpu/ops/_corpus.py", "check_sharding"),
     "missing_partition_spec.py": ("rapid_tpu/parallel/_corpus.py", "check_sharding"),
     "missing_partition_rule.py": ("rapid_tpu/parallel/_corpus.py", "check_sharding"),
+    "tenant_partition_rule.py": ("rapid_tpu/tenancy/_corpus.py", "check_sharding"),
     "retrace_hazard.py": ("rapid_tpu/models/_corpus.py", "check_sharding"),
     "clean_sharding.py": ("rapid_tpu/parallel/_corpus.py", "check_sharding"),
 }
@@ -774,7 +775,11 @@ def _run_cli(*args):
     )
 
 
+@pytest.mark.slow
 def test_cli_json_select_ignore_and_exit_codes(tmp_path):
+    # Rides the unfiltered check.sh pass (~15 s wall: each CLI invocation
+    # is a fresh interpreter paying full import + analysis); the in-process
+    # driver tests above pin the same select/ignore/exit semantics.
     bad = tmp_path / "bad.py"
     bad.write_text("def f():\n    return mesage\n")
 

@@ -1,0 +1,325 @@
+"""Tenant-fleet parity: B batched clusters must be bit-identical to B
+independent ``VirtualCluster`` runs — the non-negotiable bar (the way
+``tests/test_parallel_2d.py`` pinned the 2-D mesh).
+
+The pinned differential grid stacks B=8 tenants compiled from FOUR distinct
+sim scenario families (``partition_heal``, ``asymmetric_link``,
+``crash_during_join``, ``churn_under_loss``) at two seeds each, with
+per-tenant H/L/fd knob mixes, and drives the fleet against per-tenant
+singles two ways:
+
+- per STEP (``fleet_step``): the cut sequences, configuration ids, and
+  decision rounds must match exactly, tenant by tenant;
+- per WAVE (``fleet_wave`` — the lockstep multi-cut loop): every phase
+  group's (rounds, cuts, config id, epoch, membership) must match the
+  single-cluster ``run_until_membership`` exactly.
+
+Plus the 3-D ``('tenant', 'cohort', 'nodes')`` mesh: rule-table shardings
+with the leading tenant axis, mesh-step parity against the single-device
+fleet, and the ShardingShapeError/pad_to_multiple discipline for a tenant
+count that does not divide the tenant axis.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from rapid_tpu.models.virtual_cluster import VirtualCluster
+from rapid_tpu.parallel.mesh import (
+    COHORT_AXIS,
+    NODE_AXIS,
+    TENANT_AXIS,
+    ShardingShapeError,
+    fleet_state_shardings,
+    make_mesh,
+    pad_to_multiple,
+    shard_fleet_faults,
+    shard_fleet_state,
+)
+from rapid_tpu.sim.oracles import cuts_refine
+from rapid_tpu.tenancy import TenantFleet, chaos
+from rapid_tpu.tenancy.fleet import knob_shardings
+
+#: The pinned grid: B=8 tenants over four distinct sim families x two seeds,
+#: with a per-tenant knob mix (H/L/fd_threshold traced lanes — one compiled
+#: fleet program serves every mix).
+GRID_SPECS = [
+    ("partition_heal", 1), ("partition_heal", 2),
+    ("asymmetric_link", 1), ("asymmetric_link", 2),
+    ("crash_during_join", 1), ("crash_during_join", 2),
+    ("churn_under_loss", 1), ("churn_under_loss", 2),
+]
+GRID_KNOBS = [
+    (9, 4, 1), (8, 3, 1), (7, 2, 1), (9, 4, 1),
+    (8, 3, 1), (9, 4, 1), (7, 2, 1), (8, 3, 1),
+]
+
+
+def _drive_single(vc, max_steps):
+    """(cuts, config_ids, decision_rounds) of a per-step single-cluster
+    drive — the test_parallel_2d labeling ((slot, up/down) cut members)."""
+    cuts, ids, rounds = [], [], []
+    for i in range(max_steps):
+        was_alive = np.asarray(vc.state.alive)
+        events = vc.step()
+        if bool(events.decided):
+            mask = np.asarray(events.winner_mask)
+            cuts.append(frozenset(
+                (s, "down" if was_alive[s] else "up")
+                for s in np.nonzero(mask)[0].tolist()
+            ))
+            ids.append(vc.config_id)
+            rounds.append(i)
+    return cuts, ids, rounds
+
+
+def _injected_tenants():
+    """The grid's tenants with EVERY membership phase injected up front
+    (maximum overlapped churn; both sides of the parity get the identical
+    injections)."""
+    scenarios = chaos.compile_fleet(GRID_SPECS, knobs=GRID_KNOBS)
+    for scenario in scenarios:
+        for group in scenario.groups:
+            chaos._inject_group(scenario.vc, group)
+    return scenarios
+
+
+def test_grid_step_parity_bit_identical():
+    singles = _injected_tenants()
+    expected = [_drive_single(s.vc, 24) for s in singles]
+    assert all(cuts for cuts, _, _ in expected), "grid produced no cuts"
+
+    fleet_side = _injected_tenants()
+    fleet = TenantFleet.from_clusters([s.vc for s in fleet_side])
+    got_cuts = [[] for _ in fleet_side]
+    got_ids = [[] for _ in fleet_side]
+    got_rounds = [[] for _ in fleet_side]
+    for i in range(24):
+        was_alive = np.asarray(fleet.state.alive)
+        events = fleet.step()
+        decided = np.asarray(events.decided)
+        if not decided.any():
+            continue
+        winners = np.asarray(events.winner_mask)
+        ids_now = fleet.config_ids()
+        for t in np.nonzero(decided)[0].tolist():
+            got_cuts[t].append(frozenset(
+                (s, "down" if was_alive[t, s] else "up")
+                for s in np.nonzero(winners[t])[0].tolist()
+            ))
+            got_ids[t].append(ids_now[t])
+            got_rounds[t].append(i)
+
+    for t, (cuts, ids, rounds) in enumerate(expected):
+        label = fleet_side[t].name
+        assert got_rounds[t] == rounds, label
+        assert got_ids[t] == ids, label
+        assert got_cuts[t] == cuts, label
+        # The sim battery's refinement relation as the comparator:
+        # bit-identical sequences refine each other in both directions.
+        assert cuts_refine(got_cuts[t], [[c] for c in cuts]) is None, label
+        assert cuts_refine(cuts, [[c] for c in got_cuts[t]]) is None, label
+    # Final states identical tenant by tenant.
+    alive = np.asarray(fleet.state.alive)
+    for t, scenario in enumerate(singles):
+        np.testing.assert_array_equal(
+            alive[t], np.asarray(scenario.vc.state.alive)
+        )
+
+
+@pytest.mark.slow
+def test_grid_wave_parity_multi_phase():
+    """The lockstep fleet wave, phase group by phase group, against the
+    nested single-cluster multi-cut loop: (rounds, cuts, config id, epoch,
+    membership) per phase and the final alive masks must match exactly —
+    and the per-tenant oracle battery is clean on the genuine run.
+
+    Rides the unfiltered check.sh pass (the PR-9 wave-parity precedent):
+    tier-1's wall budget keeps the step-parity grid — the acceptance pin —
+    and test_tenancy_chaos's genuine fleet run covers the wave path's
+    phase-group resolution in-session."""
+    fleet_result = chaos.run_fleet(
+        chaos.compile_fleet(GRID_SPECS, knobs=GRID_KNOBS)
+    )
+    assert chaos.check_fleet(fleet_result) == []
+    assert fleet_result.total_cuts >= len(GRID_SPECS)  # every tenant cut
+
+    for t, (family, seed) in enumerate(GRID_SPECS):
+        scenario = chaos.compile_tenant(family, seed, GRID_KNOBS[t])
+        expected = scenario.schedule.n0
+        for g, group in enumerate(scenario.groups):
+            expected += chaos._inject_group(scenario.vc, group)
+            rounds, cuts, resolved, _ = scenario.vc.run_until_membership(
+                expected, max_steps=64, max_cuts=8, min_cuts=1,
+            )
+            record = fleet_result.phases[t][g]
+            assert resolved and record.resolved, (scenario.name, g)
+            assert record.cuts == cuts, (scenario.name, g)
+            assert record.config_id == scenario.vc.config_id, (scenario.name, g)
+            assert record.config_epoch == scenario.vc.config_epoch, (
+                scenario.name, g,
+            )
+            assert record.members == scenario.vc.membership_size, (
+                scenario.name, g,
+            )
+        assert fleet_result.final_slots[t] == frozenset(
+            np.nonzero(np.asarray(scenario.vc.state.alive))[0].tolist()
+        ), scenario.name
+
+
+# ---------------------------------------------------------------------------
+# Knob discipline
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_rejects_mismatched_static_geometry():
+    a = VirtualCluster.create(12, n_slots=16, k=4, h=3, l=1, cohorts=2,
+                              fd_threshold=1, seed=0)
+    b = VirtualCluster.create(12, n_slots=16, k=4, h=3, l=1, cohorts=4,
+                              fd_threshold=1, seed=1)
+    with pytest.raises(ValueError, match="fleet-static"):
+        TenantFleet.from_clusters([a, b])
+    # Knob fields may differ freely: same geometry, different H/L/fd.
+    c = VirtualCluster.create(12, n_slots=16, k=4, h=2, l=1, cohorts=2,
+                              fd_threshold=2, seed=2)
+    fleet = TenantFleet.from_clusters([a, c])
+    assert fleet.b == 2
+    assert fleet.knobs.h.tolist() == [3, 2]
+    assert fleet.knobs.fd_threshold.tolist() == [1, 2]
+
+
+def test_fleet_rejects_invalid_watermarks():
+    a = VirtualCluster.create(12, n_slots=16, k=4, h=5, l=1, cohorts=2,
+                              fd_threshold=1, seed=0)
+    with pytest.raises(ValueError, match="1 <= L <= H <= K"):
+        TenantFleet.from_clusters([a])
+
+
+# ---------------------------------------------------------------------------
+# The ('tenant', 'cohort', 'nodes') mesh
+# ---------------------------------------------------------------------------
+
+MESH3D_SHAPE = (2, 2, 2)
+
+
+def make_mesh_3d():
+    return make_mesh(jax.devices()[:8], shape=MESH3D_SHAPE)
+
+
+def _mesh_fleet(b=4, n_members=28, n_slots=32, cohorts=4):
+    knobs = [(3, 1, 2), (4, 2, 2), (3, 1, 2), (4, 1, 2)][:b]
+    fleet = TenantFleet.create(
+        b, n_members, n_slots=n_slots, k=4, cohorts=cohorts, knobs=knobs,
+        delivery_spread=1,
+    )
+    return fleet
+
+
+def test_fleet_shardings_carry_leading_tenant_axis():
+    mesh = make_mesh_3d()
+    shardings = fleet_state_shardings(mesh)
+    P = jax.sharding.PartitionSpec
+    assert shardings.alive.spec == P(TENANT_AXIS, NODE_AXIS)
+    assert shardings.report_bits.spec == P(TENANT_AXIS, COHORT_AXIS, NODE_AXIS)
+    assert shardings.seen_down.spec == P(TENANT_AXIS, COHORT_AXIS)
+    assert shardings.config_epoch.spec == P(TENANT_AXIS)
+    assert knob_shardings(mesh).h.spec == P(TENANT_AXIS)
+    # Placed leaves genuinely split over all eight devices: a [t, c, n]
+    # leaf's per-device shard is 1/8 of global.
+    fleet = _mesh_fleet()
+    state = shard_fleet_state(fleet.state, mesh)
+    for leaf in (state.report_bits, state.released, state.prop_mask):
+        shard = leaf.addressable_shards[0].data
+        assert shard.nbytes * 8 == leaf.nbytes, leaf.shape
+    # [t] per-configuration lanes split over 'tenant' only.
+    for leaf in (state.config_epoch, state.n_members):
+        shard = leaf.addressable_shards[0].data
+        assert shard.nbytes * 2 == leaf.nbytes, leaf.shape
+
+
+def test_fleet_shard_names_indivisible_tenant_count():
+    """Satellite: a tenant count that does not divide the 'tenant' mesh
+    axis raises the named error with the pad_to_multiple fix — pad the
+    fleet with idle tenants, never an opaque XLA failure."""
+    mesh = make_mesh_3d()
+    fleet = _mesh_fleet(b=3)
+    with pytest.raises(ShardingShapeError) as err:
+        shard_fleet_state(fleet.state, mesh)
+    msg = str(err.value)
+    assert "does not divide" in msg and "pad_to_multiple" in msg
+    assert pad_to_multiple(3, MESH3D_SHAPE[0]) == 4
+    padded = _mesh_fleet(b=pad_to_multiple(3, MESH3D_SHAPE[0]))
+    shard_fleet_state(padded.state, mesh)
+
+
+@pytest.mark.slow
+def test_mesh_fleet_step_parity_against_single_device():
+    """The audited fleet3d entrypoints (make_fleet_step/make_fleet_wave on
+    the 3-D mesh) produce bit-identical per-tenant results to the
+    single-device fleet — which the grid above ties to B independent
+    clusters, closing the chain mesh -> fleet -> singles."""
+    from rapid_tpu.tenancy.fleet import make_fleet_step, make_fleet_wave
+
+    def crashed_fleet():
+        fleet = _mesh_fleet()
+        for t in range(fleet.b):
+            # Per-tenant fault masks: different victims per tenant.
+            crashed = fleet.faults.crashed.at[t, 1 + t].set(True)
+            fleet.faults = fleet.faults._replace(crashed=crashed)
+        return fleet
+
+    single = crashed_fleet()
+    for _ in range(10):
+        single.step()
+    single_ids = single.config_ids()
+
+    mesh = make_mesh_3d()
+    fleet = crashed_fleet()
+    step = make_fleet_step(fleet.cfg, mesh)
+    state = shard_fleet_state(fleet.state, mesh)
+    faults = shard_fleet_faults(fleet.faults, mesh)
+    knobs = jax.tree.map(
+        lambda x, sh: jax.device_put(x, sh), fleet.knobs, knob_shardings(mesh)
+    )
+    for _ in range(10):
+        state, events = step(state, faults, knobs)
+    np.testing.assert_array_equal(
+        np.asarray(state.alive), np.asarray(single.state.alive)
+    )
+    mesh_ids = [
+        (int(hi) << 32) | int(lo)
+        for hi, lo in zip(np.asarray(state.config_hi), np.asarray(state.config_lo))
+    ]
+    assert mesh_ids == single_ids
+
+    # And the lockstep wave on the mesh: same multi-tenant resolution in
+    # one dispatch.
+    single2 = crashed_fleet()
+    targets = single2.membership_sizes() - 1
+    r1, c1, res1, sizes1 = single2.run_until_membership(
+        targets, max_steps=32, max_cuts=4, min_cuts=1
+    )
+    assert res1.all()
+    fleet2 = crashed_fleet()
+    wave = make_fleet_wave(fleet2.cfg, mesh, max_cuts=4)
+    state2, steps2, cuts2, resolved2, sizes2 = wave(
+        shard_fleet_state(fleet2.state, mesh),
+        shard_fleet_faults(fleet2.faults, mesh),
+        knobs,
+        jax.device_put(jnp.asarray(targets),
+                       jax.sharding.NamedSharding(
+                           mesh, jax.sharding.PartitionSpec(TENANT_AXIS))),
+        jnp.int32(32),
+        jax.device_put(jnp.ones(fleet2.b, jnp.int32),
+                       jax.sharding.NamedSharding(
+                           mesh, jax.sharding.PartitionSpec(TENANT_AXIS))),
+    )
+    assert np.asarray(resolved2).all()
+    np.testing.assert_array_equal(np.asarray(steps2), r1)
+    np.testing.assert_array_equal(np.asarray(cuts2), c1)
+    np.testing.assert_array_equal(np.asarray(sizes2), sizes1)
+    np.testing.assert_array_equal(
+        np.asarray(state2.alive), np.asarray(single2.state.alive)
+    )

@@ -1,0 +1,140 @@
+"""The batched chaos harness + per-tenant oracle battery + fleet autotune.
+
+The no-cross-tenant-bleed satellite (ISSUE 10): in a fleet where exactly
+one tenant's record violates an oracle, ``check_fleet`` must report THAT
+tenant's index and leave every other tenant's verdict clean — one broken
+chain can never taint its neighbors.
+"""
+
+import dataclasses
+
+import pytest
+
+from rapid_tpu.tenancy import chaos
+from rapid_tpu.tenancy.autotune import sweep_khl
+
+SPECS = [
+    ("partition_heal", 5),
+    ("asymmetric_link", 6),
+    ("crash_during_join", 7),
+    ("churn_under_loss", 8),
+]
+
+
+@pytest.fixture(scope="module")
+def fleet_result():
+    return chaos.run_fleet(chaos.compile_fleet(SPECS))
+
+
+def test_genuine_fleet_run_upholds_every_oracle(fleet_result):
+    assert chaos.check_fleet(fleet_result) == []
+    # Every phase group of every tenant resolved in ONE wave dispatch per
+    # group — B scenarios' convergences per dispatch is the whole point.
+    assert fleet_result.dispatches == max(
+        len(s.groups) for s in fleet_result.scenarios
+    )
+    assert fleet_result.total_cuts >= len(SPECS)
+    for i, scenario in enumerate(fleet_result.scenarios):
+        assert fleet_result.final_slots[i] == scenario.expected_slots
+
+
+def test_single_tenant_chain_violation_is_isolated(fleet_result):
+    """Exactly one tenant's chain is tampered (a re-delivered configuration
+    id); the battery must flag THAT tenant index — and nothing else."""
+    tampered = dataclasses.replace(fleet_result)
+    victim = 2
+    tampered.phases = [list(records) for records in fleet_result.phases]
+    # Re-deliver tenant 2's first committed configuration id in its last
+    # phase record — the chain now repeats an id it already delivered.
+    first = next(r for r in tampered.phases[victim] if r.cuts > 0)
+    tampered.phases[victim][-1] = dataclasses.replace(
+        tampered.phases[victim][-1], cuts=1, config_id=first.config_id
+    )
+    violations = chaos.check_fleet(tampered)
+    by_tenant = chaos.violating_tenants(violations)
+    assert set(by_tenant) == {victim}
+    assert by_tenant[victim] == ["fleet-chain-consistency"]
+    assert f"tenant {victim}" in violations[0].detail
+    assert fleet_result.scenarios[victim].name in violations[0].detail
+
+
+def test_single_tenant_membership_violation_is_isolated(fleet_result):
+    tampered = dataclasses.replace(fleet_result)
+    victim = 1
+    tampered.final_slots = list(fleet_result.final_slots)
+    tampered.final_slots[victim] = frozenset(
+        set(fleet_result.final_slots[victim]) ^ {0}
+    )
+    violations = chaos.check_fleet(tampered)
+    by_tenant = chaos.violating_tenants(violations)
+    assert set(by_tenant) == {victim}
+    assert by_tenant[victim] == ["fleet-membership"]
+
+
+def test_unresolved_phase_is_a_convergence_violation(fleet_result):
+    tampered = dataclasses.replace(fleet_result)
+    victim = 3
+    tampered.phases = [list(records) for records in fleet_result.phases]
+    tampered.phases[victim][0] = dataclasses.replace(
+        tampered.phases[victim][0], resolved=False
+    )
+    by_tenant = chaos.violating_tenants(chaos.check_fleet(tampered))
+    assert set(by_tenant) == {victim}
+    assert "fleet-convergence" in by_tenant[victim]
+
+
+def test_compile_tenant_rejects_unreplayable_and_unknown_schedules():
+    with pytest.raises(Exception, match="unknown scenario family"):
+        chaos.compile_tenant("no_such_family", 0)
+    # Engine families are all flat + restart-free by construction.
+    for family in chaos.ENGINE_FAMILIES:
+        scenario = chaos.compile_tenant(family, 3)
+        assert scenario.schedule.engine_compatible
+        assert scenario.groups
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant K/H/L autotune (the khl_sensitivity objective, batched)
+# ---------------------------------------------------------------------------
+
+
+def test_khl_sweep_artifact_shape_and_winner_selection():
+    grid = ((4, 2), (3, 1), (2, 1))
+    result = sweep_khl(
+        n=96, f=3, knob_grid=grid, k=4, cohorts=8, seed=0,
+        delivery_spread=6, max_rounds=64,
+    )
+    assert result["tenants"] == len(grid)
+    assert set(result["per_knob"]) == {"4/2", "3/1", "2/1"}
+    for cell in result["per_knob"].values():
+        assert set(cell) == {"decided", "rounds", "conflict"}
+        assert cell["decided"] is True and cell["rounds"] > 0
+    # Winner selection (the delivery_autotune shape): best_knob is the
+    # lexicographic (conflict, rounds) minimum over decided candidates.
+    scores = {
+        knob: (int(cell["conflict"]), cell["rounds"])
+        for knob, cell in result["per_knob"].items()
+    }
+    assert result["best_knob"] == min(scores, key=lambda kn: scores[kn])
+
+
+@pytest.mark.slow
+def test_khl_sweep_flags_conflict_prone_low_watermark():
+    """With heavy delivery skew and a watermark below the failure count, a
+    cohort can announce before hearing every victim — the sweep must see
+    the conflict and prefer a safe watermark over a merely fast one.
+
+    Rides the unfiltered check.sh pass (a second fleet compile at its own
+    geometry); the sweep-artifact test above keeps the autotune mechanism
+    in tier-1."""
+    result = sweep_khl(
+        n=64, f=4, knob_grid=((4, 3), (1, 1)), k=4, cohorts=16, seed=3,
+        delivery_spread=8, max_rounds=96,
+    )
+    low = result["per_knob"]["1/1"]
+    safe = result["per_knob"]["4/3"]
+    assert low["decided"] and safe["decided"]
+    assert low["conflict"] is True  # H=1: first announcement misses victims
+    assert safe["conflict"] is False
+    assert low["rounds"] < safe["rounds"]  # ...and low H IS faster
+    assert result["best_knob"] == "4/3"  # clean beats fast

@@ -55,6 +55,7 @@ HLO_LOCK_REL = "tools/analysis/hlo.lock.json"
 REGISTRY_SOURCES = (
     "rapid_tpu/models/virtual_cluster.py",
     "rapid_tpu/parallel/mesh.py",
+    "rapid_tpu/tenancy/fleet.py",
 )
 
 #: Audit shapes: small enough to compile in seconds, large enough that the
@@ -68,6 +69,14 @@ AUDIT_C = 8
 AUDIT_K = 4
 AUDIT_DEVICES = 8
 AUDIT_COHORT_DEVICES = 2
+#: The fleet audit: AUDIT_TENANTS tenant clusters over the 3-D
+#: ``('tenant', 'cohort', 'nodes')`` reshape of the same devices. The
+#: tenant axis leads, so device ids are contiguous per tenant slice —
+#: ``AUDIT_TENANT_BLOCK`` devices per tenant — which is what the
+#: cross-tenant replica-group check keys on.
+AUDIT_TENANTS = 4
+AUDIT_FLEET_MESH = (2, 2, 2)
+AUDIT_TENANT_BLOCK = AUDIT_DEVICES // AUDIT_FLEET_MESH[0]
 
 #: Relative tolerance + absolute slack for the temp/codegen memory
 #: comparison: XLA's buffer assignment may legitimately wobble a little
@@ -196,6 +205,66 @@ def _build_registry() -> "Dict[str, Dict[str, Any]]":
             ),
             "donated_leaves": state_leaves,
         }
+        # The multi-tenant fleet pair (rapid_tpu/tenancy) on the 3-D
+        # ('tenant', 'cohort', 'nodes') reshape of the same devices:
+        # AUDIT_TENANTS independent clusters with per-tenant H/L/fd knob
+        # lanes, batched into one program. These entries carry
+        # ``tenant_block`` so extract_facts computes the cross-tenant
+        # replica-group count — the budget the fleet freezes at ZERO
+        # (tenants never communicate; a group spanning two tenant device
+        # blocks can never become a frozen fact).
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from rapid_tpu.parallel.mesh import (
+            TENANT_AXIS,
+            shard_fleet_faults,
+            shard_fleet_state,
+        )
+        from rapid_tpu.tenancy.fleet import (
+            TenantFleet,
+            knob_shardings,
+            make_fleet_step,
+            make_fleet_wave,
+        )
+
+        tenants = []
+        for i in range(AUDIT_TENANTS):
+            h, l = ((3, 1), (4, 2))[i % 2]
+            tvc = VirtualCluster.create(
+                AUDIT_N - AUDIT_DEVICES, n_slots=AUDIT_N, k=AUDIT_K, h=h,
+                l=l, fd_threshold=2, cohorts=AUDIT_C, delivery_spread=2,
+                seed=i,
+            )
+            tvc.assign_cohorts_roundrobin()
+            tenants.append(tvc)
+        fleet = TenantFleet.from_clusters(tenants)
+        mesh3d = make_mesh(jax.devices()[:AUDIT_DEVICES], shape=AUDIT_FLEET_MESH)
+        fl_state = shard_fleet_state(fleet.state, mesh3d)
+        fl_faults = shard_fleet_faults(fleet.faults, mesh3d)
+        fl_knobs = jax.tree_util.tree_map(
+            jax.device_put, fleet.knobs, knob_shardings(mesh3d)
+        )
+        lane = NamedSharding(mesh3d, PartitionSpec(TENANT_AXIS))
+        targets = jax.device_put(
+            jnp.full((AUDIT_TENANTS,), AUDIT_N - AUDIT_DEVICES, jnp.int32),
+            lane,
+        )
+        min_cuts = jax.device_put(
+            jnp.zeros((AUDIT_TENANTS,), jnp.int32), lane
+        )
+        registry["fleet3d_step"] = {
+            "jit": make_fleet_step(fleet.cfg, mesh3d),
+            "args": (fl_state, fl_faults, fl_knobs),
+            "donated_leaves": state_leaves,
+            "tenant_block": AUDIT_TENANT_BLOCK,
+        }
+        registry["fleet3d_wave"] = {
+            "jit": make_fleet_wave(fleet.cfg, mesh3d),
+            "args": (fl_state, fl_faults, fl_knobs, targets, jnp.int32(64),
+                     min_cuts),
+            "donated_leaves": state_leaves,
+            "tenant_block": AUDIT_TENANT_BLOCK,
+        }
     return registry
 
 
@@ -208,10 +277,14 @@ def extract_facts(
     n: int,
     c: int,
     donation_reasons: Optional[List[str]] = None,
+    tenant_block: Optional[int] = None,
 ) -> Dict[str, Any]:
     """All budget-relevant facts of one compiled executable. ``rows`` holds
     the per-collective detail (the evidence-table grain); everything else
-    is the lock grain."""
+    is the lock grain. ``tenant_block`` (devices per tenant slice, fleet
+    entrypoints only) additionally counts collectives whose replica groups
+    span tenant blocks — the ``cross_tenant_collectives`` fact the fleet
+    budget freezes at zero."""
     text = compiled.as_text()
     rows = hlo_facts.audit_collectives(text, n, c)
     collectives: Dict[str, Dict[str, Any]] = {}
@@ -244,7 +317,7 @@ def extract_facts(
             "temp_bytes": int(analysis.temp_size_in_bytes),
             "generated_code_bytes": int(analysis.generated_code_size_in_bytes),
         }
-    return {
+    facts = {
         "collectives": collectives,
         "transfers": hlo_facts.count_transfer_ops(text),
         "donation": {
@@ -257,6 +330,12 @@ def extract_facts(
         "unknown_dtypes": sorted(set(unknown)),
         "rows": rows,
     }
+    if tenant_block is not None:
+        facts["cross_tenant_collectives"] = sum(
+            1 for row in rows
+            if hlo_facts.groups_cross_blocks(row["groups"], tenant_block)
+        )
+    return facts
 
 
 def _compile_program(spec: Dict[str, Any]) -> Tuple[Any, List[str]]:
@@ -359,6 +438,7 @@ def collect_facts(
             entry = extract_facts(
                 compiled, spec["donated_leaves"], AUDIT_N, AUDIT_C,
                 donation_reasons=reasons,
+                tenant_block=spec.get("tenant_block"),
             )
             if spec.get("waiver"):
                 entry["donation"]["waiver"] = spec["waiver"]
@@ -378,6 +458,8 @@ def facts_to_lock(facts: Dict[str, Any]) -> Dict[str, Any]:
             "n": AUDIT_N, "c": AUDIT_C, "k": AUDIT_K,
             "devices": AUDIT_DEVICES,
             "cohort_devices": AUDIT_COHORT_DEVICES,
+            "tenants": AUDIT_TENANTS,
+            "fleet_mesh": list(AUDIT_FLEET_MESH),
         },
         "entrypoints": {},
     }
@@ -391,6 +473,10 @@ def facts_to_lock(facts: Dict[str, Any]) -> Dict[str, Any]:
             "donation": donation,
             "memory": entry["memory"],
         }
+        if "cross_tenant_collectives" in entry:
+            lock["entrypoints"][name]["cross_tenant_collectives"] = entry[
+                "cross_tenant_collectives"
+            ]
     return lock
 
 
@@ -423,6 +509,28 @@ def compare_facts(
             f"payload accounting cannot size them; add the dtype, do not "
             f"guess",
         ))
+
+    # The fleet's hard budget: tenants never communicate. A collective
+    # whose replica groups span tenant device blocks is a finding in its
+    # own right — never freezable (update_hlo_lock refuses it, like a
+    # dropped donation).
+    cross = entry.get("cross_tenant_collectives")
+    if cross:
+        findings.append(Finding(
+            path, lineno, "hlo-cross-tenant-collective",
+            f"{name}: {cross} collective(s) carry the tenant axis in their "
+            f"replica groups — tenants must never communicate; fix the "
+            f"batched program (this budget is frozen at ZERO and cannot be "
+            f"locked in)",
+        ))
+    elif (
+        "cross_tenant_collectives" in locked
+        and locked["cross_tenant_collectives"] != (cross or 0)
+    ):
+        fail("hlo-lock-drift",
+             f"{name}: cross_tenant_collectives "
+             f"{locked['cross_tenant_collectives']} in the lock, "
+             f"{cross or 0} now")
 
     if "collectives" in locked:
         cur = entry["collectives"]
@@ -572,7 +680,9 @@ def check_hlo_lock(trees: Sequence[Tuple[ast.AST, str]]) -> List[Finding]:
         )]
     audit_cfg = {"n": AUDIT_N, "c": AUDIT_C, "k": AUDIT_K,
                  "devices": AUDIT_DEVICES,
-                 "cohort_devices": AUDIT_COHORT_DEVICES}
+                 "cohort_devices": AUDIT_COHORT_DEVICES,
+                 "tenants": AUDIT_TENANTS,
+                 "fleet_mesh": list(AUDIT_FLEET_MESH)}
     if locked.get("audit_config") != audit_cfg:
         return [Finding(
             HLO_LOCK_REL, 1, "hlo-lock-drift",
@@ -594,7 +704,8 @@ def update_hlo_lock() -> Tuple[List[Finding], Optional[Path]]:
     for name, entry in sorted(facts.items()):
         blocking.extend(
             f for f in compare_facts(name, entry, {"donation": {}}, (HLO_LOCK_REL, 1))
-            if f.check in ("hlo-unknown-dtype", "hlo-donation-dropped")
+            if f.check in ("hlo-unknown-dtype", "hlo-donation-dropped",
+                           "hlo-cross-tenant-collective")
         )
     if blocking:
         return blocking, None

@@ -26,8 +26,10 @@ from rapid_tpu.parallel.hlo_facts import (  # noqa: E402,F401 — re-exported
     TRANSFER_OPS,
     audit_collectives,
     classify_location,
+    collective_groups,
     collective_violations,
     count_transfer_ops,
+    groups_cross_blocks,
     input_output_aliases,
     payload_class,
     shape_bytes,
@@ -42,7 +44,9 @@ __all__ = [
     "audit_collectives",
     "classify_location",
     "collective_violations",
+    "collective_groups",
     "count_transfer_ops",
+    "groups_cross_blocks",
     "input_output_aliases",
     "payload_class",
     "shape_bytes",

@@ -62,6 +62,7 @@ SHARDING_PREFIXES = (
     "rapid_tpu/ops/",
     "rapid_tpu/models/",
     "rapid_tpu/parallel/",
+    "rapid_tpu/tenancy/",
 )
 
 #: The real files the tree-mode partition-spec check merges.
@@ -73,6 +74,7 @@ MESH_FILE = "rapid_tpu/parallel/mesh.py"
 _PYTREE_TABLES = {
     "EngineState": "state_shardings",
     "FaultInputs": "fault_shardings",
+    "TenantKnobs": "knob_shardings",
 }
 
 _LAX_LOOP_FNS = frozenset({
@@ -345,6 +347,15 @@ def _check_retrace(
 #: The regex rule table's module-level name (parallel/mesh.py).
 RULES_NAME = "PARTITION_RULES"
 
+#: The tenant batch axis (rapid_tpu/parallel/mesh.TENANT_AXIS): a pytree
+#: leaf whose shape annotation declares a leading ``[t`` dimension is a
+#: TENANT-STACKED leaf, and its rule must shard dimension 0 on this axis —
+#: an unmeshed tenant dimension replicates every tenant's state onto every
+#: tenant's devices, the exact failure mode the fleet mesh exists to
+#: prevent.
+TENANT_AXIS_NAME = "tenant"
+_TENANT_SHAPE_RE = re.compile(r"#\s*\[t[\],]")
+
 #: A replication justification whose premise died with the 1-D mesh: the
 #: cohort axis IS meshed now, so any surviving instance is a finding.
 STALE_REPLICATION_REASON = "cohort axis is not meshed"
@@ -386,9 +397,19 @@ def _partition_rules(tree: ast.AST) -> Optional[Tuple[int, List[Dict[str, Any]]]
                 for a in spec.elts
                 if not (isinstance(a, ast.Constant) and a.value is None)
             )
+
+            def _is_tenant_axis(node: ast.AST) -> bool:
+                if isinstance(node, ast.Name):
+                    return node.id == "TENANT_AXIS"
+                return (
+                    isinstance(node, ast.Constant)
+                    and node.value == TENANT_AXIS_NAME
+                )
+
             rules.append({
                 "pattern": pat.value,
                 "meshed_axes": meshed,
+                "dim0_tenant": bool(spec.elts) and _is_tenant_axis(spec.elts[0]),
                 "lineno": pat.lineno,
                 "spec_lineno": spec.lineno,
             })
@@ -409,12 +430,34 @@ def _stale_annotation_findings(rel: str, source_lines: List[str]) -> List[Findin
     ]
 
 
+def _tenant_leaves(tree: ast.AST, source_lines: List[str]) -> Set[str]:
+    """Field names of the module's state-pytree classes whose shape
+    annotation comment declares a LEADING tenant dimension (``# [t]`` /
+    ``# [t, ...]``) — the leaves the tenant-axis rule discipline covers."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef) and node.name in _PYTREE_TABLES):
+            continue
+        for stmt in node.body:
+            if not (
+                isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            ):
+                continue
+            if 1 <= stmt.lineno <= len(source_lines) and _TENANT_SHAPE_RE.search(
+                source_lines[stmt.lineno - 1]
+            ):
+                out.add(stmt.target.id)
+    return out
+
+
 def _rule_findings(
     fields_by_class: Dict[str, List[str]],
     assign_lineno: int,
     rules: List[Dict[str, Any]],
     rel: str,
     source_lines: List[str],
+    tenant_leaves: Optional[Set[str]] = None,
 ) -> List[Finding]:
     """Coverage of the engine pytree leaves by the regex rule table: every
     leaf fullmatches a rule (first match wins, mirroring
@@ -467,6 +510,17 @@ def _rule_findings(
                 f"{RULES_NAME} rule {rule['pattern']!r} fully replicates "
                 f"leaves {fields} without a `# replicated-ok: <reason>` "
                 f"justification",
+            ))
+        stacked = sorted(set(fields) & (tenant_leaves or set()))
+        if stacked and not rule["dim0_tenant"]:
+            findings.append(Finding(
+                rel, rule["spec_lineno"], "missing-partition-spec",
+                f"{RULES_NAME} rule {rule['pattern']!r} covers "
+                f"tenant-stacked leaves {stacked} ([t, ...] shape "
+                f"annotation) but does not shard dimension 0 on the "
+                f"'{TENANT_AXIS_NAME}' axis — an unmeshed tenant dimension "
+                f"replicates every tenant's state onto every tenant's "
+                f"devices",
             ))
     findings.extend(_stale_annotation_findings(rel, source_lines))
     return findings
@@ -600,7 +654,10 @@ def check_sharding(
     fields = _pytree_array_fields(tree)
     rules = _partition_rules(tree)
     if fields and rules is not None:
-        findings.extend(_rule_findings(fields, rules[0], rules[1], rel, source_lines))
+        findings.extend(_rule_findings(
+            fields, rules[0], rules[1], rel, source_lines,
+            tenant_leaves=_tenant_leaves(tree, source_lines),
+        ))
     elif fields and _table_constructor_calls(tree):
         findings.extend(_partition_spec_findings(fields, tree, rel, src))
     return sorted(set(findings), key=lambda f: (f.lineno, f.check, f.message))
@@ -628,7 +685,9 @@ def check_partition_specs(
     mesh_source = mesh_path.read_text()
     rules = _partition_rules(mesh_tree)
     if rules is not None:
+        state_source = (core.REPO / STATE_FILE).read_text()
         return _rule_findings(
-            fields, rules[0], rules[1], MESH_FILE, mesh_source.splitlines()
+            fields, rules[0], rules[1], MESH_FILE, mesh_source.splitlines(),
+            tenant_leaves=_tenant_leaves(state_tree, state_source.splitlines()),
         )
     return _partition_spec_findings(fields, mesh_tree, MESH_FILE, mesh_source)

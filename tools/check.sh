@@ -10,8 +10,10 @@ echo "== static checks (AST lint + resolution tier + compiled-program gate) =="
 # test_hlo_gate.py first: it compiles the registered engine entrypoints
 # ONCE per session — including the 2-D ('cohort','nodes') mesh wave
 # (sharded2d_wave; the 2-D step is deliberately unregistered, see
-# device_program._build_registry) — so the lint/staticcheck tree sweeps in
-# the same session reuse the facts instead of recompiling.
+# device_program._build_registry) and the multi-tenant fleet pair on the
+# 3-D ('tenant','cohort','nodes') mesh (fleet3d_step/fleet3d_wave, the
+# zero-cross-tenant-collective budget) — so the lint/staticcheck tree
+# sweeps in the same session reuse the facts instead of recompiling.
 python -m pytest tests/test_hlo_gate.py tests/test_lint.py tests/test_staticcheck.py -q -p no:randomly
 
 echo "== full suite (CPU, 8 virtual devices) =="

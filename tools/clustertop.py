@@ -156,7 +156,7 @@ def render_engine_pane(snapshots: List[Dict[str, Any]]) -> List[str]:
     if not engines:
         return []
     header = (
-        "ENGINE", "COMPILES", "CACHEHIT", "DISP99", "DISPATCHES",
+        "ENGINE", "TENANTS", "COMPILES", "CACHEHIT", "DISP99", "DISPATCHES",
         "H2D", "D2H", "LIVEBUF", "DEVMEM",
     )
     rows: List[Tuple[str, ...]] = []
@@ -165,6 +165,7 @@ def render_engine_pane(snapshots: List[Dict[str, Any]]) -> List[str]:
         compile_stats = engine.get("compile") or {}
         memory = engine.get("memory") or {}
         metrics = snapshot.get("metrics") or {}
+        tenancy = engine.get("tenancy")
         hits = compile_stats.get("persistent_cache_hits")
         misses = compile_stats.get("persistent_cache_misses")
         if isinstance(hits, int) and isinstance(misses, int) and hits + misses:
@@ -173,6 +174,10 @@ def render_engine_pane(snapshots: List[Dict[str, Any]]) -> List[str]:
             cache = "-"
         rows.append((
             str(snapshot.get("node", "?")),
+            # Tenant-fleet snapshots carry their batch width; single-cluster
+            # (and pre-fleet) snapshots dash.
+            str(tenancy.get("tenants", "-")) if isinstance(tenancy, dict)
+            else "-",
             str(compile_stats.get("compiles", "-")),
             cache,
             _quantile_cell(_dispatch_histogram(snapshot), 0.99),

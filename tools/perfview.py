@@ -18,11 +18,14 @@ runs, and can I trust the numbers". Two input kinds, freely mixed:
   (snapshot measured different code than HEAD), ``wedged`` (live attempt
   died), ``hole`` (explicit accelerator-unavailable marker),
   ``suspect-rate`` (a derived rate outside plausibility bounds — the
-  alert_deliveries_per_sec ≈ 5e10 class of bug), and ``headline-missing``
+  alert_deliveries_per_sec ≈ 5e10 class of bug), ``headline-missing``
   (an audited round that carries neither the ``n1M_crash1pct_ms``
   headline nor its explicit ``n1M_status`` marker — the 1M scale number
-  must never be silently absent). The N1M column renders the headline
-  value (or its status marker) per round.
+  must never be silently absent), and ``fleet-missing`` (same discipline
+  for the multi-tenant point: an audited round omitting BOTH
+  ``tenant_view_changes_per_sec`` and ``tenant_fleet_status``). The N1M
+  and FLEET columns render the headline / fleet values (or their status
+  markers) per round.
 
 ``--chrome out.json`` additionally writes Chrome trace-event JSON (the same
 envelope tools/traceview.py emits — Perfetto/chrome://tracing load it):
@@ -288,6 +291,18 @@ def point_flags(
         and not data.get("n1M_status")
     ):
         flags.append("headline-missing")
+    # Fleet discipline (ISSUE 10): the same rule for the multi-tenant
+    # point — an audited round must carry tenant_view_changes_per_sec or
+    # its explicit tenant_fleet_status marker; the fleet metric must never
+    # be silently absent. Pre-audit historical rounds are exempt.
+    if (
+        hlo_audit_table(data) is not None
+        and not isinstance(
+            data.get("tenant_view_changes_per_sec"), (int, float)
+        )
+        and not data.get("tenant_fleet_status")
+    ):
+        flags.append("fleet-missing")
     if hlo_drift(prev, hlo_audit_table(data)):
         flags.append("hlo-drift")
     if not flags:
@@ -325,9 +340,21 @@ def headline_cell(data: Dict[str, Any]) -> str:
     return str(status) if status else "-"
 
 
+def fleet_cell(data: Dict[str, Any]) -> str:
+    """The FLEET column: tenant_view_changes_per_sec (with the fleet shape
+    when present), else its explicit tenant_fleet_status marker, else '-'
+    (pre-fleet rounds)."""
+    value = data.get("tenant_view_changes_per_sec")
+    if isinstance(value, (int, float)):
+        return f"{float(value):.1f}/s"
+    status = data.get("tenant_fleet_status")
+    return str(status) if status else "-"
+
+
 def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
     lines = ["== perf trajectory =="]
-    header = ("ROUND", "METRIC", "VALUE", "N1M", "PLATFORM", "VSBASE", "FLAGS")
+    header = ("ROUND", "METRIC", "VALUE", "N1M", "FLEET", "PLATFORM",
+              "VSBASE", "FLAGS")
     rows: List[Tuple[str, ...]] = []
     flag_rows: List[Tuple[str, List[str]]] = []
     prev_audit: Optional[Dict[str, Any]] = None
@@ -343,6 +370,7 @@ def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
             str(data.get("metric", "?")),
             "-" if value is None else f"{float(value):.1f}ms",
             headline_cell(data),
+            fleet_cell(data),
             str(data.get("platform", "-")),
             "-" if vs is None else f"{float(vs):.2f}x"
             + ("@capture" if "vs_baseline_at_capture" in data else ""),
