@@ -279,6 +279,7 @@ STAGE_TIMEOUTS_S = {
     "stretch_point": 3000,
     "loss_variant": 900,
     "tenant_fleet": 900,
+    "stream": 900,
     "hlo_audit": 600,
     "profile": 600,
 }
@@ -342,6 +343,38 @@ def fleet_plan(platform: str, elapsed_s: float) -> "tuple[int, int, str]":
     b = _env_int("RAPID_TPU_BENCH_FLEET_B", 8)
     n_t = _env_int("RAPID_TPU_BENCH_FLEET_N", 64)
     return b, n_t, f"ramped:{b}x{n_t}"
+
+
+def stream_plan(platform: str, elapsed_s: float) -> "tuple[int, int, str]":
+    """The streaming-serving decision, pure over (platform, elapsed
+    seconds) + env: returns (waves to drive, members per cluster N,
+    stream_status). waves == 0 means the stage is skipped — but the status
+    STILL lands in the emitted JSON, so the sustained-throughput metrics
+    are never silently absent (the n1M_status discipline). On the
+    accelerator (or RAPID_TPU_BENCH_STREAM=1) the stage drives 64 waves at
+    N=4096; a CPU run exercises the full pipeline ramped down
+    (RAPID_TPU_BENCH_STREAM_WAVES/_N, default 12 x 96); past the budget
+    (RAPID_TPU_BENCH_STREAM_BUDGET_S, defaulting to the XL budget) it is
+    skipped-budget; RAPID_TPU_BENCH_NO_STREAM=1 suppresses it everywhere.
+    Unit-pinned in tests/test_bench_ledger.py."""
+    if _env_flag("RAPID_TPU_BENCH_NO_STREAM"):
+        return 0, 0, "suppressed"
+    forced = _env_flag("RAPID_TPU_BENCH_STREAM")
+    budget_s = _env_int(
+        "RAPID_TPU_BENCH_STREAM_BUDGET_S",
+        _env_int("RAPID_TPU_BENCH_XL_BUDGET_S", 1500),
+    )
+    if elapsed_s > budget_s and not forced:
+        return 0, 0, "skipped-budget"
+    if platform == "tpu" or forced:
+        return (
+            _env_int("RAPID_TPU_BENCH_STREAM_WAVES", 64),
+            _env_int("RAPID_TPU_BENCH_STREAM_N", 4096),
+            "live",
+        )
+    waves = _env_int("RAPID_TPU_BENCH_STREAM_WAVES", 12)
+    n_s = _env_int("RAPID_TPU_BENCH_STREAM_N", 96)
+    return waves, n_s, f"ramped:{waves}x{n_s}"
 
 
 def _parse_scale(spec: str) -> int:
@@ -792,6 +825,183 @@ def run_workload(ledger, profile_dir=None) -> None:
         ledger.emit(LedgerEvent.DEVICE_MEMORY, stage="tenant_fleet",
                     **fleet_memory)
 
+    # Streaming serving point (ISSUE 11 / ROADMAP item 4): sustained
+    # throughput under CONTINUOUS Poisson churn through the pipelined
+    # dispatch path (rapid_tpu/serving) — per-wave fault deltas double-
+    # buffered against in-flight dispatches, host sync only at explicit
+    # fetch boundaries. Both serving paths stream: the single cluster
+    # (crash+join churn) and the tenant fleet (per-tenant crash streams).
+    # The emitted numbers are the ones a serving system publishes —
+    # sustained view-changes/sec, p99 alert->commit latency, and the
+    # overlap-efficiency ratio (1 - host-fetch-blocked/wall, computed from
+    # the stream_fetch dispatch-phase histogram the dashboards also
+    # render). Never silently absent: stream_status always lands in the
+    # emitted JSON (the n1M_status discipline).
+    stream_waves, stream_n, stream_status = stream_plan(
+        platform, time.monotonic() - _START
+    )
+    stream_fields = {}
+    stream_memory = None
+    if stream_waves == 0:
+        _mark(f"stream stage not run: {stream_status}")
+    else:
+        from rapid_tpu.serving import (
+            FleetPoissonChurn, PoissonChurn, StreamDriver,
+        )
+        from rapid_tpu.tenancy import TenantFleet
+        from rapid_tpu.utils.histogram import LogHistogram as _StreamHist
+
+        stream_b = 4  # fleet-path tenants: enough to exercise the stacked pipe
+        rounds_per_wave = _env_int("RAPID_TPU_BENCH_STREAM_ROUNDS", 8)
+        # Fresh-slot headroom for the join half of the churn: the generator
+        # never reuses a slot (the engine's UUID discipline), so the slot
+        # table must hold every joiner the whole stream can admit.
+        stream_slots = stream_n + 2 * stream_waves
+
+        def build_stream_cluster(seed: int):
+            vcs = VirtualCluster.create(
+                stream_n, n_slots=stream_slots, k=k_rings, h=9, l=4,
+                cohorts=min(8, stream_n), fd_threshold=fd_threshold,
+                seed=seed, delivery_spread=delivery_spread,
+            )
+            vcs.assign_cohorts_roundrobin()
+            return vcs
+
+        def build_stream_fleet(seed0: int):
+            clusters = []
+            for i in range(stream_b):
+                vcs = VirtualCluster.create(
+                    stream_n, k=k_rings, h=9, l=4,
+                    cohorts=min(8, stream_n), fd_threshold=fd_threshold,
+                    seed=seed0 + i, delivery_spread=delivery_spread,
+                )
+                vcs.assign_cohorts_roundrobin()
+                clusters.append(vcs)
+            return TenantFleet.from_clusters(clusters)
+
+        with ledger.stage(
+            "stream", timeout_s=_stage_timeout("stream"),
+            n=stream_waves * rounds_per_wave,
+        ):
+            with _heartbeat(f"stream warm-up N={stream_n}"):
+                with engine_telemetry.CompileDelta() as stream_compiles:
+                    # Warm the compiled programs the stream enqueues —
+                    # engine_step at the cluster shape, fleet_step at the
+                    # stacked shape, AND the churn-injection programs
+                    # (crash scatter, predecessor_of_keys + the join
+                    # scatters) — so the timed stream measures dispatch
+                    # overlap, not XLA compiles. Per-delta-SIZE shapes
+                    # (a 2-crash wave, a 3-join wave) still compile fresh
+                    # mid-stream; stream_mid_stream_compiles below keeps
+                    # that residual pollution observable instead of
+                    # pretending it away.
+                    warm = build_stream_cluster(seed=7_000)
+                    warm.crash([0])
+                    warm.inject_join_wave([stream_n])
+                    warm.step()
+                    warm.sync()
+                    warm_fleet = build_stream_fleet(seed0=7_100)
+                    warm_fleet.stream_crash([(0, 1)])
+                    warm_fleet.step()
+                    warm_fleet.sync()
+                    del warm, warm_fleet
+            with engine_telemetry.CompileDelta() as stream_mid:
+                # Single-cluster path: seeded Poisson crash+join churn,
+                # waves pipelined `depth` deep behind in-flight dispatches.
+                vcs = build_stream_cluster(seed=7_200)
+                vcs.sync()
+                stream_driver = StreamDriver(
+                    vcs, rounds_per_wave=rounds_per_wave, depth=2
+                )
+                for wave in PoissonChurn(
+                    stream_n, stream_slots, rate=2.0, seed=7_300
+                ).waves(stream_waves):
+                    stream_driver.submit(wave)
+                cluster_stream = stream_driver.drain()
+                _mark(
+                    f"stream cluster: {cluster_stream.cuts} view changes over "
+                    f"{cluster_stream.waves} waves in {cluster_stream.wall_ms:.1f} ms "
+                    f"(overlap {cluster_stream.overlap_efficiency})"
+                )
+                # Fleet path: the same pipeline over the stacked engine.
+                fleet_s = build_stream_fleet(seed0=7_400)
+                fleet_s.sync()
+                fleet_stream_driver = StreamDriver(
+                    fleet_s, rounds_per_wave=rounds_per_wave, depth=2
+                )
+                for wave in FleetPoissonChurn(
+                    stream_b, stream_n, rate=0.5, seed=7_500
+                ).waves(stream_waves):
+                    fleet_stream_driver.submit(wave)
+                fleet_stream = fleet_stream_driver.drain()
+                _mark(
+                    f"stream fleet: {fleet_stream.cuts} view changes over "
+                    f"{fleet_stream.waves} waves in {fleet_stream.wall_ms:.1f} ms"
+                )
+            # Combined sustained metrics over BOTH paths: total committed
+            # view changes over total stream wall clock, p99 over the
+            # merged alert->commit histograms, overlap over the summed
+            # fetch-blocked time (all three checkable from the per-target
+            # telemetry scrapes).
+            wall_ms_total = cluster_stream.wall_ms + fleet_stream.wall_ms
+            cuts_total = cluster_stream.cuts + fleet_stream.cuts
+            fetch_ms_total = (
+                cluster_stream.fetch_blocked_ms + fleet_stream.fetch_blocked_ms
+            )
+            merged_latency = _StreamHist.merged(
+                hist for target in (vcs, fleet_s)
+                if (hist := target.metrics.timings.get(
+                    "engine_stream_alert_to_commit"
+                )) is not None
+            )
+            stream_fields = {
+                "stream_view_changes_per_sec": (
+                    round(cuts_total / (wall_ms_total / 1000.0), 2)
+                    if wall_ms_total > 0 else None
+                ),
+                "stream_p99_alert_to_commit_ms": (
+                    round(float(merged_latency.quantile(0.99)), 3)
+                    if merged_latency.count else None
+                ),
+                "stream_overlap_efficiency": (
+                    round(max(0.0, min(1.0, 1.0 - fetch_ms_total / wall_ms_total)), 4)
+                    if wall_ms_total > 0 else None
+                ),
+                "stream_waves": stream_waves,
+                "stream_rounds_per_wave": rounds_per_wave,
+                "stream_n": stream_n,
+                "stream_fleet_tenants": stream_b,
+                "stream_view_changes": cuts_total,
+                "stream_wall_ms": round(wall_ms_total, 3),
+                "stream_cluster_view_changes_per_sec": (
+                    round(cluster_stream.view_changes_per_sec, 2)
+                    if cluster_stream.view_changes_per_sec is not None else None
+                ),
+                "stream_fleet_view_changes_per_sec": (
+                    round(fleet_stream.view_changes_per_sec, 2)
+                    if fleet_stream.view_changes_per_sec is not None else None
+                ),
+                "stream_h2d_bytes": cluster_stream.h2d_bytes + fleet_stream.h2d_bytes,
+                # Compiles that landed INSIDE the timed stream (per-delta-
+                # size scatter shapes the warm-up cannot enumerate): the
+                # reader's gauge for how much of wall_ms/p99 is compile
+                # pollution rather than dispatch overlap.
+                "stream_mid_stream_compiles": stream_mid.delta.get("compiles", 0),
+                "stream_mid_stream_compile_ms": stream_mid.delta.get(
+                    "compile_ms", 0.0
+                ),
+            }
+            stream_memory = engine_telemetry.device_memory_snapshot()
+            _mark(
+                f"stream: {cuts_total} view changes in {wall_ms_total:.1f} ms "
+                f"({stream_fields['stream_view_changes_per_sec']}/s, overlap "
+                f"{stream_fields['stream_overlap_efficiency']})"
+            )
+        ledger.emit(LedgerEvent.COMPILE_STATS, stage="stream",
+                    **stream_compiles.delta)
+        ledger.emit(LedgerEvent.DEVICE_MEMORY, stage="stream",
+                    **stream_memory)
+
     # Compiled-program audit (ISSUE 8, analysis family 12): compile the
     # registered engine entrypoints at the fixed audit shapes ON THIS
     # PLATFORM and embed the per-entrypoint collective/memory table, so the
@@ -887,6 +1097,15 @@ def run_workload(ledger, profile_dir=None) -> None:
             else {}
         ),
         **({"fleet_device_memory": fleet_memory} if fleet_memory is not None else {}),
+        # Streaming serving point (ISSUE 11): sustained view-changes/sec,
+        # p99 alert->commit, and overlap efficiency through the pipelined
+        # dispatch path over BOTH serving shapes (single cluster + fleet).
+        # Never silently absent — stream_status says exactly what the point
+        # is when the values themselves are missing ("ramped:WxN" = CPU
+        # pipeline exercise; "skipped-budget"; "suppressed").
+        "stream_status": stream_status,
+        **{k: v for k, v in stream_fields.items() if v is not None},
+        **({"stream_device_memory": stream_memory} if stream_memory is not None else {}),
         "samples_ms": [round(s, 3) for s in samples],
         "churn_resolution_hist": sample_hist.summary(),
         "view_changes": cuts_per_sample,

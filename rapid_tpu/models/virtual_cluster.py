@@ -17,7 +17,6 @@ reduction here is a sum/any over N, which XLA lowers to psum over ICI.
 from __future__ import annotations
 
 import time
-from contextlib import contextmanager
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -45,6 +44,7 @@ from rapid_tpu.ops.rings import (
     ring_topology_from_perm,
 )
 from rapid_tpu.utils import engine_telemetry, exposition
+from rapid_tpu.utils.dispatch import DispatchSeam
 from rapid_tpu.utils.health import NodeHealth
 from rapid_tpu.utils.metrics import Metrics
 
@@ -785,12 +785,16 @@ run_until_membership = jax.jit(
 )
 
 
-class VirtualCluster:
+class VirtualCluster(DispatchSeam):
     """Host driver around the device engine: owns the state, injects faults
     and join waves, and runs rounds until convergence.
 
     This is the deployment the BASELINE targets: N virtual Rapid endpoints
     co-located on TPU hosts, alerts/votes as device-array writes.
+
+    The telemetry seams (transfer accounting, the phase-validated
+    ``_dispatch`` timer) are the shared :class:`DispatchSeam` — one
+    vocabulary across this driver, the fleet, and the streaming pipeline.
     """
 
     def __init__(self, cfg: EngineConfig, state: EngineState):
@@ -804,37 +808,11 @@ class VirtualCluster:
         # events are process-global (one XLA cache per process), captured by
         # the engine_telemetry collector and read at snapshot time.
         self.metrics = Metrics()
+        # Attached by rapid_tpu.serving.StreamDriver: the streaming pipeline
+        # surfaces its sustained-throughput stats through this cluster's
+        # telemetry snapshot (None = batch-only driver, no stream section).
+        self.stream = None
         engine_telemetry.install()
-
-    # -- telemetry seams ------------------------------------------------
-
-    def _account_h2d(self, *arrays) -> None:
-        """Charge host->device uploads (indices, masks, initial state) to
-        the transfer-byte counter. Host-side accounting at the driver seams:
-        only arrays that originate on the host are charged, which is exactly
-        the traffic a remote-tunnel deployment pays for."""
-        self.metrics.inc(
-            "engine_h2d_bytes",
-            int(sum(int(getattr(a, "nbytes", 0) or 0) for a in arrays)),
-        )
-
-    def _account_d2h(self, nbytes: int) -> None:
-        self.metrics.inc("engine_d2h_bytes", int(nbytes))
-
-    @contextmanager
-    def _dispatch(self, entry: str):
-        """Time one device dispatch+fetch pair into the bounded per-entry
-        latency histogram (``engine_dispatch_ms{phase=<entry>}``) and bump
-        the dispatch counter — the engine's per-dispatch observability grain."""
-        self.metrics.inc("engine_dispatches")
-        start = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.metrics.record_ms(
-                "engine_dispatch", (time.perf_counter() - start) * 1000.0,
-                phase=entry,
-            )
 
     # -- construction ---------------------------------------------------
 
@@ -1044,7 +1022,9 @@ class VirtualCluster:
             fd_count=jnp.asarray(-offsets.astype(np.int32))
         )
 
-    def inject_join_wave(self, slots: Sequence[int]) -> None:
+    def inject_join_wave(
+        self, slots: Sequence[int], check_admissible: bool = True
+    ) -> None:
         """Admit a batch of joiners: their gatekeepers (ring predecessors)
         emit UP alerts on all rings at once — the batched equivalent of the
         two-phase join's phase 2 (Cluster.java:406-437).
@@ -1059,22 +1039,31 @@ class VirtualCluster:
         through a FRESH slot (new identity lanes), never by re-admitting its
         old slot — slot identities are the engine's UUIDs, and reusing one
         would reproduce a previous configuration id (the reference rejects
-        reused UUIDs outright, UUIDAlreadySeenError)."""
+        reused UUIDs outright, UUIDAlreadySeenError).
+
+        ``check_admissible=False`` skips the [j]-bool admissibility fetch —
+        the streaming pipeline's spelling (rapid_tpu/serving): that fetch is
+        a host sync that would stall every enqueued wave behind it, and the
+        stream's churn generator already owns the slot bookkeeping (fresh
+        slots only, never reused). Callers without that host-side guarantee
+        must keep the check: an inadmissible joiner silently replays an old
+        configuration id."""
         slots = np.asarray(slots)
         state = self.state
         idx = self._slot_index(slots)
-        # Enforce the rejoin discipline host-side (the engine's
-        # UUIDAlreadySeenError): current members, already-pending joiners,
-        # and retired identity lanes are not admissible. Index on device
-        # first so the ONE device->host fetch (a full tunnel round trip)
-        # carries [j] bools, not the whole [n] state.
-        bad = np.asarray((state.alive | state.join_pending | state.retired)[idx])
-        self._account_d2h(bad.nbytes)
-        if bad.any():
-            raise ValueError(
-                f"slots not admissible as joiners (member/pending/retired): "
-                f"{slots[bad].tolist()}"
-            )
+        if check_admissible:
+            # Enforce the rejoin discipline host-side (the engine's
+            # UUIDAlreadySeenError): current members, already-pending
+            # joiners, and retired identity lanes are not admissible. Index
+            # on device first so the ONE device->host fetch (a full tunnel
+            # round trip) carries [j] bools, not the whole [n] state.
+            bad = np.asarray((state.alive | state.join_pending | state.retired)[idx])
+            self._account_d2h(bad.nbytes)
+            if bad.any():
+                raise ValueError(
+                    f"slots not admissible as joiners (member/pending/retired): "
+                    f"{slots[bad].tolist()}"
+                )
 
         # Expected observers (gatekeepers) of each joiner: the alive ring
         # predecessors of its keys. Everything below is device-side
@@ -1129,12 +1118,28 @@ class VirtualCluster:
 
     # -- execution ------------------------------------------------------
 
-    def step(self) -> StepEvents:
+    def _step(self, phase: str) -> StepEvents:
+        """ONE body for both step spellings: only the dispatch-phase label
+        differs, so a change here cannot diverge the streamed path from the
+        batch path the bit-identity tests pin."""
         self.metrics.inc("engine_steps")
         self.metrics.inc("engine_convergence_steps")
-        with self._dispatch("step"):
+        with self._dispatch(phase):
             self.state, events = engine_step(self.cfg, self.state, self.faults)
         return events
+
+    def step(self) -> StepEvents:
+        return self._step("step")
+
+    def stream_step(self) -> StepEvents:
+        """One ENQUEUED engine round for the streaming pipeline
+        (rapid_tpu/serving): the same compiled ``engine_step`` program as
+        :meth:`step` — bit-identical math — accounted under the
+        ``stream_enqueue`` phase and guaranteed fetch-free, so the host
+        returns as soon as JAX has queued the dispatch. The returned events
+        stay device-resident (they are the stream driver's completion
+        ticket); reading them here would put a host sync on the pipeline."""
+        return self._step("stream_enqueue")
 
     def sync(self) -> int:
         """Force completion of all pending uploads/compute on the cluster
@@ -1301,6 +1306,14 @@ class VirtualCluster:
                 "use_pallas": self.cfg.use_pallas,
                 "compile": engine_telemetry.compile_snapshot(),
                 "memory": engine_telemetry.device_memory_snapshot(),
+                # Streaming tier (rapid_tpu/serving): present only when a
+                # StreamDriver is attached — batch-only scrapes keep their
+                # series set (golden names pinned either way).
+                **(
+                    {"stream": self.stream.snapshot()}
+                    if self.stream is not None
+                    else {}
+                ),
             },
             "transport": {},
             "recorder": None,

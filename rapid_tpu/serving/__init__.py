@@ -1,0 +1,32 @@
+"""Streaming serving mode: overlap host I/O with device compute and measure
+sustained throughput, not one-shot convergence (ROADMAP item 4).
+
+``stream`` holds the pipeline — :class:`~rapid_tpu.serving.stream.StreamDriver`
+double-buffers per-wave ``FaultInputs`` deltas against the in-flight engine
+dispatches and synchronizes only at explicit fetch boundaries;
+:class:`~rapid_tpu.serving.stream.PoissonChurn` turns a seeded arrival-rate
+spec into per-wave churn deltas in the sim families' fault vocabulary, so
+chaos schedules stream through the same pipe.
+"""
+
+from rapid_tpu.serving.stream import (  # noqa: F401
+    STREAMABLE_KINDS,
+    FleetPoissonChurn,
+    FleetWave,
+    PoissonChurn,
+    StreamDriver,
+    StreamResult,
+    StreamWave,
+    waves_from_schedule,
+)
+
+__all__ = [
+    "FleetPoissonChurn",
+    "FleetWave",
+    "PoissonChurn",
+    "StreamDriver",
+    "StreamResult",
+    "StreamWave",
+    "STREAMABLE_KINDS",
+    "waves_from_schedule",
+]

@@ -45,8 +45,6 @@ Batched-control-flow tradeoffs, stated plainly:
 
 from __future__ import annotations
 
-import time
-from contextlib import contextmanager
 from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -71,6 +69,7 @@ from rapid_tpu.parallel.mesh import (
     match_partition_rules,
 )
 from rapid_tpu.utils import engine_telemetry, exposition
+from rapid_tpu.utils.dispatch import DispatchSeam
 from rapid_tpu.utils.health import NodeHealth
 from rapid_tpu.utils.metrics import Metrics
 
@@ -309,9 +308,10 @@ def stack_pytrees(trees: Sequence):
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
 
 
-class TenantFleet:
+class TenantFleet(DispatchSeam):
     """Host driver over the batched engine: owns the stacked state, the
-    per-tenant knobs, and the dispatch telemetry.
+    per-tenant knobs, and the dispatch telemetry (the shared
+    :class:`DispatchSeam` — one phase vocabulary across every driver).
 
     Construction is by stacking ordinary per-tenant ``VirtualCluster``
     builds (:meth:`from_clusters`) — every injection seam (crash, join
@@ -340,6 +340,8 @@ class TenantFleet:
         self.knobs = knobs
         self.b = b
         self.metrics = Metrics()
+        # Attached by rapid_tpu.serving.StreamDriver (None = batch-only).
+        self.stream = None
         engine_telemetry.install()
 
     # -- construction ---------------------------------------------------
@@ -423,33 +425,6 @@ class TenantFleet:
             clusters.append(vc)
         return cls.from_clusters(clusters)
 
-    # -- telemetry seams (the VirtualCluster discipline, fleet-labeled) --
-
-    def _account_h2d(self, *arrays) -> None:
-        self.metrics.inc(
-            "engine_h2d_bytes",
-            int(sum(int(getattr(a, "nbytes", 0) or 0) for a in arrays)),
-        )
-
-    def _account_d2h(self, nbytes: int) -> None:
-        self.metrics.inc("engine_d2h_bytes", int(nbytes))
-
-    @contextmanager
-    def _dispatch(self, entry: str):
-        """Time one device dispatch+fetch pair into the bounded per-entry
-        latency histogram (``engine_dispatch_ms{phase=<entry>}``) and bump
-        the dispatch counter — the VirtualCluster seam, fleet-labeled."""
-        self.metrics.inc("engine_dispatches")
-        start = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.metrics.record_ms(
-                "engine_dispatch",
-                (time.perf_counter() - start) * 1000.0,
-                phase=entry,
-            )
-
     # -- execution ------------------------------------------------------
 
     def step(self) -> StepEvents:
@@ -462,12 +437,48 @@ class TenantFleet:
         (:meth:`run_to_decision` / :meth:`run_until_membership`) do the cut
         accounting; a step-driven loop that fetches events itself (the
         autotune sweep) observes its cuts in its own results."""
+        return self._step("fleet_step")
+
+    def stream_step(self) -> StepEvents:
+        """One ENQUEUED batched round for the streaming pipeline
+        (rapid_tpu/serving): the same compiled ``fleet_step`` program as
+        :meth:`step` — bit-identical per tenant — accounted under the
+        ``stream_enqueue`` phase and guaranteed fetch-free; the stacked
+        events stay device-resident (the stream driver's ticket)."""
+        return self._step("stream_enqueue")
+
+    def _step(self, phase: str) -> StepEvents:
+        """ONE body for both step spellings: only the dispatch-phase label
+        differs, so a change here cannot diverge the streamed path from the
+        batch path the bit-identity tests pin."""
         self.metrics.inc("engine_tenant_rounds", self.b)
-        with self._dispatch("fleet_step"):
+        with self._dispatch(phase):
             self.state, events = fleet_step(
                 self.cfg, self.state, self.faults, self.knobs
             )
         return events
+
+    def stream_crash(self, pairs) -> None:
+        """Crash ``(tenant, slot)`` pairs mid-stream: one device-side
+        scatter onto the stacked crash mask — only the [m, 2] int32 index
+        array crosses the host->device boundary, and the update enqueues
+        behind the in-flight dispatches (no fetch, no sync). Host-side
+        bounds check first: jnp scatters CLAMP out-of-range indices, which
+        would silently crash tenant b-1 / slot n-1 on a typo."""
+        arr = np.asarray(pairs, dtype=np.int32).reshape(-1, 2)
+        if arr.size and (
+            arr[:, 0].min() < 0 or arr[:, 0].max() >= self.b
+            or arr[:, 1].min() < 0 or arr[:, 1].max() >= self.cfg.n
+        ):
+            raise IndexError(
+                f"(tenant, slot) pairs out of range [0, {self.b}) x "
+                f"[0, {self.cfg.n}): {arr.tolist()}"
+            )
+        self._account_h2d(arr)
+        idx = jnp.asarray(arr)
+        self.faults = self.faults._replace(
+            crashed=self.faults.crashed.at[idx[:, 0], idx[:, 1]].set(True)
+        )
 
     def run_to_decision(self, max_steps: int = 64):
         """Every tenant runs to its own first view change in one dispatch;
@@ -605,6 +616,14 @@ class TenantFleet:
                         tenant_rounds / dispatches, 3
                     ) if dispatches else 0.0,
                 },
+                # Streaming tier: present only when a StreamDriver is
+                # attached (the VirtualCluster rule — batch-only scrapes
+                # keep their series set).
+                **(
+                    {"stream": self.stream.snapshot()}
+                    if self.stream is not None
+                    else {}
+                ),
             },
             "transport": {},
             "recorder": None,

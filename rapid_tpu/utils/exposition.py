@@ -40,7 +40,11 @@ import math
 from typing import Any, Dict, List, Optional
 
 from rapid_tpu.utils.health import NodeHealth
-from rapid_tpu.utils.histogram import cumulative_from_summary
+from rapid_tpu.utils.histogram import LogHistogram, cumulative_from_summary
+
+#: The zero-count summary shape, for series that must exist from the first
+#: scrape even though their instrument is minted lazily on first record.
+_EMPTY_HISTOGRAM_SUMMARY = LogHistogram().summary()
 
 _PREFIX = "rapid"
 
@@ -90,6 +94,29 @@ ENGINE_KNOWN_COUNTERS = (
 TENANCY_KNOWN_COUNTERS = (
     "engine_tenant_rounds",
     "engine_tenant_cuts",
+)
+
+#: Streaming-tier counters zero-filled on snapshots whose ``engine`` section
+#: carries a ``stream`` block (a ``rapid_tpu.serving.StreamDriver`` is
+#: attached to the driver) — same stable-series rule; batch-only scrapes
+#: never grow them.
+STREAM_KNOWN_COUNTERS = (
+    "engine_stream_waves",
+    "engine_stream_cuts",
+)
+
+#: ``engine.stream`` gauge keys (``StreamDriver.snapshot()``); rate/ratio
+#: gauges are None before the first drain and render NaN so the series set
+#: is stable from the first scrape.
+_ENGINE_STREAM_GAUGES = (
+    "waves_submitted",
+    "waves_completed",
+    "waves_in_flight",
+    "rounds_per_wave",
+    "depth",
+    "view_changes_per_sec",
+    "overlap_efficiency",
+    "p99_alert_to_commit_ms",
 )
 
 #: ``engine.compile`` counter keys -> metric suffix (all render as
@@ -232,6 +259,15 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
         counters.update({name: 0 for name in ENGINE_KNOWN_COUNTERS})
     if isinstance(engine_section, dict) and "tenancy" in engine_section:
         counters.update({name: 0 for name in TENANCY_KNOWN_COUNTERS})
+    if isinstance(engine_section, dict) and "stream" in engine_section:
+        counters.update({name: 0 for name in STREAM_KNOWN_COUNTERS})
+        # The alert->commit timer is lazily minted on the first wave
+        # COMPLETION, so a scrape between attach and first completion
+        # would otherwise lack the histogram triplet — zero-fill it (the
+        # stable-series rule the counters above follow).
+        metrics.setdefault(
+            "engine_stream_alert_to_commit_ms", _EMPTY_HISTOGRAM_SUMMARY
+        )
     timers: Dict[str, Dict[str, Any]] = {}
     for name, value in metrics.items():
         if isinstance(value, dict):
@@ -283,6 +319,19 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
             value = memory.get(key)
             out.sample(f"{_PREFIX}_engine_{key}", "gauge",
                        float("nan") if value is None else value, node=node)
+        stream = engine.get("stream")
+        if isinstance(stream, dict):
+            # The streaming tier (rapid_tpu/serving): pipeline state and
+            # the drained sustained rates as gauges (NaN pre-drain — the
+            # series set is stable from the first scrape); the cumulative
+            # wave/cut counters ride the ordinary metrics section,
+            # zero-filled above, and the alert->commit histogram renders
+            # from the timer family like every other timer.
+            for key in _ENGINE_STREAM_GAUGES:
+                value = stream.get(key)
+                out.sample(f"{_PREFIX}_engine_stream_{key}", "gauge",
+                           float("nan") if value is None else value,
+                           node=node)
         tenancy = engine.get("tenancy")
         if isinstance(tenancy, dict):
             # The fleet tier: tenant count and per-dispatch tenant

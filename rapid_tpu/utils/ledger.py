@@ -73,6 +73,7 @@ STAGE_NAMES = frozenset({
     "stretch_point",
     "loss_variant",
     "tenant_fleet",
+    "stream",
     "hlo_audit",
     "profile",
 })

@@ -1,0 +1,52 @@
+"""Lint corpus: blocking reads inside the streaming pipeline.
+
+In a serving module EVERY device->host read is a pipeline stall — JAX async
+dispatch only overlaps host work with device compute while the host never
+blocks — so each spelling below is a finding anywhere in the module (not
+just inside traced functions), unless it is a declared fetch boundary
+(``# host-sync-ok: <reason>``).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class MiniDriver:
+    def __init__(self, target):
+        self.target = target
+        self.pending = []
+        # Casts of HOST values are not fetches — the checker resolves the
+        # call inside the cast, so a numpy rng draw stays clean.
+        self.budget = int(np.random.default_rng(0).poisson(2.0))
+
+    def submit(self, wave):
+        events = self.target.stream_step(wave)
+        # Probing the ticket by VALUE forces the fetch the pipeline exists
+        # to avoid — a stall on every submit.
+        done = bool(events.decided.item())  # expect: host-sync-in-stream
+        self.pending.append((wave, events.decided, done))
+
+    def progress(self):
+        # Peeking at device state mid-stream is an undeclared fetch —
+        # in EITHER numpy spelling (array copies, asarray may alias; both
+        # materialize the device buffer on host).
+        host_view = np.asarray(self.target.state.alive)  # expect: host-sync-in-stream
+        host_copy = np.array(self.target.state.seen_down)  # expect: host-sync-in-stream
+        fetched = jax.device_get(host_view)  # expect: host-sync-in-stream
+        fetched = fetched + host_copy.sum()
+        # The scalar-fetch CAST spelling — the one the pipeline's own
+        # drain fetch uses — blocks just the same.
+        epoch = int(jnp.sum(self.target.state.config_epoch))  # expect: host-sync-in-stream
+        return fetched.sum() + epoch
+
+    def drain(self):
+        for _wave, ticket, _done in self.pending:
+            jax.block_until_ready(ticket)  # host-sync-ok: declared drain boundary
+        last = self.pending[-1][1] if self.pending else None
+        if last is not None:
+            last.block_until_ready()  # expect: host-sync-in-stream
+        total = int(jnp.sum(self.target.state.config_epoch))  # host-sync-ok: the one drain-time epoch fetch
+        self.pending.clear()
+        return total

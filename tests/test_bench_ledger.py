@@ -89,6 +89,11 @@ def test_cpu_run_emits_complete_ledger(tmp_path):
             # tenants.
             "RAPID_TPU_BENCH_FLEET_B": "4",
             "RAPID_TPU_BENCH_FLEET_N": "48",
+            # Tiny stream: the FULL pipelined path runs (ramped) — Poisson
+            # churn double-buffered through both the single-cluster and
+            # fleet stream drivers.
+            "RAPID_TPU_BENCH_STREAM_WAVES": "6",
+            "RAPID_TPU_BENCH_STREAM_N": "48",
         },
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
@@ -162,6 +167,29 @@ def test_cpu_run_emits_complete_ledger(tmp_path):
         e["event"] == "device_memory" and e.get("stage") == "tenant_fleet"
         for e in events
     )
+    # ISSUE 11 streaming path, same run: the stream stage drove Poisson
+    # churn through the pipelined dispatch path (both serving shapes) in
+    # its own bracketed, budgeted stage — sustained view-changes/sec, p99
+    # alert->commit, and the overlap-efficiency ratio all land in the
+    # emitted JSON with an explicit status marker (never silently absent).
+    assert result["stream_status"] == "ramped:6x48"
+    assert result["stream_waves"] == 6 and result["stream_n"] == 48
+    assert result["stream_view_changes_per_sec"] >= 0
+    assert result["stream_p99_alert_to_commit_ms"] > 0
+    assert 0.0 <= result["stream_overlap_efficiency"] <= 1.0
+    assert result["stream_h2d_bytes"] > 0  # churn deltas crossed the seam
+    [(stream_begin, stream_close)] = pairs["stream"]
+    assert stream_close["event"] == "stage_end"
+    assert stream_begin["timeout_s"] > 0
+    assert stream_begin["n"] == 6 * 8  # engine rounds enqueued per path
+    assert any(
+        e["event"] == "device_memory" and e.get("stage") == "stream"
+        for e in events
+    )
+    assert any(
+        e["event"] == "compile_stats" and e.get("stage") == "stream"
+        for e in events
+    )
 
 
 def test_headline_plan_is_never_silently_absent(monkeypatch):
@@ -210,6 +238,34 @@ def test_fleet_plan_is_never_silently_absent(monkeypatch):
     assert bench.fleet_plan("cpu", 2000.0) == (4, 48, "live")
     monkeypatch.setenv("RAPID_TPU_BENCH_NO_FLEET", "1")
     assert bench.fleet_plan("tpu", 0.0) == (0, 0, "suppressed")
+
+
+def test_stream_plan_is_never_silently_absent(monkeypatch):
+    """ISSUE 11: every branch of the streaming-serving policy yields an
+    explicit status (the headline_plan discipline) — 64 waves at N=4096 on
+    the accelerator, ramped on CPU, skipped-budget past the (shared-default)
+    budget, suppressed on request, forced when asked."""
+    for name in ("RAPID_TPU_BENCH_NO_STREAM", "RAPID_TPU_BENCH_STREAM",
+                 "RAPID_TPU_BENCH_STREAM_WAVES", "RAPID_TPU_BENCH_STREAM_N",
+                 "RAPID_TPU_BENCH_STREAM_BUDGET_S",
+                 "RAPID_TPU_BENCH_XL_BUDGET_S"):
+        monkeypatch.delenv(name, raising=False)
+    assert bench.stream_plan("tpu", 0.0) == (64, 4096, "live")
+    assert bench.stream_plan("cpu", 0.0) == (12, 96, "ramped:12x96")
+    monkeypatch.setenv("RAPID_TPU_BENCH_STREAM_WAVES", "6")
+    monkeypatch.setenv("RAPID_TPU_BENCH_STREAM_N", "48")
+    assert bench.stream_plan("cpu", 0.0) == (6, 48, "ramped:6x48")
+    # Past the budget the point is skipped — but NAMED; the stream budget
+    # defaults to the XL budget so one env override governs all three tails.
+    assert bench.stream_plan("tpu", 2000.0) == (0, 0, "skipped-budget")
+    monkeypatch.setenv("RAPID_TPU_BENCH_STREAM_BUDGET_S", "3000")
+    assert bench.stream_plan("tpu", 2000.0)[2] == "live"
+    # ...and forcing runs it anywhere, at the env-resolved scale.
+    monkeypatch.setenv("RAPID_TPU_BENCH_STREAM_BUDGET_S", "1")
+    monkeypatch.setenv("RAPID_TPU_BENCH_STREAM", "1")
+    assert bench.stream_plan("cpu", 2000.0) == (6, 48, "live")
+    monkeypatch.setenv("RAPID_TPU_BENCH_NO_STREAM", "1")
+    assert bench.stream_plan("tpu", 0.0) == (0, 0, "suppressed")
 
 
 def test_parse_scale_spellings():

@@ -9,6 +9,8 @@ import json
 import sys
 from pathlib import Path
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 
@@ -393,6 +395,132 @@ def test_clustertop_engine_pane_shows_tenants():
     # A single-cluster snapshot dashes the column, never crashes.
     vc_row = _engine_pane_row(frame, "virtual-cluster/16")
     assert vc_row.split()[1] == "-"
+
+
+# ---------------------------------------------------------------------------
+# Streaming tier (rapid_tpu/serving): the stream section's golden names
+# ---------------------------------------------------------------------------
+
+#: The streaming scrape's complete metric-name vocabulary — the
+#: single-cluster golden list plus the stream tier: the pipeline gauges
+#: (rates NaN pre-drain so the series set is stable from the first scrape),
+#: the zero-filled wave/cut counters, and the alert->commit latency
+#: histogram. Same API rule: renaming one breaks scrape configs.
+GOLDEN_STREAM_METRIC_NAMES = sorted(
+    set(GOLDEN_ENGINE_METRIC_NAMES)
+    | {
+        "rapid_engine_stream_alert_to_commit_ms_bucket",
+        "rapid_engine_stream_alert_to_commit_ms_count",
+        "rapid_engine_stream_alert_to_commit_ms_sum",
+        "rapid_engine_stream_cuts_total",
+        "rapid_engine_stream_depth",
+        "rapid_engine_stream_overlap_efficiency",
+        "rapid_engine_stream_p99_alert_to_commit_ms",
+        "rapid_engine_stream_rounds_per_wave",
+        "rapid_engine_stream_view_changes_per_sec",
+        "rapid_engine_stream_waves_completed",
+        "rapid_engine_stream_waves_in_flight",
+        "rapid_engine_stream_waves_submitted",
+        "rapid_engine_stream_waves_total",
+    }
+)
+
+
+def _streamed_cluster():
+    from rapid_tpu.serving import PoissonChurn, StreamDriver
+
+    vc = VirtualCluster.create(
+        24, n_slots=32, k=3, h=3, l=1, cohorts=2, fd_threshold=2, seed=0
+    )
+    vc.assign_cohorts_roundrobin()
+    driver = StreamDriver(vc, rounds_per_wave=2, depth=2)
+    for wave in PoissonChurn(24, 32, rate=1.0, seed=4).waves(3):
+        driver.submit(wave)
+    driver.drain()
+    return vc
+
+
+def test_stream_prometheus_names_are_golden():
+    vc = _streamed_cluster()
+    names = exposition.metric_names(vc.prometheus_text())
+    assert names == GOLDEN_STREAM_METRIC_NAMES
+
+
+def test_stream_section_only_grows_series_when_attached():
+    # A batch-only driver keeps the batch vocabulary — attaching a
+    # StreamDriver is what opts a scrape into the stream tier.
+    vc = _cluster()
+    vc.step()
+    names = exposition.metric_names(vc.prometheus_text())
+    assert not any("stream" in name for name in names)
+    assert names == GOLDEN_ENGINE_METRIC_NAMES
+
+
+def test_stream_vocabulary_complete_from_attach_not_first_completion():
+    # The alert->commit timer is minted lazily on the first wave
+    # COMPLETION; the scrape must still carry the full stream vocabulary —
+    # histogram triplet included, zero-count — from the moment the driver
+    # attaches, or dashboards keyed on the golden names see the series set
+    # change mid-run (the stable-series rule the counters follow).
+    from rapid_tpu.serving import StreamDriver
+
+    vc = _cluster()
+    StreamDriver(vc, rounds_per_wave=2, depth=2)  # attach, zero traffic
+    names = exposition.metric_names(vc.prometheus_text())
+    assert names == GOLDEN_STREAM_METRIC_NAMES
+
+
+def test_dispatch_phase_vocabulary_enforced_at_write_time():
+    # Satellite (ISSUE 11): the phase vocabulary is enforced where it is
+    # WRITTEN — a typo'd phase raises instead of silently minting a new
+    # histogram series that every dashboard keyed on the known names would
+    # miss.
+    from rapid_tpu.utils.dispatch import ENGINE_DISPATCH_PHASES
+
+    assert {"stream_enqueue", "stream_fetch"} <= ENGINE_DISPATCH_PHASES
+    vc = _cluster()
+    with pytest.raises(ValueError, match="unregistered engine dispatch phase"):
+        with vc._dispatch("stream_enque"):  # the typo class under test
+            pass
+    # The registered pair lands in the shared family like every entrypoint.
+    vc.stream_step()
+    family = vc.metrics.phase_timings["engine_dispatch"]
+    assert family["stream_enqueue"].count == 1
+
+
+def test_clustertop_renders_stream_pane():
+    vc = _streamed_cluster()
+    frame = clustertop.render_frame([vc.telemetry_snapshot()])
+    assert "STREAM" in frame and "OVERLAP" in frame and "INFLIGHT" in frame
+    lines = frame.splitlines()
+    start = next(i for i, l in enumerate(lines) if l.startswith("STREAM"))
+    row = next(l for l in lines[start:] if l.startswith("virtual-cluster/"))
+    cells = row.split()
+    assert cells[1] == "0"  # nothing in flight after drain
+    assert cells[2] == "3" and cells[3] == "3"  # submitted == completed
+
+
+def test_clustertop_stream_pane_tolerates_pre_stream_snapshots():
+    # Batch-only snapshots (no stream section) render no stream pane; a
+    # pre-drain stream section (None rates) renders dashes, never a crash.
+    vc = _cluster()
+    frame = clustertop.render_frame([vc.telemetry_snapshot()])
+    assert "INFLIGHT" not in frame
+    pre_drain = {
+        "node": "virtual-cluster/64", "metrics": {}, "transport": {},
+        "recorder": None,
+        "engine": {"stream": {
+            "waves_submitted": 2, "waves_completed": 0, "waves_in_flight": 2,
+            "view_changes_per_sec": None, "overlap_efficiency": None,
+            "p99_alert_to_commit_ms": None,
+        }},
+    }
+    frame = clustertop.render_frame([pre_drain])
+    assert "INFLIGHT" in frame
+    lines = frame.splitlines()
+    start = next(i for i, l in enumerate(lines) if l.startswith("STREAM"))
+    row = next(l for l in lines[start:] if l.startswith("virtual-cluster/64"))
+    assert "-" in row  # the undrained rates dash
 
 
 def test_engine_counters_zero_filled_only_for_engine_snapshots():

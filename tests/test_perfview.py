@@ -196,6 +196,54 @@ def test_trajectory_renders_fleet_column_and_flags_missing(tmp_path, capsys):
     assert "fleet-missing" not in lines["BENCH_r30"]  # pre-audit history
 
 
+def test_trajectory_renders_stream_column_and_flags_missing(tmp_path, capsys):
+    """ISSUE 11: stream_view_changes_per_sec renders as its own trajectory
+    column (with the p99 alert->commit beside it) under the existing trust
+    flags; an AUDITED round that omits both the value and its explicit
+    stream_status marker flags stream-missing; pre-audit historical rounds
+    are exempt."""
+    audit = {"sharded2d_wave": {"collectives": 5, "hot_loop_collectives": 1,
+                                "temp_bytes": 10, "donation_dropped": 0}}
+    points = {
+        # Pre-audit historical round: exempt (sorts first).
+        "BENCH_r40.json": {"metric": "m", "value": 1.0, "platform": "cpu"},
+        # Audited + measured stream point: rate + p99 in the STREAM column.
+        "BENCH_r41.json": {"metric": "m", "value": 100.0, "platform": "tpu",
+                           "hlo_audit": audit, "n1M_status": "live",
+                           "tenant_fleet_status": "live",
+                           "stream_status": "live",
+                           "stream_view_changes_per_sec": 84.5,
+                           "stream_p99_alert_to_commit_ms": 41.03,
+                           "stream_overlap_efficiency": 0.91},
+        # Audited + explicit ramped marker (CPU pipeline exercise): no flag.
+        "BENCH_r42.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, "n1M_status": "ramped:256",
+                           "tenant_fleet_status": "ramped:8x64",
+                           "stream_status": "ramped:12x96"},
+        # Audited round that silently dropped the stream point: flagged.
+        "BENCH_r43.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, "n1M_status": "ramped:256",
+                           "tenant_fleet_status": "ramped:8x64"},
+    }
+    paths = []
+    for name, data in points.items():
+        p = tmp_path / name
+        p.write_text(json.dumps(data))
+        paths.append(str(p))
+    assert perfview.main(paths) == 0
+    out = capsys.readouterr().out
+    assert "STREAM" in out.splitlines()[1]  # the trajectory header row
+    lines = {line.split()[0]: line for line in out.splitlines()
+             if line.startswith("BENCH_r4")}
+    assert "84.5/s" in lines["BENCH_r41"]
+    assert "p99=41.0ms" in lines["BENCH_r41"]
+    assert "stream-missing" not in lines["BENCH_r41"]
+    assert "ramped:12x96" in lines["BENCH_r42"]
+    assert "stream-missing" not in lines["BENCH_r42"]
+    assert "stream-missing" in lines["BENCH_r43"]
+    assert "stream-missing" not in lines["BENCH_r40"]  # pre-audit history
+
+
 def test_chrome_trace_envelope(tmp_path, capsys):
     path = _complete_ledger(tmp_path)
     chrome_path = tmp_path / "trace.json"

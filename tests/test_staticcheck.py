@@ -365,6 +365,7 @@ _CORPUS_CHECKERS = {
     "donation_dropped.py": ("rapid_tpu/models/_corpus.py", "check_device_program"),
     "clean_device_program.py": ("rapid_tpu/models/_corpus.py", "check_device_program"),
     "host_sync_in_hot_path.py": ("rapid_tpu/ops/_corpus.py", "check_sharding"),
+    "host_sync_in_stream.py": ("rapid_tpu/serving/_corpus.py", "check_sharding"),
     "missing_partition_spec.py": ("rapid_tpu/parallel/_corpus.py", "check_sharding"),
     "missing_partition_rule.py": ("rapid_tpu/parallel/_corpus.py", "check_sharding"),
     "tenant_partition_rule.py": ("rapid_tpu/tenancy/_corpus.py", "check_sharding"),

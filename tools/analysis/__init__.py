@@ -34,9 +34,11 @@ Check families (one module each; ``core`` owns the driver/CLI/Finding):
                       donation/memory facts frozen in ``hlo.lock.json``
 13. ``sharding``    — source seams that produce bad compiled programs:
                       partition-spec coverage of the engine state pytree,
-                      host syncs inside traced hot paths, jit callsites
-                      that forget buffer donation or invite retraces
-                      (ops/models/parallel)
+                      host syncs inside traced hot paths AND anywhere in
+                      the streaming pipeline (rapid_tpu/serving — every
+                      blocking read there is a declared fetch boundary or
+                      a finding), jit callsites that forget buffer
+                      donation or invite retraces (ops/models/parallel)
 
 ``staticcheck --families`` prints this catalog; ``--update-wire-lock`` /
 ``--update-hlo-lock`` regenerate the lockfiles after an intentional
@@ -73,7 +75,12 @@ from .device_program import (
 from .dispatch import DISPATCH_PREFIXES, check_dispatch
 from .ledger import LEDGER_PREFIXES, check_ledger
 from .names import check_undefined_names
-from .sharding import SHARDING_PREFIXES, check_partition_specs, check_sharding
+from .sharding import (
+    SHARDING_PREFIXES,
+    STREAM_PREFIXES,
+    check_partition_specs,
+    check_sharding,
+)
 from .signatures import check_call_signatures
 from .taskflow import TASKFLOW_PREFIXES, check_taskflow
 from .trace_safety import TRACE_SAFETY_PREFIXES, check_trace_safety
@@ -98,6 +105,7 @@ __all__ = [
     "LEDGER_PREFIXES",
     "LOCK_REL",
     "SHARDING_PREFIXES",
+    "STREAM_PREFIXES",
     "TASKFLOW_PREFIXES",
     "TRACE_SAFETY_PREFIXES",
     "WIRE_FILES",

@@ -94,6 +94,7 @@ ALL_CHECK_NAMES = frozenset({
     # sharding family
     "missing-partition-spec",
     "host-sync-in-hot-path",
+    "host-sync-in-stream",
     "donation-mismatch",
     "retrace-hazard",
 })
@@ -123,8 +124,9 @@ FAMILIES = (
                        "entrypoints (collectives, transfers, donation, "
                        "memory) frozen in hlo.lock.json"),
     ("sharding", "engine sharding discipline: partition-spec coverage, "
-                 "host syncs in the hot path, donation/static-argnames at "
-                 "jit seams (ops/models/parallel)"),
+                 "host syncs in the hot path and the streaming pipeline, "
+                 "donation/static-argnames at jit seams "
+                 "(ops/models/parallel/serving)"),
 )
 
 

@@ -25,6 +25,16 @@ compiled programs, over ``rapid_tpu/ops/``, ``rapid_tpu/models/``, and
   and callables handed to ``lax.while_loop``/``lax.cond``/``lax.scan``):
   each is a device->host round trip the fused-dispatch design exists to
   avoid. Escape hatch ``# host-sync-ok: <reason>``.
+- ``host-sync-in-stream`` — the streaming-pipeline sibling of the check
+  above, over ``rapid_tpu/serving/``: a blocking read
+  (``block_until_ready`` — method or ``jax.block_until_ready`` —,
+  ``.item()``, ``jax.device_get``, ``np.asarray``, and the scalar-fetch
+  casts ``int(jnp...)``/``float(jnp...)`` over resolvable jax calls)
+  ANYWHERE in the pipeline module body stalls every enqueued wave behind
+  it, so each one must be an explicit fetch boundary justified with
+  ``# host-sync-ok: <reason>``. Unlike the hot-path check this one is not
+  limited to traced functions: the stream driver's whole value is that
+  its HOST code never blocks outside declared boundaries.
 - ``donation-mismatch`` — a ``jax.jit`` application whose wrapped callable
   takes the engine ``state`` pytree but whose ``donate_argnums`` does not
   cover it: the long-running driver loop then holds two copies of the
@@ -65,6 +75,11 @@ SHARDING_PREFIXES = (
     "rapid_tpu/tenancy/",
 )
 
+#: The streaming-pipeline prefix: every blocking read here must be a
+#: justified fetch boundary (``host-sync-in-stream``), not just the ones
+#: inside traced functions.
+STREAM_PREFIXES = ("rapid_tpu/serving/",)
+
 #: The real files the tree-mode partition-spec check merges.
 STATE_FILE = "rapid_tpu/models/state.py"
 MESH_FILE = "rapid_tpu/parallel/mesh.py"
@@ -93,7 +108,29 @@ def _comment_ok(source_lines: List[str], lineno: int, marker: str) -> bool:
     return False
 
 
-# -- host-sync-in-hot-path ---------------------------------------------------
+# -- host-sync-in-hot-path / host-sync-in-stream -----------------------------
+
+
+def _blocking_read(node: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    """The blocking-read spelling of a call node — the one classifier both
+    host-sync checks share, so the two can never disagree about what counts
+    as a device->host sync. None = not a blocking read."""
+    dotted = _dotted(node.func, aliases)
+    if dotted == "jax.device_get":
+        return "jax.device_get"
+    if dotted == "jax.block_until_ready":
+        return "jax.block_until_ready(...)"
+    if dotted in ("numpy.asarray", "np.asarray", "numpy.array", "np.array"):
+        # Both spellings materialize a device array on host (np.array just
+        # also copies); classifying only asarray would leave np.array as a
+        # silent undeclared-sync spelling.
+        return f"{dotted} (implicit device fetch)"
+    if (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr in _HOST_SYNC_METHODS
+    ):
+        return f".{node.func.attr}()"
+    return None
 
 
 def _traced_functions(tree: ast.AST, aliases: Dict[str, str]) -> List[ast.AST]:
@@ -134,18 +171,8 @@ def _check_host_sync(
         for node in ast.walk(fn):
             if not isinstance(node, ast.Call) or id(node) in seen:
                 continue
-            what = None
-            dotted = _dotted(node.func, aliases)
-            if dotted == "jax.device_get":
-                what = "jax.device_get"
-            elif dotted in ("numpy.asarray", "np.asarray"):
-                what = f"{dotted} (implicit device fetch)"
-            elif (
-                isinstance(node.func, ast.Attribute)
-                and node.func.attr in _HOST_SYNC_METHODS
-            ):
-                what = f".{node.func.attr}()"
-            elif (
+            what = _blocking_read(node, aliases)
+            if what is None and (
                 isinstance(node.func, ast.Name)
                 and node.func.id == "float"
                 and node.args
@@ -164,6 +191,64 @@ def _check_host_sync(
                 f"(jnp ops / lax.cond), or justify with "
                 f"`# host-sync-ok: <reason>`",
             ))
+
+
+def _cast_of_device_value(
+    node: ast.Call, aliases: Dict[str, str]
+) -> Optional[str]:
+    """The scalar-fetch cast spelling: ``int(...)``/``float(...)`` whose
+    argument computes through a ``jax.*``/``jax.numpy.*`` call — e.g.
+    ``int(jnp.sum(state.config_epoch))``, the drain-fetch spelling the
+    pipeline itself uses. Casts of host values (numpy rng draws, plain
+    attributes) pass: an AST pass cannot know a bare name holds a device
+    array, so this branch is precise on the calls it CAN resolve rather
+    than noisy on everything."""
+    if not (
+        isinstance(node.func, ast.Name)
+        and node.func.id in ("int", "float")
+        and node.args
+    ):
+        return None
+    for sub in ast.walk(node.args[0]):
+        if isinstance(sub, ast.Call):
+            dotted = _dotted(sub.func, aliases) or ""
+            if dotted.startswith(("jax.", "jnp.")):
+                return f"{node.func.id}({dotted}(...)) (scalar fetch)"
+    return None
+
+
+def _check_stream_host_sync(
+    tree: ast.AST,
+    aliases: Dict[str, str],
+    rel: str,
+    source_lines: List[str],
+    findings: List[Finding],
+) -> None:
+    """The streaming-pipeline variant: every blocking-read spelling in a
+    serving module is a pipeline stall (JAX async dispatch only overlaps
+    host work with device compute while the host never blocks), so each one
+    must be a declared fetch boundary — hatch ``# host-sync-ok: <reason>``
+    — not just the ones inside traced functions. Covers the shared
+    classifier's spellings plus the scalar-fetch casts over resolvable
+    jax/jnp calls (:func:`_cast_of_device_value`)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        what = _blocking_read(node, aliases) or _cast_of_device_value(
+            node, aliases
+        )
+        if what is None:
+            continue
+        if _comment_ok(source_lines, node.lineno, "# host-sync-ok:"):
+            continue
+        findings.append(Finding(
+            rel, node.lineno, "host-sync-in-stream",
+            f"{what} in the streaming pipeline: a blocking read here "
+            f"stalls every enqueued wave behind it — keep the pipeline "
+            f"fetch-free (enqueue-only dispatches, device-resident "
+            f"tickets), or declare the fetch boundary with "
+            f"`# host-sync-ok: <reason>`",
+        ))
 
 
 # -- donation-mismatch -------------------------------------------------------
@@ -640,7 +725,8 @@ def check_sharding(
     tree-mode check."""
     rel = core.rel(path)
     posix = rel.replace("\\", "/")
-    if not any(posix.startswith(p) for p in SHARDING_PREFIXES):
+    is_stream = any(posix.startswith(p) for p in STREAM_PREFIXES)
+    if not is_stream and not any(posix.startswith(p) for p in SHARDING_PREFIXES):
         return []
     src = source if source is not None else path.read_text()
     if tree is None:
@@ -648,6 +734,13 @@ def check_sharding(
     aliases = _import_aliases(tree)
     source_lines = src.splitlines()
     findings: List[Finding] = []
+    if is_stream:
+        # Serving modules get the strict whole-module discipline (every
+        # blocking read is a declared boundary) and none of the jit-seam
+        # checks — the pipeline is host code in front of already-audited
+        # compiled entrypoints.
+        _check_stream_host_sync(tree, aliases, rel, source_lines, findings)
+        return sorted(set(findings), key=lambda f: (f.lineno, f.check, f.message))
     _check_host_sync(tree, aliases, rel, source_lines, findings)
     _check_donation(tree, aliases, rel, source_lines, findings)
     _check_retrace(tree, aliases, rel, source_lines, findings)

@@ -190,6 +190,50 @@ def render_engine_pane(snapshots: List[Dict[str, Any]]) -> List[str]:
     return ["", *_render_table(header, rows)]
 
 
+def _fmt_ratio(value: Any) -> str:
+    if not isinstance(value, (int, float)):
+        return "-"
+    return f"{100.0 * float(value):.1f}%"
+
+
+def _fmt_opt(value: Any, spec: str = ".2f") -> str:
+    if not isinstance(value, (int, float)):
+        return "-"
+    return format(float(value), spec)
+
+
+def render_stream_pane(snapshots: List[Dict[str, Any]]) -> List[str]:
+    """The streaming-serving rows: one line per snapshot whose ``engine``
+    section carries a ``stream`` block (a rapid_tpu.serving.StreamDriver is
+    attached) — waves in flight, pipeline progress, sustained rate, overlap
+    efficiency, p99 alert->commit. Pre-stream snapshots (no ``stream`` key,
+    or pre-drain None rates) contribute nothing / dashes, never a crash."""
+    streams = [
+        s for s in snapshots
+        if isinstance(s.get("engine"), dict)
+        and isinstance(s["engine"].get("stream"), dict)
+    ]
+    if not streams:
+        return []
+    header = (
+        "STREAM", "INFLIGHT", "SUBMITTED", "COMPLETED", "RATE/S",
+        "OVERLAP", "P99MS",
+    )
+    rows: List[Tuple[str, ...]] = []
+    for snapshot in sorted(streams, key=lambda s: str(s.get("node", ""))):
+        stream = snapshot["engine"]["stream"]
+        rows.append((
+            str(snapshot.get("node", "?")),
+            _fmt_opt(stream.get("waves_in_flight"), ".0f"),
+            _fmt_opt(stream.get("waves_submitted"), ".0f"),
+            _fmt_opt(stream.get("waves_completed"), ".0f"),
+            _fmt_opt(stream.get("view_changes_per_sec")),
+            _fmt_ratio(stream.get("overlap_efficiency")),
+            _fmt_opt(stream.get("p99_alert_to_commit_ms"), ".1f"),
+        ))
+    return ["", *_render_table(header, rows)]
+
+
 def render_frame(
     snapshots: List[Dict[str, Any]], errors: Optional[List[str]] = None
 ) -> str:
@@ -254,6 +298,7 @@ def render_frame(
         ))
     lines.extend(_render_table(header, rows))
     lines.extend(render_engine_pane(snapshots))
+    lines.extend(render_stream_pane(snapshots))
     for error in errors or ():
         lines.append(f"! {error}")
     return "\n".join(lines) + "\n"

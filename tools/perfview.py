@@ -21,11 +21,14 @@ runs, and can I trust the numbers". Two input kinds, freely mixed:
   alert_deliveries_per_sec ≈ 5e10 class of bug), ``headline-missing``
   (an audited round that carries neither the ``n1M_crash1pct_ms``
   headline nor its explicit ``n1M_status`` marker — the 1M scale number
-  must never be silently absent), and ``fleet-missing`` (same discipline
+  must never be silently absent), ``fleet-missing`` (same discipline
   for the multi-tenant point: an audited round omitting BOTH
-  ``tenant_view_changes_per_sec`` and ``tenant_fleet_status``). The N1M
-  and FLEET columns render the headline / fleet values (or their status
-  markers) per round.
+  ``tenant_view_changes_per_sec`` and ``tenant_fleet_status``), and
+  ``stream-missing`` (same discipline for the streaming-serving point:
+  an audited round omitting BOTH ``stream_view_changes_per_sec`` and
+  ``stream_status``). The N1M, FLEET, and STREAM columns render the
+  headline / fleet / sustained-stream values (or their status markers)
+  per round.
 
 ``--chrome out.json`` additionally writes Chrome trace-event JSON (the same
 envelope tools/traceview.py emits — Perfetto/chrome://tracing load it):
@@ -303,6 +306,18 @@ def point_flags(
         and not data.get("tenant_fleet_status")
     ):
         flags.append("fleet-missing")
+    # Streaming discipline (ISSUE 11): same rule for the sustained-serving
+    # point — an audited round must carry stream_view_changes_per_sec or
+    # its explicit stream_status marker; the streaming metric must never be
+    # silently absent. Pre-audit historical rounds are exempt.
+    if (
+        hlo_audit_table(data) is not None
+        and not isinstance(
+            data.get("stream_view_changes_per_sec"), (int, float)
+        )
+        and not data.get("stream_status")
+    ):
+        flags.append("stream-missing")
     if hlo_drift(prev, hlo_audit_table(data)):
         flags.append("hlo-drift")
     if not flags:
@@ -351,10 +366,25 @@ def fleet_cell(data: Dict[str, Any]) -> str:
     return str(status) if status else "-"
 
 
+def stream_cell(data: Dict[str, Any]) -> str:
+    """The STREAM column: sustained stream_view_changes_per_sec with the
+    p99 alert->commit beside it when present, else the explicit
+    stream_status marker, else '-' (pre-stream rounds)."""
+    value = data.get("stream_view_changes_per_sec")
+    if isinstance(value, (int, float)):
+        p99 = data.get("stream_p99_alert_to_commit_ms")
+        suffix = (
+            f" p99={float(p99):.1f}ms" if isinstance(p99, (int, float)) else ""
+        )
+        return f"{float(value):.1f}/s{suffix}"
+    status = data.get("stream_status")
+    return str(status) if status else "-"
+
+
 def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
     lines = ["== perf trajectory =="]
-    header = ("ROUND", "METRIC", "VALUE", "N1M", "FLEET", "PLATFORM",
-              "VSBASE", "FLAGS")
+    header = ("ROUND", "METRIC", "VALUE", "N1M", "FLEET", "STREAM",
+              "PLATFORM", "VSBASE", "FLAGS")
     rows: List[Tuple[str, ...]] = []
     flag_rows: List[Tuple[str, List[str]]] = []
     prev_audit: Optional[Dict[str, Any]] = None
@@ -371,6 +401,7 @@ def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
             "-" if value is None else f"{float(value):.1f}ms",
             headline_cell(data),
             fleet_cell(data),
+            stream_cell(data),
             str(data.get("platform", "-")),
             "-" if vs is None else f"{float(vs):.2f}x"
             + ("@capture" if "vs_baseline_at_capture" in data else ""),

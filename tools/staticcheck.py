@@ -38,6 +38,7 @@ from analysis import (  # noqa: E402,F401 — re-exported API surface
     LEDGER_PREFIXES,
     LOCK_REL,
     SHARDING_PREFIXES,
+    STREAM_PREFIXES,
     TASKFLOW_PREFIXES,
     TRACE_SAFETY_PREFIXES,
     WIRE_FILES,
@@ -83,6 +84,7 @@ __all__ = [
     "LOCK_REL",
     "REPO",
     "SHARDING_PREFIXES",
+    "STREAM_PREFIXES",
     "TASKFLOW_PREFIXES",
     "TRACE_SAFETY_PREFIXES",
     "WIRE_FILES",
