@@ -280,6 +280,7 @@ STAGE_TIMEOUTS_S = {
     "loss_variant": 900,
     "tenant_fleet": 900,
     "stream": 900,
+    "chaos": 900,
     "hlo_audit": 600,
     "profile": 600,
 }
@@ -375,6 +376,38 @@ def stream_plan(platform: str, elapsed_s: float) -> "tuple[int, int, str]":
     waves = _env_int("RAPID_TPU_BENCH_STREAM_WAVES", 12)
     n_s = _env_int("RAPID_TPU_BENCH_STREAM_N", 96)
     return waves, n_s, f"ramped:{waves}x{n_s}"
+
+
+def chaos_plan(platform: str, elapsed_s: float) -> "tuple[int, str]":
+    """The adversarial-chaos decision, pure over (platform, elapsed
+    seconds) + env: returns (fleet tenant count B, chaos_status). B == 0
+    means the stage is skipped — but the status STILL lands in the emitted
+    JSON, so the chaos throughput metric is never silently absent (the
+    n1M_status discipline). On the accelerator (or RAPID_TPU_BENCH_CHAOS=1)
+    the stage resolves 256 mixed hostile scenarios per fleet; a CPU run
+    exercises the full stage path ramped down (RAPID_TPU_BENCH_CHAOS_B,
+    default 12 — at least one tenant per fleet family); past the budget
+    (RAPID_TPU_BENCH_CHAOS_BUDGET_S, defaulting to the XL budget) it is
+    skipped-budget; RAPID_TPU_BENCH_NO_CHAOS=1 suppresses it everywhere.
+    Unit-pinned in tests/test_bench_ledger.py."""
+    if _env_flag("RAPID_TPU_BENCH_NO_CHAOS"):
+        return 0, "suppressed"
+    forced = _env_flag("RAPID_TPU_BENCH_CHAOS")
+    budget_s = _env_int(
+        "RAPID_TPU_BENCH_CHAOS_BUDGET_S",
+        _env_int("RAPID_TPU_BENCH_XL_BUDGET_S", 1500),
+    )
+    if elapsed_s > budget_s and not forced:
+        return 0, "skipped-budget"
+    if platform == "tpu" or forced:
+        return _env_int("RAPID_TPU_BENCH_CHAOS_B", 256), "live"
+    from rapid_tpu.sim.fuzz import N_SLOTS
+
+    # The ramped marker's shape is BxN: B tenants at the fuzz families'
+    # shared per-tenant slot geometry (derived, so a geometry retune can't
+    # leave the published status lying about what ran).
+    b = _env_int("RAPID_TPU_BENCH_CHAOS_B", 12)
+    return b, f"ramped:{b}x{N_SLOTS}"
 
 
 def _parse_scale(spec: str) -> int:
@@ -1002,6 +1035,56 @@ def run_workload(ledger, profile_dir=None) -> None:
         ledger.emit(LedgerEvent.DEVICE_MEMORY, stage="stream",
                     **stream_memory)
 
+    # Adversarial-chaos point (ISSUE 12): B mixed hostile scenarios —
+    # Byzantine false alerts against the H/L watermarks, committee crashes
+    # inside the hier reconfiguration window, plus the honest families —
+    # compiled per tenant and resolved in batched fleet-wave dispatches
+    # with the stability soak (rapid_tpu/tenancy/chaos.py). The metric is
+    # chaos_scenarios_per_sec: scenarios resolved (and oracle-checked
+    # clean) per second of fleet dispatch. Never silently absent:
+    # chaos_status always lands in the emitted JSON (the n1M_status
+    # discipline); CPU runs exercise the stage ramped-down.
+    chaos_b, chaos_status = chaos_plan(platform, time.monotonic() - _START)
+    chaos_fields = {}
+    if chaos_b == 0:
+        _mark(f"chaos stage not run: {chaos_status}")
+    else:
+        from rapid_tpu.tenancy import chaos as tchaos
+
+        with ledger.stage("chaos", timeout_s=_stage_timeout("chaos"), n=chaos_b):
+            with _heartbeat(f"chaos fleet B={chaos_b} warm-up"):
+                with engine_telemetry.CompileDelta() as chaos_compiles:
+                    # Warm the batched wave/step executables at the exact
+                    # [B, geometry] shape, so the timed round measures
+                    # dispatch throughput, not XLA compiles.
+                    tchaos.fuzz_fleet(
+                        chaos_b, base_seed=70_000, shrink_failures=False
+                    )
+            chaos_summary = tchaos.fuzz_fleet(
+                chaos_b, base_seed=71_000, shrink_failures=False
+            )
+            assert not chaos_summary["violations"], (
+                "chaos fleet violations:\n"
+                + "\n".join(chaos_summary["violations"])
+            )
+            chaos_fields = {
+                "chaos_scenarios_per_sec": chaos_summary["scenarios_per_sec"],
+                "chaos_tenants": chaos_b,
+                "chaos_dispatches": chaos_summary["dispatches"],
+                "chaos_view_changes": chaos_summary["total_cuts"],
+                "chaos_wall_ms": chaos_summary["wall_ms"],
+                "chaos_families": len(chaos_summary["families"]),
+            }
+            _mark(
+                f"chaos: {chaos_b} hostile scenarios over "
+                f"{len(chaos_summary['families'])} families in "
+                f"{chaos_summary['wall_ms']:.1f} ms "
+                f"({chaos_summary['scenarios_per_sec']:.1f} scenarios/s), "
+                f"0 violations"
+            )
+        ledger.emit(LedgerEvent.COMPILE_STATS, stage="chaos",
+                    **chaos_compiles.delta)
+
     # Compiled-program audit (ISSUE 8, analysis family 12): compile the
     # registered engine entrypoints at the fixed audit shapes ON THIS
     # PLATFORM and embed the per-entrypoint collective/memory table, so the
@@ -1106,6 +1189,13 @@ def run_workload(ledger, profile_dir=None) -> None:
         "stream_status": stream_status,
         **{k: v for k, v in stream_fields.items() if v is not None},
         **({"stream_device_memory": stream_memory} if stream_memory is not None else {}),
+        # Adversarial-chaos point (ISSUE 12): hostile scenarios resolved
+        # (and oracle-checked clean) per second of batched fleet dispatch.
+        # Never silently absent — chaos_status says exactly what the point
+        # is when the value itself is missing ("ramped:Bx12" = CPU
+        # stage-path exercise; "skipped-budget"; "suppressed").
+        "chaos_status": chaos_status,
+        **{k: v for k, v in chaos_fields.items() if v is not None},
         "samples_ms": [round(s, 3) for s in samples],
         "churn_resolution_hist": sample_hist.summary(),
         "view_changes": cuts_per_sample,

@@ -49,6 +49,13 @@ class InProcessNetwork:
         # (rapid_tpu/sim/faults.py) plugs in here. None = a perfect network,
         # zero overhead on the common path.
         self.shaper = None
+        # One-shot message-triggered callbacks, consulted on every server
+        # handle — the chaos runner's ``committee_crash`` arming point: a
+        # fault that must land at an exact PROTOCOL moment (e.g. between
+        # cohort-cut forwarding and the global decision) hooks the first
+        # sighting of the message that opens the window. Empty on the
+        # common path.
+        self.tripwires: List["RequestTripwire"] = []
         # Account wire-EQUIVALENT bytes (what the codec would put on a TCP
         # frame) in every client/server TransportStats. Off by default: no
         # bytes actually move in-process, and encoding every message only
@@ -73,6 +80,25 @@ class ServerDropFirstN:
             self._remaining -= 1
             return True
         return False
+
+
+class RequestTripwire:
+    """Fire a callback ONCE when the first message of a type is observed at
+    any server — the in-process analog of an interceptor that reacts to a
+    protocol moment rather than a wall-clock one. The callback runs
+    synchronously BEFORE the triggering message is handled, so a fault it
+    injects (e.g. crashing the recipient) affects the triggering delivery
+    itself, exactly like a process dying as the datagram arrives."""
+
+    def __init__(self, message_type: Type, callback) -> None:
+        self._type = message_type
+        self._callback = callback
+        self.fired = False
+
+    def observe(self, request: RapidRequest) -> None:
+        if not self.fired and isinstance(request, self._type):
+            self.fired = True
+            self._callback()
 
 
 class ClientDelayer:
@@ -125,6 +151,13 @@ class InProcessServer(MessagingServer):
         self.stats.rx(
             len(encode_request(request)) if self._network.count_wire_bytes else 0
         )
+        for tripwire in self._network.tripwires:
+            tripwire.observe(request)
+        if self.listen_address in self._network.blackholed:
+            # A tripwire (or a concurrent fault) crashed THIS server while
+            # the message was in flight: the triggering delivery is lost
+            # with the process, like a real crash mid-arrival.
+            raise ConnectionError(f"server {self.listen_address} crashed")
         for interceptor in self.drop_interceptors:
             if interceptor.should_drop(request):
                 raise ConnectionError("dropped by interceptor")

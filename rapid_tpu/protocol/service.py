@@ -18,7 +18,7 @@ import asyncio
 import logging
 import random
 from collections import OrderedDict
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from rapid_tpu.errors import NodeNotInRingError
 from rapid_tpu.messaging.base import Broadcaster, MessagingClient, UnicastToAllBroadcaster
@@ -948,6 +948,29 @@ class MembershipService:
                 ),
             )
         )
+
+    async def inject_byzantine_alert(
+        self, subject: Endpoint, status: EdgeStatus, ring_numbers: Sequence[int]
+    ) -> None:
+        """Chaos seam: enqueue an edge report this node NEVER observed — a
+        lying observer (rapid_tpu/sim's ``false_alert``/``alert_storm``
+        events). The lie rides the real machinery end to end: the batcher
+        broadcasts it, redelivery repeats it, and every receiver's H/L cut
+        detector tallies the claimed rings exactly as it would honest
+        evidence — which is the point: the paper's stability claim (sub-H
+        report counts DELAY, never trigger, a view change) is only tested
+        by reports that are actually false. Takes the protocol lock like
+        any handler; no internal state is bypassed."""
+        async with self._lock:
+            self._enqueue_alert(
+                AlertMessage(
+                    edge_src=self.my_addr,
+                    edge_dst=subject,
+                    edge_status=status,
+                    configuration_id=self.view.configuration_id,
+                    ring_numbers=tuple(int(r) for r in ring_numbers),
+                )
+            )
 
     def _create_failure_detectors(self) -> None:
         if self._stopped:

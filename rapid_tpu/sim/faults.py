@@ -60,6 +60,30 @@ kind                 semantics
 ``clock_skew``       shift one slot's clock readings by offset_ms
 ``clock_pause``      freeze one slot's clock and park its timers (GC pause)
 ``clock_resume``     thaw a paused clock; parked timers fire late
+``false_alert``      a Byzantine observer (``slots[0]``) broadcasts edge
+                     reports it never observed about a healthy ``subject``:
+                     one alert claiming the given ``rings``. DOWN claims
+                     accumulate in every receiver's H/L cut detector; the
+                     paper's stability claim is that a cumulative count held
+                     in [L, H) DELAYS (never triggers) a view change, while
+                     a count pushed past H evicts the healthy subject — but
+                     the eviction must still be one agreed, chain-consistent
+                     cut. UP claims about a present host are filtered by
+                     every receiver (a no-op lie, kept for coverage).
+``alert_storm``      K-1-style collusion: every slot in ``slots`` lies
+                     simultaneously about ``subject``, the claimed ``rings``
+                     distributed round-robin across the liars. Cumulative
+                     ring semantics identical to ``false_alert`` (receivers
+                     dedup per (subject, ring), so colluders re-claiming
+                     the same rings add nothing).
+``committee_crash``  arm a tripwire that crash-stops ``slots[0]`` (a
+                     hierarchical global-committee member) the instant the
+                     first ``CohortCutMessage`` hits any server — i.e.
+                     BETWEEN cohort-cut forwarding and the global decision,
+                     the hier reconfiguration window (the committee-crash
+                     shape of arXiv:1906.01365). Hier-profile only; must be
+                     ``settle=False`` (it overlaps the membership event
+                     whose reconfiguration trips it).
 ==================  ========================================================
 
 ``dwell_ms`` on every event is how much simulated time the runner advances
@@ -75,6 +99,7 @@ import random
 from dataclasses import dataclass, field
 from typing import Dict, List, NamedTuple, Tuple
 
+from rapid_tpu.settings import Settings
 from rapid_tpu.types import (
     BatchedAlertMessage,
     FastRoundPhase2bMessage,
@@ -83,6 +108,19 @@ from rapid_tpu.types import (
     ProbeMessage,
 )
 from rapid_tpu.utils.clock import Clock
+
+#: The protocol watermarks adversarial schedules are judged against — the
+#: reference defaults every sim profile boots with (Settings(); the engine
+#: twins compile the same triple in compile_tenant). Schedule-level
+#: accounting (does this false-report total evict?) must use ONE definition
+#: or the runner, the oracles, and the tenancy compiler would disagree
+#: about what a hostile schedule is expected to do. Per-tenant knob
+#: overrides in the fleet deliberately diverge from these (the
+#: knob/schedule-mismatch repro shape of tests/test_tenancy_chaos.py).
+_DEFAULTS = Settings()
+WATERMARK_K = _DEFAULTS.k
+WATERMARK_H = _DEFAULTS.h
+WATERMARK_L = _DEFAULTS.l
 
 #: drop_first_n message-type vocabulary: the serializable names a schedule
 #: may target (mirrors the reference interceptor fixtures' targeted types).
@@ -110,7 +148,13 @@ ENVIRONMENT_KINDS = frozenset({
     "clock_skew", "clock_pause", "clock_resume",
 })
 
-ALL_KINDS = MEMBERSHIP_KINDS | ENVIRONMENT_KINDS
+#: Hostile events: observers that LIE (false_alert / alert_storm — their
+#: membership effect is conditional on the cumulative false-report count
+#: crossing H) and the committee-member crash armed on the hier
+#: reconfiguration window (always -1, applied when the tripwire fires).
+ADVERSARIAL_KINDS = frozenset({"false_alert", "alert_storm", "committee_crash"})
+
+ALL_KINDS = MEMBERSHIP_KINDS | ENVIRONMENT_KINDS | ADVERSARIAL_KINDS
 
 
 class LinkPlan(NamedTuple):
@@ -186,18 +230,39 @@ class LinkShaper:
         await self._clock.sleep_ms(delay_ms)
 
 
+class ScheduleError(ValueError):
+    """The schedule is ill-formed (unknown kind, slot-lifecycle violation,
+    seed-node fault, ...). Raised by :meth:`FaultSchedule.validate`, and at
+    :class:`FaultEvent` construction for kinds outside the registered
+    vocabulary."""
+
+
 @dataclass(frozen=True)
 class FaultEvent:
     """One schedule entry. ``slots`` carries the subject slot indices (empty
     for global events); ``args`` the kind-specific parameters; ``dwell_ms``
     the simulated time advanced after the event; ``settle=False`` overlaps a
-    membership event with the next one instead of convergence-waiting."""
+    membership event with the next one instead of convergence-waiting.
+
+    Construction with an unregistered ``kind`` raises immediately: the
+    vocabulary (ALL_KINDS), the fuzz FAMILIES table, and the chaosrun CLI
+    all index on these strings, and a typo'd kind must fail at the point it
+    is minted — never ride silently into a schedule file the runner then
+    crashes on mid-scenario (the chaosvocab lint family pins the static
+    half of this)."""
 
     kind: str
     slots: Tuple[int, ...] = ()
     args: Dict[str, object] = field(default_factory=dict)
     dwell_ms: float = 0.0
     settle: bool = True
+
+    def __post_init__(self) -> None:
+        if self.kind not in ALL_KINDS:
+            raise ScheduleError(
+                f"unknown kind {self.kind!r}; registered kinds: "
+                f"{sorted(ALL_KINDS)}"
+            )
 
     def to_dict(self) -> Dict[str, object]:
         out: Dict[str, object] = {"kind": self.kind}
@@ -222,11 +287,6 @@ class FaultEvent:
             dwell_ms=float(data.get("dwell_ms", 0.0)),  # type: ignore[arg-type]
             settle=bool(data.get("settle", True)),
         )
-
-
-class ScheduleError(ValueError):
-    """The schedule is ill-formed (unknown kind, slot-lifecycle violation,
-    seed-node fault, ...). Raised by :meth:`FaultSchedule.validate`."""
 
 
 @dataclass
@@ -302,6 +362,46 @@ class FaultSchedule:
     def from_json(cls, text: str) -> "FaultSchedule":
         return cls.from_dict(json.loads(text))
 
+    # -- adversarial accounting ----------------------------------------
+
+    def adversarial_crossings(self) -> Dict[int, Tuple[int, Tuple[int, ...]]]:
+        """``{event index: (subject slot, cumulative claimed rings)}`` for
+        every ``false_alert``/``alert_storm`` event whose cumulative
+        distinct-ring count about its subject crosses the H watermark —
+        THE definition of "this lie evicts", shared by the runner (expected
+        membership), the oracles (stability judgment), the phase grouping
+        (engine replay), and the tenancy compiler. Receivers dedup reports
+        per (subject, ring), so only DISTINCT rings count, and only DOWN
+        claims (UP about a present host is filtered by every receiver)."""
+        crossings: Dict[int, Tuple[int, Tuple[int, ...]]] = {}
+        rings_of: Dict[int, set] = {}
+        evicted: set = set()
+        for i, event in enumerate(self.events):
+            if event.kind not in ("false_alert", "alert_storm"):
+                continue
+            if str(event.args.get("status", "DOWN")) != "DOWN":
+                continue
+            subject = int(event.args["subject"])  # type: ignore[arg-type]
+            if subject in evicted:
+                continue
+            acc = rings_of.setdefault(subject, set())
+            acc.update(int(r) for r in event.args.get("rings", ()))  # type: ignore[union-attr]
+            if len(acc) >= WATERMARK_H:
+                crossings[i] = (subject, tuple(sorted(acc)))
+                evicted.add(subject)
+        return crossings
+
+    def _adversarial_removed(self) -> set:
+        """Slots the hostile events remove: committee-crash victims plus
+        every subject whose false-report count crosses H."""
+        removed = {
+            s for s, _ in self.adversarial_crossings().values()
+        }
+        for event in self.events:
+            if event.kind == "committee_crash":
+                removed |= set(event.slots)
+        return removed
+
     # -- static validation ---------------------------------------------
 
     def validate(self) -> None:
@@ -316,18 +416,21 @@ class FaultSchedule:
         fresh = set(range(self.n0, self.n_slots))
         removed: set = set()
         paused: set = set()
+        false_rings: Dict[int, set] = {}
+        armed_tripwire: int = -1  # index of a committee_crash awaiting its trigger
         for i, event in enumerate(self.events):
             where = f"event {i} ({event.kind})"
-            if event.kind not in ALL_KINDS:
-                raise ScheduleError(f"{where}: unknown kind")
             if 0 in event.slots and event.kind in (
-                MEMBERSHIP_KINDS | {"partition", "ingress_block", "clock_pause"}
+                MEMBERSHIP_KINDS
+                | {"partition", "ingress_block", "clock_pause", "committee_crash"}
             ):
                 raise ScheduleError(f"{where}: slot 0 (seed/observer) may not be faulted")
             if event.dwell_ms < 0:
                 raise ScheduleError(f"{where}: negative dwell_ms")
             if event.kind in MEMBERSHIP_KINDS and not event.slots:
                 raise ScheduleError(f"{where}: membership event needs slots")
+            if event.kind in MEMBERSHIP_KINDS:
+                armed_tripwire = -1  # this event's reconfiguration trips it
             if event.kind == "crash":
                 bad = set(event.slots) - live
                 if bad:
@@ -399,6 +502,68 @@ class FaultSchedule:
                     raise ScheduleError(f"{where}: need 0 <= delay_min_ms <= delay_max_ms")
                 if event.slots and p == 0 and hi == 0:
                     raise ScheduleError(f"{where}: a non-empty group needs loss or delay")
+            elif event.kind in ("false_alert", "alert_storm"):
+                subject = event.args.get("subject")
+                if not isinstance(subject, int):
+                    raise ScheduleError(f"{where}: needs an int subject arg")
+                if subject == 0:
+                    raise ScheduleError(
+                        f"{where}: slot 0 (seed/observer) may not be the subject"
+                    )
+                if subject not in live:
+                    raise ScheduleError(f"{where}: subject {subject} not live")
+                status = str(event.args.get("status", "DOWN"))
+                if status not in ("DOWN", "UP"):
+                    raise ScheduleError(f"{where}: status must be DOWN or UP")
+                rings = list(event.args.get("rings", ()))  # type: ignore[arg-type]
+                if not rings or not all(
+                    isinstance(r, int) and 0 <= r < WATERMARK_K for r in rings
+                ):
+                    raise ScheduleError(
+                        f"{where}: rings must be a non-empty list of ints in "
+                        f"[0, {WATERMARK_K})"
+                    )
+                if event.kind == "false_alert":
+                    if len(event.slots) != 1:
+                        raise ScheduleError(f"{where}: takes exactly one liar slot")
+                else:
+                    if not event.slots:
+                        raise ScheduleError(f"{where}: a storm needs liar slots")
+                liars = set(event.slots)
+                if 0 in liars:
+                    raise ScheduleError(
+                        f"{where}: slot 0 (reference observer) never lies"
+                    )
+                if subject in liars:
+                    raise ScheduleError(f"{where}: the subject cannot lie about itself")
+                bad = liars - live
+                if bad:
+                    raise ScheduleError(f"{where}: non-live liars {sorted(bad)}")
+                if status == "DOWN":
+                    acc = false_rings.setdefault(subject, set())
+                    acc.update(int(r) for r in rings)
+                    if len(acc) >= WATERMARK_H:
+                        # Past H the lie evicts: the subject leaves the
+                        # expected membership like any schedule-removed slot.
+                        live.discard(subject)
+                        removed.add(subject)
+            elif event.kind == "committee_crash":
+                if self.profile != "hier":
+                    raise ScheduleError(
+                        f"{where}: only the hier profile has a global committee"
+                    )
+                if len(event.slots) != 1:
+                    raise ScheduleError(f"{where}: takes exactly one victim slot")
+                if event.slots[0] not in live:
+                    raise ScheduleError(f"{where}: slot {event.slots[0]} not live")
+                if event.settle:
+                    raise ScheduleError(
+                        f"{where}: must be settle=False — the crash fires "
+                        f"during the NEXT membership event's reconfiguration"
+                    )
+                live -= set(event.slots)
+                removed |= set(event.slots)
+                armed_tripwire = i
             elif event.kind == "clock_skew":
                 if len(event.slots) != 1 or "offset_ms" not in event.args:
                     raise ScheduleError(f"{where}: needs one slot and offset_ms")
@@ -415,22 +580,55 @@ class FaultSchedule:
                 if len(event.slots) != 1 or event.slots[0] not in paused:
                     raise ScheduleError(f"{where}: needs one paused slot")
                 paused -= set(event.slots)
+        if armed_tripwire >= 0:
+            # The tripwire only fires when a reconfiguration forwards a
+            # cohort cut; a schedule with nothing membership-changing after
+            # the arming would leave the victim alive while the expected-
+            # membership accounting (runner + oracles) counts it removed —
+            # false violations against a correct cluster.
+            raise ScheduleError(
+                f"event {armed_tripwire} (committee_crash): no membership "
+                f"event follows to trigger the reconfiguration tripwire"
+            )
         if self.events and not self.events[-1].settle:
             raise ScheduleError("last event must settle (nothing follows to absorb it)")
 
     # -- derived views --------------------------------------------------
 
-    def membership_phases(self) -> List[List[Tuple[str, Tuple[int, ...]]]]:
+    def membership_phases(self) -> List[List[FaultEvent]]:
         """The membership-changing events, grouped: consecutive
         ``settle=False`` events merge with the next settling one into one
         overlapped group (the runner converges once per group, and the
-        differential oracle replays group-at-a-time)."""
-        groups: List[List[Tuple[str, Tuple[int, ...]]]] = []
-        current: List[Tuple[str, Tuple[int, ...]]] = []
-        for event in self.events:
-            if event.kind not in MEMBERSHIP_KINDS:
+        differential oracle replays group-at-a-time).
+
+        Adversarial events ride along exactly when they change membership:
+        a ``committee_crash`` always (its victim is evicted), a
+        ``false_alert``/``alert_storm`` only at its H-crossing event — and
+        the crossing event is NORMALIZED to carry the cumulative claimed
+        ring set in ``args["rings"]``, so a group consumer (engine replay,
+        tenancy compiler) sees the full ≥H report load in one entry without
+        re-deriving the accumulation. Sub-H lies are environment-shaped:
+        they delay, never change, membership, and appear in no group."""
+        crossings = self.adversarial_crossings()
+        groups: List[List[FaultEvent]] = []
+        current: List[FaultEvent] = []
+        for i, event in enumerate(self.events):
+            if event.kind in ADVERSARIAL_KINDS:
+                if event.kind == "committee_crash":
+                    current.append(event)
+                elif i in crossings:
+                    subject, rings = crossings[i]
+                    current.append(FaultEvent(
+                        event.kind, event.slots,
+                        {"subject": subject, "rings": list(rings)},
+                        event.dwell_ms, event.settle,
+                    ))
+                else:
+                    continue
+            elif event.kind in MEMBERSHIP_KINDS:
+                current.append(event)
+            else:
                 continue
-            current.append((event.kind, event.slots))
             if event.settle:
                 groups.append(current)
                 current = []
@@ -444,19 +642,24 @@ class FaultSchedule:
         for event in self.events:
             if event.kind in MEMBERSHIP_KINDS:
                 n += MEMBER_DELTA[event.kind] * len(event.slots)
-        return n
+        return n - len(self._adversarial_removed())
 
     def expected_removed_slots(self) -> set:
         """Slots the schedule itself removes from membership (crashed, left,
-        or evicted by an asymmetric partition) and never restarts — the set
-        absent from the expected FINAL membership."""
+        evicted by an asymmetric partition, committee-crashed, or falsely
+        accused past H) and never restarts — the set absent from the
+        expected FINAL membership."""
         removed: set = set()
         for event in self.events:
-            if event.kind in ("crash", "leave", "partition_oneway"):
+            if event.kind in ("crash", "leave", "partition_oneway", "committee_crash"):
                 removed |= set(event.slots)
             elif event.kind == "restart":
                 removed -= set(event.slots)
-        return removed
+        crossed = {s for s, _ in self.adversarial_crossings().values()}
+        for event in self.events:
+            if event.kind == "restart":
+                crossed -= set(event.slots)
+        return removed | crossed
 
     def ever_removed_slots(self) -> set:
         """Slots removed at ANY point, restarts notwithstanding — the set
@@ -464,9 +667,9 @@ class FaultSchedule:
         incarnation may rightly learn of its own eviction)."""
         removed: set = set()
         for event in self.events:
-            if event.kind in ("crash", "leave", "partition_oneway"):
+            if event.kind in ("crash", "leave", "partition_oneway", "committee_crash"):
                 removed |= set(event.slots)
-        return removed
+        return removed | {s for s, _ in self.adversarial_crossings().values()}
 
     @property
     def engine_compatible(self) -> bool:

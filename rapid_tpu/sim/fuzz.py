@@ -3,10 +3,13 @@
 Three pieces:
 
 - **Named scenario families** — the four canonical adverse-network shapes
-  (partition-heal, asymmetric link, crash-during-join, churn-under-loss)
-  plus the three WAN-shaped hierarchical-membership shapes (inter-cohort
-  loss/latency asymmetry, delegate gray failure, cohort-boundary flapping —
-  ``profile="hier"``, run over the two-level protocol of
+  (partition-heal, asymmetric link, crash-during-join, churn-under-loss),
+  the ADVERSARIAL shapes (false-alert stability, watermark probe — Byzantine
+  observers lying against the H/L watermarks, scenarios the paper's
+  honest-but-flaky evaluation never reached), and the WAN-shaped
+  hierarchical-membership shapes (inter-cohort loss/latency asymmetry,
+  delegate gray failure, cohort-boundary flapping, committee crash during
+  reconfiguration — ``profile="hier"``, run over the two-level protocol of
   :mod:`rapid_tpu.hier`), each a seeded generator over a fixed slot
   geometry so every (family, seed) pair is one pinned, replayable scenario.
   The tier-1 chaos smoke runs a pinned grid of these; ``tools/chaosrun.py``
@@ -33,7 +36,13 @@ from pathlib import Path
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from rapid_tpu.hier.cohorts import CohortMap
-from rapid_tpu.sim.faults import FaultEvent, FaultSchedule, ScheduleError
+from rapid_tpu.sim.faults import (
+    WATERMARK_H,
+    WATERMARK_L,
+    FaultEvent,
+    FaultSchedule,
+    ScheduleError,
+)
 from rapid_tpu.sim.oracles import Violation, check_all
 from rapid_tpu.sim.scenario import (
     RunResult,
@@ -140,11 +149,69 @@ def churn_under_loss(seed: int) -> FaultSchedule:
 
 
 # ---------------------------------------------------------------------------
+# adversarial families: Byzantine observers against the H/L watermarks
+# ---------------------------------------------------------------------------
+
+
+def false_alert_stability(seed: int) -> FaultSchedule:
+    """The paper's stability claim, tested against an observer that LIES:
+    a Byzantine liar claims a seeded number of distinct rings in [L, H)
+    about a healthy subject, then three more colluders re-claim the SAME
+    rings (an idempotent storm — per-ring dedup must keep the count where
+    it is). The cumulative tally sits in the stable band for the whole run:
+    no view change may fire, the subject stays in every view, and once the
+    lies cease the cluster is simply converged (it never moved)."""
+    rng = random.Random(f"false-alert-stability:{seed}")
+    pool = _initial_live(rng)
+    liar, subject = pool[0], pool[1]
+    colluders = tuple(sorted(pool[2:5]))
+    reports = rng.randint(WATERMARK_L, WATERMARK_H - 1)
+    rings = list(range(reports))
+    return FaultSchedule(
+        n0=N0, n_slots=N_SLOTS, seed=seed, name=f"false_alert_stability/{seed}",
+        events=[
+            FaultEvent("false_alert", (liar,),
+                       args={"subject": subject, "rings": rings},
+                       dwell_ms=2_000),
+            FaultEvent("alert_storm", colluders,
+                       args={"subject": subject, "rings": rings},
+                       dwell_ms=2_000),
+        ],
+    )
+
+
+def watermark_probe(seed: int) -> FaultSchedule:
+    """Adversarially timed against the exact watermark boundary: one liar
+    holds the subject's count at a seeded value in [L, H) for a dwell (the
+    stable band — no view change), then a storm of colluders tops the
+    cumulative count up to EXACTLY H. The healthy subject is evicted — the
+    adversary wins that much — but the eviction must be one agreed,
+    chain-consistent cut: every node delivers the same (wrong) view."""
+    rng = random.Random(f"watermark-probe:{seed}")
+    pool = _initial_live(rng)
+    liar, subject = pool[0], pool[1]
+    colluders = tuple(sorted(pool[2:4]))
+    hold = rng.randint(WATERMARK_L, WATERMARK_H - 1)
+    return FaultSchedule(
+        n0=N0, n_slots=N_SLOTS, seed=seed, name=f"watermark_probe/{seed}",
+        events=[
+            FaultEvent("false_alert", (liar,),
+                       args={"subject": subject, "rings": list(range(hold))},
+                       dwell_ms=1_500),
+            FaultEvent("alert_storm", colluders,
+                       args={"subject": subject,
+                             "rings": list(range(hold, WATERMARK_H))},
+                       dwell_ms=1_000),
+        ],
+    )
+
+
+# ---------------------------------------------------------------------------
 # WAN-shaped hierarchical families (rapid_tpu/hier; profile="hier")
 # ---------------------------------------------------------------------------
 
 
-def _hier_geometry(seed: int):
+def hier_geometry(seed: int):
     """The cohort structure of the INITIAL 8-member hierarchical cluster for
     a family seed: (cohort map, slot-of-endpoint). Deterministic — the
     generator reasons about the exact cohorts the runner will boot, so a
@@ -165,7 +232,7 @@ def wan_cohort_asym(seed: int) -> FaultSchedule:
     local fast path never crosses the boundary — detection and cohort
     agreement run at LAN speed — and only the thin global tier pays the WAN;
     its redelivery/classic machinery must absorb the loss."""
-    cmap, endpoints, slot_of = _hier_geometry(seed)
+    cmap, endpoints, slot_of = hier_geometry(seed)
     rng = random.Random(f"wan-cohort-asym:{seed}")
     seed_cohort = cmap.cohort_of(endpoints[0])
     far = next(c for c in range(cmap.n_cohorts) if c != seed_cohort)
@@ -193,7 +260,7 @@ def delegate_gray_failure(seed: int) -> FaultSchedule:
     cohort must detect it, decide the cut without it, fail over the
     forwarding chain, and the committee must decide classically around the
     unresponsive member; a joiner then lands through the healed network."""
-    cmap, endpoints, slot_of = _hier_geometry(seed)
+    cmap, endpoints, slot_of = hier_geometry(seed)
     rng = random.Random(f"delegate-gray:{seed}")
     committee = [ep for ep in cmap.committee() if ep != endpoints[0]]
     victim = slot_of[rng.choice(committee)]
@@ -219,7 +286,7 @@ def cohort_boundary_flap(seed: int) -> FaultSchedule:
     config pulls); cohort-local detection must stay quiet about it (no
     false evictions of the flapping link's endpoints) and the overlapped
     churn must still serialize into one consistent chain."""
-    cmap, endpoints, slot_of = _hier_geometry(seed)
+    cmap, endpoints, slot_of = hier_geometry(seed)
     rng = random.Random(f"boundary-flap:{seed}")
     seed_cohort = cmap.cohort_of(endpoints[0])
     far = next(c for c in range(cmap.n_cohorts) if c != seed_cohort)
@@ -244,14 +311,51 @@ def cohort_boundary_flap(seed: int) -> FaultSchedule:
     )
 
 
+def committee_crash_during_reconfig(seed: int) -> FaultSchedule:
+    """Crash a global-committee member INSIDE the hier reconfiguration
+    window (the committee-crash shape of "Reconfigurable Atomic Transaction
+    Commit", arXiv:1906.01365): the armed tripwire fires the instant the
+    triggering crash's cohort cut is forwarded to the committee — after
+    forwarding, before the global decision. The committee must still decide
+    (classic fallback around the dead member), the cohort forwarding chain
+    must fail over, and the dead committee member is detected and evicted
+    in a follow-up cut — two removals, one consistent chain."""
+    cmap, endpoints, slot_of = hier_geometry(seed)
+    rng = random.Random(f"committee-crash:{seed}")
+    committee = [ep for ep in cmap.committee() if ep != endpoints[0]]
+    victim = slot_of[rng.choice(committee)]
+    # The trigger must be a NON-committee member: the committee is static
+    # for the configuration and sized 2 per cohort, so losing the armed
+    # victim AND a committee-member trigger would drop the global tier
+    # below its classic majority — a legitimate wedge, but a different
+    # scenario (quorum loss) than the reconfiguration-window crash this
+    # family pins.
+    committee_slots = {slot_of[ep] for ep in cmap.committee()}
+    trigger_pool = [
+        s for s in range(1, N0) if s != victim and s not in committee_slots
+    ]
+    trigger = rng.choice(trigger_pool)
+    return FaultSchedule(
+        n0=N0, n_slots=N_SLOTS, seed=seed, profile="hier",
+        name=f"committee_crash_during_reconfig/{seed}",
+        events=[
+            FaultEvent("committee_crash", (victim,), settle=False),
+            FaultEvent("crash", (trigger,), dwell_ms=1_000),
+        ],
+    )
+
+
 FAMILIES: Dict[str, Callable[[int], FaultSchedule]] = {
     "partition_heal": partition_heal,
     "asymmetric_link": asymmetric_link,
     "crash_during_join": crash_during_join,
     "churn_under_loss": churn_under_loss,
+    "false_alert_stability": false_alert_stability,
+    "watermark_probe": watermark_probe,
     "wan_cohort_asym": wan_cohort_asym,
     "delegate_gray_failure": delegate_gray_failure,
     "cohort_boundary_flap": cohort_boundary_flap,
+    "committee_crash_during_reconfig": committee_crash_during_reconfig,
 }
 
 

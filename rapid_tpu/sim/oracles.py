@@ -18,6 +18,12 @@ protocol's safety and liveness claims (paper §3, §5):
 - ``membership-outcome`` — the final membership is exactly the schedule's
   surviving slots, and only slots the schedule removed were evicted
   (a KICKED on any other node is a false eviction).
+- ``stability`` — the flaky/hostile-observer claim (paper §4.2, pushed to
+  observers that LIE): a never-crashed subject whose cumulative false-report
+  count stayed below H must never be evicted — in any cut, not just the
+  final membership; past-H false reports may evict, but the wrong cut must
+  still be one agreed, chain-consistent decision (the other oracles enforce
+  that half once the schedule accounting counts the subject as removed).
 - ``bounded-convergence`` — after the last fault heals, every live node
   reaches the final configuration within the schedule's simulated-time
   budget.
@@ -33,7 +39,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, List, Sequence, Set, Tuple
 
-from rapid_tpu.sim.faults import MEMBER_DELTA, FaultSchedule
+from rapid_tpu.sim.faults import WATERMARK_H, FaultSchedule
 from rapid_tpu.sim.scenario import RunResult
 from rapid_tpu.types import EdgeStatus, Endpoint
 
@@ -144,6 +150,58 @@ def check_membership_outcome(result: RunResult) -> List[Violation]:
     return violations
 
 
+def check_stability(result: RunResult) -> List[Violation]:
+    """The paper's stability claim, extended to HOSTILE observers (the half
+    the reference's evaluation never tests): a never-crashed subject whose
+    cumulative FALSE-report count stayed below the H watermark must never
+    be evicted — not in the final membership (membership-outcome covers
+    that) and not in ANY intermediate cut or KICKED signal (this oracle's
+    addition: a transient wrongful eviction would slip past an
+    outcome-only check). False alerts pushed past H MAY evict — the
+    adversary can buy a wrong cut — but the schedule accounting then counts
+    the subject as removed, so chain-consistency, agreement, and
+    membership-outcome still enforce that the wrong cut is ONE agreed,
+    chain-consistent decision."""
+    s = result.schedule
+    lied_about = {
+        int(e.args["subject"])  # type: ignore[arg-type]
+        for e in s.events
+        if e.kind in ("false_alert", "alert_storm")
+    }
+    if not lied_about:
+        return []
+    crossed = {sub for sub, _ in s.adversarial_crossings().values()}
+    # Subjects also removed by HONEST schedule events (crash/leave/...) are
+    # legitimately evicted regardless of the lies; judge only the rest.
+    honestly_removed = {
+        slot
+        for e in s.events
+        if e.kind in ("crash", "leave", "partition_oneway", "committee_crash")
+        for slot in e.slots
+    }
+    protected = lied_about - crossed - honestly_removed
+    violations: List[Violation] = []
+    for subject in sorted(protected):
+        endpoint = result.endpoints[subject]
+        for i, cut in enumerate(result.cuts):
+            if (endpoint, EdgeStatus.DOWN) in cut:
+                violations.append(Violation(
+                    "stability",
+                    f"slot {subject} was cut DOWN (cut {i}) although its "
+                    f"false-report count stayed below H={WATERMARK_H} and it "
+                    f"never failed — sub-H reports must delay, not trigger, "
+                    f"a view change",
+                ))
+                break
+        if subject in result.kicked:
+            violations.append(Violation(
+                "stability",
+                f"slot {subject} observed its own eviction (KICKED) although "
+                f"its false-report count stayed below H={WATERMARK_H}",
+            ))
+    return violations
+
+
 def check_bounded_convergence(result: RunResult) -> List[Violation]:
     if result.aborted_at_event is not None:
         return [Violation(
@@ -202,6 +260,43 @@ def cuts_refine(fine_cuts: Sequence[Set], coarse_groups: Sequence[Sequence[froze
     return None
 
 
+def inject_engine_event(vc, event) -> int:
+    """Apply one membership-phase event to an engine cluster and return its
+    expected-membership delta — THE host-event -> engine-seam mapping,
+    shared by the differential replay below and the tenancy chaos compiler
+    (rapid_tpu/tenancy/chaos.py), so the two can never diverge on what a
+    schedule means at the engine grain:
+
+    - ``join``/``leave`` — the engine's own injection seams;
+    - ``crash``/``partition_oneway``/``committee_crash`` — detector-identical
+      crash-stops (the engine has no committee; the victim's removal is
+      what the membership chain must agree on);
+    - ``false_alert``/``alert_storm`` (H-crossing, normalized by
+      ``membership_phases`` to carry the cumulative ring set) — per-(subject,
+      ring) probe failures (``set_flaky_edges``): the engine's observers of
+      those rings report DOWN about the healthy subject, the exact tally the
+      host's lying broadcast produces."""
+    import numpy as np
+
+    kind, slots, args = event.kind, list(event.slots), event.args
+    if kind == "join":
+        vc.inject_join_wave(slots)
+        return len(slots)
+    if kind == "leave":
+        vc.initiate_leave(slots)
+        return -len(slots)
+    if kind in ("false_alert", "alert_storm"):
+        subject = int(args["subject"])
+        rings = [int(r) for r in args["rings"]]
+        probe = np.array(vc.faults.probe_fail, dtype=bool)
+        probe[subject, rings] = True
+        vc.set_flaky_edges(probe)
+        return -1  # only H-crossing lies appear in phase groups
+    # crash / partition_oneway / committee_crash are detector-identical.
+    vc.crash(slots)
+    return -len(slots)
+
+
 def replay_through_engine(
     schedule: FaultSchedule, endpoints: Sequence[Endpoint]
 ) -> Tuple[List[List[frozenset]], Set[Endpoint]]:
@@ -227,14 +322,8 @@ def replay_through_engine(
     groups: List[List[frozenset]] = []
     expected = schedule.n0
     for group in schedule.membership_phases():
-        for kind, slots in group:
-            if kind == "join":
-                vc.inject_join_wave(list(slots))
-            elif kind == "leave":
-                vc.initiate_leave(list(slots))
-            else:  # crash and one-way ingress partition are detector-identical
-                vc.crash(list(slots))
-            expected += MEMBER_DELTA[kind] * len(slots)
+        for event in group:
+            expected += inject_engine_event(vc, event)
         cuts: List[frozenset] = []
         # One decision per injected event at most; overlapped groups may
         # resolve in fewer cuts (one combined decision) or one per event.
@@ -304,6 +393,7 @@ HOST_ORACLES = (
     check_monotonicity,
     check_agreement,
     check_membership_outcome,
+    check_stability,
     check_bounded_convergence,
 )
 

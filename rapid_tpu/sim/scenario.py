@@ -29,7 +29,11 @@ from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from rapid_tpu.errors import JoinError
-from rapid_tpu.messaging.inprocess import InProcessNetwork, ServerDropFirstN
+from rapid_tpu.messaging.inprocess import (
+    InProcessNetwork,
+    RequestTripwire,
+    ServerDropFirstN,
+)
 from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
 from rapid_tpu.protocol.cluster import Cluster
 from rapid_tpu.protocol.events import ClusterEvents
@@ -42,7 +46,7 @@ from rapid_tpu.sim.faults import (
     LinkShaper,
     schedule_rng,
 )
-from rapid_tpu.types import Endpoint, NodeId
+from rapid_tpu.types import CohortCutMessage, EdgeStatus, Endpoint, NodeId
 from rapid_tpu.utils.clock import ManualClock, NodeClock
 
 
@@ -283,6 +287,51 @@ class SimHarness:
             ServerDropFirstN(DROPPABLE_MESSAGES[message], count)
         )
 
+    # -- adversarial primitives (Byzantine observers, committee crash) --
+
+    async def false_alert(
+        self, liar: int, subject: int, rings: Sequence[int], status: str = "DOWN"
+    ) -> None:
+        """Slot ``liar`` broadcasts edge reports it never observed about
+        ``subject``, claiming the given ring numbers — the hostile half of
+        the paper's flaky-observer stability story (sim/faults.py
+        ``false_alert``). The lie rides the real alert machinery (batching,
+        broadcast, redelivery) via the service's Byzantine seam."""
+        await self.clusters[liar].service.inject_byzantine_alert(
+            self.endpoints[subject],
+            EdgeStatus.DOWN if status == "DOWN" else EdgeStatus.UP,
+            rings,
+        )
+
+    async def alert_storm(
+        self, liars: Sequence[int], subject: int, rings: Sequence[int],
+        status: str = "DOWN",
+    ) -> None:
+        """Simultaneous collusion: the claimed rings are distributed
+        round-robin across the liars, so the RECEIVER-side cumulative tally
+        is identical to one liar claiming them all — but the reports arrive
+        from distinct senders in distinct batches (exercising per-ring
+        dedup across senders)."""
+        liars = list(liars)
+        for j, liar in enumerate(liars):
+            share = [r for i, r in enumerate(rings) if i % len(liars) == j]
+            if share:
+                await self.false_alert(liar, subject, share, status)
+
+    def arm_committee_crash(self, victim: int) -> None:
+        """Crash ``victim`` the instant the first CohortCutMessage hits any
+        server: the window between cohort-cut forwarding and the global
+        decision — the hier reconfiguration gap of arXiv:1906.01365. The
+        tripwire fires synchronously before the triggering message is
+        handled, so a victim that was the recipient loses the message with
+        the process."""
+
+        def fire() -> None:
+            if victim in self.live_ids:
+                self.crash([victim])
+
+        self.network.tripwires.append(RequestTripwire(CohortCutMessage, fire))
+
     # -- convergence ----------------------------------------------------
 
     def _agreeing(self, expected: int, include_blocked: bool) -> bool:
@@ -475,6 +524,11 @@ class ScenarioRunner:
         faultlog: List[dict] = []
         aborted_at: Optional[int] = None
         overlap_pending = 0  # unsettled membership events awaiting a settle
+        # Which false_alert/alert_storm events cross H (and therefore evict
+        # their subject) — precomputed once so the runner, the schedule's
+        # expected-membership accounting, and the oracles share the single
+        # cumulative-ring definition in faults.py.
+        crossings = s.adversarial_crossings()
 
         for i, event in enumerate(s.events):
             faultlog.append(
@@ -488,7 +542,16 @@ class ScenarioRunner:
                 # what the cluster reached, not what it never attempted.
                 aborted_at = i
                 break
-            if event.kind in MEMBERSHIP_KINDS:
+            if i in crossings or event.kind == "committee_crash":
+                # A past-H lie evicts its healthy subject; an armed
+                # committee crash removes its victim once tripped. Both
+                # change the expected membership like any schedule fault.
+                expected -= 1
+            if (
+                event.kind in MEMBERSHIP_KINDS
+                or i in crossings
+                or event.kind == "committee_crash"
+            ):
                 if not event.settle:
                     overlap_pending += 1
                     # The dwell is the overlap window: how much simulated
@@ -577,6 +640,23 @@ class ScenarioRunner:
         if kind == "partition_oneway":
             harness.partition_one_way(slots[0])
             return -1
+        if kind == "false_alert":
+            await harness.false_alert(
+                slots[0], int(args["subject"]),
+                [int(r) for r in args["rings"]],  # type: ignore[union-attr]
+                str(args.get("status", "DOWN")),
+            )
+            return 0  # the H-crossing delta is the run loop's (cumulative)
+        if kind == "alert_storm":
+            await harness.alert_storm(
+                slots, int(args["subject"]),
+                [int(r) for r in args["rings"]],  # type: ignore[union-attr]
+                str(args.get("status", "DOWN")),
+            )
+            return 0
+        if kind == "committee_crash":
+            harness.arm_committee_crash(slots[0])
+            return 0  # armed, not yet crashed; the run loop expects -1
         if kind == "partition":
             harness.partition(slots)
         elif kind == "ingress_block":

@@ -74,6 +74,7 @@ STAGE_NAMES = frozenset({
     "loss_variant",
     "tenant_fleet",
     "stream",
+    "chaos",
     "hlo_audit",
     "profile",
 })

@@ -1,0 +1,51 @@
+"""Lint corpus: chaos vocabulary drift, every defect class.
+
+A miniature of the three registries the chaosvocab family cross-checks:
+an unknown ``FaultEvent`` kind (typo'd past the closed vocabulary), a
+``FAMILIES`` key whose generator function was renamed out from under it,
+a fleet mix-table entry naming an unregistered family, and a CLI family
+argument with a hand-typed choices list. The allowlisted construction
+shows the deliberate-fixture escape hatch.
+"""
+
+import argparse
+
+from rapid_tpu.sim.faults import FaultEvent, FaultSchedule
+from rapid_tpu.sim.fuzz import FAMILIES as _REAL  # noqa: F401
+
+
+def crash_wave(seed: int) -> FaultSchedule:
+    return FaultSchedule(
+        n0=8, n_slots=12, seed=seed,
+        events=[
+            FaultEvent("crash", (3,)),  # registered: clean
+            FaultEvent("falce_alert", (1,),  # expect: chaos-unknown-kind
+                       args={"subject": 2, "rings": [0]}),
+            FaultEvent("explode", (1,)),  # chaos-kind-ok: deliberate fixture
+        ],
+    )
+
+
+def join_wave(seed: int) -> FaultSchedule:
+    return FaultSchedule(
+        n0=8, n_slots=12, seed=seed,
+        events=[FaultEvent("join", (8, 9))],
+    )
+
+
+FAMILIES = {
+    "crash_wave": crash_wave,
+    "join_surge": join_wave,  # expect: chaos-family-drift
+}
+
+ENGINE_FAMILIES = (
+    "partition_heal",
+    "partition_heel",  # expect: chaos-family-drift
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("family", nargs="?",  # expect: chaos-family-drift
+                        choices=["crash_wave", "join_surge"])
+    return parser

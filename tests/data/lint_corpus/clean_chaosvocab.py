@@ -1,0 +1,40 @@
+"""Lint corpus: the chaos vocabulary discipline upheld — zero findings.
+
+Registered kinds only, a ``FAMILIES`` table whose keys match their
+generators, mix tables naming real registered families, and a CLI family
+argument wired to the registry itself.
+"""
+
+import argparse
+
+from rapid_tpu.sim import fuzz as simfuzz
+from rapid_tpu.sim.faults import FaultEvent, FaultSchedule
+
+
+def partition_flap(seed: int) -> FaultSchedule:
+    return FaultSchedule(
+        n0=8, n_slots=12, seed=seed,
+        events=[
+            FaultEvent("partition", (3, 4), dwell_ms=500),
+            FaultEvent("heal_partitions"),
+            FaultEvent("false_alert", (1,),
+                       args={"subject": 2, "rings": [0, 1]}),
+        ],
+    )
+
+
+FAMILIES = {
+    "partition_flap": partition_flap,
+}
+
+ENGINE_FAMILIES = (
+    "partition_heal",
+    "churn_under_loss",
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("family", nargs="?", default=None,
+                        choices=sorted(simfuzz.FAMILIES))
+    return parser

@@ -94,6 +94,10 @@ def test_cpu_run_emits_complete_ledger(tmp_path):
             # fleet stream drivers.
             "RAPID_TPU_BENCH_STREAM_WAVES": "6",
             "RAPID_TPU_BENCH_STREAM_N": "48",
+            # Tiny adversarial-chaos fleet: the FULL stage path runs
+            # (ramped) — warm-up + timed fuzz round over 4 mixed hostile
+            # scenarios, oracle-checked clean.
+            "RAPID_TPU_BENCH_CHAOS_B": "4",
         },
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
@@ -190,6 +194,25 @@ def test_cpu_run_emits_complete_ledger(tmp_path):
         e["event"] == "compile_stats" and e.get("stage") == "stream"
         for e in events
     )
+    # ISSUE 12 adversarial-chaos path, same run: the chaos stage resolved
+    # B mixed hostile scenarios (Byzantine false alerts, committee crashes,
+    # honest churn) through batched fleet dispatches in its own bracketed,
+    # budgeted stage — scenarios/sec lands in the emitted JSON with an
+    # explicit status marker (never silently absent), zero violations.
+    assert result["chaos_status"] == "ramped:4x12"
+    assert result["chaos_tenants"] == 4
+    assert result["chaos_scenarios_per_sec"] > 0
+    assert result["chaos_wall_ms"] > 0
+    assert result["chaos_dispatches"] >= 1
+    assert result["chaos_families"] >= 1
+    [(chaos_begin, chaos_close)] = pairs["chaos"]
+    assert chaos_close["event"] == "stage_end"
+    assert chaos_begin["timeout_s"] > 0
+    assert chaos_begin["n"] == 4  # tenants (hostile scenarios) under test
+    assert any(
+        e["event"] == "compile_stats" and e.get("stage") == "chaos"
+        for e in events
+    )
 
 
 def test_headline_plan_is_never_silently_absent(monkeypatch):
@@ -266,6 +289,33 @@ def test_stream_plan_is_never_silently_absent(monkeypatch):
     assert bench.stream_plan("cpu", 2000.0) == (6, 48, "live")
     monkeypatch.setenv("RAPID_TPU_BENCH_NO_STREAM", "1")
     assert bench.stream_plan("tpu", 0.0) == (0, 0, "suppressed")
+
+
+def test_chaos_plan_is_never_silently_absent(monkeypatch):
+    """ISSUE 12: every branch of the adversarial-chaos policy yields an
+    explicit status (the headline_plan discipline) — 256 mixed hostile
+    scenarios per fleet on the accelerator, ramped on CPU, skipped-budget
+    past the (shared-default) budget, suppressed on request, forced when
+    asked."""
+    for name in ("RAPID_TPU_BENCH_NO_CHAOS", "RAPID_TPU_BENCH_CHAOS",
+                 "RAPID_TPU_BENCH_CHAOS_B", "RAPID_TPU_BENCH_CHAOS_BUDGET_S",
+                 "RAPID_TPU_BENCH_XL_BUDGET_S"):
+        monkeypatch.delenv(name, raising=False)
+    assert bench.chaos_plan("tpu", 0.0) == (256, "live")
+    assert bench.chaos_plan("cpu", 0.0) == (12, "ramped:12x12")
+    monkeypatch.setenv("RAPID_TPU_BENCH_CHAOS_B", "4")
+    assert bench.chaos_plan("cpu", 0.0) == (4, "ramped:4x12")
+    # Past the budget the stage is skipped — but NAMED; the chaos budget
+    # defaults to the XL budget so one env override governs every tail.
+    assert bench.chaos_plan("tpu", 2000.0) == (0, "skipped-budget")
+    monkeypatch.setenv("RAPID_TPU_BENCH_CHAOS_BUDGET_S", "3000")
+    assert bench.chaos_plan("tpu", 2000.0)[1] == "live"
+    # ...and forcing runs it anywhere, at the env-resolved scale.
+    monkeypatch.setenv("RAPID_TPU_BENCH_CHAOS_BUDGET_S", "1")
+    monkeypatch.setenv("RAPID_TPU_BENCH_CHAOS", "1")
+    assert bench.chaos_plan("cpu", 2000.0) == (4, "live")
+    monkeypatch.setenv("RAPID_TPU_BENCH_NO_CHAOS", "1")
+    assert bench.chaos_plan("tpu", 0.0) == (0, "suppressed")
 
 
 def test_parse_scale_spellings():

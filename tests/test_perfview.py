@@ -244,6 +244,55 @@ def test_trajectory_renders_stream_column_and_flags_missing(tmp_path, capsys):
     assert "stream-missing" not in lines["BENCH_r40"]  # pre-audit history
 
 
+def test_trajectory_renders_chaos_column_and_flags_missing(tmp_path, capsys):
+    """ISSUE 12: chaos_scenarios_per_sec renders as its own trajectory
+    column (with the fleet tenant count beside it) under the existing
+    trust flags; an AUDITED round that omits both the value and its
+    explicit chaos_status marker flags chaos-missing; pre-audit historical
+    rounds are exempt."""
+    audit = {"fleet3d_wave": {"collectives": 74, "hot_loop_collectives": 74,
+                              "temp_bytes": 10, "donation_dropped": 0}}
+    points = {
+        # Pre-audit historical round: exempt (sorts first).
+        "BENCH_r50.json": {"metric": "m", "value": 1.0, "platform": "cpu"},
+        # Audited + measured chaos point: rate + tenants in CHAOS column.
+        "BENCH_r51.json": {"metric": "m", "value": 100.0, "platform": "tpu",
+                           "hlo_audit": audit, "n1M_status": "live",
+                           "tenant_fleet_status": "live",
+                           "stream_status": "live",
+                           "chaos_status": "live",
+                           "chaos_scenarios_per_sec": 412.5,
+                           "chaos_tenants": 256},
+        # Audited + explicit ramped marker (CPU stage-path run): no flag.
+        "BENCH_r52.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, "n1M_status": "ramped:256",
+                           "tenant_fleet_status": "ramped:8x64",
+                           "stream_status": "ramped:12x96",
+                           "chaos_status": "ramped:12x12"},
+        # Audited round that silently dropped the chaos point: flagged.
+        "BENCH_r53.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, "n1M_status": "ramped:256",
+                           "tenant_fleet_status": "ramped:8x64",
+                           "stream_status": "ramped:12x96"},
+    }
+    paths = []
+    for name, data in points.items():
+        p = tmp_path / name
+        p.write_text(json.dumps(data))
+        paths.append(str(p))
+    assert perfview.main(paths) == 0
+    out = capsys.readouterr().out
+    assert "CHAOS" in out.splitlines()[1]  # the trajectory header row
+    lines = {line.split()[0]: line for line in out.splitlines()
+             if line.startswith("BENCH_r5")}
+    assert "412.5/s B=256" in lines["BENCH_r51"]
+    assert "chaos-missing" not in lines["BENCH_r51"]
+    assert "ramped:12x12" in lines["BENCH_r52"]
+    assert "chaos-missing" not in lines["BENCH_r52"]
+    assert "chaos-missing" in lines["BENCH_r53"]
+    assert "chaos-missing" not in lines["BENCH_r50"]  # pre-audit history
+
+
 def test_chrome_trace_envelope(tmp_path, capsys):
     path = _complete_ledger(tmp_path)
     chrome_path = tmp_path / "trace.json"

@@ -1,0 +1,124 @@
+"""The stability-band separation pin (ISSUE 12 acceptance): the SAME
+Byzantine false-alert scenario, judged on both sides of the H watermark.
+
+The paper's stability claim says flaky reports between the L and H
+watermarks DELAY — never trigger — a view change. These tests push that
+claim to observers that LIE (reports about a node that never failed) and
+pin the exact separation the ``stability`` oracle enforces:
+
+- **held in [L, H)** — no eviction of the healthy subject, no cut at all,
+  and the run converges once the alerts cease (the cluster simply never
+  moved);
+- **pushed past H** — the healthy subject IS evicted (the adversary buys a
+  wrong cut), but the eviction is one agreed, chain-consistent decision:
+  every node delivers the same view sequence and the full oracle battery
+  (agreement, chain prefix, membership outcome vs the schedule's own
+  accounting) holds.
+
+Both runs are deterministic across reruns — a repro file of either IS the
+scenario. Geometry mirrors the fuzz families (n0=8 of 12 slots) so these
+schedules are fleet-compilable too (tests/test_tenancy_chaos.py covers the
+engine grain)."""
+
+from rapid_tpu.sim.faults import (
+    WATERMARK_H,
+    WATERMARK_L,
+    FaultEvent,
+    FaultSchedule,
+)
+from rapid_tpu.sim.fuzz import run_schedule
+from rapid_tpu.sim.oracles import check_all
+
+SUBJECT = 3
+LIAR = 5
+
+
+def _band_schedule(storm_rings: int, name: str) -> FaultSchedule:
+    """One liar holds the subject's cumulative count at H-1 distinct rings
+    (one short of eviction — the adversarially hardest stable point), then
+    a two-colluder storm claims ``storm_rings`` rings. With
+    ``storm_rings == H-1`` the storm only RE-claims (per-ring dedup keeps
+    the tally in the band); with ``storm_rings == H`` it adds exactly one
+    fresh ring and tops the count up to H. The two schedules differ by ONE
+    claimed ring — that ring is the whole separation."""
+    return FaultSchedule(
+        n0=8, n_slots=12, seed=0, name=name,
+        events=[
+            FaultEvent("false_alert", (LIAR,),
+                       args={"subject": SUBJECT,
+                             "rings": list(range(WATERMARK_H - 1))},
+                       dwell_ms=2_000),
+            FaultEvent("alert_storm", (4, 6),
+                       args={"subject": SUBJECT,
+                             "rings": list(range(storm_rings))},
+                       dwell_ms=2_000),
+        ],
+    )
+
+
+def test_sub_h_false_alerts_never_evict_and_the_run_converges():
+    # Held at H-1: inside the stable band, one report short of eviction —
+    # the adversarially hardest stable point.
+    schedule = _band_schedule(WATERMARK_H - 1, "band/stable")
+    assert WATERMARK_L <= WATERMARK_H - 1 < WATERMARK_H
+    result = run_schedule(schedule)
+    assert check_all(result) == []
+    # No view change fired anywhere after bring-up: zero cuts, nobody
+    # kicked — the configuration chain never moved.
+    assert result.cuts == []
+    assert result.kicked == []
+    # And the subject is still a member everywhere once the alerts cease.
+    assert result.endpoints[SUBJECT] in result.final_membership
+    assert result.final_converged
+    assert len(result.final_membership) == 8
+
+
+def test_past_h_false_alerts_evict_with_one_agreed_chain():
+    # The SAME shape pushed one ring past the band: the lie crosses H and
+    # the healthy subject is evicted — wrongly, but CONSISTENTLY.
+    schedule = _band_schedule(WATERMARK_H, "band/crossed")
+    assert schedule.adversarial_crossings()  # the schedule accounts the lie
+    assert schedule.expected_members() == 7
+    result = run_schedule(schedule)
+    # The full battery holds: agreement, chain consistency, membership
+    # outcome (vs the schedule's own ≥H accounting), stability (the oracle
+    # only protects sub-H subjects), bounded convergence.
+    assert check_all(result) == []
+    # Exactly one cut, agreed by every live node: the wrong-but-consistent
+    # eviction of the subject.
+    assert len(result.cuts) == 1
+    assert result.endpoints[SUBJECT] not in result.final_membership
+    assert len(result.final_membership) == 7
+    # The evicted subject learned of its own eviction (KICKED) — it was
+    # alive to hear the verdict (never actually crashed).
+    assert SUBJECT in result.kicked
+
+
+def test_band_separation_is_deterministic_across_reruns():
+    # Both sides of the band replay bit-identically: same cuts, same
+    # chains, same outcome — a written repro IS the scenario.
+    for rings in (WATERMARK_H - 1, WATERMARK_H):
+        a = run_schedule(_band_schedule(rings, "band/det"))
+        b = run_schedule(_band_schedule(rings, "band/det"))
+        assert a.cuts == b.cuts
+        assert a.configs == b.configs
+        assert a.final_membership == b.final_membership
+        assert sorted(a.kicked) == sorted(b.kicked)
+
+
+def test_up_lies_about_a_present_host_are_filtered():
+    # The no-op lie: UP claims about a host that is already in the view are
+    # dropped by every receiver — kept for coverage of the filter branch.
+    schedule = FaultSchedule(
+        n0=8, n_slots=12, seed=0, name="band/up-noop",
+        events=[
+            FaultEvent("false_alert", (LIAR,),
+                       args={"subject": SUBJECT, "rings": [0, 1],
+                             "status": "UP"},
+                       dwell_ms=1_000),
+        ],
+    )
+    result = run_schedule(schedule)
+    assert check_all(result) == []
+    assert result.cuts == []
+    assert len(result.final_membership) == 8

@@ -56,8 +56,20 @@ def test_schedule_json_round_trip_is_identity():
     assert restored.to_json() == schedule.to_json()
 
 
+def test_unknown_kind_raises_at_construction():
+    # The vocabulary is closed at the point a kind is MINTED: a typo'd kind
+    # must never ride silently into a schedule file the runner then crashes
+    # on mid-scenario (the chaosvocab lint pins the static half of this).
+    with pytest.raises(ScheduleError, match="unknown kind"):
+        FaultEvent("explode", (1,))  # chaos-kind-ok: the pin IS the defect
+    with pytest.raises(ScheduleError, match="unknown kind"):
+        FaultSchedule.from_dict({
+            "version": 1, "n0": 8, "n_slots": 12,
+            "events": [{"kind": "explode", "slots": [1]}],
+        })
+
+
 @pytest.mark.parametrize("events,message", [
-    ([FaultEvent("explode", (1,))], "unknown kind"),
     ([FaultEvent("crash", (0,))], "slot 0"),
     ([FaultEvent("crash", (9,))], "non-live"),
     ([FaultEvent("join", (1,))], "non-fresh"),
@@ -90,7 +102,10 @@ def test_membership_phases_group_overlapped_events():
         ],
     )
     schedule.validate()
-    assert schedule.membership_phases() == [
+    assert [
+        [(e.kind, e.slots) for e in group]
+        for group in schedule.membership_phases()
+    ] == [
         [("join", (8, 9)), ("crash", (3,))],
         [("leave", (4,))],
     ]

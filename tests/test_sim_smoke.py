@@ -1,12 +1,14 @@
-"""Tier-1 chaos smoke: the seven scenario families over pinned seeds, every
+"""Tier-1 chaos smoke: the ten scenario families over pinned seeds, every
 oracle, explicit CPU budget.
 
-35 pinned (family, seed) runs — the four flat families (partition-heal,
-asymmetric link, crash-during-join, churn-under-loss) plus the three
-WAN-shaped hierarchical families (wan_cohort_asym, delegate_gray_failure,
-cohort_boundary_flap — profile="hier", two cohorts, rapid_tpu/hier) at 5
-seeds each — each through the FULL oracle battery including the
-host<->device differential replay. One test drives the whole grid so the
+50 pinned (family, seed) runs — the four flat families (partition-heal,
+asymmetric link, crash-during-join, churn-under-loss), the two adversarial
+families (false_alert_stability, watermark_probe — Byzantine observers
+against the H/L watermarks), and the four WAN-shaped hierarchical families
+(wan_cohort_asym, delegate_gray_failure, cohort_boundary_flap,
+committee_crash_during_reconfig — profile="hier", two cohorts,
+rapid_tpu/hier) at 5 seeds each — each through the FULL oracle battery
+including the host<->device differential replay. One test drives the whole grid so the
 asserted budget covers everything: the budget is process CPU time (wall
 clock would flake under CI contention), and it bounds what the tier-1 gate
 is allowed to spend on chaos coverage — a regression that slows simulated
@@ -21,7 +23,7 @@ import pytest
 from rapid_tpu.sim.fuzz import FAMILIES, run_schedule, scenario_family
 from rapid_tpu.sim.oracles import check_all
 
-#: 5 pinned seeds per family = 35 pinned scenarios in tier-1.
+#: 5 pinned seeds per family = 50 pinned scenarios in tier-1.
 SEEDS = (1, 2, 3, 4, 5)
 
 #: Process-CPU budget for the full grid, including the engine compile the
@@ -45,10 +47,14 @@ def test_pinned_chaos_grid_upholds_every_oracle():
                     f"{schedule.name}: "
                     + "; ".join(str(v) for v in violations)
                 )
-            if not result.cuts:
+            if not result.cuts and schedule.membership_phases():
+                # Zero cuts is vacuous ONLY when the schedule demands
+                # membership changes; the stable-band adversarial family
+                # (false_alert_stability) holds every report below H, so
+                # "no cut ever" IS the asserted outcome there.
                 failures.append(f"{schedule.name}: produced no cuts (vacuous run)")
     spent = time.process_time() - started
-    assert runs == len(FAMILIES) * len(SEEDS) == 35
+    assert runs == len(FAMILIES) * len(SEEDS) == 50
     assert not failures, "\n".join(failures)
     assert spent < CPU_BUDGET_S, (
         f"chaos smoke burned {spent:.1f}s CPU (budget {CPU_BUDGET_S}s): "

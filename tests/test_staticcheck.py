@@ -284,7 +284,7 @@ def test_narrowed_roots_skip_liveness(tmp_path, monkeypatch):
 
 def test_whole_tree_is_finding_free():
     # The gate itself: resolution-tier findings fail the build exactly the
-    # way error-prone fails the reference's. All thirteen check families
+    # way error-prone fails the reference's. All fourteen check families
     # run — including the compiled-program gate (device_program), whose
     # entrypoint compiles are collected ONCE per process; pre-warm that
     # session cache here so this budget pins the ANALYSIS cost, not the
@@ -299,7 +299,7 @@ def test_whole_tree_is_finding_free():
     elapsed = time.process_time() - started
     assert not findings, "\n".join(str(f) for f in findings)
     assert elapsed < 15.0, (
-        f"thirteen-family tree sweep used {elapsed:.1f}s CPU (budget 15s)"
+        f"fourteen-family tree sweep used {elapsed:.1f}s CPU (budget 15s)"
     )
 
 
@@ -371,6 +371,8 @@ _CORPUS_CHECKERS = {
     "tenant_partition_rule.py": ("rapid_tpu/tenancy/_corpus.py", "check_sharding"),
     "retrace_hazard.py": ("rapid_tpu/models/_corpus.py", "check_sharding"),
     "clean_sharding.py": ("rapid_tpu/parallel/_corpus.py", "check_sharding"),
+    "chaos_unknown_kind.py": ("rapid_tpu/sim/_corpus.py", "check_chaosvocab"),
+    "clean_chaosvocab.py": ("rapid_tpu/sim/_corpus.py", "check_chaosvocab"),
 }
 
 
@@ -807,7 +809,7 @@ def test_cli_json_select_ignore_and_exit_codes(tmp_path):
 
 
 def test_cli_families_lists_all_families():
-    assert len(staticcheck.FAMILIES) == 13
+    assert len(staticcheck.FAMILIES) == 14
     result = _run_cli("--families")
     assert result.returncode == 0
     for name, _description in staticcheck.FAMILIES:

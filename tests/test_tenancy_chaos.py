@@ -86,11 +86,168 @@ def test_unresolved_phase_is_a_convergence_violation(fleet_result):
 def test_compile_tenant_rejects_unreplayable_and_unknown_schedules():
     with pytest.raises(Exception, match="unknown scenario family"):
         chaos.compile_tenant("no_such_family", 0)
-    # Engine families are all flat + restart-free by construction.
+    # Engine families are all flat + restart-free by construction. Every
+    # compiled scenario carries WORK: membership phase groups, or (the
+    # stable-band adversarial shape) a persistent sub-H false-report load
+    # the stability soak judges.
     for family in chaos.ENGINE_FAMILIES:
         scenario = chaos.compile_tenant(family, 3)
         assert scenario.schedule.engine_compatible
-        assert scenario.groups
+        assert scenario.groups or scenario.stable_subjects
+
+
+# ---------------------------------------------------------------------------
+# Adversarial fleet: hostile + hier families mixed, stability soak
+# ---------------------------------------------------------------------------
+
+#: One tenant per fleet family — the mixed hostile workload of
+#: ``chaosrun fuzz --fleet`` at its smallest complete shape. Module-scope:
+#: every adversarial-fleet test below reads this one run (PR 10 budget
+#: convention — one fleet compile, many assertions).
+ADVERSARIAL_SPECS = [
+    (family, 20 + i) for i, family in enumerate(chaos.FLEET_FAMILIES)
+]
+
+
+@pytest.fixture(scope="module")
+def adversarial_result():
+    return chaos.run_fleet(chaos.compile_fleet(ADVERSARIAL_SPECS))
+
+
+def test_fleet_families_cover_every_mix_table_and_lead_adversarial():
+    # FLEET_FAMILIES is hand-ordered (adversarial first) — completeness vs
+    # the engine/hier mix tables must be pinned or a new family could be
+    # silently dropped from the fuzz cycle; and any B >= 3 must carry all
+    # three Byzantine shapes (the small-B bench stage stays adversarial).
+    assert set(chaos.FLEET_FAMILIES) == (
+        set(chaos.ENGINE_FAMILIES) | set(chaos.HIER_FAMILIES)
+    )
+    assert len(chaos.FLEET_FAMILIES) == len(
+        chaos.ENGINE_FAMILIES + chaos.HIER_FAMILIES
+    )
+    assert set(chaos.FLEET_FAMILIES[:3]) == {
+        "false_alert_stability", "watermark_probe",
+        "committee_crash_during_reconfig",
+    }
+
+
+def test_mixed_adversarial_fleet_upholds_every_oracle(adversarial_result):
+    # Honest, Byzantine, and hier cross-product families in ONE fleet: the
+    # whole battery holds, every tenant lands on its schedule's accounting
+    # (including healthy subjects falsely accused past H — evicted, agreed).
+    assert chaos.check_fleet(adversarial_result) == []
+    for i, scenario in enumerate(adversarial_result.scenarios):
+        assert adversarial_result.final_slots[i] == scenario.expected_slots
+
+
+def test_stability_soak_ran_and_stable_tenants_held_the_band(
+    adversarial_result,
+):
+    # The fleet carries sub-H false-report tenants (false_alert_stability),
+    # so the soak must have stepped — and those tenants committed ZERO cuts
+    # through it ("no eviction" is a run, not a vacuous skip).
+    assert adversarial_result.soak_rounds == chaos.STABILITY_SOAK_ROUNDS
+    assert adversarial_result.soak_cuts is not None
+    stable = [
+        i for i, s in enumerate(adversarial_result.scenarios)
+        if s.stable_subjects
+    ]
+    assert stable  # the mix genuinely includes stable-band tenants
+    for i in stable:
+        assert int(adversarial_result.soak_cuts[i]) == 0
+
+
+def test_fleet_run_reports_first_class_throughput(adversarial_result):
+    # scenarios_per_sec is the headline number chaosrun/bench publish:
+    # always present, consistent with the recorded wall clock.
+    assert adversarial_result.wall_ms > 0
+    assert adversarial_result.scenarios_per_sec == pytest.approx(
+        len(ADVERSARIAL_SPECS) / (adversarial_result.wall_ms / 1000.0)
+    )
+
+
+def test_midrun_injection_failure_names_its_tenant():
+    """ISSUE 12 satellite: a scenario whose fault injection raises
+    mid-``run_fleet`` must surface as a ``fleet-injection`` violation
+    naming its tenant index — never a bare exception that kills the other
+    tenants' verdicts."""
+    scenarios = chaos.compile_fleet([("partition_heal", 5), ("crash_during_join", 7)])
+    victim = 1
+    # Tamper the compiled groups with an injection the engine rejects: a
+    # join wave naming a slot outside the cluster's slot table.
+    from rapid_tpu.sim.faults import FaultEvent
+
+    scenarios[victim].groups[0] = [FaultEvent("join", (99,))]
+    result = chaos.run_fleet(scenarios)  # must NOT raise
+    violations = chaos.check_fleet(result)
+    by_tenant = chaos.violating_tenants(violations)
+    assert victim in by_tenant
+    assert "fleet-injection" in by_tenant[victim]
+    # The healthy tenant's verdict is untouched by its neighbor's failure.
+    assert 0 not in by_tenant
+    assert result.final_slots[0] == scenarios[0].expected_slots
+    # And the errored tenant is otherwise skipped, not judged on the state
+    # the failure left behind (exactly one violation for it).
+    assert by_tenant[victim] == ["fleet-injection"]
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant shrinking: the violating tenant collapses to a 1-tenant repro
+# ---------------------------------------------------------------------------
+
+
+def test_tampered_tenant_shrinks_to_minimal_single_tenant_repro(tmp_path):
+    """The PR 5 single-cluster shrinker pin, at the fleet grain: a known
+    two-tenant violating fleet — tenant 1 runs a LOWERED H knob under a
+    stable-band schedule, so the engine evicts a subject the schedule's
+    reference-watermark accounting protects — shrinks to a <=3-event
+    single-tenant repro that still fails IDENTICALLY on replay."""
+    specs = [("partition_heal", 1), ("false_alert_stability", 3)]
+    knobs = [(9, 4, 1), (5, 2, 1)]  # tenant 1: H=5 < the schedule's H=9
+    scenarios = chaos.compile_fleet(specs, knobs=knobs)
+    violations = chaos.check_fleet(chaos.run_fleet(scenarios))
+    by_tenant = chaos.violating_tenants(violations)
+    assert set(by_tenant) == {1}  # only the knob-tampered tenant fails
+    oracles = set(by_tenant[1])
+    assert "fleet-stability" in oracles
+
+    t, minimal, min_violations, runs = chaos.shrink_tenant(
+        chaos.compile_fleet(specs, knobs=knobs), violations
+    )
+    assert t == 1
+    assert len(minimal.events) <= 3
+    assert runs > 0
+    # The reduction preserved the verdict: the same oracle set still flags
+    # the same tenant.
+    assert oracles <= set(chaos.violating_tenants(min_violations)[1])
+
+    # Collapse to a single-tenant repro dir and replay it: the recorded
+    # violations reproduce line for line (the chaosrun replay contract).
+    repro = chaos.write_fleet_repro(
+        tmp_path / "repro", minimal, knobs[1], "false_alert_stability", 3,
+        tenant_index=1, fleet_size=len(specs),
+    )
+    recorded = [
+        line for line in (repro / "violations.txt").read_text().splitlines()
+        if line and line != "(none)"
+    ]
+    assert recorded  # the repro still fails after collapsing to one tenant
+    _result, replayed = chaos.replay_fleet_repro(repro)
+    assert sorted(map(str, replayed)) == sorted(recorded)
+
+
+@pytest.mark.slow
+def test_fleet_fuzz_broad_sweep_is_clean():
+    # Two tenants per family through fuzz_fleet end to end (summary shape,
+    # per-family tallies, no violations). Rides the unfiltered check.sh
+    # pass; the module fixture keeps one-per-family coverage in tier-1.
+    summary = chaos.fuzz_fleet(2 * len(chaos.FLEET_FAMILIES), base_seed=500)
+    assert summary["violations"] == []
+    assert summary["tenants"] == 2 * len(chaos.FLEET_FAMILIES)
+    assert set(summary["families"]) == set(chaos.FLEET_FAMILIES)
+    assert all(n == 2 for n in summary["families"].values())
+    assert summary["family_violations"] == {}
+    assert summary["scenarios_per_sec"] > 0
 
 
 # ---------------------------------------------------------------------------

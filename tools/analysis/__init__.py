@@ -52,6 +52,7 @@ CLI shim) or the build gate in tests/test_staticcheck.py.
 from __future__ import annotations
 
 from . import core
+from .chaosvocab import check_chaosvocab
 from .clocks import CLOCK_DISCIPLINE_PREFIXES, check_clock_injection
 from .concurrency import CONCURRENCY_PREFIXES, check_concurrency
 from .core import (
@@ -110,6 +111,7 @@ __all__ = [
     "TRACE_SAFETY_PREFIXES",
     "WIRE_FILES",
     "check_call_signatures",
+    "check_chaosvocab",
     "check_clock_injection",
     "check_concurrency",
     "check_dead_definitions",

@@ -97,6 +97,9 @@ ALL_CHECK_NAMES = frozenset({
     "host-sync-in-stream",
     "donation-mismatch",
     "retrace-hazard",
+    # chaosvocab family
+    "chaos-unknown-kind",
+    "chaos-family-drift",
 })
 
 #: The check families, in documentation order — one (name, description)
@@ -127,6 +130,9 @@ FAMILIES = (
                  "host syncs in the hot path and the streaming pipeline, "
                  "donation/static-argnames at jit seams "
                  "(ops/models/parallel/serving)"),
+    ("chaosvocab", "chaos vocabulary discipline: FaultEvent kinds, scenario "
+                   "FAMILIES, fleet mix tables, and the chaosrun CLI cannot "
+                   "drift from the registered registries"),
 )
 
 
@@ -192,9 +198,9 @@ def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
     # The per-file check imports live here (not module top level) so the
     # CLI shim can import this module before sys.path is fully arranged.
     from . import (
-        clocks, concurrency, deadcode, determinism, device_program, dispatch,
-        ledger, names, sharding, signatures, taskflow, trace_safety,
-        wire_schema,
+        chaosvocab, clocks, concurrency, deadcode, determinism,
+        device_program, dispatch, ledger, names, sharding, signatures,
+        taskflow, trace_safety, wire_schema,
     )
 
     per_file_checks = [
@@ -208,6 +214,7 @@ def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
         determinism.check_determinism,
         ledger.check_ledger,
         sharding.check_sharding,
+        chaosvocab.check_chaosvocab,
     ]
     full_tree = tuple(roots) == DEFAULT_ROOTS
     if not full_tree:

@@ -9,17 +9,28 @@ Three subcommands:
             timeline with fault-injection annotations (via tools/traceview).
 
 ``fuzz``    N seeded random schedules; every oracle violation is shrunk to a
-            minimal repro and written under the output directory.
+            minimal repro and written under the output directory. With
+            ``--fleet B`` the round instead compiles B mixed scenarios —
+            honest, adversarial (Byzantine false alerts against the H/L
+            watermarks), and hier cross-product families — onto one batched
+            engine fleet (rapid_tpu/tenancy/chaos.py), resolves them in wave
+            dispatches plus the stability soak, and prints wall clock,
+            first-class scenarios/sec, and per-family violation tallies;
+            a violating tenant is shrunk (quiescent-filler probes at the
+            same fleet shape) and written as a single-tenant fleet repro.
 
 ``replay``  re-run a written repro directory; exits nonzero iff the recorded
             violations reproduce (they must — a repro that stops failing is
-            itself news worth printing).
+            itself news worth printing). Fleet repros (the ``fleet.json``
+            marker) replay through the engine fleet path with the recorded
+            per-tenant knobs; sim repros replay through the host runner.
 
 Usage:
 
     python tools/chaosrun.py run partition_heal --seed 3 --artifacts /tmp/r
     python tools/chaosrun.py run --schedule repro/schedule.json
     python tools/chaosrun.py fuzz --seeds 20 --out /tmp/fuzz
+    python tools/chaosrun.py fuzz --fleet 256 --out /tmp/fleet
     python tools/chaosrun.py replay /tmp/fuzz/seed7
 """
 
@@ -77,6 +88,8 @@ def cmd_run(args: argparse.Namespace) -> int:
 
 def cmd_fuzz(args: argparse.Namespace) -> int:
     out = Path(args.out) if args.out else Path(tempfile.mkdtemp(prefix="chaosfuzz-"))
+    if args.fleet:
+        return _fuzz_fleet(args, out)
     seeds = range(args.base_seed, args.base_seed + args.seeds)
     summaries = simfuzz.fuzz(seeds, out_dir=out)
     failing = [s for s in summaries if s["violations"]]
@@ -97,7 +110,36 @@ def cmd_fuzz(args: argparse.Namespace) -> int:
     return 1 if failing else 0
 
 
+def _fuzz_fleet(args: argparse.Namespace, out: Path) -> int:
+    """The batched adversarial round: B scenarios per dispatch through the
+    tenancy fleet, scenarios/sec as the headline, per-family tallies."""
+    from rapid_tpu.tenancy import chaos as tchaos
+
+    summary = tchaos.fuzz_fleet(
+        args.fleet, base_seed=args.base_seed, out_dir=out
+    )
+    for family in sorted(summary["families"]):
+        total = summary["families"][family]
+        bad = summary["family_violations"].get(family, 0)
+        print(f"family {family}: {total - bad}/{total} clean"
+              + (f" ({bad} violating)" if bad else ""))
+    for v in summary["violations"]:
+        print(f"VIOLATION {v}")
+    if "shrunk_tenant" in summary:
+        print(f"shrunk tenant {summary['shrunk_tenant']} to "
+              f"{summary['shrunk_events']} event(s) in "
+              f"{summary['shrink_runs']} probe run(s); repro "
+              f"{summary.get('repro', '(not written)')}")
+    print(f"{summary['tenants']} scenarios in {summary['dispatches']} "
+          f"dispatch(es), {summary['total_cuts']} view changes, "
+          f"{summary['wall_ms']:.0f} ms wall — "
+          f"{summary['scenarios_per_sec']:.1f} scenarios/sec")
+    return 1 if summary["violations"] else 0
+
+
 def cmd_replay(args: argparse.Namespace) -> int:
+    if (Path(args.repro) / "fleet.json").exists():
+        return _replay_fleet(args)
     recorded_path = Path(args.repro) / "violations.txt"
     recorded = (
         [line for line in recorded_path.read_text().splitlines()
@@ -121,6 +163,30 @@ def cmd_replay(args: argparse.Namespace) -> int:
     return 1 if violations else 0
 
 
+def _replay_fleet(args: argparse.Namespace) -> int:
+    """Replay a single-tenant FLEET repro (the per-tenant shrinker's
+    artifact) through the engine fleet path with the recorded knobs."""
+    from rapid_tpu.tenancy import chaos as tchaos
+
+    recorded_path = Path(args.repro) / "violations.txt"
+    recorded = (
+        [line for line in recorded_path.read_text().splitlines()
+         if line and line != "(none)"]
+        if recorded_path.exists()
+        else []
+    )
+    _result, violations = tchaos.replay_fleet_repro(args.repro)
+    for v in violations:
+        print(f"VIOLATION {v}")
+    if recorded and sorted(map(str, violations)) != sorted(recorded):
+        print("chaosrun replay: violations DIVERGED from the recorded repro:",
+              file=sys.stderr)
+        for line in recorded:
+            print(f"  recorded: {line}", file=sys.stderr)
+        return 1
+    return 1 if violations else 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="chaosrun",
@@ -129,12 +195,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     sub = parser.add_subparsers(dest="command", required=True)
 
     run_p = sub.add_parser("run", help="run one named scenario or schedule file")
+    # choices= comes straight from the FAMILIES registry (never a re-typed
+    # list): a typo'd family errors with the real vocabulary, and the
+    # chaosvocab lint pins that this wiring cannot drift.
     run_p.add_argument("family", nargs="?", default=None,
-                       help=f"scenario family: {', '.join(sorted(simfuzz.FAMILIES))} "
-                            "(wan_cohort_asym / delegate_gray_failure / "
-                            "cohort_boundary_flap boot the two-level hierarchical "
-                            "profile, rapid_tpu/hier; traceview lanes their "
-                            "artifacts by cohort)")
+                       choices=sorted(simfuzz.FAMILIES),
+                       help="scenario family (hier-profile families boot the "
+                            "two-level hierarchical protocol, rapid_tpu/hier; "
+                            "traceview lanes their artifacts by cohort)")
     run_p.add_argument("--seed", type=int, default=0)
     run_p.add_argument("--schedule", default=None, metavar="JSON",
                        help="run this schedule file instead of a named family")
@@ -148,6 +216,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     fuzz_p.add_argument("--seeds", type=int, default=10)
     fuzz_p.add_argument("--base-seed", type=int, default=0)
     fuzz_p.add_argument("--out", default=None, metavar="DIR")
+    fuzz_p.add_argument("--fleet", type=int, default=0, metavar="B",
+                        help="instead of host-runner seeds, compile B mixed "
+                             "scenarios (honest + adversarial + hier "
+                             "cross-product families, independent seeds) "
+                             "onto one batched engine fleet and report "
+                             "scenarios/sec + per-family violation tallies; "
+                             "violating tenants shrink to single-tenant "
+                             "fleet repros")
     fuzz_p.set_defaults(fn=cmd_fuzz)
 
     replay_p = sub.add_parser("replay", help="re-run a written repro directory")

@@ -26,8 +26,11 @@ runs, and can I trust the numbers". Two input kinds, freely mixed:
   ``tenant_view_changes_per_sec`` and ``tenant_fleet_status``), and
   ``stream-missing`` (same discipline for the streaming-serving point:
   an audited round omitting BOTH ``stream_view_changes_per_sec`` and
-  ``stream_status``). The N1M, FLEET, and STREAM columns render the
-  headline / fleet / sustained-stream values (or their status markers)
+  ``stream_status``), and ``chaos-missing`` (same discipline for the
+  adversarial-chaos point: an audited round omitting BOTH
+  ``chaos_scenarios_per_sec`` and ``chaos_status``). The N1M, FLEET,
+  STREAM, and CHAOS columns render the headline / fleet /
+  sustained-stream / chaos-throughput values (or their status markers)
   per round.
 
 ``--chrome out.json`` additionally writes Chrome trace-event JSON (the same
@@ -318,6 +321,16 @@ def point_flags(
         and not data.get("stream_status")
     ):
         flags.append("stream-missing")
+    # Chaos discipline (ISSUE 12): same rule for the adversarial-chaos
+    # point — an audited round must carry chaos_scenarios_per_sec or its
+    # explicit chaos_status marker; the chaos throughput metric must never
+    # be silently absent. Pre-audit historical rounds are exempt.
+    if (
+        hlo_audit_table(data) is not None
+        and not isinstance(data.get("chaos_scenarios_per_sec"), (int, float))
+        and not data.get("chaos_status")
+    ):
+        flags.append("chaos-missing")
     if hlo_drift(prev, hlo_audit_table(data)):
         flags.append("hlo-drift")
     if not flags:
@@ -381,9 +394,23 @@ def stream_cell(data: Dict[str, Any]) -> str:
     return str(status) if status else "-"
 
 
+def chaos_cell(data: Dict[str, Any]) -> str:
+    """The CHAOS column: adversarial scenarios resolved (and oracle-checked
+    clean) per second of batched fleet dispatch, with the tenant count when
+    present, else the explicit chaos_status marker, else '-' (pre-chaos
+    rounds)."""
+    value = data.get("chaos_scenarios_per_sec")
+    if isinstance(value, (int, float)):
+        tenants = data.get("chaos_tenants")
+        suffix = f" B={int(tenants)}" if isinstance(tenants, int) else ""
+        return f"{float(value):.1f}/s{suffix}"
+    status = data.get("chaos_status")
+    return str(status) if status else "-"
+
+
 def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
     lines = ["== perf trajectory =="]
-    header = ("ROUND", "METRIC", "VALUE", "N1M", "FLEET", "STREAM",
+    header = ("ROUND", "METRIC", "VALUE", "N1M", "FLEET", "STREAM", "CHAOS",
               "PLATFORM", "VSBASE", "FLAGS")
     rows: List[Tuple[str, ...]] = []
     flag_rows: List[Tuple[str, List[str]]] = []
@@ -402,6 +429,7 @@ def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
             headline_cell(data),
             fleet_cell(data),
             stream_cell(data),
+            chaos_cell(data),
             str(data.get("platform", "-")),
             "-" if vs is None else f"{float(vs):.2f}x"
             + ("@capture" if "vs_baseline_at_capture" in data else ""),

@@ -43,6 +43,7 @@ from analysis import (  # noqa: E402,F401 — re-exported API surface
     TRACE_SAFETY_PREFIXES,
     WIRE_FILES,
     check_call_signatures,
+    check_chaosvocab,
     check_clock_injection,
     check_concurrency,
     check_dead_definitions,
@@ -89,6 +90,7 @@ __all__ = [
     "TRACE_SAFETY_PREFIXES",
     "WIRE_FILES",
     "check_call_signatures",
+    "check_chaosvocab",
     "check_clock_injection",
     "check_concurrency",
     "check_dead_definitions",
