@@ -218,6 +218,76 @@ def derived_metrics(*, n: int, n_join: int, n_crash: int, k_rings: int,
     }
 
 
+#: The deployment-sizing ladder the ROADMAP's 100M question is answered
+#: over: measured-validated bytes/member projected to each scale (the
+#: policy re-derives per N — index lanes re-widen to int32 past 32k slots,
+#: so the 10M/100M rows are honest, not a small-N extrapolation).
+MEM_SIZING_SCALES = (("100k", 100_000), ("1M", 1_000_000),
+                     ("10M", 10_000_000), ("100M", 100_000_000))
+
+
+def memory_report(hlo_audit: dict, *, n: int, k_rings: int, cohorts: int,
+                  fd_window: int = 0, use_pallas: bool = False) -> dict:
+    """The bench's memory-footprint fields (ISSUE 13): bytes/member under
+    the wide / compact / compact+bit-packed layouts at THIS run's geometry,
+    the run's total state bytes, a 100k->100M sizing table, and a
+    never-silently-absent ``mem_status``.
+
+    ``mem_status`` is ``live:hlo-audit`` when the compiled-program audit
+    measured argument bytes for both the wide and compact step entrypoints
+    (memory_analysis() — the formula is then cross-checked against the
+    artifact by tests/test_hlo_gate.py), else ``computed:<why>`` — the
+    formula alone (exact over LANE_SPECS, which the state constructors are
+    pinned against)."""
+    from rapid_tpu.models.state import EngineConfig, state_bytes_per_member
+
+    def cfg_at(n_at: int, compact: int) -> "EngineConfig":
+        return EngineConfig(
+            n=n_at, k=k_rings, h=9, l=4, c=min(cohorts, n_at),
+            fd_window=fd_window, use_pallas=use_pallas, compact=compact,
+        )
+
+    wide_bpm = state_bytes_per_member(cfg_at(n, 0))
+    compact_bpm = state_bytes_per_member(cfg_at(n, 1))
+    packed_bpm = state_bytes_per_member(cfg_at(n, 1), packed=True)
+    if isinstance(hlo_audit, dict) and not ("error" in hlo_audit):
+        have = {
+            name: entry.get("argument_bytes")
+            for name, entry in hlo_audit.items()
+            if isinstance(entry, dict)
+        }
+        if have.get("step") and have.get("step_compact"):
+            mem_status = "live:hlo-audit"
+        else:
+            mem_status = "computed:audit-lacks-step-memory"
+    else:
+        reason = (
+            hlo_audit.get("error", "absent") if isinstance(hlo_audit, dict)
+            else "absent"
+        )
+        mem_status = f"computed:{reason.splitlines()[0][:80]}"
+    sizing = {}
+    for label, n_at in MEM_SIZING_SCALES:
+        w = state_bytes_per_member(cfg_at(n_at, 0))
+        c = state_bytes_per_member(cfg_at(n_at, 1))
+        p = state_bytes_per_member(cfg_at(n_at, 1), packed=True)
+        sizing[label] = {
+            "n": n_at,
+            "wide_gb": round(w * n_at / 1e9, 3),
+            "compact_gb": round(c * n_at / 1e9, 3),
+            "packed_gb": round(p * n_at / 1e9, 3),
+            "bytes_per_member": round(c, 2),
+        }
+    return {
+        "bytes_per_member": round(compact_bpm, 2),
+        "bytes_per_member_wide": round(wide_bpm, 2),
+        "bytes_per_member_packed": round(packed_bpm, 2),
+        "state_bytes_total": int(compact_bpm * n),
+        "mem_status": mem_status,
+        "mem_sizing": sizing,
+    }
+
+
 def hlo_audit_summary() -> dict:
     """Per-entrypoint compiled-program facts at the fixed audit shapes
     (tools/analysis/device_program.py, session-cached): collective counts
@@ -255,6 +325,10 @@ def hlo_audit_summary() -> dict:
             "hot_loop_collectives": sum(v["count"] for v in hot.values()),
             "hot_loop_bytes": sum(v["bytes"] for v in hot.values()),
             "temp_bytes": entry["memory"].get("temp_bytes"),
+            # Per-device argument bytes (memory_analysis): the measured
+            # side of the bytes/member story — step vs step_compact is the
+            # compaction saving at the audit shape.
+            "argument_bytes": entry["memory"].get("argument_bytes"),
             "donation_dropped": entry["donation"]["dropped"],
         }
     return summary
@@ -1100,6 +1174,19 @@ def run_workload(ledger, profile_dir=None) -> None:
             _mark(f"hlo audit unavailable: {hlo_audit['error']}")
         else:
             _mark(f"hlo audit: {len(hlo_audit)} entrypoints compiled")
+        # Memory-footprint fields (ISSUE 13): bytes/member at this run's
+        # geometry + the 100k->100M sizing table, status-stamped from the
+        # audit's memory_analysis — never silently absent.
+        mem_fields = memory_report(
+            hlo_audit, n=n, k_rings=k_rings, cohorts=cohorts,
+            use_pallas=use_pallas,
+        )
+        _mark(
+            f"memory: {mem_fields['bytes_per_member']:.0f} B/member compact "
+            f"vs {mem_fields['bytes_per_member_wide']:.0f} wide "
+            f"({mem_fields['mem_status']}); 100M sizing "
+            f"{mem_fields['mem_sizing']['100M']['compact_gb']:.0f} GB"
+        )
 
     # Opt-in jax.profiler capture (--profile DIR): one extra resolved churn
     # under utils/profiling.trace, as its own budgeted stage — TensorBoard/
@@ -1217,6 +1304,11 @@ def run_workload(ledger, profile_dir=None) -> None:
         # trajectory's communication-budget axis — perfview flags
         # collective-count drift between rounds from this.
         "hlo_audit": hlo_audit,
+        # State-compaction memory axis (ISSUE 13): bytes/member under the
+        # wide/compact/packed layouts, the run's total state bytes, the
+        # 100k->100M deployment sizing, and the never-silently-absent
+        # mem_status — perfview renders the MEM column from these.
+        **mem_fields,
         # Engine-tier provenance for the trajectory: how much compile time
         # this run paid and whether the persistent cache carried it.
         "compiles": engine_compiles["compiles"],

@@ -1,18 +1,30 @@
 from rapid_tpu.models.state import (
+    CompactionPolicy,
     EngineConfig,
     EngineState,
     FaultInputs,
     StepEvents,
+    compaction_policy,
     initial_state,
+    pack_masks,
+    state_bytes_per_member,
+    unpack_masks,
+    widen_state,
 )
 from rapid_tpu.models.virtual_cluster import VirtualCluster, engine_step
 
 __all__ = [
+    "CompactionPolicy",
     "EngineConfig",
     "EngineState",
     "FaultInputs",
     "StepEvents",
+    "compaction_policy",
     "initial_state",
+    "pack_masks",
+    "state_bytes_per_member",
+    "unpack_masks",
+    "widen_state",
     "VirtualCluster",
     "engine_step",
 ]

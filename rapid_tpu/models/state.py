@@ -19,16 +19,32 @@ instances, ``MultiNodeCutDetector.java:31-37``, sampled at C of them).
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import Dict, NamedTuple, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from rapid_tpu.ops.hashing import masked_set_hash
 from rapid_tpu.ops.rings import ring_perms, ring_topology_from_perm
 
 # Sentinel for "this edge's alert has not fired": far enough in the future
-# that (round_idx - FIRE_NEVER) stays hugely negative in int32.
+# that (round_idx - FIRE_NEVER) stays hugely negative in int32. The compact
+# int16 storage uses FIRE_NEVER_NARROW instead; the invariant (an unfired
+# edge's age stays negative for every in-envelope round index, under the
+# NARROWEST round dtype the policy can pick) is pinned by
+# tests/test_state_compaction.py::test_fire_never_sentinel_invariant —
+# a test, not just this comment.
 FIRE_NEVER = 1 << 30
+#: The int16-storage sentinel: fire rounds are real (< ROUND_ENVELOPE)
+#: or this. Kept a power of two with headroom so (round_idx - sentinel)
+#: is not merely negative but ~-2^14 at the envelope edge.
+FIRE_NEVER_NARROW = 1 << 14
+#: Operating envelope of the compact round counter: a single configuration
+#: may run at most this many rounds before fire_round narrowing (int16,
+#: FIRE_NEVER_NARROW sentinel) loses the unfired/fired distinction. Every
+#: view change resets round_idx to 0; tier-1 dispatch budgets are <= 255
+#: rounds, so the envelope holds ~64 maximal dispatches per configuration.
+ROUND_ENVELOPE = FIRE_NEVER_NARROW - 1
 
 
 class EngineConfig(NamedTuple):
@@ -89,10 +105,180 @@ class EngineConfig(NamedTuple):
     # bit-identical across widths. Tune per shape with
     # examples/delivery_autotune.py on hardware.
     pallas_lanes: int = 128
+    # State-compaction level (an int, not a string: EngineConfig persists
+    # as an int64 vector in checkpoints). 0 = the historical wide
+    # int32/uint32 layout (the differential oracle); 1 = config-derived
+    # dtype narrowing per :func:`compaction_policy` — every lane stored at
+    # the minimal legal dtype for this config's K/C/N/fd_window, arithmetic
+    # accumulated at >= int32 and bit-identical to wide within the
+    # documented envelopes (ROUND_ENVELOPE rounds and < 2^15 - 1 classic
+    # attempts / fd events per configuration).
+    compact: int = 0
+
+
+class CompactionPolicy(NamedTuple):
+    """Per-lane storage dtypes, a pure function of :class:`EngineConfig`
+    (:func:`compaction_policy`). Dtype fields are numpy dtype NAMES (strings
+    keep the policy hashable and trivially serializable); ``fire_never`` is
+    the "edge never fired" sentinel legal at the ``round`` dtype.
+
+    Lane kinds:
+
+    - ``idx``     — ring/topology index tables and cp rank indices, values
+                    in [-1, n-1]: int8 below 129 slots, int16 below 32769.
+    - ``cohort``  — receiver-cohort indices, values in [-1, c-1]: int8
+                    below 128 cohorts (c is capped at 1024 -> never wider
+                    than int16).
+    - ``counter`` — fd_count / classic-Paxos rank rounds / classic_epoch /
+                    rounds_undecided: int16 (envelope: < 2^15 - 1 events
+                    per configuration; every view change resets them).
+    - ``hist``    — fd_hist bit-history: the minimal unsigned dtype holding
+                    ``fd_window`` bits (uint8 for the counter mode's unused
+                    lane and windows <= 8).
+    - ``report``  — report_bits ring bitmasks: the minimal unsigned dtype
+                    holding K bits. Held at uint32 under ``use_pallas``
+                    (the Mosaic delivery kernel emits uint32 words).
+    - ``round``   — fire_round: int16 with the FIRE_NEVER_NARROW sentinel
+                    (envelope: ROUND_ENVELOPE rounds per configuration).
+    """
+
+    idx: str
+    cohort: str
+    counter: str
+    hist: str
+    report: str
+    round: str
+    fire_never: int
+
+
+#: The historical layout — and the differential oracle the compact path is
+#: pinned bit-identical against.
+WIDE_POLICY = CompactionPolicy(
+    idx="int32", cohort="int32", counter="int32", hist="uint32",
+    report="uint32", round="int32", fire_never=FIRE_NEVER,
+)
+
+#: EngineState/FaultInputs lanes the derived policy may store below 32 bits
+#: — the ``dtype-widening`` lint (tools/analysis/sharding.py) watches
+#: arithmetic on exactly these names; the two sets are pinned equal by
+#: tests/test_state_compaction.py.
+NARROWABLE_LANES = frozenset({
+    "ring_perm", "obs_idx", "subj_idx", "inval_obs", "cohort_of",
+    "fd_count", "fd_hist", "fire_round", "report_bits",
+    "cp_rnd_r", "cp_rnd_i", "cp_vrnd_r", "cp_vrnd_i", "cp_vval_src",
+    "classic_epoch", "rounds_undecided",
+})
+
+
+def min_index_dtype(n: int) -> str:
+    """Smallest signed dtype holding indices in [-1, n-1]."""
+    if n <= 1 << 7:
+        return "int8"
+    if n <= 1 << 15:
+        return "int16"
+    return "int32"
+
+
+def _min_bits_dtype(bits: int) -> str:
+    """Smallest unsigned dtype holding a ``bits``-wide bitmask."""
+    if bits <= 8:
+        return "uint8"
+    if bits <= 16:
+        return "uint16"
+    return "uint32"
+
+
+def compaction_policy(cfg: "EngineConfig") -> CompactionPolicy:
+    """THE config->dtype derivation (pure; the compiled program's layout is
+    a function of the static config, so a policy change is a recompile,
+    never a silent reinterpretation). ``cfg.compact == 0`` returns the wide
+    oracle layout unchanged."""
+    if not cfg.compact:
+        return WIDE_POLICY
+    return CompactionPolicy(
+        idx=min_index_dtype(cfg.n),
+        cohort=min_index_dtype(cfg.c),
+        counter="int16",
+        # fd_window == 0 (counter mode) leaves fd_hist unused — store the
+        # all-zeros lane at the minimal width rather than special-casing.
+        hist=_min_bits_dtype(max(cfg.fd_window, 1)),
+        report="uint32" if cfg.use_pallas else _min_bits_dtype(cfg.k),
+        round="int16",
+        fire_never=FIRE_NEVER_NARROW,
+    )
+
+
+#: field -> (shape symbols over (n, k, c), policy-kind). One table for BOTH
+#: pytrees (the namespaces share no field name); the policy kinds "uint32"
+#: / "int32" / "bool" are fixed-width (hash lanes, scalars the drivers
+#: fetch, membership masks).
+LANE_SPECS: Dict[str, Tuple[Tuple[str, ...], str]] = {
+    # EngineState
+    "key_hi": (("k", "n"), "uint32"),
+    "key_lo": (("k", "n"), "uint32"),
+    "ring_perm": (("k", "n"), "idx"),
+    "id_hi": (("n",), "uint32"),
+    "id_lo": (("n",), "uint32"),
+    "alive": (("n",), "bool"),
+    "obs_idx": (("k", "n"), "idx"),
+    "subj_idx": (("k", "n"), "idx"),
+    "inval_obs": (("k", "n"), "idx"),
+    "config_epoch": ((), "int32"),
+    "config_hi": ((), "uint32"),
+    "config_lo": ((), "uint32"),
+    "n_members": ((), "int32"),
+    "fd_count": (("n", "k"), "counter"),
+    "fd_hist": (("n", "k"), "hist"),
+    "fd_fired": (("n", "k"), "bool"),
+    "fire_round": (("n", "k"), "round"),
+    "join_pending": (("n",), "bool"),
+    "cohort_of": (("n",), "cohort"),
+    "report_bits": (("c", "n"), "report"),
+    "seen_down": (("c",), "bool"),
+    "released": (("c", "n"), "bool"),
+    "announced": (("c",), "bool"),
+    "prop_mask": (("c", "n"), "bool"),
+    "prop_hi": (("c",), "uint32"),
+    "prop_lo": (("c",), "uint32"),
+    "vote_hi": (("n",), "uint32"),
+    "vote_lo": (("n",), "uint32"),
+    "vote_valid": (("n",), "bool"),
+    "rounds_undecided": ((), "counter"),
+    "cp_rnd_r": (("n",), "counter"),
+    "cp_rnd_i": (("n",), "idx"),
+    "cp_vrnd_r": (("n",), "counter"),
+    "cp_vrnd_i": (("n",), "idx"),
+    "cp_vval_src": (("n",), "cohort"),
+    "classic_epoch": ((), "counter"),
+    "round_idx": ((), "int32"),
+    "retired": (("n",), "bool"),
+    # FaultInputs
+    "crashed": (("n",), "bool"),
+    "probe_fail": (("n", "k"), "bool"),
+    "rx_block": (("c", "n"), "bool"),
+}
+
+
+def lane_dtypes(cfg: "EngineConfig") -> Dict[str, str]:
+    """field -> numpy dtype name under this config's policy, for every
+    EngineState/FaultInputs lane."""
+    pol = compaction_policy(cfg)
+    kinds = {
+        "idx": pol.idx, "cohort": pol.cohort, "counter": pol.counter,
+        "hist": pol.hist, "report": pol.report, "round": pol.round,
+        "uint32": "uint32", "int32": "int32", "bool": "bool",
+    }
+    return {field: kinds[kind] for field, (_shape, kind) in LANE_SPECS.items()}
 
 
 class EngineState(NamedTuple):
-    """Device state for one virtual cluster (all arrays padded to n slots)."""
+    """Device state for one virtual cluster (all arrays padded to n slots).
+
+    Dtype comments below are the WIDE (``compact=0``) layout; under
+    ``compact=1`` every lane named in :data:`NARROWABLE_LANES` is stored at
+    :func:`compaction_policy`'s minimal dtype instead (same shapes, same
+    values, bit-identical protocol behavior within the documented
+    envelopes)."""
 
     # Identity & topology (key lanes static per slot; topology re-derived on
     # view change).
@@ -182,9 +368,12 @@ def initial_state(cfg: EngineConfig, key_hi, key_lo, id_hi, id_lo, alive) -> Eng
             f"({cfg.fd_window}): the edge could never fire"
         )
     alive = jnp.asarray(alive, dtype=bool)
+    pol = compaction_policy(cfg)
+    idt, cdt = jnp.dtype(pol.idx), jnp.dtype(pol.cohort)
+    ndt, rdt = jnp.dtype(pol.counter), jnp.dtype(pol.round)
     # The one sort: ring keys are static per slot, so every topology after
     # this (including every view change) is O(N) scans over these perms.
-    perm = ring_perms(jnp.asarray(key_hi), jnp.asarray(key_lo))
+    perm = ring_perms(jnp.asarray(key_hi), jnp.asarray(key_lo)).astype(idt)
     topo = ring_topology_from_perm(perm, alive)
     config_hi, config_lo = masked_set_hash(jnp.asarray(id_hi), jnp.asarray(id_lo), alive)
     n, k, c = cfg.n, cfg.k, cfg.c
@@ -195,22 +384,22 @@ def initial_state(cfg: EngineConfig, key_hi, key_lo, id_hi, id_lo, alive) -> Eng
         id_hi=jnp.asarray(id_hi, dtype=jnp.uint32),
         id_lo=jnp.asarray(id_lo, dtype=jnp.uint32),
         alive=alive,
-        obs_idx=topo.obs_idx,
-        subj_idx=topo.subj_idx,
+        obs_idx=topo.obs_idx.astype(idt),
+        subj_idx=topo.subj_idx.astype(idt),
         # A copy, not an alias: engine_step donates its input state, and the
         # runtime rejects the same buffer donated twice.
-        inval_obs=topo.obs_idx + 0,
+        inval_obs=jnp.copy(topo.obs_idx.astype(idt)),
         config_epoch=jnp.int32(0),
         config_hi=config_hi,
         config_lo=config_lo,
         n_members=jnp.sum(alive, dtype=jnp.int32),
-        fd_count=jnp.zeros((n, k), dtype=jnp.int32),
-        fd_hist=jnp.zeros((n, k), dtype=jnp.uint32),
+        fd_count=jnp.zeros((n, k), dtype=ndt),
+        fd_hist=jnp.zeros((n, k), dtype=jnp.dtype(pol.hist)),
         fd_fired=jnp.zeros((n, k), dtype=bool),
-        fire_round=jnp.full((n, k), FIRE_NEVER, dtype=jnp.int32),
+        fire_round=jnp.full((n, k), pol.fire_never, dtype=rdt),
         join_pending=jnp.zeros((n,), dtype=bool),
-        cohort_of=jnp.zeros((n,), dtype=jnp.int32),
-        report_bits=jnp.zeros((c, n), dtype=jnp.uint32),
+        cohort_of=jnp.zeros((n,), dtype=cdt),
+        report_bits=jnp.zeros((c, n), dtype=jnp.dtype(pol.report)),
         seen_down=jnp.zeros((c,), dtype=bool),
         released=jnp.zeros((c, n), dtype=bool),
         announced=jnp.zeros((c,), dtype=bool),
@@ -220,13 +409,13 @@ def initial_state(cfg: EngineConfig, key_hi, key_lo, id_hi, id_lo, alive) -> Eng
         vote_hi=jnp.zeros((n,), dtype=jnp.uint32),
         vote_lo=jnp.zeros((n,), dtype=jnp.uint32),
         vote_valid=jnp.zeros((n,), dtype=bool),
-        rounds_undecided=jnp.int32(0),
-        cp_rnd_r=jnp.zeros((n,), dtype=jnp.int32),
-        cp_rnd_i=jnp.zeros((n,), dtype=jnp.int32),
-        cp_vrnd_r=jnp.zeros((n,), dtype=jnp.int32),
-        cp_vrnd_i=jnp.zeros((n,), dtype=jnp.int32),
-        cp_vval_src=jnp.full((n,), -1, dtype=jnp.int32),
-        classic_epoch=jnp.int32(0),
+        rounds_undecided=jnp.zeros((), dtype=ndt),
+        cp_rnd_r=jnp.zeros((n,), dtype=ndt),
+        cp_rnd_i=jnp.zeros((n,), dtype=idt),
+        cp_vrnd_r=jnp.zeros((n,), dtype=ndt),
+        cp_vrnd_i=jnp.zeros((n,), dtype=idt),
+        cp_vval_src=jnp.full((n,), -1, dtype=cdt),
+        classic_epoch=jnp.zeros((), dtype=ndt),
         round_idx=jnp.int32(0),
         retired=jnp.zeros((n,), dtype=bool),
     )
@@ -267,3 +456,199 @@ class StepEvents(NamedTuple):
     # step sees post-reset zeros — observers must use these instead).
     prop_hi: jnp.ndarray  # [c] uint32
     prop_lo: jnp.ndarray  # [c] uint32
+
+
+# ---------------------------------------------------------------------------
+# Wide <-> compact converters (the differential seam)
+# ---------------------------------------------------------------------------
+
+
+def _cast_lanes(tree, dtypes: Dict[str, str], fire_never_src: int, fire_never_out: int):
+    """Cast every lane of an EngineState/FaultInputs pytree to ``dtypes``,
+    remapping the source layout's fire_round sentinel to
+    ``fire_never_out``. Elementwise converts only — jit-safe."""
+    out = {}
+    for field, value in tree._asdict().items():
+        dt = jnp.dtype(dtypes[field])
+        if field == "fire_round":
+            value = jnp.where(
+                value == jnp.asarray(fire_never_src, value.dtype),
+                jnp.asarray(fire_never_out, dt),
+                value.astype(dt),
+            )
+        out[field] = value.astype(dt)
+    return type(tree)(**out)
+
+
+def widen_state(cfg: EngineConfig, state: EngineState) -> EngineState:
+    """A compact state as the wide int32/uint32 layout (sentinel remapped to
+    :data:`FIRE_NEVER`). The identity on an already-wide state — which is
+    what lets every wide-vs-compact differential compare
+    ``widen_state(compact_cfg, compact_state)`` against the oracle's state
+    leaf-for-leaf, bit-for-bit."""
+    return _cast_lanes(
+        state, lane_dtypes(cfg._replace(compact=0)),
+        compaction_policy(cfg).fire_never, FIRE_NEVER,
+    )
+
+
+def narrow_state(cfg: EngineConfig, state: EngineState) -> EngineState:
+    """A WIDE state at ``cfg``'s compact policy dtypes (inverse of
+    :func:`widen_state` within the envelopes). Host callers migrating
+    checkpoints should validate ranges first (:func:`validate_envelope`) —
+    the cast itself wraps silently, as device casts do."""
+    return _cast_lanes(
+        state, lane_dtypes(cfg), FIRE_NEVER, compaction_policy(cfg).fire_never
+    )
+
+
+def validate_envelope(cfg: EngineConfig, state: EngineState) -> None:
+    """Host-side (fetching) range check that a WIDE state fits ``cfg``'s
+    compact policy: counters within int16, round_idx within
+    ROUND_ENVELOPE, fire rounds real-or-sentinel. Raises ValueError naming
+    the first offending lane — the loud alternative to a wrapping cast."""
+    pol = compaction_policy(cfg)
+    if pol == WIDE_POLICY:
+        return
+    limits = {
+        "fd_count": (-(1 << 15), (1 << 15) - 1),
+        "cp_rnd_r": (0, (1 << 15) - 1),
+        "cp_vrnd_r": (0, (1 << 15) - 1),
+        "classic_epoch": (0, (1 << 15) - 1),
+        "rounds_undecided": (0, (1 << 15) - 1),
+        "round_idx": (0, ROUND_ENVELOPE),
+    }
+    for field, (lo, hi) in limits.items():
+        arr = np.asarray(getattr(state, field))
+        if arr.size and (int(arr.min()) < lo or int(arr.max()) > hi):
+            raise ValueError(
+                f"state lane {field!r} range [{arr.min()}, {arr.max()}] "
+                f"exceeds the compact envelope [{lo}, {hi}]"
+            )
+    fire = np.asarray(state.fire_round)
+    real = fire[fire != FIRE_NEVER]
+    if real.size and (int(real.min()) < 0 or int(real.max()) > ROUND_ENVELOPE):
+        raise ValueError(
+            f"fire_round carries a non-sentinel value outside "
+            f"[0, {ROUND_ENVELOPE}]: [{real.min()}, {real.max()}]"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Opt-in bit-packed bool masks (pack/unpack ops + whole-pytree converters)
+# ---------------------------------------------------------------------------
+
+#: bool lane -> the SLOT axis it packs 8-to-a-byte along (the n dimension:
+#: the only axis guaranteed large; [c]-only lanes stay bool — a cohort
+#: count need not divide 8 and saves c/8 bytes total).
+PACKED_MASK_AXES: Dict[str, int] = {
+    "alive": 0, "join_pending": 0, "vote_valid": 0, "retired": 0,
+    "fd_fired": 0, "released": 1, "prop_mask": 1,
+    "crashed": 0, "probe_fail": 0, "rx_block": 1,
+}
+
+
+def pack_bool(mask: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Pack a bool array 8-to-a-byte along ``axis`` (little-endian within
+    the byte: element i rides bit i%8 of word i//8). The axis length must
+    divide 8 — pad the mask (``parallel.mesh.pad_to_multiple``) first."""
+    mask = jnp.asarray(mask, dtype=bool)
+    size = mask.shape[axis]
+    if size % 8:
+        raise ValueError(
+            f"pack_bool axis {axis} has length {size}, not a multiple of 8"
+        )
+    moved = jnp.moveaxis(mask, axis, -1)
+    grouped = moved.reshape(*moved.shape[:-1], size // 8, 8)
+    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))
+    words = jnp.sum(grouped.astype(jnp.uint8) * weights, axis=-1, dtype=jnp.uint8)
+    return jnp.moveaxis(words, -1, axis)
+
+
+def unpack_bool(words: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Inverse of :func:`pack_bool`: uint8 words -> the bool mask (length
+    8x along ``axis``)."""
+    moved = jnp.moveaxis(jnp.asarray(words, dtype=jnp.uint8), axis, -1)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (moved[..., None] >> shifts) & jnp.uint8(1)
+    flat = bits.reshape(*moved.shape[:-1], moved.shape[-1] * 8)
+    return jnp.moveaxis(flat, -1, axis).astype(bool)
+
+
+def pack_masks(tree):
+    """The opt-in bit-packed representation of an EngineState/FaultInputs
+    pytree: every bool lane in :data:`PACKED_MASK_AXES` packed along its
+    slot axis (shape [n] -> [n/8], [c, n] -> [c, n/8], [n, k] -> [n/8, k]).
+    Same field names — the :data:`parallel.mesh.PARTITION_RULES` table and
+    :func:`parallel.mesh.shard_pytree`'s divisibility validation cover the
+    packed shapes unchanged. Requires n % 8 == 0."""
+    return type(tree)(**{
+        field: (
+            pack_bool(value, axis=PACKED_MASK_AXES[field])
+            if field in PACKED_MASK_AXES
+            else value
+        )
+        for field, value in tree._asdict().items()
+    })
+
+
+def unpack_masks(tree):
+    """Inverse of :func:`pack_masks` (exact: pack/unpack is a bijection on
+    whole bytes)."""
+    return type(tree)(**{
+        field: (
+            unpack_bool(value, axis=PACKED_MASK_AXES[field])
+            if field in PACKED_MASK_AXES
+            else value
+        )
+        for field, value in tree._asdict().items()
+    })
+
+
+# ---------------------------------------------------------------------------
+# Sizing: bytes/member as a pure function of the config (the bench's
+# 10M/100M deployment-sizing table reads exactly this)
+# ---------------------------------------------------------------------------
+
+
+def _lane_elems(shape_syms: Tuple[str, ...], n: int, k: int, c: int) -> int:
+    dims = {"n": n, "k": k, "c": c}
+    total = 1
+    for sym in shape_syms:
+        total *= dims[sym]
+    return total
+
+
+def state_bytes_total(cfg: EngineConfig, packed: bool = False) -> int:
+    """Total at-rest bytes of one cluster's EngineState + FaultInputs under
+    ``cfg``'s policy (``packed=True`` additionally prices the opt-in
+    bit-packed bool masks). Exact: LANE_SPECS mirrors the constructors
+    field-for-field (pinned by tests/test_state_compaction.py against a
+    real state pytree's leaf nbytes)."""
+    dtypes = lane_dtypes(cfg)
+    total = 0
+    for field, (shape_syms, _kind) in LANE_SPECS.items():
+        elems = _lane_elems(shape_syms, cfg.n, cfg.k, cfg.c)
+        if packed and field in PACKED_MASK_AXES:
+            # Packs along an n-sized axis: 1 bit per element.
+            total += (elems + 7) // 8
+        else:
+            total += elems * np.dtype(dtypes[field]).itemsize
+    return total
+
+
+def state_bytes_per_member(cfg: EngineConfig, packed: bool = False) -> float:
+    """Per-slot state footprint — the scale metric ROADMAP item 5's 100M
+    sizing is computed from."""
+    return state_bytes_total(cfg, packed=packed) / cfg.n
+
+
+def pytree_nbytes(tree) -> int:
+    """Logical bytes of a pytree's array leaves (works on ShapeDtypeStructs
+    and concrete arrays alike — no fetch)."""
+    import jax
+
+    return sum(
+        int(np.prod(leaf.shape, dtype=np.int64)) * np.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree_util.tree_leaves(tree)
+    )

@@ -24,11 +24,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from rapid_tpu.models.state import (
-    FIRE_NEVER,
     EngineConfig,
     EngineState,
     FaultInputs,
     StepEvents,
+    compaction_policy,
     initial_state,
 )
 from rapid_tpu.ops.consensus import tally_candidates
@@ -116,8 +116,12 @@ def _fd_tick(cfg: EngineConfig, state: EngineState, faults: FaultInputs, observe
         # still jitter detection by delaying window-full.
         probed = observer_active & state.alive[:, None]
         fd_count = jnp.where(probed, state.fd_count + 1, state.fd_count)
-        window_mask = jnp.uint32((1 << cfg.fd_window) - 1)
-        shifted = ((state.fd_hist << 1) | probe_failed.astype(jnp.uint32)) & window_mask
+        # Mask and OR-in at the lane's own (policy) dtype: a uint32 operand
+        # here would silently re-widen the whole history lane (the
+        # dtype-widening lint class) — fd_window <= 8*itemsize by policy.
+        hdt = state.fd_hist.dtype
+        window_mask = jnp.asarray((1 << cfg.fd_window) - 1, hdt)
+        shifted = ((state.fd_hist << 1) | probe_failed.astype(hdt)) & window_mask
         fd_hist = jnp.where(probed, shifted, state.fd_hist)
         past_threshold = (_popcount32(fd_hist) >= cfg.fd_threshold) & (
             fd_count >= cfg.fd_window
@@ -170,7 +174,10 @@ def _deliver_alerts(cfg: EngineConfig, state: EngineState, fire_round, blocked_r
     slot_salt = jnp.arange(n, dtype=jnp.uint32) * jnp.uint32(0x85EBCA77)
     epoch_salt = state.config_epoch.astype(jnp.uint32) * jnp.uint32(0x27D4EB2F)
 
-    new_bits = jnp.zeros((c, n), dtype=jnp.uint32)
+    # Accumulate at the report lane's own (policy) dtype: K <= 8*itemsize
+    # by construction, and a uint32 accumulator would re-widen the merge.
+    rdt = state.report_bits.dtype
+    new_bits = jnp.zeros((c, n), dtype=rdt)
     for ring in range(k):
         blocked = (blocked_rows[word_idx * k + ring, :] >> bit_idx[:, None]) & 1  # [c, n]
         if cfg.delivery_spread > 0:
@@ -195,7 +202,7 @@ def _deliver_alerts(cfg: EngineConfig, state: EngineState, fire_round, blocked_r
         else:
             delay = 0
         delivered = (age_kn[ring][None, :] >= delay) & (blocked == 0)  # [c, n]
-        new_bits = new_bits | (delivered.astype(jnp.uint32) << jnp.uint32(ring))
+        new_bits = new_bits | (delivered.astype(rdt) << jnp.asarray(ring, rdt))
     return new_bits
 
 
@@ -235,7 +242,12 @@ def _compute_round(
         edge_masks = _edge_masks(cfg, state, faults)
     observer_active, blocked_rows = edge_masks
     fd_count, fd_hist, fd_fired, fire = _fd_tick(cfg, state, faults, observer_active)
-    fire_round = jnp.where(fire, state.round_idx, state.fire_round)
+    # Stamp at the lane's (policy) dtype: round_idx is int32 and a bare
+    # where() would re-widen the whole [n, k] lane. In-envelope round
+    # indices (< fire_never) cast losslessly.
+    fire_round = jnp.where(
+        fire, state.round_idx.astype(state.fire_round.dtype), state.fire_round
+    )
     alerts_emitted = jnp.sum(fire, dtype=jnp.int32)
 
     # 2. Broadcast delivery: alert for edge (s, ring) originates at the edge's
@@ -257,7 +269,7 @@ def _compute_round(
     new_bits = jax.lax.cond(
         need_delivery,
         lambda: _deliver_alerts(cfg, state, fire_round, blocked_rows),
-        lambda: jnp.zeros((c, n), dtype=jnp.uint32),
+        lambda: jnp.zeros((c, n), dtype=state.report_bits.dtype),
     )
     # Alerts for ALIVE subjects are DOWN reports; join-pending subjects'
     # reports are UP and must not arm implicit invalidation.
@@ -328,10 +340,15 @@ def _compute_round(
     #     path skips the cumsum/gathers entirely.
     def classic_attempt(cp):
         cp_rnd_r, cp_rnd_i, cp_vrnd_r, cp_vrnd_i, cp_vval_src = cp
+        # Lane (policy) dtypes the attempt's stores must land at: racer
+        # indices/ranks computed in int32 and narrowed on store — a bare
+        # int32 operand in a where() would silently re-widen the lane.
+        idt = cp_rnd_i.dtype
+        cdt = cp_vval_src.dtype
         active = state.alive & ~faults.crashed
         n_active = jnp.sum(active, dtype=jnp.int32)
         majority = state.n_members // 2 + 1
-        round_num = 2 + state.classic_epoch
+        round_num = 2 + state.classic_epoch  # stays at the counter dtype
         slot_ids = jnp.arange(n, dtype=jnp.int32)
         cohort_ids = jnp.arange(c, dtype=jnp.int32)
         active_rank = jnp.cumsum(active.astype(jnp.int32))
@@ -352,7 +369,7 @@ def _compute_round(
                 + 1,
                 1,
             )
-            coords.append(jnp.argmax(active & (active_rank == target)).astype(jnp.int32))
+            coords.append(jnp.argmax(active & (active_rank == target)).astype(idt))
 
         # Distinct racers only: a duplicate pick would duplicate a rank.
         valid = []
@@ -394,9 +411,9 @@ def _compute_round(
             )
             chosen = jnp.where(
                 jnp.any(max_counts > 0),
-                jnp.argmax(max_counts).astype(jnp.int32),
+                jnp.argmax(max_counts).astype(cdt),
                 jnp.where(
-                    jnp.any(announced), jnp.argmax(announced).astype(jnp.int32), -1
+                    jnp.any(announced), jnp.argmax(announced).astype(cdt), -1
                 ),
             )
             per.append((coord, hears_coord, promise, phase1_ok, chosen))
@@ -434,7 +451,7 @@ def _compute_round(
             accept_count = jnp.sum(can_accept, dtype=jnp.int32)
             won = phase1_ok & (chosen >= 0) & (accept_count >= majority)
             fb_decided = fb_decided | won
-            chosen_winner = jnp.where(won, chosen, chosen_winner)
+            chosen_winner = jnp.where(won, chosen.astype(jnp.int32), chosen_winner)
             acc_r = jnp.where(can_accept, round_num, acc_r)
             acc_i = jnp.where(can_accept, coord, acc_i)
             acc_src = jnp.where(can_accept, chosen, acc_src)
@@ -553,9 +570,14 @@ def apply_view_change_impl(
     DOWN alerts, which re-fire from the persistent crash masks, a wiped UP
     edge would never re-fire and the joiner would be stranded forever."""
     n, k, c = cfg.n, cfg.k, cfg.c
+    pol = compaction_policy(cfg)
+    idt, cdt = jnp.dtype(pol.idx), jnp.dtype(pol.cohort)
+    ndt, rdt = jnp.dtype(pol.counter), jnp.dtype(pol.round)
     alive2 = state.alive ^ winner_mask
     # Sort-free: O(N) scans over the static key-order perms, not a K-ring
     # argsort — at N=1M the re-sort was the commit path's largest block.
+    # The topology kernels compute at int32; stores narrow to the policy's
+    # index dtype (lossless: values in [-1, n-1]).
     topo = ring_topology_from_perm(state.ring_perm, alive2)
     config_hi, config_lo = masked_set_hash(state.id_hi, state.id_lo, alive2)
     still_pending = state.join_pending & ~winner_mask  # [n]
@@ -564,19 +586,23 @@ def apply_view_change_impl(
         alive=alive2,
         # Departing members' identity lanes are spent forever.
         retired=state.retired | (winner_mask & state.alive),
-        obs_idx=jnp.where(still_pending[None, :], state.obs_idx, topo.obs_idx),
-        subj_idx=topo.subj_idx,
-        inval_obs=jnp.where(still_pending[None, :], state.inval_obs, topo.obs_idx),
+        obs_idx=jnp.where(
+            still_pending[None, :], state.obs_idx, topo.obs_idx.astype(idt)
+        ),
+        subj_idx=topo.subj_idx.astype(idt),
+        inval_obs=jnp.where(
+            still_pending[None, :], state.inval_obs, topo.obs_idx.astype(idt)
+        ),
         config_epoch=state.config_epoch + 1,
         config_hi=config_hi,
         config_lo=config_lo,
         n_members=jnp.sum(alive2, dtype=jnp.int32),
-        fd_count=jnp.zeros((n, k), dtype=jnp.int32),
-        fd_hist=jnp.zeros((n, k), dtype=jnp.uint32),
+        fd_count=jnp.zeros((n, k), dtype=ndt),
+        fd_hist=jnp.zeros((n, k), dtype=jnp.dtype(pol.hist)),
         fd_fired=fd_fired2,
-        fire_round=jnp.where(fd_fired2, 0, FIRE_NEVER),
+        fire_round=jnp.where(fd_fired2, 0, jnp.asarray(pol.fire_never, rdt)),
         join_pending=still_pending,
-        report_bits=jnp.zeros((c, n), dtype=jnp.uint32),
+        report_bits=jnp.zeros((c, n), dtype=jnp.dtype(pol.report)),
         seen_down=jnp.zeros((c,), dtype=bool),
         released=jnp.zeros((c, n), dtype=bool),
         announced=jnp.zeros((c,), dtype=bool),
@@ -586,13 +612,13 @@ def apply_view_change_impl(
         vote_hi=jnp.zeros((n,), dtype=jnp.uint32),
         vote_lo=jnp.zeros((n,), dtype=jnp.uint32),
         vote_valid=jnp.zeros((n,), dtype=bool),
-        rounds_undecided=jnp.int32(0),
-        cp_rnd_r=jnp.zeros((n,), dtype=jnp.int32),
-        cp_rnd_i=jnp.zeros((n,), dtype=jnp.int32),
-        cp_vrnd_r=jnp.zeros((n,), dtype=jnp.int32),
-        cp_vrnd_i=jnp.zeros((n,), dtype=jnp.int32),
-        cp_vval_src=jnp.full((n,), -1, dtype=jnp.int32),
-        classic_epoch=jnp.int32(0),
+        rounds_undecided=jnp.zeros((), dtype=ndt),
+        cp_rnd_r=jnp.zeros((n,), dtype=ndt),
+        cp_rnd_i=jnp.zeros((n,), dtype=idt),
+        cp_vrnd_r=jnp.zeros((n,), dtype=ndt),
+        cp_vrnd_i=jnp.zeros((n,), dtype=idt),
+        cp_vval_src=jnp.full((n,), -1, dtype=cdt),
+        classic_epoch=jnp.zeros((), dtype=ndt),
         round_idx=jnp.int32(0),
     )
 
@@ -834,10 +860,14 @@ class VirtualCluster(DispatchSeam):
         fd_window: int = 0,
         delivery_prob_permille: int = 1000,
         pallas_lanes: int = 128,
+        compact: bool = False,
     ) -> "VirtualCluster":
         """Synthetic cluster: slot identities are random 64-bit lanes (the
         host never materializes 100K endpoint strings; interop deployments
-        use from_endpoints)."""
+        use from_endpoints). ``compact=True`` stores the engine state at
+        the config-derived narrow dtypes (models/state.compaction_policy)
+        — bit-identical protocol behavior, a fraction of the bytes/member
+        (the wide layout stays the differential oracle)."""
         n = n_slots if n_slots is not None else n_members
         assert n >= n_members
         _validate_delivery_prob(delivery_prob_permille)
@@ -849,6 +879,7 @@ class VirtualCluster(DispatchSeam):
             fd_window=fd_window,
             delivery_prob_permille=delivery_prob_permille,
             pallas_lanes=pallas_lanes,
+            compact=int(compact),
         )
         rng = np.random.default_rng(seed)
         key_hi = rng.integers(0, 2**32, size=(k, n), dtype=np.uint32)
@@ -881,6 +912,7 @@ class VirtualCluster(DispatchSeam):
         pallas_lanes: int = 128,
         n_members: Optional[int] = None,
         topology: str = "native",
+        compact: bool = False,
     ) -> "VirtualCluster":
         """Build from real endpoints with the host view's exact ring keys, so
         the engine's topology matches a host MembershipView bit-for-bit.
@@ -916,6 +948,7 @@ class VirtualCluster(DispatchSeam):
             fd_window=fd_window,
             delivery_prob_permille=delivery_prob_permille,
             pallas_lanes=pallas_lanes,
+            compact=int(compact),
         )
         key_hi0, key_lo0 = endpoint_ring_keys(endpoints, k, topology=topology)
         key_hi = np.zeros((k, n), dtype=np.uint32)
@@ -972,10 +1005,16 @@ class VirtualCluster(DispatchSeam):
             # the D2H round trip this path exists to avoid.
             self._account_h2d(edge_mask)
         em = jnp.asarray(edge_mask)  # [j, k] bool
+        rdt = state.fire_round.dtype  # policy round dtype + its sentinel
+        pol = compaction_policy(self.cfg)
         self.state = state._replace(
             fd_fired=state.fd_fired.at[idx].set(em),
             fire_round=state.fire_round.at[idx].set(
-                jnp.where(em, state.round_idx, jnp.int32(FIRE_NEVER))
+                jnp.where(
+                    em,
+                    state.round_idx.astype(rdt),
+                    jnp.asarray(pol.fire_never, rdt),
+                )
             ),
         )
 
@@ -994,7 +1033,9 @@ class VirtualCluster(DispatchSeam):
         idx = self._slot_index(slots)
         self.state = state._replace(
             obs_idx=state.obs_idx.at[:, idx].set(
-                jnp.broadcast_to(idx[None, :], (self.cfg.k, len(slots)))
+                jnp.broadcast_to(
+                    idx[None, :], (self.cfg.k, len(slots))
+                ).astype(state.obs_idx.dtype)
             )
         )
         self._stamp_fired_edges(idx, np.ones((len(slots), self.cfg.k), dtype=bool))
@@ -1016,11 +1057,20 @@ class VirtualCluster(DispatchSeam):
         ``spread_rounds`` rounds apart (negative initial counters). This is
         the engine's analog of real-world detection jitter — the source of
         almost-everywhere-agreement conflicts the H/L watermarks absorb."""
+        cdt = np.dtype(compaction_policy(self.cfg).counter)
+        if spread_rounds >= np.iinfo(cdt).max:
+            # Not an assert: python -O must not skip this — a wrapped offset
+            # would silently invert the jitter direction.
+            raise ValueError(
+                f"spread_rounds {spread_rounds} exceeds the fd_count "
+                f"envelope of the {cdt.name} compaction policy"
+            )
         offsets = rng.integers(0, spread_rounds + 1, size=(self.cfg.n, self.cfg.k))
-        self._account_h2d(offsets.astype(np.int32))
-        self.state = self.state._replace(
-            fd_count=jnp.asarray(-offsets.astype(np.int32))
-        )
+        # Cast host-side first: the byte counter charges what actually
+        # uploads (the policy-dtype lane, not the rng's int64 draw).
+        narrowed = (-offsets).astype(cdt)
+        self._account_h2d(narrowed)
+        self.state = self.state._replace(fd_count=jnp.asarray(narrowed))
 
     def inject_join_wave(
         self, slots: Sequence[int], check_admissible: bool = True
@@ -1076,20 +1126,25 @@ class VirtualCluster(DispatchSeam):
         )  # [k, j]
 
         # The gatekeeper IS the joiner's observer pre-admission (for both
-        # alert delivery and implicit invalidation).
+        # alert delivery and implicit invalidation). predecessor_of_keys
+        # computes at int32; the scatter narrows to the lane's policy dtype.
+        pred_n = pred.astype(state.obs_idx.dtype)
         self.state = state._replace(
             join_pending=state.join_pending.at[idx].set(True),
-            obs_idx=state.obs_idx.at[:, idx].set(pred),
-            inval_obs=state.inval_obs.at[:, idx].set(pred),
+            obs_idx=state.obs_idx.at[:, idx].set(pred_n),
+            inval_obs=state.inval_obs.at[:, idx].set(pred_n),
         )
         # Mark each (joiner, ring) edge as fired now where a gatekeeper
         # exists; delivery (rx-block + jitter) happens in the round body.
         self._stamp_fired_edges(idx, (pred >= 0).T)
 
     def assign_cohorts(self, cohort_of: np.ndarray) -> None:
-        # Host-side cast first so the transfer counter charges the int32
-        # bytes that actually upload (an int64 input would double-count).
-        arr = np.asarray(cohort_of, dtype=np.int32)
+        # Host-side cast first so the transfer counter charges the bytes
+        # that actually upload — the policy's cohort-index dtype (int32
+        # wide, int8/int16 compact), not the caller's int64.
+        arr = np.asarray(
+            cohort_of, dtype=np.dtype(compaction_policy(self.cfg).cohort)
+        )
         self._account_h2d(arr)
         self.state = self.state._replace(cohort_of=jnp.asarray(arr))
 
@@ -1112,7 +1167,9 @@ class VirtualCluster(DispatchSeam):
         self.faults = self.faults._replace(rx_block=jnp.asarray(arr))
         self.state = self.state._replace(
             fire_round=jnp.where(
-                self.state.fd_fired, self.state.round_idx, self.state.fire_round
+                self.state.fd_fired,
+                self.state.round_idx.astype(self.state.fire_round.dtype),
+                self.state.fire_round,
             )
         )
 

@@ -14,6 +14,15 @@ Two kernels:
   path).
 - ``tally_sorted`` — no candidate knowledge: sort the vote hashes and find
   the longest run (single-chip / debugging path).
+
+Narrow-width discipline (the compact engine state,
+models/state.compaction_policy): vote/candidate hash lanes are identity
+and stay uint32 under every policy; every count in this module already
+accumulates at an EXPLICIT ``dtype=jnp.int32`` (``jnp.sum(matches, ...)``,
+``total``) rather than inheriting an input dtype — which is exactly why
+the tallies are width-independent of however narrowly the caller stores
+its state. Keep any new reduction here explicitly int32-accumulated; the
+``dtype-widening`` lint guards the store side in the round body.
 """
 
 from __future__ import annotations

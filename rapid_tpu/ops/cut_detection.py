@@ -188,16 +188,20 @@ def cohort_watermark_pass(
         # legitimize implicit edges. Per-ring loop: [c, n] gathers, never a
         # [c, n, k] materialization (C can be in the hundreds).
         in_union = (stable & ~released) | flux  # [c, n]
-        implicit_bits = jnp.zeros((c, n), dtype=jnp.uint32)
+        # Accumulate at the report lane's own dtype (uint8/uint16 under the
+        # compact policy, K <= 8*itemsize by construction): a uint32
+        # operand would silently re-widen the whole [c, n] lane.
+        bdt = report_bits.dtype
+        implicit_bits = jnp.zeros((c, n), dtype=bdt)
         for ring in range(k):
             obs_r = inval_obs[ring]  # [n]
             gathered = in_union[:, jnp.clip(obs_r, 0, n - 1)]  # [c, n]
             implicit_r = flux & gathered & (obs_r >= 0)[None, :] & seen_down[:, None]
             implicit_bits = implicit_bits | (
-                implicit_r.astype(jnp.uint32) << jnp.uint32(ring)
+                implicit_r.astype(bdt) << jnp.asarray(ring, bdt)
             )
         merged = report_bits | implicit_bits
-        return jnp.where(subject_mask[None, :], merged, jnp.uint32(0))
+        return jnp.where(subject_mask[None, :], merged, 0)
 
     need_invalidation = jnp.any(flux & seen_down[:, None])
     report_bits = jax.lax.cond(need_invalidation, with_implicit, lambda r: r, report_bits)

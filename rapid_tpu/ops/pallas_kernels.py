@@ -67,10 +67,14 @@ def watermark_merge_classify_impl(
     traced int32 scalars — the tenant fleet (rapid_tpu/tenancy) vmaps this
     pass with PER-TENANT watermarks, so the comparisons must trace; both
     spellings lower to the identical compare ops.
-    Returns (merged_bits uint32, cls int32: 0 none / 1 flux / 2 stable),
-    shaped like the inputs.
+    Returns (merged_bits at the INPUT bitmask dtype, cls int32: 0 none /
+    1 flux / 2 stable), shaped like the inputs. Dtype-preserving on
+    purpose: the compact engine stores report bitmasks at uint8/uint16
+    (models/state.compaction_policy) and a uint32 operand here would
+    silently re-widen the lane — the weak-typed zero keeps the merge at
+    the lane's own width while the popcount accumulates at int32.
     """
-    merged = jnp.where(subject_mask, old_bits | new_bits, jnp.uint32(0))
+    merged = jnp.where(subject_mask, old_bits | new_bits, 0)
     tally = _popcount32(merged)
     stable = tally >= h
     flux = (tally >= l) & (tally < h)

@@ -170,7 +170,13 @@ def ring_topology_from_perm(perm: jnp.ndarray, alive: jnp.ndarray) -> RingTopolo
     """``ring_topology`` without the sort: derive all K rings' topology from
     the static key-order permutations (``ring_perms``) and the current alive
     mask with O(N) scans. Output is bit-identical to ``ring_topology``
-    (equivalence pinned in tests/test_ops_rings.py)."""
+    (equivalence pinned in tests/test_ops_rings.py).
+
+    Accepts ``perm`` at ANY integer dtype — the compact engine stores its
+    ring_perm at the policy's index width (int8/int16,
+    models/state.compaction_policy) and gathers/scatters index with it
+    directly; the returned tables are int32 (position arithmetic
+    accumulates wide here) and the caller narrows on store."""
     obs, subj, order = jax.vmap(_from_perm_single, in_axes=(0, None))(
         jnp.asarray(perm), jnp.asarray(alive, dtype=bool)
     )

@@ -84,6 +84,45 @@ def shape_bytes(shape_str: str, unknown: Optional[List[str]] = None) -> int:
     return (total_bits + 7) // 8
 
 
+def entry_parameter_bytes(
+    compiled_text: str, unknown: Optional[List[str]] = None
+) -> Dict[str, int]:
+    """Per-dtype payload bytes of the ENTRY computation's parameters —
+    the compiled-artifact proof that a dtype-narrowing policy actually
+    landed (a compact engine program's signature carries s8/s16/u8/u16
+    argument lanes where the wide oracle carries only s32/u32/pred).
+
+    Parses the ``ENTRY %name (arg: dtype[dims], ...) -> ...`` header line;
+    nested computations' parameters (while bodies etc.) are deliberately
+    excluded — only the entry signature is the program's argument surface.
+    Sub-byte dtypes price at their true bit width via :data:`DTYPE_BITS`."""
+    for line in compiled_text.splitlines():
+        stripped = line.strip()
+        if not stripped.startswith("ENTRY "):
+            continue
+        head, sep, _tail = stripped.partition(") -> ")
+        if not sep:
+            continue
+        params = head.partition("(")[2]
+        out: Dict[str, int] = {}
+        for dtype, dims in _SHAPE_RE.findall(params):
+            bits = DTYPE_BITS.get(dtype)
+            if bits is None:
+                if unknown is None:
+                    raise ValueError(
+                        f"unknown HLO dtype {dtype!r} in ENTRY parameters"
+                    )
+                unknown.append(dtype)
+                continue
+            elems = 1
+            for d in dims.split(","):
+                if d:
+                    elems *= int(d)
+            out[dtype] = out.get(dtype, 0) + (elems * bits + 7) // 8
+        return out
+    return {}
+
+
 def classify_location(op_name: str) -> str:
     """hot-loop / hot-loop-cond / cond / prologue, from op_name metadata.
 

@@ -32,6 +32,16 @@ This is the TPU equivalent of the reference's scale story (§ SURVEY 5.7):
 the reference keeps per-node load O(K) as N grows; here the whole cluster's
 protocol state is data-parallel over the mesh, and per-device cohort state
 shrinks by the cohort-axis size instead of replicating.
+
+Compaction: the rule table is keyed on FIELD NAMES, so the config-derived
+narrow layout (``EngineConfig.compact=1`` — models/state.compaction_policy)
+and the opt-in bit-packed mask representation (``state.pack_masks``: [n] ->
+[n/8] uint8 along the slot axis, ranks preserved) shard through the SAME
+rules with no second table: per-device bytes shrink by the dtype ratio on
+top of the 1/dn axis split. :func:`shard_pytree`'s up-front divisibility
+validation covers the packed shapes too — a packed [n/8] lane that does
+not divide the node axis raises the same named ``ShardingShapeError``
+(pack after padding: ``pad_to_multiple(n, 8 * node_devices)``).
 """
 
 from __future__ import annotations

@@ -126,7 +126,12 @@ def save_engine_state(path, cfg: "EngineConfig", state: "EngineState") -> None:
 
 
 def load_engine_state(path) -> Tuple["EngineConfig", "EngineState"]:
-    from rapid_tpu.models.state import FIRE_NEVER, EngineConfig, EngineState
+    from rapid_tpu.models.state import (
+        EngineConfig,
+        EngineState,
+        compaction_policy,
+        lane_dtypes,
+    )
 
     with np.load(path) as data:
         vals = [int(v) for v in data["__cfg__"]]
@@ -151,21 +156,28 @@ def load_engine_state(path) -> Tuple["EngineConfig", "EngineState"]:
 
         # Fields added after a checkpoint was written fill with their
         # initial-state defaults (per-configuration state is safe to reset:
-        # at worst a fallback restarts from round 2).
+        # at worst a fallback restarts from round 2) — at the POLICY dtypes
+        # of the saved config, so a compact checkpoint's filled lanes match
+        # the lanes the engine would have built (models/state
+        # compaction_policy; wide configs keep the historical int32s).
+        dts = {f: jnp.dtype(d) for f, d in lane_dtypes(cfg).items()}
+        fire_never = compaction_policy(cfg).fire_never
         defaults = {
-            "cp_rnd_r": lambda: jnp.zeros((cfg.n,), dtype=jnp.int32),
-            "cp_rnd_i": lambda: jnp.zeros((cfg.n,), dtype=jnp.int32),
-            "cp_vrnd_r": lambda: jnp.zeros((cfg.n,), dtype=jnp.int32),
-            "cp_vrnd_i": lambda: jnp.zeros((cfg.n,), dtype=jnp.int32),
-            "cp_vval_src": lambda: jnp.full((cfg.n,), -1, dtype=jnp.int32),
-            "classic_epoch": lambda: jnp.int32(0),
+            "cp_rnd_r": lambda: jnp.zeros((cfg.n,), dtype=dts["cp_rnd_r"]),
+            "cp_rnd_i": lambda: jnp.zeros((cfg.n,), dtype=dts["cp_rnd_i"]),
+            "cp_vrnd_r": lambda: jnp.zeros((cfg.n,), dtype=dts["cp_vrnd_r"]),
+            "cp_vrnd_i": lambda: jnp.zeros((cfg.n,), dtype=dts["cp_vrnd_i"]),
+            "cp_vval_src": lambda: jnp.full(
+                (cfg.n,), -1, dtype=dts["cp_vval_src"]
+            ),
+            "classic_epoch": lambda: jnp.zeros((), dtype=dts["classic_epoch"]),
             "fire_round": lambda: jnp.where(
                 jnp.asarray(data["fd_fired"]),
-                jnp.int32(0),
-                jnp.int32(FIRE_NEVER),
+                jnp.zeros((), dtype=dts["fire_round"]),
+                jnp.asarray(fire_never, dtype=dts["fire_round"]),
             ),
             "round_idx": lambda: jnp.int32(0),
-            "fd_hist": lambda: jnp.zeros((cfg.n, cfg.k), dtype=jnp.uint32),
+            "fd_hist": lambda: jnp.zeros((cfg.n, cfg.k), dtype=dts["fd_hist"]),
             # NOT per-configuration state: retirement is cross-configuration
             # history and cannot be reconstructed from an old checkpoint.
             # Resuming one forgets which identity lanes were spent — callers
@@ -176,7 +188,7 @@ def load_engine_state(path) -> Tuple["EngineConfig", "EngineState"]:
             # lanes for checkpoints written before the field existed.
             "ring_perm": lambda: _ring_perms(
                 jnp.asarray(data["key_hi"]), jnp.asarray(data["key_lo"])
-            ),
+            ).astype(dts["ring_perm"]),
         }
         arrays = {}
         for field in EngineState._fields:

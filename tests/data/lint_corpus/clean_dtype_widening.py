@@ -1,0 +1,27 @@
+"""Lint corpus, clean counterpart: narrow-lane stores the dtype-widening
+check must accept — compute-in-int32-cast-on-store, name-only bindings,
+astype-wrapped arithmetic, and untracked (never-narrowed) lanes."""
+
+import jax.numpy as jnp
+
+
+def tick(state, probed):
+    # The round-body convention: arithmetic bound to a name (its dtype was
+    # settled where it was computed), the store passes the NAME.
+    fd_count = jnp.where(probed, state.fd_count + 1, state.fd_count)
+    state = state._replace(fd_count=fd_count)
+    # Arithmetic wrapped in astype at any depth is an explicit cast.
+    state = state._replace(
+        fire_round=jnp.where(
+            probed[:, 0],
+            (state.round_idx.astype(jnp.int32) + 0).astype(state.fire_round.dtype),
+            state.fire_round,
+        )
+    )
+    # Lanes outside NARROWABLE_LANES may do inline arithmetic freely:
+    # round_idx/config_epoch stay int32 under every policy.
+    state = state._replace(
+        round_idx=state.round_idx + 1,
+        config_epoch=state.config_epoch + 1,
+    )
+    return state

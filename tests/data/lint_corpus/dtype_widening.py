@@ -1,0 +1,40 @@
+"""Lint corpus: arithmetic stored into policy-narrowed engine lanes.
+
+Under the compact policy (models/state.compaction_policy) ``fd_count`` is
+int16 and ``report_bits`` uint8 — jnp promotion re-widens either the moment
+an int32/uint32 operand touches the store expression, silently un-doing the
+compaction while every differential keeps passing (wide mode compiles
+identically either way). The clean spellings: compute-cast-bind-store a
+NAME, or wrap the arithmetic in ``.astype(...)``.
+"""
+
+import jax.numpy as jnp
+
+
+def tick(state, probe_failed, new_bits):
+    # Inline add on a narrowed counter lane: int16 + int32 -> int32.
+    state = state._replace(
+        fd_count=state.fd_count + jnp.int32(1)  # expect: dtype-widening
+    )
+    # Inline OR on the narrowed bitmask lane: uint8 | uint32 -> uint32.
+    state = state._replace(
+        report_bits=state.report_bits | new_bits.astype(jnp.uint32)  # expect: dtype-widening
+    )
+    # Escaped: the justification names why the widening is intended.
+    state = state._replace(
+        rounds_undecided=state.rounds_undecided + 1  # widen-ok: weak-typed literal stays at the lane dtype
+    )
+    # Clean: accumulate wide, cast the store explicitly.
+    state = state._replace(
+        fire_round=(state.fire_round.astype(jnp.int32) + 1).astype(state.fire_round.dtype)
+    )
+    return state
+
+
+def rebuild(EngineState, n, k, topo):
+    # Constructor keyword with un-cast arithmetic on a narrowed index lane.
+    return EngineState(
+        obs_idx=topo.obs_idx + 0,  # expect: dtype-widening
+        subj_idx=topo.subj_idx.astype(jnp.int16),
+        fd_count=jnp.zeros((n, k), dtype=jnp.int16),
+    )

@@ -213,6 +213,34 @@ def test_cpu_run_emits_complete_ledger(tmp_path):
         e["event"] == "compile_stats" and e.get("stage") == "chaos"
         for e in events
     )
+    # ISSUE 13 memory path, same run: the hlo_audit stage (begin/end
+    # bracketed above with every other stage) emits the state-compaction
+    # memory axis end-to-end on CPU — bytes/member under all three
+    # layouts, the run's total, the 100k->100M sizing table, and the
+    # never-silently-absent mem_status.
+    [(mem_begin, mem_close)] = pairs["hlo_audit"]
+    assert mem_close["event"] == "stage_end"
+    assert mem_begin["timeout_s"] > 0
+    assert result["mem_status"]  # never silently absent
+    assert 0 < result["bytes_per_member"] < result["bytes_per_member_wide"]
+    assert result["bytes_per_member_packed"] < result["bytes_per_member"]
+    # bytes_per_member is rounded in the JSON; the total is exact.
+    assert abs(
+        result["state_bytes_total"] - result["bytes_per_member"] * result["n_members"]
+    ) <= result["n_members"]
+    sizing = result["mem_sizing"]
+    assert set(sizing) == {"100k", "1M", "10M", "100M"}
+    for row in sizing.values():
+        assert row["compact_gb"] < row["wide_gb"]
+    # The 100M sizing is the ROADMAP deliverable: a concrete GB figure.
+    assert sizing["100M"]["n"] == 100_000_000
+    assert sizing["100M"]["compact_gb"] > 0
+    # The audit compiled the compact entrypoints, so the status is the
+    # measured one (memory_analysis argument bytes present for the pair).
+    assert result["mem_status"] == "live:hlo-audit"
+    assert result["hlo_audit"]["step_compact"]["argument_bytes"] < (
+        result["hlo_audit"]["step"]["argument_bytes"]
+    )
 
 
 def test_headline_plan_is_never_silently_absent(monkeypatch):
@@ -316,6 +344,38 @@ def test_chaos_plan_is_never_silently_absent(monkeypatch):
     assert bench.chaos_plan("cpu", 2000.0) == (4, "live")
     monkeypatch.setenv("RAPID_TPU_BENCH_NO_CHAOS", "1")
     assert bench.chaos_plan("tpu", 0.0) == (0, "suppressed")
+
+
+def test_memory_report_status_is_never_silently_absent():
+    """ISSUE 13: memory_report is pure over (audit table, geometry) and
+    always yields a mem_status — measured when the audit carries argument
+    bytes for the wide+compact step pair, an explicit computed:<why>
+    marker otherwise (audit errored, absent, or lacking memory analysis)."""
+    geometry = dict(n=1024, k_rings=10, cohorts=8)
+    live = bench.memory_report(
+        {"step": {"argument_bytes": 1000}, "step_compact": {"argument_bytes": 600}},
+        **geometry,
+    )
+    assert live["mem_status"] == "live:hlo-audit"
+    assert 0 < live["bytes_per_member"] < live["bytes_per_member_wide"]
+    assert set(live["mem_sizing"]) == {"100k", "1M", "10M", "100M"}
+
+    errored = bench.memory_report({"error": "needs 8 devices"}, **geometry)
+    assert errored["mem_status"].startswith("computed:")
+    assert errored["bytes_per_member"] == live["bytes_per_member"]
+
+    partial = bench.memory_report(
+        {"step": {"argument_bytes": None}, "step_compact": {}}, **geometry
+    )
+    assert partial["mem_status"] == "computed:audit-lacks-step-memory"
+
+    # The sizing ladder re-derives the policy per N: the 100M row's
+    # bytes/member EXCEEDS the small-N row's (index lanes re-widen to
+    # int32 past 32k slots) — the table is honest, not an extrapolation.
+    assert (
+        live["mem_sizing"]["100M"]["bytes_per_member"]
+        > live["bytes_per_member"]
+    )
 
 
 def test_parse_scale_spellings():

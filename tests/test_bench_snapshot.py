@@ -392,14 +392,22 @@ def test_hlo_audit_summary_embeds_per_entrypoint_budget_table():
     table = bench.hlo_audit_summary()
     assert "error" not in table, table
     assert {"step", "run_to_decision", "run_until_membership", "sync",
+            "step_compact",
             "sharded_step", "sharded_wave", "sharded2d_wave",
             "fleet3d_step", "fleet3d_wave"} == set(table)
     for name, row in table.items():
         assert set(row) == {
             "collectives", "collective_bytes", "hot_loop_collectives",
-            "hot_loop_bytes", "temp_bytes", "donation_dropped",
+            "hot_loop_bytes", "temp_bytes", "argument_bytes",
+            "donation_dropped",
         }, name
         assert row["donation_dropped"] == 0, name
+    # The compaction saving is visible in the embedded table (the bench's
+    # memory_report keys its mem_status off exactly this pair).
+    assert (
+        table["step_compact"]["argument_bytes"]
+        < table["step"]["argument_bytes"]
+    )
     # Sharded programs communicate; single-device ones must not.
     assert table["sharded_wave"]["hot_loop_collectives"] > 0
     assert table["step"]["collectives"] == 0

@@ -187,7 +187,7 @@ def test_legacy_positional_config_drops_stale_watermark_value(tmp_path):
     np.savez_compressed(legacy, **kept)
 
     cfg, state = load_engine_state(legacy)
-    assert cfg.pallas_lanes == EngineConfig.__new__.__defaults__[-1] == 128
+    assert cfg.pallas_lanes == EngineConfig._field_defaults["pallas_lanes"] == 128
     assert cfg._replace(pallas_lanes=vc.cfg.pallas_lanes) == vc.cfg
     restored = VirtualCluster(cfg, state)
     restored.crash([3])

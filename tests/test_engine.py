@@ -439,7 +439,12 @@ def test_rx_block_past_word_boundary():
     assert announced_union.sum() >= 1
 
 
+@pytest.mark.slow
 def test_concurrent_coordinators_lower_rank_phase2a_loses():
+    # Rides the unfiltered check.sh pass (~11 s wall). Tier-1
+    # representative of racing-coordinator rank ordering:
+    # test_concurrent_coordinators_partitioned_higher_rank_lower_wins
+    # (same phase1/phase2 rank machinery, plus the partition masks).
     # Two coordinators race in one classic attempt with full connectivity:
     # both win phase 1 (every acceptor promises each heard rank in order),
     # but every acceptor's final rnd is the higher rank, so the lower-ranked
@@ -745,7 +750,12 @@ def test_readmitting_retired_slot_is_rejected():
         vc.inject_join_wave([50])
 
 
+@pytest.mark.slow
 def test_windowed_fd_mode_forgives_intermittent_blips():
+    # Rides the unfiltered check.sh pass (~15 s wall). Tier-1
+    # representatives of the windowed policy: the host<->device agreement
+    # oracle test_windowed_fd.py::test_host_and_device_windowed_rules_agree
+    # and the host-side policy table in the same file.
     # Device-side windowed policy (cfg.fd_window, the paper's rule): an edge
     # failing 1 round in every 4 never accumulates fd_threshold failures
     # within the window, so it NEVER fires — while the reference code's

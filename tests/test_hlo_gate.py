@@ -107,6 +107,7 @@ def test_registered_entrypoints_audit_clean_against_committed_lock():
         )
     assert set(facts) == {
         "step", "run_to_decision", "run_until_membership", "sync",
+        "step_compact",
         "sharded_step", "sharded_wave", "sharded2d_wave",
         "fleet3d_step", "fleet3d_wave",
     }
@@ -120,7 +121,8 @@ def test_sharded_entrypoints_have_collectives_single_device_do_not():
     for name in ("sharded_step", "sharded_wave", "sharded2d_wave",
                  "fleet3d_step", "fleet3d_wave"):
         assert facts[name]["collectives"], name
-    for name in ("step", "run_to_decision", "run_until_membership", "sync"):
+    for name in ("step", "run_to_decision", "run_until_membership", "sync",
+                 "step_compact"):
         assert facts[name]["collectives"] == {}, name
     # Both waves' unconditional hot loops stay reduce-class at scalar/[n]
     # payloads; [c,n]-scale traffic is cond-gated — the parallel/audit
@@ -232,6 +234,84 @@ def test_2d_cohort_state_memory_is_sharded_not_replicated():
     assert saved >= 0.9 * expected_saving, (
         saved, expected_saving, repl_args, rules_args,
     )
+
+
+def test_compact_entrypoints_shrink_argument_bytes():
+    """ISSUE 13 acceptance, from the compiled artifact: the compact-policy
+    step carries >= 30% fewer per-device argument bytes than the wide
+    oracle at the audit shape (the wave's argument surface is
+    byte-identical modulo three int32 control scalars — registering the
+    step freezes the claim for both, the PR-9 single-representative
+    convention), its entry signature actually carries the narrow dtypes
+    (s16/s8/u8 — the policy landed, not just the formula), donation stays
+    fully aliased, and its hot-loop collective and transfer budgets match
+    the wide twin's (empty/none on the single-device audit programs —
+    compaction adds no communication)."""
+    facts = staticcheck.collect_facts()
+    locked = json.loads((REPO / staticcheck.HLO_LOCK_REL).read_text())
+    for wide_name, compact_name in (
+        ("step", "step_compact"),
+    ):
+        wide_args = facts[wide_name]["memory"]["argument_bytes"]
+        compact_args = facts[compact_name]["memory"]["argument_bytes"]
+        assert compact_args <= 0.7 * wide_args, (
+            wide_name, wide_args, compact_args,
+        )
+        assert locked["entrypoints"][compact_name]["memory"][
+            "argument_bytes"
+        ] == compact_args
+        dtypes = facts[compact_name]["parameter_dtype_bytes"]
+        assert {"s16", "s8", "u8"} <= set(dtypes), dtypes
+        wide_dtypes = facts[wide_name]["parameter_dtype_bytes"]
+        assert set(wide_dtypes) <= {"pred", "s32", "u32"}, wide_dtypes
+        donation = facts[compact_name]["donation"]
+        assert donation["dropped"] == 0
+        assert donation["aliased"] == donation["donated_leaves"] > 0
+        # No new hot-loop collectives and no host<->device transfers vs
+        # the wide twin.
+        hot_wide = {
+            k for k in facts[wide_name]["collectives"] if k.startswith("hot-loop/")
+        }
+        hot_compact = {
+            k for k in facts[compact_name]["collectives"]
+            if k.startswith("hot-loop/")
+        }
+        assert hot_compact <= hot_wide
+        assert facts[compact_name]["transfers"] == facts[wide_name]["transfers"]
+
+
+def test_compact_formula_matches_compiled_argument_bytes():
+    """The bench's bytes/member formula (models/state.state_bytes_total) is
+    the compiled artifact's own argument accounting: state+faults bytes at
+    the audit geometry equal memory_analysis()'s argument bytes minus the
+    non-state scalars (the wave carries three int32 control scalars; the
+    step none)."""
+    from rapid_tpu.models.state import EngineConfig, state_bytes_total
+
+    facts = staticcheck.collect_facts()
+    cfg = EngineConfig(
+        n=device_program.AUDIT_N, k=device_program.AUDIT_K, h=3, l=1,
+        c=device_program.AUDIT_C, fd_threshold=2, delivery_spread=2,
+    )
+    for name, compact in (("step", 0), ("step_compact", 1)):
+        formula = state_bytes_total(cfg._replace(compact=compact))
+        measured = facts[name]["memory"]["argument_bytes"]
+        assert measured == formula, (name, measured, formula)
+
+
+def test_update_lock_refuses_on_compaction_differential_mismatch(monkeypatch):
+    """`--update-hlo-lock` must not freeze memory budgets while the
+    compact engine disagrees with its wide oracle: a reported mismatch
+    becomes a blocking finding and no lock is written."""
+    monkeypatch.setattr(
+        device_program, "compaction_differential_ok",
+        lambda: "wide<->compact differential disagrees on state lane 'fd_count'",
+    )
+    findings, path = device_program.update_hlo_lock()
+    assert path is None
+    assert any(
+        "wide<->compact differential" in f.message for f in findings
+    ), findings
 
 
 def test_fleet_entrypoints_have_zero_cross_tenant_collectives():

@@ -293,6 +293,51 @@ def test_trajectory_renders_chaos_column_and_flags_missing(tmp_path, capsys):
     assert "chaos-missing" not in lines["BENCH_r50"]  # pre-audit history
 
 
+def test_trajectory_renders_mem_column_and_flags_missing(tmp_path, capsys):
+    """ISSUE 13: bytes_per_member renders as the MEM trajectory column
+    (compact figure with the wide one beside it) under the existing trust
+    flags; an AUDITED round omitting both the value and its explicit
+    mem_status marker flags mem-missing; pre-audit historical rounds are
+    exempt."""
+    audit = {"step": {"collectives": 0, "hot_loop_collectives": 0,
+                      "temp_bytes": 10, "donation_dropped": 0}}
+    common = {"n1M_status": "ramped:256", "tenant_fleet_status": "ramped:4x48",
+              "stream_status": "ramped:6x48", "chaos_status": "ramped:4x12"}
+    points = {
+        # Pre-audit historical round: exempt (sorts first).
+        "BENCH_r60.json": {"metric": "m", "value": 1.0, "platform": "cpu"},
+        # Audited + measured memory point: bytes/member in the MEM column.
+        "BENCH_r61.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, **common,
+                           "mem_status": "live:hlo-audit",
+                           "bytes_per_member": 246.4,
+                           "bytes_per_member_wide": 445.0},
+        # Audited + explicit computed marker: status cell, no flag.
+        "BENCH_r62.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, **common,
+                           "mem_status": "computed:audit-lacks-step-memory"},
+        # Audited round that silently dropped the memory point: flagged.
+        "BENCH_r63.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, **common},
+    }
+    paths = []
+    for name, data in points.items():
+        p = tmp_path / name
+        p.write_text(json.dumps(data))
+        paths.append(str(p))
+    assert perfview.main(paths) == 0
+    out = capsys.readouterr().out
+    assert "MEM" in out.splitlines()[1]  # the trajectory header row
+    lines = {line.split()[0]: line for line in out.splitlines()
+             if line.startswith("BENCH_r6")}
+    assert "246B/m (wide 445)" in lines["BENCH_r61"]
+    assert "mem-missing" not in lines["BENCH_r61"]
+    assert "computed:audit-lacks-step-memory" in lines["BENCH_r62"]
+    assert "mem-missing" not in lines["BENCH_r62"]
+    assert "mem-missing" in lines["BENCH_r63"]
+    assert "mem-missing" not in lines["BENCH_r60"]  # pre-audit history
+
+
 def test_chrome_trace_envelope(tmp_path, capsys):
     path = _complete_ledger(tmp_path)
     chrome_path = tmp_path / "trace.json"

@@ -11,7 +11,12 @@ import pytest
 from rapid_tpu.utils import profiling
 
 
+@pytest.mark.slow
 def test_nested_trace_is_rejected_eagerly(tmp_path):
+    # Rides the unfiltered check.sh pass (~16 s wall: three REAL
+    # jax.profiler trace starts). Tier-1 representative of the guard:
+    # test_guard_resets_when_block_raises (one trace start, same
+    # already-active latch).
     with profiling.trace(str(tmp_path / "outer")):
         with pytest.raises(RuntimeError, match="does not nest"):
             with profiling.trace(str(tmp_path / "inner")):

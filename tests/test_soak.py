@@ -6,6 +6,7 @@ import asyncio
 import random
 
 import numpy as np
+import pytest
 
 from rapid_tpu.models.virtual_cluster import VirtualCluster
 
@@ -158,7 +159,11 @@ def test_engine_mixed_lifecycle_soak_with_jitter_and_windowed_fd():
     assert not bool(np.asarray(vc.state.announced).any())
 
 
+@pytest.mark.slow
 def test_fused_wave_churn_soak_twenty_epochs():
+    # Rides the unfiltered check.sh pass (~11 s wall). Tier-1 keeps the
+    # per-step soak above plus the fused-wave multi-cut representative
+    # test_engine.py::test_run_until_membership_matches_sequential_decisions.
     # The whole-wave dispatch across MANY configurations: per-configuration
     # state resets (cut detector, votes, FD counters, classic acceptors)
     # must survive repeated on-device view-change application inside the

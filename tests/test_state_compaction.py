@@ -1,0 +1,449 @@
+"""Config-derived state compaction (ISSUE 13) pins.
+
+The compact engine (``EngineConfig.compact=1``) stores every
+:data:`NARROWABLE_LANES` lane at :func:`compaction_policy`'s minimal legal
+dtype; the wide int32/uint32 layout stays the differential ORACLE. The bar
+here: wide and compact runs of the same scenario are bit-identical —
+identical cuts, configuration ids, decision rounds, and (after
+:func:`widen_state`) identical state pytrees leaf-for-leaf — across the
+mixed scenario grid: crash/join/churn on a single cluster, a tenancy
+representative, and a streaming representative (larger grids ride ``slow``
+per the PR-10 budget convention).
+
+Also pinned: the FIRE_NEVER sentinel invariant under the narrowest round
+dtype the policy can pick (the models/state.py:30 comment as a test), the
+bit-pack/unpack bijection, the sizing formula against real pytrees, the
+policy <-> lint lane-set mirror, and mesh placement of compact/packed
+states through the unchanged rule table.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from rapid_tpu.models import state as S  # noqa: E402
+from rapid_tpu.models.state import (  # noqa: E402
+    FIRE_NEVER,
+    FIRE_NEVER_NARROW,
+    ROUND_ENVELOPE,
+    EngineConfig,
+    compaction_policy,
+    narrow_state,
+    widen_state,
+)
+from rapid_tpu.models.virtual_cluster import VirtualCluster  # noqa: E402
+
+GEOM = dict(k=3, h=3, l=1, cohorts=2, fd_threshold=2)
+
+
+def _cluster(compact, n=24, n_slots=40, seed=0, **kw):
+    params = {**GEOM, **kw}
+    vc = VirtualCluster.create(
+        n, n_slots=n_slots, seed=seed, compact=compact, **params
+    )
+    vc.assign_cohorts_roundrobin()
+    return vc
+
+
+def _assert_states_identical(wide_vc, compact_vc, label=""):
+    widened = widen_state(compact_vc.cfg, compact_vc.state)
+    for field in wide_vc.state._fields:
+        a = np.asarray(getattr(wide_vc.state, field))
+        b = np.asarray(getattr(widened, field))
+        assert a.dtype == b.dtype, (label, field, a.dtype, b.dtype)
+        assert (a == b).all(), (label, field)
+    for field in wide_vc.faults._fields:
+        a = np.asarray(getattr(wide_vc.faults, field))
+        b = np.asarray(getattr(compact_vc.faults, field))
+        assert (a == b).all(), (label, field)
+    assert wide_vc.config_id == compact_vc.config_id, label
+
+
+# ---------------------------------------------------------------------------
+# Policy derivation (pure)
+# ---------------------------------------------------------------------------
+
+
+def test_policy_is_wide_by_default():
+    cfg = EngineConfig(n=1024, k=10, h=9, l=4)
+    assert compaction_policy(cfg) == S.WIDE_POLICY
+    assert cfg.compact == 0
+
+
+def test_policy_picks_minimal_legal_dtypes():
+    base = dict(k=10, h=9, l=4, compact=1)
+    # Index width follows N (values live in [-1, n-1]).
+    assert compaction_policy(EngineConfig(n=128, **base)).idx == "int8"
+    assert compaction_policy(EngineConfig(n=129, **base)).idx == "int16"
+    assert compaction_policy(EngineConfig(n=1 << 15, **base)).idx == "int16"
+    assert compaction_policy(EngineConfig(n=(1 << 15) + 1, **base)).idx == "int32"
+    # Cohort width follows C.
+    assert compaction_policy(EngineConfig(n=256, c=8, **base)).cohort == "int8"
+    assert compaction_policy(EngineConfig(n=256, c=512, **base)).cohort == "int16"
+    # Report bitmask width follows K; the Pallas delivery kernel emits
+    # uint32 words, so use_pallas holds the lane wide.
+    assert compaction_policy(EngineConfig(n=256, k=8, h=3, l=1, compact=1)).report == "uint8"
+    assert compaction_policy(EngineConfig(n=256, k=9, h=3, l=1, compact=1)).report == "uint16"
+    assert compaction_policy(EngineConfig(n=256, k=17, h=3, l=1, compact=1)).report == "uint32"
+    assert (
+        compaction_policy(
+            EngineConfig(n=256, k=8, h=3, l=1, use_pallas=True, compact=1)
+        ).report
+        == "uint32"
+    )
+    # History width follows fd_window (0 = the unused counter-mode lane).
+    assert compaction_policy(EngineConfig(n=256, fd_window=0, **base)).hist == "uint8"
+    assert compaction_policy(EngineConfig(n=256, fd_window=8, **base)).hist == "uint8"
+    assert compaction_policy(EngineConfig(n=256, fd_window=9, **base)).hist == "uint16"
+    assert compaction_policy(EngineConfig(n=256, fd_window=32, **base)).hist == "uint32"
+    pol = compaction_policy(EngineConfig(n=256, **base))
+    assert pol.counter == "int16" and pol.round == "int16"
+    assert pol.fire_never == FIRE_NEVER_NARROW
+
+
+def test_lane_specs_cover_every_pytree_field():
+    from rapid_tpu.models.state import EngineState, FaultInputs
+
+    assert set(S.LANE_SPECS) == set(EngineState._fields) | set(FaultInputs._fields)
+
+
+def test_narrowable_lanes_mirror_the_lint_set():
+    # The sharding analyzer keeps a LITERAL mirror (the analysis package
+    # imports no jax-bearing library module); this pin is what keeps the
+    # two sets from drifting.
+    from analysis import sharding as sharding_checks
+
+    assert sharding_checks.NARROWED_LANES == S.NARROWABLE_LANES
+    # And every narrowed lane is actually narrow under a compact policy.
+    dts = S.lane_dtypes(EngineConfig(n=128, k=4, h=3, l=1, c=2, compact=1))
+    for lane in S.NARROWABLE_LANES:
+        assert np.dtype(dts[lane]).itemsize < 4, lane
+
+
+# ---------------------------------------------------------------------------
+# Sizing formula & bit-packing
+# ---------------------------------------------------------------------------
+
+
+def test_state_bytes_formula_matches_real_pytree():
+    # The compact variant; the wide formula is additionally pinned against
+    # the compiled artifact's own argument accounting (both layouts) in
+    # tests/test_hlo_gate.py::test_compact_formula_matches_compiled_argument_bytes.
+    vc = _cluster(True)
+    measured = S.pytree_nbytes(vc.state) + S.pytree_nbytes(vc.faults)
+    assert measured == S.state_bytes_total(vc.cfg)
+    packed = S.pytree_nbytes(S.pack_masks(vc.state)) + S.pytree_nbytes(
+        S.pack_masks(vc.faults)
+    )
+    assert packed == S.state_bytes_total(vc.cfg, packed=True)
+
+
+def test_compact_policy_shrinks_bytes_per_member():
+    wide = EngineConfig(n=1024, k=10, h=9, l=4, c=8)
+    comp = wide._replace(compact=1)
+    assert S.state_bytes_per_member(comp) <= 0.7 * S.state_bytes_per_member(wide)
+    assert S.state_bytes_per_member(comp, packed=True) < S.state_bytes_per_member(comp)
+    # 10M/100M re-derive the policy at scale: index lanes re-widen, the
+    # sizing stays honest (bigger than a naive small-N extrapolation).
+    big = EngineConfig(n=100_000_000, k=10, h=9, l=4, c=64, compact=1)
+    assert compaction_policy(big).idx == "int32"
+
+
+def test_pack_unpack_is_a_bijection():
+    rng = np.random.default_rng(3)
+    for shape, axis in [((40,), 0), ((40, 3), 0), ((2, 40), 1), ((16,), 0)]:
+        mask = rng.random(shape) < 0.3
+        packed = S.pack_bool(mask, axis=axis)
+        assert packed.dtype == jnp.uint8
+        assert packed.shape[axis] == shape[axis] // 8
+        assert (np.asarray(S.unpack_bool(packed, axis=axis)) == mask).all()
+    with pytest.raises(ValueError, match="multiple of 8"):
+        S.pack_bool(np.zeros(13, bool), axis=0)
+
+
+def test_pack_masks_roundtrips_whole_state():
+    vc = _cluster(True)
+    vc.crash([1, 2])
+    packed = S.pack_masks(vc.state)
+    assert packed.alive.shape == (5,) and packed.alive.dtype == jnp.uint8
+    assert packed.released.shape == (2, 5)
+    assert packed.fd_fired.shape == (5, 3)
+    assert packed.report_bits.dtype == vc.state.report_bits.dtype  # untouched
+    un = S.unpack_masks(packed)
+    for field in vc.state._fields:
+        assert (
+            np.asarray(getattr(un, field)) == np.asarray(getattr(vc.state, field))
+        ).all(), field
+    pf = S.pack_masks(vc.faults)
+    assert pf.crashed.shape == (5,)
+    assert (np.asarray(S.unpack_masks(pf).crashed) == np.asarray(vc.faults.crashed)).all()
+
+
+# ---------------------------------------------------------------------------
+# FIRE_NEVER sentinel under the narrowest round dtype (the state.py:30
+# comment, as a test)
+# ---------------------------------------------------------------------------
+
+
+def test_fire_never_sentinel_invariant():
+    # k/n match the module GEOM so initial_state's ring jits are shared.
+    cfg = EngineConfig(n=40, k=3, h=3, l=1, c=2, delivery_spread=2, compact=1)
+    pol = compaction_policy(cfg)
+    assert jnp.dtype(pol.round) == jnp.int16  # the narrowest pick
+    assert pol.fire_never == FIRE_NEVER_NARROW
+    # Storable without wrap, and distinct from every in-envelope round.
+    assert np.int16(pol.fire_never) == pol.fire_never
+    assert pol.fire_never > ROUND_ENVELOPE
+    # The invariant itself: an unfired edge's age (round_idx - sentinel,
+    # accumulated at int32 as the round body does) stays NEGATIVE for
+    # every in-envelope round index, so `age >= delay` can never deliver
+    # (delays are >= 0).
+    rounds = np.arange(0, ROUND_ENVELOPE + 1, dtype=np.int32)
+    ages = rounds - np.int32(pol.fire_never)
+    assert (ages < 0).all()
+    # One past the envelope the distinction is lost — the envelope is the
+    # boundary, not slack.
+    assert (ROUND_ENVELOPE + 1) - pol.fire_never == 0
+    # Round-trip through the converters: sentinel maps narrow<->wide.
+    from rapid_tpu.models.state import initial_state
+
+    rng = np.random.default_rng(0)
+    st = initial_state(
+        cfg,
+        rng.integers(0, 2**32, (3, 40), dtype=np.uint32),
+        rng.integers(0, 2**32, (3, 40), dtype=np.uint32),
+        rng.integers(0, 2**32, 40, dtype=np.uint32),
+        rng.integers(0, 2**32, 40, dtype=np.uint32),
+        np.ones(40, bool),
+    )
+    assert st.fire_round.dtype == jnp.int16
+    assert int(np.asarray(st.fire_round).max()) == FIRE_NEVER_NARROW
+    wide = widen_state(cfg, st)
+    assert wide.fire_round.dtype == jnp.int32
+    assert int(np.asarray(wide.fire_round).max()) == FIRE_NEVER
+    back = narrow_state(cfg, wide)
+    assert (np.asarray(back.fire_round) == np.asarray(st.fire_round)).all()
+
+
+def test_unfired_edges_never_deliver_near_the_envelope_edge():
+    # Engine-level: a compact cluster pushed near the last in-envelope
+    # round index still runs the whole detection->delivery->cut pipeline
+    # correctly — the crashed slot's edges fire and deliver at the high
+    # round stamps while every UNFIRED edge's sentinel age stays negative
+    # (no phantom reports; this is exactly what int16 overflow would break
+    # a few rounds later). Same GEOM config as the differential tests, so
+    # the compiled compact step is shared across the module.
+    vc = _cluster(True)
+    high = ROUND_ENVELOPE - 8
+    vc.state = vc.state._replace(round_idx=jnp.int32(high))
+    vc.crash([3])
+    decided = False
+    for _ in range(8):
+        events = vc.step()
+        bits = np.asarray(vc.state.report_bits)
+        assert (bits[:, :3] == 0).all() and (bits[:, 4:] == 0).all()
+        if bool(events.decided):
+            decided = True
+            assert set(np.nonzero(np.asarray(events.winner_mask))[0]) == {3}
+            break
+    assert decided  # the pipeline completed at envelope-edge round stamps
+
+
+def test_envelope_validation_and_stagger_guard():
+    wide_vc = _cluster(False)
+    cfg = wide_vc.cfg._replace(compact=1)
+    S.validate_envelope(cfg, wide_vc.state)  # clean state passes
+    bad = wide_vc.state._replace(round_idx=jnp.int32(ROUND_ENVELOPE + 5))
+    with pytest.raises(ValueError, match="round_idx"):
+        S.validate_envelope(cfg, bad)
+    comp = _cluster(True)
+    with pytest.raises(ValueError, match="envelope"):
+        comp.stagger_fd_counts(np.random.default_rng(0), spread_rounds=1 << 15)
+
+
+# ---------------------------------------------------------------------------
+# Wide <-> compact bit-identity: the mixed scenario grid
+# ---------------------------------------------------------------------------
+
+
+def _drive_churn(vc):
+    """Crash + join + leave waves through per-round ``step`` dispatches
+    (the compiled ``engine_step`` is shared with the stream differential
+    and the envelope test — one compact compile per session): returns
+    (per-cut labels, config_ids, rounds_per_phase)."""
+    cuts, ids, rounds = [], [], []
+
+    def run(target):
+        for round_idx in range(96):
+            was_alive = np.asarray(vc.state.alive)
+            events = vc.step()
+            if bool(events.decided):
+                mask = np.asarray(events.winner_mask)
+                cuts.append(frozenset(
+                    (s, "down" if was_alive[s] else "up")
+                    for s in np.nonzero(mask)[0].tolist()
+                ))
+                ids.append(vc.config_id)
+                if vc.membership_size == target:
+                    rounds.append(round_idx + 1)
+                    return
+        raise AssertionError(f"did not reach membership {target}")
+
+    vc.crash([1, 5, 9])
+    run(21)
+    vc.inject_join_wave([30, 31])
+    run(23)
+    vc.initiate_leave([2])
+    run(22)
+    return cuts, ids, rounds
+
+
+def test_mixed_churn_differential_wide_vs_compact():
+    """Tier-1 representative: crash wave + join wave + graceful leave,
+    identical decision rounds, cut counts, config-id chains, and final
+    state+faults pytrees (widened) between the wide oracle and the compact
+    engine."""
+    wide, comp = _cluster(False), _cluster(True)
+    _assert_states_identical(wide, comp, "initial")
+    wide_cuts, wide_ids, wide_rounds = _drive_churn(wide)
+    comp_cuts, comp_ids, comp_rounds = _drive_churn(comp)
+    assert wide_cuts and wide_cuts == comp_cuts
+    assert wide_rounds == comp_rounds
+    assert wide_ids == comp_ids
+    _assert_states_identical(wide, comp, "after churn")
+
+
+def test_tenancy_differential_wide_vs_compact():
+    """The tenancy representative: a 2-tenant fleet of compact clusters is
+    bit-identical (widened) to the wide fleet on per-tenant crash waves."""
+    from rapid_tpu.tenancy import TenantFleet
+
+    def fleet(compact):
+        clusters = []
+        for i in range(2):
+            vc = _cluster(compact, n=16, n_slots=16, seed=20 + i)
+            clusters.append(vc)
+        return TenantFleet.from_clusters(clusters)
+
+    fw, fc = fleet(False), fleet(True)
+    for f in (fw, fc):
+        f.stream_crash([(0, 2), (1, 5)])
+    # Per-round batched steps (the compiled fleet_step — the wide one is
+    # shared with the stream-fleet tests' identical config): identical
+    # per-tenant decision rounds and winner masks.
+    decided_rounds_w, decided_rounds_c = [], []
+    for rounds, f in ((decided_rounds_w, fw), (decided_rounds_c, fc)):
+        for round_idx in range(24):
+            events = f.step()
+            for t in np.nonzero(np.asarray(events.decided))[0]:
+                rounds.append((round_idx, int(t),
+                               tuple(np.nonzero(np.asarray(events.winner_mask[t]))[0])))
+    assert decided_rounds_w and decided_rounds_w == decided_rounds_c
+    widened = widen_state(fc.cfg, fc.state)
+    for field in fw.state._fields:
+        a = np.asarray(getattr(fw.state, field))
+        b = np.asarray(getattr(widened, field))
+        assert a.dtype == b.dtype and (a == b).all(), field
+
+
+def test_stream_differential_wide_vs_compact():
+    """The streaming representative: one seeded Poisson schedule through
+    StreamDriver over a wide and a compact cluster — identical cut counts,
+    config chains, and final (widened) state pytrees."""
+    from rapid_tpu.serving import PoissonChurn, StreamDriver
+
+    waves = PoissonChurn(24, 40, rate=1.0, seed=7).waves(5)
+    results = {}
+    for compact in (False, True):
+        vc = _cluster(compact)
+        driver = StreamDriver(vc, rounds_per_wave=4, depth=2)
+        for wave in waves:
+            driver.submit(wave)
+        results[compact] = (vc, driver.drain())
+    (wide, wide_res), (comp, comp_res) = results[False], results[True]
+    assert wide_res.cuts == comp_res.cuts and wide_res.cuts > 0
+    assert wide.config_epoch == comp.config_epoch
+    _assert_states_identical(wide, comp, "stream")
+
+
+@pytest.mark.slow
+def test_adverse_grid_differential_wide_vs_compact():
+    """Broader grid (check.sh's unfiltered pass): partition + classic
+    fallback + concurrent coordinators, windowed FD, and sub-round
+    delivery jitter — every variant bit-identical."""
+    variants = [
+        dict(delivery_spread=2, fallback_rounds=4, concurrent_coordinators=2,
+             cohorts=4, delivery_prob_permille=500),
+        dict(fd_window=5),
+        dict(delivery_spread=3, delivery_prob_permille=250),
+    ]
+    for kw in variants:
+        wide = _cluster(False, n=20, n_slots=32, seed=3, **kw)
+        comp = _cluster(True, n=20, n_slots=32, seed=3, **kw)
+        for vc in (wide, comp):
+            vc.stagger_fd_counts(np.random.default_rng(5), spread_rounds=3)
+            if kw.get("cohorts"):
+                rx = np.zeros((kw["cohorts"], 32), bool)
+                rx[1, :] = True
+                vc.set_rx_block(rx)
+            vc.crash([0, 7])
+        rw = wide.run_until_membership(18, min_cuts=1, max_steps=160)
+        rc = comp.run_until_membership(18, min_cuts=1, max_steps=160)
+        assert rw == rc, kw
+        _assert_states_identical(wide, comp, str(kw))
+
+
+# ---------------------------------------------------------------------------
+# Mesh placement: the unchanged rule table covers compact + packed shapes
+# ---------------------------------------------------------------------------
+
+
+def test_compact_and_packed_states_place_through_the_same_rules():
+    from rapid_tpu.parallel.mesh import (
+        ShardingShapeError,
+        make_mesh,
+        shard_faults,
+        shard_state,
+    )
+
+    if jax.device_count() < 8:
+        pytest.skip("needs the forced 8-device CPU mesh")
+    vc = _cluster(True, n=60, n_slots=64)
+    mesh = make_mesh(jax.devices()[:8])
+    sharded = shard_state(vc.state, mesh)
+    assert sharded.fd_count.dtype == jnp.int16
+    assert sharded.report_bits.dtype == jnp.uint8
+    assert (np.asarray(sharded.alive) == np.asarray(vc.state.alive)).all()
+    shard_faults(vc.faults, mesh)
+    # Packed masks through the SAME table: [64] -> [8] divides 8 devices.
+    placed = shard_state(S.pack_masks(vc.state), mesh)
+    assert placed.alive.shape == (8,) and placed.alive.dtype == jnp.uint8
+    # n=40 packs to [5], which does NOT divide 8 devices: the named
+    # validation error, not XLA's opaque per-shard failure.
+    bad = S.pack_masks(_cluster(True).state)
+    with pytest.raises(ShardingShapeError, match="pad_to_multiple"):
+        shard_state(bad, mesh)
+
+
+def test_checkpoint_roundtrips_compact_state(tmp_path):
+    from rapid_tpu.utils.checkpoint import load_engine_state, save_engine_state
+
+    vc = _cluster(True)
+    vc.crash([1, 4])
+    vc.run_until_converged(64)
+    path = tmp_path / "compact.npz"
+    save_engine_state(path, vc.cfg, vc.state)
+    cfg2, state2 = load_engine_state(path)
+    assert cfg2 == vc.cfg and cfg2.compact == 1
+    for field in vc.state._fields:
+        a, b = np.asarray(getattr(vc.state, field)), np.asarray(getattr(state2, field))
+        assert a.dtype == b.dtype and (a == b).all(), field

@@ -370,6 +370,8 @@ _CORPUS_CHECKERS = {
     "missing_partition_rule.py": ("rapid_tpu/parallel/_corpus.py", "check_sharding"),
     "tenant_partition_rule.py": ("rapid_tpu/tenancy/_corpus.py", "check_sharding"),
     "retrace_hazard.py": ("rapid_tpu/models/_corpus.py", "check_sharding"),
+    "dtype_widening.py": ("rapid_tpu/models/_corpus.py", "check_sharding"),
+    "clean_dtype_widening.py": ("rapid_tpu/models/_corpus.py", "check_sharding"),
     "clean_sharding.py": ("rapid_tpu/parallel/_corpus.py", "check_sharding"),
     "chaos_unknown_kind.py": ("rapid_tpu/sim/_corpus.py", "check_chaosvocab"),
     "clean_chaosvocab.py": ("rapid_tpu/sim/_corpus.py", "check_chaosvocab"),

@@ -97,6 +97,7 @@ ALL_CHECK_NAMES = frozenset({
     "host-sync-in-stream",
     "donation-mismatch",
     "retrace-hazard",
+    "dtype-widening",
     # chaosvocab family
     "chaos-unknown-kind",
     "chaos-family-drift",
@@ -128,8 +129,8 @@ FAMILIES = (
                        "memory) frozen in hlo.lock.json"),
     ("sharding", "engine sharding discipline: partition-spec coverage, "
                  "host syncs in the hot path and the streaming pipeline, "
-                 "donation/static-argnames at jit seams "
-                 "(ops/models/parallel/serving)"),
+                 "donation/static-argnames at jit seams, dtype-widening "
+                 "on policy-narrowed lanes (ops/models/parallel/serving)"),
     ("chaosvocab", "chaos vocabulary discipline: FaultEvent kinds, scenario "
                    "FAMILIES, fleet mix tables, and the chaosrun CLI cannot "
                    "drift from the registered registries"),

@@ -130,6 +130,21 @@ def _build_registry() -> "Dict[str, Dict[str, Any]]":
     state, faults = vc.state, vc.faults
     state_leaves = len(jax.tree_util.tree_leaves(state))
 
+    # The compact-state twin (ISSUE 13): identical geometry/seed, state
+    # stored at the config-derived narrow dtypes. Registered so the lock
+    # freezes the per-device argument-byte saving of the [k,n]/[c,n]-
+    # dominated entrypoints against the wide layout above — and so any
+    # future compiled-program drift of the compact path fails the gate
+    # like every other entrypoint.
+    vc_c = VirtualCluster.create(
+        AUDIT_N - AUDIT_DEVICES, n_slots=AUDIT_N, k=AUDIT_K, h=3, l=1,
+        fd_threshold=2, cohorts=AUDIT_C, delivery_spread=2, seed=0,
+        compact=True,
+    )
+    vc_c.assign_cohorts_roundrobin()
+    cfg_c = vc_c.cfg
+    state_c, faults_c = vc_c.state, vc_c.faults
+
     registry: Dict[str, Dict[str, Any]] = {
         "step": {
             "jit": jax.jit(
@@ -161,6 +176,21 @@ def _build_registry() -> "Dict[str, Dict[str, Any]]":
             "jit": jax.jit(sync_checksum_impl),
             "args": (state, faults),
             "donated_leaves": 0,
+        },
+        # Only the compact STEP is registered (the PR-9 convention that
+        # kept the 2-D step unregistered): the wave's argument surface is
+        # byte-identical to the step's modulo three trailing int32 control
+        # scalars, so the step alone freezes the compaction saving, while
+        # a second compact while-loop compile would cost ~10 s of every
+        # tier-1 session. The compact wave path stays differentially
+        # driven against the wide oracle in tests/test_state_compaction.py
+        # (the adverse grid rides check.sh's unfiltered pass).
+        "step_compact": {
+            "jit": jax.jit(
+                lambda s, f: engine_step_impl(cfg_c, s, f), donate_argnums=(0,)
+            ),
+            "args": (state_c, faults_c),
+            "donated_leaves": state_leaves,
         },
     }
     if jax.device_count() >= AUDIT_DEVICES:
@@ -319,6 +349,15 @@ def extract_facts(
         }
     facts = {
         "collectives": collectives,
+        # Entry-signature bytes per dtype: the artifact-level proof of the
+        # state-compaction policy (compact entrypoints carry s8/s16/u8
+        # argument lanes; the wide oracle only s32/u32/pred). Informational
+        # in the lock — argument_bytes is the exact-compared budget; an
+        # unknown dtype here surfaces through the same hlo-unknown-dtype
+        # finding as the payload accounting.
+        "parameter_dtype_bytes": hlo_facts.entry_parameter_bytes(
+            text, unknown=unknown
+        ),
         "transfers": hlo_facts.count_transfer_ops(text),
         "donation": {
             "donated_leaves": donated_leaves,
@@ -472,6 +511,7 @@ def facts_to_lock(facts: Dict[str, Any]) -> Dict[str, Any]:
             "transfers": entry["transfers"],
             "donation": donation,
             "memory": entry["memory"],
+            "parameter_dtype_bytes": entry["parameter_dtype_bytes"],
         }
         if "cross_tenant_collectives" in entry:
             lock["entrypoints"][name]["cross_tenant_collectives"] = entry[
@@ -692,10 +732,51 @@ def check_hlo_lock(trees: Sequence[Tuple[ast.AST, str]]) -> List[Finding]:
     return compare_lock(facts, locked, HLO_LOCK_REL)
 
 
+def compaction_differential_ok() -> Optional[str]:
+    """Run a small mixed crash+join scenario through the WIDE engine and
+    the COMPACT engine (same geometry/seed) and compare the widened compact
+    state leaf-for-leaf. Returns None on bit-identity, else a message
+    naming the first divergent lane. ``update_hlo_lock`` refuses to freeze
+    new memory budgets while this disagrees: a compact layout that has
+    drifted from its oracle must be fixed, not locked in."""
+    import numpy as np
+
+    from rapid_tpu.models.state import widen_state
+    from rapid_tpu.models.virtual_cluster import VirtualCluster
+
+    def drive(compact: bool) -> VirtualCluster:
+        vc = VirtualCluster.create(
+            56, n_slots=64, k=3, h=3, l=1, cohorts=4, fd_threshold=2,
+            delivery_spread=1, seed=17, compact=compact,
+        )
+        vc.assign_cohorts_roundrobin()
+        vc.crash([1, 9, 20])
+        vc.inject_join_wave([60, 61])
+        vc.run_until_membership(55, min_cuts=2)
+        return vc
+
+    wide, compact = drive(False), drive(True)
+    widened = widen_state(compact.cfg, compact.state)
+    for field in wide.state._fields:
+        a = np.asarray(getattr(wide.state, field))
+        b = np.asarray(getattr(widened, field))
+        if a.dtype != b.dtype or not (a == b).all():
+            return (
+                f"wide<->compact differential disagrees on state lane "
+                f"{field!r} (crash+join scenario at n=64) — fix the "
+                f"compaction layer before regenerating the lock"
+            )
+    if wide.config_id != compact.config_id:
+        return "wide<->compact differential disagrees on the configuration id"
+    return None
+
+
 def update_hlo_lock() -> Tuple[List[Finding], Optional[Path]]:
     """Regenerate the lockfile from freshly-collected facts. Refuses while
-    an unknown dtype or an unwaived dropped donation is present — a budget
-    the gate would immediately fail must be fixed, not frozen."""
+    an unknown dtype, an unwaived dropped donation, or a wide<->compact
+    state differential disagreement is present — a budget the gate would
+    immediately fail (or a compact layout that no longer matches its
+    oracle) must be fixed, not frozen."""
     try:
         facts = collect_facts()
     except RuntimeError as exc:
@@ -707,6 +788,9 @@ def update_hlo_lock() -> Tuple[List[Finding], Optional[Path]]:
             if f.check in ("hlo-unknown-dtype", "hlo-donation-dropped",
                            "hlo-cross-tenant-collective")
         )
+    mismatch = compaction_differential_ok()
+    if mismatch:
+        blocking.append(Finding(HLO_LOCK_REL, 1, "hlo-lock-drift", mismatch))
     if blocking:
         return blocking, None
     lock_path = core.REPO / HLO_LOCK_REL

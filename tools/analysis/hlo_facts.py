@@ -29,6 +29,7 @@ from rapid_tpu.parallel.hlo_facts import (  # noqa: E402,F401 — re-exported
     collective_groups,
     collective_violations,
     count_transfer_ops,
+    entry_parameter_bytes,
     groups_cross_blocks,
     input_output_aliases,
     payload_class,
@@ -46,6 +47,7 @@ __all__ = [
     "collective_violations",
     "collective_groups",
     "count_transfer_ops",
+    "entry_parameter_bytes",
     "groups_cross_blocks",
     "input_output_aliases",
     "payload_class",

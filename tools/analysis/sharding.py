@@ -46,6 +46,17 @@ compiled programs, over ``rapid_tpu/ops/``, ``rapid_tpu/models/``, and
   again — one silent recompile per spelling. Wrap the constant
   (``jnp.int32(x)``) or pin the parameter static. Escape hatch
   ``# retrace-ok: <reason>``.
+- ``dtype-widening`` — inline arithmetic stored back into a
+  policy-NARROWED engine lane (``models/state.NARROWABLE_LANES`` — int8/
+  int16/uint8 under the compact policy) without an explicit cast: jnp
+  type promotion silently re-widens the whole lane to int32/uint32 the
+  moment a wide operand touches the expression, un-doing the compaction
+  byte-for-byte while every test keeps passing (wide mode compiles
+  identically). Convicts a ``_replace(...)``/state-constructor keyword
+  for a narrowed lane whose value contains a BinOp not wrapped in an
+  ``.astype(...)``; name-only stores pass (the round body's convention:
+  compute, cast, bind, store the name). Escape hatch
+  ``# widen-ok: <reason>``.
 
 Resolution is conservative (skip-don't-guess), matching the rest of the
 package: only same-file jit applications are resolved, only direct
@@ -427,6 +438,75 @@ def _check_retrace(
             ))
 
 
+# -- dtype-widening ----------------------------------------------------------
+
+#: The engine lanes the compact policy stores below 32 bits — the LITERAL
+#: mirror of ``rapid_tpu/models/state.NARROWABLE_LANES`` (the analysis
+#: package imports no jax-bearing library module; the two sets are pinned
+#: equal by tests/test_state_compaction.py so they cannot drift).
+NARROWED_LANES = frozenset({
+    "ring_perm", "obs_idx", "subj_idx", "inval_obs", "cohort_of",
+    "fd_count", "fd_hist", "fire_round", "report_bits",
+    "cp_rnd_r", "cp_rnd_i", "cp_vrnd_r", "cp_vrnd_i", "cp_vval_src",
+    "classic_epoch", "rounds_undecided",
+})
+
+#: Call shapes whose keywords are lane STORES: the NamedTuple ``_replace``
+#: method and the state-pytree constructors themselves.
+_STORE_CONSTRUCTORS = frozenset({"EngineState", "FaultInputs"})
+
+
+def _binop_outside_astype(node: ast.AST, inside: bool = False) -> bool:
+    """True when the expression contains a BinOp not enclosed by an
+    ``.astype(...)`` call — arithmetic whose result dtype is promotion's
+    choice, not the lane's. Comparisons and boolean ops are excluded (they
+    produce bools, which no narrowed lane stores)."""
+    if isinstance(node, ast.Call) and (
+        isinstance(node.func, ast.Attribute) and node.func.attr == "astype"
+    ):
+        inside = True
+    if isinstance(node, ast.BinOp) and not inside:
+        return True
+    return any(
+        _binop_outside_astype(child, inside) for child in ast.iter_child_nodes(node)
+    )
+
+
+def _check_dtype_widening(
+    tree: ast.AST,
+    rel: str,
+    source_lines: List[str],
+    findings: List[Finding],
+) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        is_replace = (
+            isinstance(node.func, ast.Attribute) and node.func.attr == "_replace"
+        )
+        is_ctor = (
+            isinstance(node.func, ast.Name)
+            and node.func.id in _STORE_CONSTRUCTORS
+        )
+        if not (is_replace or is_ctor):
+            continue
+        for kw in node.keywords:
+            if kw.arg not in NARROWED_LANES:
+                continue
+            if not _binop_outside_astype(kw.value):
+                continue
+            if _comment_ok(source_lines, kw.value.lineno, "# widen-ok:"):
+                continue
+            findings.append(Finding(
+                rel, kw.value.lineno, "dtype-widening",
+                f"arithmetic stored into policy-narrowed lane {kw.arg!r} "
+                f"without an explicit cast: jnp type promotion re-widens "
+                f"the lane to 32 bits the moment a wide operand appears — "
+                f"accumulate in int32 and `.astype(...)` the store (or "
+                f"justify with `# widen-ok: <reason>`)",
+            ))
+
+
 # -- missing-partition-spec --------------------------------------------------
 
 #: The regex rule table's module-level name (parallel/mesh.py).
@@ -744,6 +824,7 @@ def check_sharding(
     _check_host_sync(tree, aliases, rel, source_lines, findings)
     _check_donation(tree, aliases, rel, source_lines, findings)
     _check_retrace(tree, aliases, rel, source_lines, findings)
+    _check_dtype_widening(tree, rel, source_lines, findings)
     fields = _pytree_array_fields(tree)
     rules = _partition_rules(tree)
     if fields and rules is not None:

@@ -10,10 +10,19 @@ echo "== static checks (AST lint + resolution tier + compiled-program gate) =="
 # test_hlo_gate.py first: it compiles the registered engine entrypoints
 # ONCE per session — including the 2-D ('cohort','nodes') mesh wave
 # (sharded2d_wave; the 2-D step is deliberately unregistered, see
-# device_program._build_registry) and the multi-tenant fleet pair on the
+# device_program._build_registry), the multi-tenant fleet pair on the
 # 3-D ('tenant','cohort','nodes') mesh (fleet3d_step/fleet3d_wave, the
-# zero-cross-tenant-collective budget) — so the lint/staticcheck tree
+# zero-cross-tenant-collective budget), and the compact-state step
+# (step_compact — the memory budget that freezes the dtype-narrowing
+# saving; one representative per the PR-9 compile-cost convention) —
+# so the lint/staticcheck tree
 # sweeps in the same session reuse the facts instead of recompiling.
+#
+# Memory-budget regen after a compaction-policy change: run
+#   python tools/staticcheck.py --update-hlo-lock
+# (under XLA_FLAGS=--xla_force_host_platform_device_count=8). It refuses
+# while the wide<->compact state differential disagrees — a compact layout
+# that drifted from its oracle must be fixed, never frozen into the lock.
 python -m pytest tests/test_hlo_gate.py tests/test_lint.py tests/test_staticcheck.py -q -p no:randomly
 
 echo "== full suite (CPU, 8 virtual devices) =="

@@ -26,12 +26,14 @@ runs, and can I trust the numbers". Two input kinds, freely mixed:
   ``tenant_view_changes_per_sec`` and ``tenant_fleet_status``), and
   ``stream-missing`` (same discipline for the streaming-serving point:
   an audited round omitting BOTH ``stream_view_changes_per_sec`` and
-  ``stream_status``), and ``chaos-missing`` (same discipline for the
+  ``stream_status``), ``chaos-missing`` (same discipline for the
   adversarial-chaos point: an audited round omitting BOTH
-  ``chaos_scenarios_per_sec`` and ``chaos_status``). The N1M, FLEET,
-  STREAM, and CHAOS columns render the headline / fleet /
-  sustained-stream / chaos-throughput values (or their status markers)
-  per round.
+  ``chaos_scenarios_per_sec`` and ``chaos_status``), and ``mem-missing``
+  (same discipline for the state-compaction memory point: an audited
+  round omitting BOTH ``bytes_per_member`` and ``mem_status``). The N1M,
+  FLEET, STREAM, CHAOS, and MEM columns render the headline / fleet /
+  sustained-stream / chaos-throughput / bytes-per-member values (or
+  their status markers) per round.
 
 ``--chrome out.json`` additionally writes Chrome trace-event JSON (the same
 envelope tools/traceview.py emits — Perfetto/chrome://tracing load it):
@@ -331,6 +333,16 @@ def point_flags(
         and not data.get("chaos_status")
     ):
         flags.append("chaos-missing")
+    # Memory discipline (ISSUE 13): same rule for the state-compaction
+    # point — an audited round must carry bytes_per_member or its explicit
+    # mem_status marker; the memory-footprint metric must never be
+    # silently absent. Pre-audit historical rounds are exempt.
+    if (
+        hlo_audit_table(data) is not None
+        and not isinstance(data.get("bytes_per_member"), (int, float))
+        and not data.get("mem_status")
+    ):
+        flags.append("mem-missing")
     if hlo_drift(prev, hlo_audit_table(data)):
         flags.append("hlo-drift")
     if not flags:
@@ -394,6 +406,21 @@ def stream_cell(data: Dict[str, Any]) -> str:
     return str(status) if status else "-"
 
 
+def mem_cell(data: Dict[str, Any]) -> str:
+    """The MEM column: compact bytes/member (with the wide figure beside
+    it when present), else the explicit mem_status marker, else '-'
+    (pre-compaction rounds)."""
+    value = data.get("bytes_per_member")
+    if isinstance(value, (int, float)):
+        wide = data.get("bytes_per_member_wide")
+        suffix = (
+            f" (wide {float(wide):.0f})" if isinstance(wide, (int, float)) else ""
+        )
+        return f"{float(value):.0f}B/m{suffix}"
+    status = data.get("mem_status")
+    return str(status) if status else "-"
+
+
 def chaos_cell(data: Dict[str, Any]) -> str:
     """The CHAOS column: adversarial scenarios resolved (and oracle-checked
     clean) per second of batched fleet dispatch, with the tenant count when
@@ -411,7 +438,7 @@ def chaos_cell(data: Dict[str, Any]) -> str:
 def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
     lines = ["== perf trajectory =="]
     header = ("ROUND", "METRIC", "VALUE", "N1M", "FLEET", "STREAM", "CHAOS",
-              "PLATFORM", "VSBASE", "FLAGS")
+              "MEM", "PLATFORM", "VSBASE", "FLAGS")
     rows: List[Tuple[str, ...]] = []
     flag_rows: List[Tuple[str, List[str]]] = []
     prev_audit: Optional[Dict[str, Any]] = None
@@ -430,6 +457,7 @@ def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
             fleet_cell(data),
             stream_cell(data),
             chaos_cell(data),
+            mem_cell(data),
             str(data.get("platform", "-")),
             "-" if vs is None else f"{float(vs):.2f}x"
             + ("@capture" if "vs_baseline_at_capture" in data else ""),
