@@ -355,6 +355,7 @@ STAGE_TIMEOUTS_S = {
     "tenant_fleet": 900,
     "stream": 900,
     "chaos": 900,
+    "recovery": 600,
     "hlo_audit": 600,
     "profile": 600,
 }
@@ -484,6 +485,41 @@ def chaos_plan(platform: str, elapsed_s: float) -> "tuple[int, str]":
     return b, f"ramped:{b}x{N_SLOTS}"
 
 
+def recovery_plan(platform: str, elapsed_s: float) -> "tuple[int, int, str]":
+    """The self-healing drill decision (ISSUE 15), pure over (platform,
+    elapsed seconds) + env: returns (members per cluster N, waves to
+    stream, recovery_status). N == 0 means the stage is skipped — but the
+    status STILL lands in the emitted JSON, so the MTTR metric is never
+    silently absent (the n1M_status discipline). The drill: a supervised
+    stream with an injected transient failure and a simulated process kill
+    mid-schedule, checkpoint-cadence writes, a deterministic resume (the
+    measured MTTR), and a bit-identity check against the uninterrupted
+    twin. On the accelerator (or RAPID_TPU_BENCH_RECOVERY=1) it runs at
+    N=4096 x 16 waves; a CPU run exercises the full drill ramped down
+    (RAPID_TPU_BENCH_RECOVERY_N/_WAVES, default 64 x 6); past the budget
+    (RAPID_TPU_BENCH_RECOVERY_BUDGET_S, defaulting to the XL budget) it is
+    skipped-budget; RAPID_TPU_BENCH_NO_RECOVERY=1 suppresses it
+    everywhere. Unit-pinned in tests/test_bench_ledger.py."""
+    if _env_flag("RAPID_TPU_BENCH_NO_RECOVERY"):
+        return 0, 0, "suppressed"
+    forced = _env_flag("RAPID_TPU_BENCH_RECOVERY")
+    budget_s = _env_int(
+        "RAPID_TPU_BENCH_RECOVERY_BUDGET_S",
+        _env_int("RAPID_TPU_BENCH_XL_BUDGET_S", 1500),
+    )
+    if elapsed_s > budget_s and not forced:
+        return 0, 0, "skipped-budget"
+    if platform == "tpu" or forced:
+        return (
+            _env_int("RAPID_TPU_BENCH_RECOVERY_N", 4096),
+            _env_int("RAPID_TPU_BENCH_RECOVERY_WAVES", 16),
+            "live",
+        )
+    n_r = _env_int("RAPID_TPU_BENCH_RECOVERY_N", 64)
+    waves = _env_int("RAPID_TPU_BENCH_RECOVERY_WAVES", 6)
+    return n_r, waves, f"ramped:{waves}x{n_r}"
+
+
 def _parse_scale(spec: str) -> int:
     """'10M' -> 10_000_000, '250k' -> 250_000, bare ints pass through; 0 on
     anything unparseable (the stretch point is opt-in — a typo'd env value
@@ -523,7 +559,23 @@ def run_workload(ledger, profile_dir=None) -> None:
 
         platform = jax.devices()[0].platform
         _mark(f"devices initialized: platform={platform} count={len(jax.devices())}")
-        _enable_persistent_compile_cache()
+        if platform == "cpu":
+            # DELIBERATELY no persistent compile cache on the CPU backend:
+            # executables deserialized from it corrupt the heap under
+            # donated executions on this jaxlib — sometimes a glibc abort,
+            # sometimes SILENT scribbling over unrelated live buffers.
+            # Root-caused twice: first for sharded executables (the
+            # device_program audit scopes the cache off,
+            # tools/analysis/device_program.py), then for single-device
+            # ones by the recovery drill's bit-identity assertion — the
+            # one bench workload that CHECKS bits caught what every other
+            # stage silently tolerated. CPU runs are ramped-down smoke
+            # paths; cold compiles cost seconds and measure real code.
+            _mark("persistent compilation cache disabled on cpu "
+                  "(deserialized executables corrupt donated executions; "
+                  "see tools/analysis/device_program.py)")
+        else:
+            _enable_persistent_compile_cache()
 
     import numpy as np
 
@@ -1080,13 +1132,13 @@ def run_workload(ledger, profile_dir=None) -> None:
                 "stream_fleet_tenants": stream_b,
                 "stream_view_changes": cuts_total,
                 "stream_wall_ms": round(wall_ms_total, 3),
-                "stream_cluster_view_changes_per_sec": (
-                    round(cluster_stream.view_changes_per_sec, 2)
-                    if cluster_stream.view_changes_per_sec is not None else None
+                # Always floats post-drain (0.0 on degenerate streams —
+                # the ISSUE-15 rate-math contract), never None.
+                "stream_cluster_view_changes_per_sec": round(
+                    cluster_stream.view_changes_per_sec, 2
                 ),
-                "stream_fleet_view_changes_per_sec": (
-                    round(fleet_stream.view_changes_per_sec, 2)
-                    if fleet_stream.view_changes_per_sec is not None else None
+                "stream_fleet_view_changes_per_sec": round(
+                    fleet_stream.view_changes_per_sec, 2
                 ),
                 "stream_h2d_bytes": cluster_stream.h2d_bytes + fleet_stream.h2d_bytes,
                 # Compiles that landed INSIDE the timed stream (per-delta-
@@ -1158,6 +1210,157 @@ def run_workload(ledger, profile_dir=None) -> None:
             )
         ledger.emit(LedgerEvent.COMPILE_STATS, stage="chaos",
                     **chaos_compiles.delta)
+
+    # Self-healing drill (ISSUE 15): a supervised stream with an injected
+    # transient dispatch failure and a simulated process kill mid-schedule;
+    # the supervisor retries on seeded backoff, writes checkpoint-cadence
+    # fleet checkpoints, and the drill resumes from the newest valid one —
+    # the measured resume duration is recovery_mttr_ms, and the resumed
+    # run's final state must be BIT-IDENTICAL to an uninterrupted twin
+    # (asserted, not assumed). Never silently absent: recovery_status
+    # always lands in the emitted JSON (the n1M_status discipline).
+    recovery_n, recovery_waves, recovery_status = recovery_plan(
+        platform, time.monotonic() - _START
+    )
+    recovery_fields = {}
+    if recovery_n == 0:
+        _mark(f"recovery stage not run: {recovery_status}")
+    else:
+        import tempfile
+
+        from rapid_tpu.serving import (
+            PoissonChurn as _RecChurn,
+            SimulatedProcessKill,
+            Supervisor,
+            SupervisorFaultPlan,
+            recovery as serving_recovery,
+        )
+
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _no_persistent_cache():
+            # SCOPED: the drill's executables must be FRESH compiles, never
+            # deserialized from the persistent cache. Root-caused via this
+            # very stage's bit-identity assertion (the sibling note in
+            # tools/analysis/device_program.py covers the sharded flavor):
+            # on this jaxlib's CPU backend, executables deserialized from
+            # the cache corrupt the heap under donated executions —
+            # sometimes a glibc double-free abort, sometimes SILENT
+            # scribbling over unrelated live buffers (observed: the twin's
+            # static key lanes diverging). The drill is the one bench
+            # workload that *checks* bits, so it must not run poisoned; its
+            # shapes are stage-unique, so the scoped disable guarantees
+            # fresh compiles at a few seconds' cost.
+            prev = None
+            restore = False
+            try:
+                prev = jax.config.jax_compilation_cache_dir
+                jax.config.update("jax_compilation_cache_dir", None)
+                restore = True
+            except Exception:  # noqa: BLE001 — no cache knob, nothing to scope
+                pass
+            try:
+                yield
+            finally:
+                if restore:
+                    jax.config.update("jax_compilation_cache_dir", prev)
+
+        rec_rounds = _env_int("RAPID_TPU_BENCH_RECOVERY_ROUNDS", 4)
+        rec_slots = recovery_n + 2 * recovery_waves
+        rec_kill_after = recovery_waves // 2
+        rec_every = max(1, recovery_waves // 3)
+
+        def build_recovery_cluster(seed: int):
+            vcr = VirtualCluster.create(
+                recovery_n, n_slots=rec_slots, k=k_rings, h=9, l=4,
+                cohorts=min(8, recovery_n), fd_threshold=fd_threshold,
+                seed=seed, delivery_spread=delivery_spread,
+            )
+            vcr.assign_cohorts_roundrobin()
+            return vcr
+
+        with ledger.stage(
+            "recovery", timeout_s=_stage_timeout("recovery"),
+            n=recovery_n,
+        ), _no_persistent_cache():
+            with _heartbeat(f"recovery drill N={recovery_n}"):
+                # Uninterrupted twin: the bit-identity oracle.
+                twin = build_recovery_cluster(seed=8_000)
+                twin_sup = Supervisor(twin, rounds_per_wave=rec_rounds)
+                for wave in _RecChurn(
+                    recovery_n, rec_slots, rate=2.0, seed=8_100
+                ).waves(recovery_waves):
+                    twin_sup.submit(wave)
+                twin_sup.drain()
+                # The drill: transient failure at wave 1, kill mid-schedule.
+                ckpt_dir = tempfile.mkdtemp(prefix="rapid-recovery-")
+                drill = build_recovery_cluster(seed=8_000)
+                drill_sup = Supervisor(
+                    drill, rounds_per_wave=rec_rounds,
+                    checkpoint_dir=ckpt_dir, checkpoint_every=rec_every,
+                    fault_plan=SupervisorFaultPlan(
+                        transient_submit=((1, 1),),
+                        kill_after_wave=rec_kill_after,
+                    ),
+                    ledger=ledger, ledger_stage="recovery",
+                )
+                churn = _RecChurn(recovery_n, rec_slots, rate=2.0, seed=8_100)
+                killed_at = None
+                try:
+                    for wave_idx in range(recovery_waves):
+                        drill_sup.submit(churn.wave())
+                except SimulatedProcessKill as exc:
+                    killed_at = exc.wave_index
+                assert killed_at is not None, "drill kill never fired"
+                t_rec = time.monotonic()
+                resumed_sup, next_wave = serving_recovery.resume(
+                    ckpt_dir, checkpoint_every=rec_every,
+                    ledger=ledger, ledger_stage="recovery",
+                )
+                churn2 = serving_recovery.fast_forward(
+                    _RecChurn(recovery_n, rec_slots, rate=2.0, seed=8_100),
+                    next_wave,
+                )
+                for wave_idx in range(next_wave, recovery_waves):
+                    resumed_sup.submit(churn2.wave())
+                resumed = resumed_sup.drain()
+                mttr_ms = resumed_sup.last_resume_ms
+                resume_to_serving_ms = (time.monotonic() - t_rec) * 1000.0
+                import jax as _jax
+
+                bit_identical = bool(_jax.tree_util.tree_all(
+                    _jax.tree_util.tree_map(
+                        lambda a, b: bool((np.asarray(a) == np.asarray(b)).all()),
+                        resumed_sup.target.state, twin.state,
+                    )
+                )) and resumed_sup.target.config_id == twin.config_id
+                assert bit_identical, (
+                    "resumed drill diverged from the uninterrupted twin"
+                )
+            recovery_fields = {
+                "recovery_mttr_ms": round(mttr_ms, 3),
+                "recovery_resume_to_serving_ms": round(
+                    resume_to_serving_ms, 3
+                ),
+                "recovery_killed_after_wave": killed_at,
+                "recovery_resumed_wave": next_wave,
+                "recovery_waves": recovery_waves,
+                "recovery_n": recovery_n,
+                "recovery_checkpoints": int(
+                    drill.metrics.counters.get("engine_recovery_checkpoints", 0)
+                ),
+                "recovery_retries": int(
+                    drill.metrics.counters.get("engine_recovery_retries", 0)
+                ),
+                "recovery_replayed_cuts": resumed.cuts,
+                "recovery_bit_identical": bit_identical,
+            }
+            _mark(
+                f"recovery: killed after wave {killed_at}, resumed at wave "
+                f"{next_wave} in {mttr_ms:.1f} ms (serving again in "
+                f"{resume_to_serving_ms:.1f} ms), final state bit-identical"
+            )
 
     # Compiled-program audit (ISSUE 8, analysis family 12): compile the
     # registered engine entrypoints at the fixed audit shapes ON THIS
@@ -1283,6 +1486,14 @@ def run_workload(ledger, profile_dir=None) -> None:
         # stage-path exercise; "skipped-budget"; "suppressed").
         "chaos_status": chaos_status,
         **{k: v for k, v in chaos_fields.items() if v is not None},
+        # Self-healing drill point (ISSUE 15): MTTR of the deterministic
+        # checkpoint-resume after an injected mid-stream kill, with the
+        # bit-identity verdict beside it. Never silently absent —
+        # recovery_status says exactly what the point is when the value
+        # itself is missing ("ramped:WxN" = CPU drill; "skipped-budget";
+        # "suppressed").
+        "recovery_status": recovery_status,
+        **{k: v for k, v in recovery_fields.items() if v is not None},
         "samples_ms": [round(s, 3) for s in samples],
         "churn_resolution_hist": sample_hist.summary(),
         "view_changes": cuts_per_sample,

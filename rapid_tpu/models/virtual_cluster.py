@@ -838,6 +838,10 @@ class VirtualCluster(DispatchSeam):
         # surfaces its sustained-throughput stats through this cluster's
         # telemetry snapshot (None = batch-only driver, no stream section).
         self.stream = None
+        # Attached by rapid_tpu.serving.supervisor.Supervisor: the
+        # self-healing tier's checkpoint/retry/wedge stats (None = no
+        # supervision, no recovery section).
+        self.recovery = None
         engine_telemetry.install()
 
     # -- construction ---------------------------------------------------
@@ -1369,6 +1373,13 @@ class VirtualCluster(DispatchSeam):
                 **(
                     {"stream": self.stream.snapshot()}
                     if self.stream is not None
+                    else {}
+                ),
+                # Supervision tier: present only when a Supervisor is
+                # attached (same stable-series rule).
+                **(
+                    {"recovery": self.recovery.snapshot()}
+                    if self.recovery is not None
                     else {}
                 ),
             },

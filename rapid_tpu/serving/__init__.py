@@ -7,8 +7,17 @@ dispatches and synchronizes only at explicit fetch boundaries;
 :class:`~rapid_tpu.serving.stream.PoissonChurn` turns a seeded arrival-rate
 spec into per-wave churn deltas in the sim families' fault vocabulary, so
 chaos schedules stream through the same pipe.
+
+``supervisor`` + ``recovery`` hold the self-healing tier over that pipeline:
+deadline-bounded dispatch with seeded-backoff retries
+(:class:`~rapid_tpu.serving.supervisor.Supervisor`), crash-consistent
+checkpoint/resume with bit-identical deterministic replay, per-tenant
+quarantine of poisoned fleet tenants, and the seeded
+:class:`~rapid_tpu.serving.supervisor.SupervisorFaultPlan` that injects
+every failure class the tier must survive.
 """
 
+from rapid_tpu.serving import recovery  # noqa: F401
 from rapid_tpu.serving.stream import (  # noqa: F401
     STREAMABLE_KINDS,
     FleetPoissonChurn,
@@ -19,14 +28,31 @@ from rapid_tpu.serving.stream import (  # noqa: F401
     StreamWave,
     waves_from_schedule,
 )
+from rapid_tpu.serving.supervisor import (  # noqa: F401
+    BackoffPolicy,
+    DispatchWedgedError,
+    SimulatedProcessKill,
+    Supervisor,
+    SupervisorBudgets,
+    SupervisorFaultPlan,
+    TransientDispatchError,
+)
 
 __all__ = [
+    "BackoffPolicy",
+    "DispatchWedgedError",
     "FleetPoissonChurn",
     "FleetWave",
     "PoissonChurn",
+    "SimulatedProcessKill",
     "StreamDriver",
     "StreamResult",
     "StreamWave",
     "STREAMABLE_KINDS",
+    "Supervisor",
+    "SupervisorBudgets",
+    "SupervisorFaultPlan",
+    "TransientDispatchError",
+    "recovery",
     "waves_from_schedule",
 ]

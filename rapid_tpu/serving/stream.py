@@ -266,7 +266,7 @@ class StreamResult(NamedTuple):
     rounds: int  # engine rounds enqueued (waves * rounds_per_wave)
     cuts: int  # view changes committed (config-epoch delta, fetched once)
     wall_ms: float  # first submit -> drain completion
-    view_changes_per_sec: Optional[float]  # cuts over wall (None pre-traffic)
+    view_changes_per_sec: float  # cuts over wall (0.0 on zero-wave/zero-elapsed drains)
     p99_alert_to_commit_ms: Optional[float]  # submit -> observed-complete p99
     overlap_efficiency: Optional[float]  # 1 - fetch-blocked/wall, in [0, 1]
     fetch_blocked_ms: float  # host time in stream_fetch (the un-overlapped part)
@@ -308,7 +308,15 @@ class StreamDriver:
     returns the :class:`StreamResult` with the sustained metrics.
     """
 
-    def __init__(self, target, rounds_per_wave: int = 8, depth: int = 2) -> None:
+    def __init__(
+        self,
+        target,
+        rounds_per_wave: int = 8,
+        depth: int = 2,
+        clock=None,
+        ticket_wait=None,
+        ticket_ready=None,
+    ) -> None:
         if rounds_per_wave < 1:
             raise ValueError(f"rounds_per_wave must be >= 1, got {rounds_per_wave}")
         if depth < 1:
@@ -316,6 +324,26 @@ class StreamDriver:
         self.target = target
         self.rounds_per_wave = int(rounds_per_wave)
         self.depth = int(depth)
+        #: Injected timing source (seconds; monotonic). Every latency/wall
+        #: decision below reads THIS, so a supervisor (or a test) owns time;
+        #: the default is the process clock.
+        self._clock = clock if clock is not None else time.perf_counter  # wall-clock-ok: default timing source when no supervisor injects one
+        #: Injected blocking-wait seam: ``(budget_phase, wave_index, ticket)
+        #: -> None``. The supervision tier (rapid_tpu/serving/supervisor.py)
+        #: installs its deadline-bounded waiter here; the default waits
+        #: unboundedly (the pre-supervision behavior). ``budget_phase`` is
+        #: the budget-table key ("submit" for backpressure waits, "drain",
+        #: "stream_fetch"), distinct from the telemetry phase label (always
+        #: ``stream_fetch`` — the histogram measures host-blocked time
+        #: regardless of WHY the host blocked).
+        self._ticket_wait = ticket_wait
+        #: Injected non-blocking readiness probe: ``(wave_index, ticket) ->
+        #: bool``, consulted by the opportunistic reaper. The supervisor
+        #: installs one that reports its fault plan's wedged/lost tickets
+        #: as never-ready — without it, a depth>1 pipeline would reap a
+        #: plan-wedged wave through the REAL probe before any bounded wait
+        #: ever saw it, silently bypassing the injected fault.
+        self._ticket_ready = ticket_ready
         self._is_fleet = hasattr(target, "knobs")
         # Host-side admissibility mirror (single-cluster path): ONE
         # pre-stream fetch of the slot-lifecycle lanes, then pure host
@@ -362,11 +390,11 @@ class StreamDriver:
         Returns as soon as everything is QUEUED — the only blocking path is
         backpressure at ``depth`` waves in flight."""
         if self._t0_stream is None:
-            self._t0_stream = time.perf_counter()
+            self._t0_stream = self._clock()
         while len(self._pending) >= self.depth:
-            self._complete_wave()
+            self._complete_wave("submit")
         self._reap_ready()
-        t_submit = time.perf_counter()
+        t_submit = self._clock()
         self._apply(wave)
         events = None
         for _ in range(self.rounds_per_wave):
@@ -380,13 +408,21 @@ class StreamDriver:
 
     def drain(self) -> StreamResult:
         """Complete every outstanding wave, fetch the committed-cut count,
-        and report the sustained metrics (cumulative since construction)."""
+        and report the sustained metrics (cumulative since construction).
+
+        Degenerate streams are well-defined, never NaN/inf: a zero-wave
+        drain (nothing ever submitted) and a zero-elapsed drain (a clock
+        too coarse to observe the stream's wall time) both report rate 0.0
+        — dividing by a ~0 wall would publish an absurd rate into bench
+        JSON, and ``None`` would erase the difference between "not yet
+        drained" and "drained, nothing to rate". Pinned in
+        tests/test_stream.py."""
         while self._pending:
-            self._complete_wave()
+            self._complete_wave("drain")
         epoch_total = self._fetch_epoch_total()
         cuts = epoch_total - self._epoch0
         wall_ms = (
-            (time.perf_counter() - self._t0_stream) * 1000.0
+            (self._clock() - self._t0_stream) * 1000.0
             if self._t0_stream is not None
             else 0.0
         )
@@ -405,7 +441,7 @@ class StreamDriver:
             cuts=cuts,
             wall_ms=wall_ms,
             view_changes_per_sec=(
-                cuts / (wall_ms / 1000.0) if wall_ms > 0 else None
+                cuts / (wall_ms / 1000.0) if wall_ms > 0 else 0.0
             ),
             p99_alert_to_commit_ms=(
                 float(self._latency.quantile(0.99)) if self._latency.count else None
@@ -457,34 +493,62 @@ class StreamDriver:
             self.target.inject_join_wave(list(wave.join), check_admissible=False)
             self._inadmissible[list(wave.join)] = True
 
-    def _complete_wave(self) -> None:
+    def _complete_wave(self, budget_phase: str = "stream_fetch") -> None:
         """Block on the OLDEST wave's ticket — an explicit ``stream_fetch``
-        boundary — and record its alert->commit latency."""
+        boundary — and record its alert->commit latency. ``budget_phase``
+        names WHY the host is blocking (backpressure inside ``submit``, the
+        ``drain`` sweep) for the injected deadline waiter; the telemetry
+        phase stays ``stream_fetch`` either way."""
         idx, t_submit, ticket = self._pending.popleft()
         with self.target._dispatch("stream_fetch"):
-            jax.block_until_ready(ticket)  # host-sync-ok: the explicit fetch boundary
+            if self._ticket_wait is not None:
+                self._ticket_wait(budget_phase, idx, ticket)
+            else:
+                jax.block_until_ready(ticket)  # host-sync-ok: the explicit fetch boundary
         self._record_completion(t_submit)
 
     def _reap_ready(self) -> None:
-        """Retire already-completed waves without blocking (is_ready probe)
-        so alert->commit latencies are observed close to actual completion
-        instead of at the next forced boundary."""
-        while self._pending and _ticket_ready(self._pending[0][2]):
+        """Retire already-completed waves without blocking (is_ready probe,
+        or the injected fault-aware probe) so alert->commit latencies are
+        observed close to actual completion instead of at the next forced
+        boundary."""
+        while self._pending and (
+            self._ticket_ready(self._pending[0][0], self._pending[0][2])
+            if self._ticket_ready is not None
+            else _ticket_ready(self._pending[0][2])
+        ):
             _idx, t_submit, _ticket = self._pending.popleft()
             self._record_completion(t_submit)
 
     def _record_completion(self, t_submit: float) -> None:
-        latency_ms = (time.perf_counter() - t_submit) * 1000.0
+        latency_ms = (self._clock() - t_submit) * 1000.0
         self._latency.observe(latency_ms)
         self.target.metrics.record_ms("engine_stream_alert_to_commit", latency_ms)
         self.waves_completed += 1
 
     def _fetch_epoch_total(self) -> int:
-        """Total committed view changes across the target (sum of
+        """Total committed view changes across the SERVING tenants (sum of
         config_epoch — scalar for a cluster, [t] lanes for a fleet), one
-        4-byte fetch under the ``stream_fetch`` phase."""
+        4-byte fetch under the ``stream_fetch`` phase. Quarantined fleet
+        tenants are masked out: the batched step program keeps executing
+        their rounds (vmap lockstep — freezing them there would need a new
+        program input, i.e. a recompile), so a poisoned tenant's garbage
+        epoch increments must not pollute the published cut counts and
+        rates. With a deadline waiter installed, the wait for the enqueued
+        work is bounded BEFORE the scalar fetch, so a wedged pipeline
+        surfaces as the waiter's named error, never an unbounded block
+        inside the fetch."""
         with self.target._dispatch("stream_fetch"):
-            total = int(jnp.sum(self.target.state.config_epoch))  # host-sync-ok: fetch boundary
+            epoch = self.target.state.config_epoch
+            if self._ticket_wait is not None:
+                self._ticket_wait("stream_fetch", self.waves_submitted, epoch)
+            quarantined = getattr(self.target, "quarantined", ())
+            if quarantined:
+                serving = np.ones(epoch.shape, dtype=bool)
+                serving[list(quarantined)] = False
+                self.target._account_h2d(serving)
+                epoch = jnp.where(jnp.asarray(serving), epoch, 0)
+            total = int(jnp.sum(epoch))  # host-sync-ok: fetch boundary
         self.target._account_d2h(4)
         return total
 
@@ -503,8 +567,10 @@ class StreamDriver:
             "rounds_per_wave": self.rounds_per_wave,
             "depth": self.depth,
             "view_changes_per_sec": (
+                # Always a float after a drain (0.0 on degenerate streams);
+                # None means "not yet drained", nothing else.
                 round(last.view_changes_per_sec, 3)
-                if last is not None and last.view_changes_per_sec is not None
+                if last is not None
                 else None
             ),
             "overlap_efficiency": (

@@ -247,6 +247,47 @@ def fleet_wave_impl(
     return jax.vmap(one)(state, faults, knobs, target, min_cuts)
 
 
+def tenant_health_impl(cfg: EngineConfig, state: EngineState) -> jnp.ndarray:
+    """The cheap device-side health reduction: one [t] bool lane, True =
+    the tenant's state satisfies the protocol invariants. This is the
+    serving tier's poisoned-tenant tripwire (rapid_tpu/serving/supervisor):
+    every lane is integral, so "finite" materializes as range/consistency
+    checks — the device-side twin of ``models/state.validate_envelope``
+    plus the cross-lane invariants a corrupted tenant breaks first:
+
+    - ``n_members`` equals the alive population and sits in [0, n];
+    - no slot is simultaneously alive and retired (identities are spent
+      exactly once);
+    - the per-configuration counters (round_idx, rounds_undecided,
+      classic_epoch, promised classic ranks) are non-negative, and under a
+      compact layout round_idx sits inside ROUND_ENVELOPE (the
+      validate_envelope tripwire — past it the narrow fire_round sentinel
+      stops being distinguishable).
+
+    Reductions only (no gathers, no cross-tenant ops): the compiled cost is
+    one pass over the [t, ...] lanes, and the hlo budgets are untouched —
+    this helper is deliberately NOT a registered device_program entrypoint.
+    """
+    from rapid_tpu.models.state import ROUND_ENVELOPE
+
+    def one(s: EngineState) -> jnp.ndarray:
+        ok = s.n_members == jnp.sum(s.alive, dtype=jnp.int32)
+        ok &= (s.n_members >= 0) & (s.n_members <= cfg.n)
+        ok &= ~jnp.any(s.alive & s.retired)
+        ok &= s.round_idx >= 0
+        ok &= s.rounds_undecided.astype(jnp.int32) >= 0
+        ok &= s.classic_epoch.astype(jnp.int32) >= 0
+        ok &= jnp.all(s.cp_rnd_r.astype(jnp.int32) >= 0)
+        ok &= s.config_epoch >= 0
+        if cfg.compact:
+            ok &= s.round_idx <= ROUND_ENVELOPE
+        return ok
+
+    return jax.vmap(one)(state)
+
+
+tenant_health = jax.jit(tenant_health_impl, static_argnums=(0,))  # donate-ok: read-only health reduction — the state must survive the scan
+
 fleet_step = jax.jit(fleet_step_impl, static_argnums=(0,), donate_argnums=(1,))
 fleet_run_to_decision = jax.jit(
     fleet_run_to_decision_impl, static_argnums=(0,), donate_argnums=(1,)
@@ -342,6 +383,12 @@ class TenantFleet(DispatchSeam):
         self.metrics = Metrics()
         # Attached by rapid_tpu.serving.StreamDriver (None = batch-only).
         self.stream = None
+        # Attached by rapid_tpu.serving.supervisor.Supervisor (None = no
+        # supervision tier — batch scrapes keep their series set).
+        self.recovery = None
+        # tenant -> raw frozen membership captured at quarantine time (the
+        # per-tenant freeze-lane inputs; see quarantine()).
+        self._quarantined: dict = {}
         engine_telemetry.install()
 
     # -- construction ---------------------------------------------------
@@ -519,7 +566,20 @@ class TenantFleet(DispatchSeam):
         min_cuts = np.broadcast_to(
             np.asarray(min_cuts, dtype=np.int32), (self.b,)
         ).copy()
-        if targets.min() < 0 or targets.max() > self.cfg.n:
+        # Quarantined tenants ride the wave FROZEN: their target lane is
+        # pinned to the raw membership captured at quarantine time and
+        # min_cuts to 0, so the lockstep loop's done lane is True from
+        # iteration 0 — the tenant's state never changes, inside the SAME
+        # compiled program (data, not a recompile). The captured value may
+        # be garbage (that is WHY the tenant was quarantined), so the range
+        # check below applies only to the serving lanes.
+        serving = np.ones(self.b, dtype=bool)
+        for t, frozen_members in self._quarantined.items():
+            targets[t] = frozen_members
+            min_cuts[t] = 0
+            serving[t] = False
+        bad = targets[serving]
+        if bad.size and (bad.min() < 0 or bad.max() > self.cfg.n):
             raise ValueError(
                 f"targets must be in [0, {self.cfg.n}]: {targets.tolist()}"
             )
@@ -547,6 +607,96 @@ class TenantFleet(DispatchSeam):
     def sync(self) -> None:
         """Complete all pending uploads/compute on the fleet state."""
         jax.block_until_ready(self.state)
+
+    # -- health & quarantine (the serving supervision tier's seams) ------
+
+    def health_scan(self) -> np.ndarray:
+        """Run the device-side health reduction
+        (:func:`tenant_health_impl`) over every tenant: one dispatch, one
+        [t]-bool fetch; returns the POISONED mask (True = invariants
+        violated). Cheap enough to run between waves — the supervisor's
+        poisoned-tenant tripwire."""
+        with self._dispatch("health_scan"):
+            ok = np.asarray(tenant_health(self.cfg, self.state))
+        self._account_d2h(ok.nbytes)
+        return ~ok
+
+    def tenant_health_report(self, t: int) -> List[str]:
+        """Host-side diagnosis of ONE tenant: the named violations behind a
+        health_scan hit (the repro's violations.txt). Mirrors
+        :func:`tenant_health_impl` check for check — the device scan is the
+        cheap tripwire, this is the loud explanation, and the two cannot
+        disagree on a poisoned tenant because both read the same lanes."""
+        from rapid_tpu.models.state import ROUND_ENVELOPE
+
+        if not 0 <= t < self.b:
+            raise IndexError(f"tenant index {t} out of range [0, {self.b})")
+        s = self.tenant_state(t)
+        violations: List[str] = []
+        alive = int(np.sum(np.asarray(s.alive)))
+        members = int(s.n_members)
+        self._account_d2h(np.asarray(s.alive).nbytes + 4)
+        if members != alive:
+            violations.append(
+                f"tenant {t}: n_members={members} != alive population {alive}"
+            )
+        if not 0 <= members <= self.cfg.n:
+            violations.append(
+                f"tenant {t}: n_members={members} outside [0, {self.cfg.n}]"
+            )
+        if bool(np.any(np.asarray(s.alive) & np.asarray(s.retired))):
+            violations.append(
+                f"tenant {t}: slot(s) simultaneously alive and retired"
+            )
+        for lane in ("round_idx", "rounds_undecided", "classic_epoch"):
+            value = int(getattr(s, lane))
+            if value < 0:
+                violations.append(f"tenant {t}: {lane}={value} negative")
+        if int(np.min(np.asarray(s.cp_rnd_r))) < 0:
+            violations.append(f"tenant {t}: negative promised classic rank")
+        if int(s.config_epoch) < 0:
+            violations.append(
+                f"tenant {t}: config_epoch={int(s.config_epoch)} negative"
+            )
+        if self.cfg.compact and int(s.round_idx) > ROUND_ENVELOPE:
+            violations.append(
+                f"tenant {t}: round_idx={int(s.round_idx)} past the compact "
+                f"envelope {ROUND_ENVELOPE} (validate_envelope tripwire)"
+            )
+        return violations
+
+    def quarantine(self, tenants: Sequence[int]) -> None:
+        """Quarantine tenants inside the RUNNING compiled program: capture
+        each tenant's raw membership (one [t] fetch, shared) and pin its
+        wave-path freeze lanes to it — the lockstep ``done`` mask the fleet
+        wave already carries holds the tenant bit-frozen from iteration 0,
+        with no recompile (the lanes are data) and zero effect on the other
+        B-1 tenants (vmap independence, the zero-cross-tenant budget frozen
+        in hlo.lock.json). The batched STEP path has no freeze lane (a
+        per-tenant gate there would be a new program input — a recompile,
+        which this mechanism exists to avoid): step dispatches keep
+        executing the quarantined tenant's rounds, harmlessly to the
+        others; serving callers stop feeding it churn and exclude it from
+        their accounting (the supervision tier does both). Idempotent per
+        tenant; never reversible within a fleet's lifetime (a poisoned
+        state has no un-poison story — export the repro and re-admit a
+        fresh tenant instead)."""
+        members = np.asarray(self.state.n_members)
+        self._account_d2h(members.nbytes)
+        for t in tenants:
+            t = int(t)
+            if not 0 <= t < self.b:
+                raise IndexError(
+                    f"tenant index {t} out of range [0, {self.b})"
+                )
+            if t not in self._quarantined:
+                self._quarantined[t] = int(members[t])
+                self.metrics.inc("engine_tenant_quarantines")
+
+    @property
+    def quarantined(self) -> Tuple[int, ...]:
+        """The quarantined tenant indices, sorted."""
+        return tuple(sorted(self._quarantined))
 
     # -- observers ------------------------------------------------------
 
@@ -615,6 +765,7 @@ class TenantFleet(DispatchSeam):
                     "tenant_rounds_per_dispatch": round(
                         tenant_rounds / dispatches, 3
                     ) if dispatches else 0.0,
+                    "quarantined": len(self._quarantined),
                 },
                 # Streaming tier: present only when a StreamDriver is
                 # attached (the VirtualCluster rule — batch-only scrapes
@@ -622,6 +773,13 @@ class TenantFleet(DispatchSeam):
                 **(
                     {"stream": self.stream.snapshot()}
                     if self.stream is not None
+                    else {}
+                ),
+                # Supervision tier: present only when a Supervisor is
+                # attached (same stable-series rule).
+                **(
+                    {"recovery": self.recovery.snapshot()}
+                    if self.recovery is not None
                     else {}
                 ),
             },

@@ -8,17 +8,86 @@ and streamed to every joiner. This module makes that durable:
 - host path: ``Configuration`` <-> bytes (the wire codec's field layout), so a
   node can restart into a known view and rejoin from peers;
 - device path: the whole ``EngineState`` <-> one ``.npz`` file, so a 100K-node
-  virtual cluster resumes mid-protocol (reports, votes, FD counters intact).
+  virtual cluster resumes mid-protocol (reports, votes, FD counters intact);
+- serving path: :func:`save_serving_state` / :func:`load_serving_state` — one
+  crash-consistent checkpoint of a whole serving target (state + faults, and
+  for fleet-stacked targets the per-tenant knob lanes) plus a JSON meta block
+  (the supervisor's wave cursor, rapid_tpu/serving/recovery.py).
+
+Durability discipline (every writer here): the payload is sealed with an
+xxh64 integrity trailer (the in-tree ``utils/xxhash.py``) and published by
+atomic tmp-file + ``os.replace`` — a reader never observes a half-written
+file, and a torn/bit-flipped/truncated one fails loudly as
+:class:`CheckpointCorruptError` (a named error the recovery tier can fall
+back on) instead of a numpy/zipfile/struct traceback. Pre-trailer
+checkpoints still load (the trailer is detected, never assumed).
 """
 
 from __future__ import annotations
 
+import io
+import json
 import logging
-from typing import TYPE_CHECKING, Tuple
+import os
+import struct
+from pathlib import Path
+from typing import TYPE_CHECKING, Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from rapid_tpu.utils.xxhash import xxh64
+
 LOG = logging.getLogger(__name__)
+
+
+class CheckpointCorruptError(ValueError):
+    """A checkpoint file failed its framing or integrity checks (truncated,
+    bit-flipped, bad magic, or an unreadable archive). Subclasses ValueError
+    so pre-hardening callers that caught ValueError keep working; the
+    recovery tier catches THIS name to fall back to an older checkpoint."""
+
+
+#: Integrity trailer: payload || 8-byte LE xxh64(payload) || magic.
+_TRAILER_MAGIC = b"RTXS"
+_TRAILER_LEN = 8 + len(_TRAILER_MAGIC)
+
+
+def _seal(payload: bytes) -> bytes:
+    return payload + struct.pack("<Q", xxh64(payload)) + _TRAILER_MAGIC
+
+
+def _unseal(data: bytes, path) -> bytes:
+    """Verify and strip the integrity trailer. Files from pre-trailer
+    writers (no magic) pass through unverified — backward compatible, and a
+    truncation that happens to cut the trailer off cleanly still fails
+    downstream on the archive framing."""
+    if len(data) >= _TRAILER_LEN and data[-len(_TRAILER_MAGIC):] == _TRAILER_MAGIC:
+        payload = data[:-_TRAILER_LEN]
+        (digest,) = struct.unpack("<Q", data[-_TRAILER_LEN:-len(_TRAILER_MAGIC)])
+        if xxh64(payload) != digest:
+            raise CheckpointCorruptError(
+                f"{path}: checkpoint integrity trailer mismatch (the file "
+                f"was corrupted after it was written)"
+            )
+        return payload
+    return data
+
+
+def _atomic_write(path, data: bytes) -> None:
+    """Publish ``data`` at ``path`` via tmp-file + rename: a crash mid-write
+    leaves the previous checkpoint intact, never a half-written file under
+    the published name."""
+    path = os.fspath(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def _default_retired(cfg):
@@ -79,21 +148,42 @@ def configuration_to_bytes(config: Configuration) -> bytes:
 
 def configuration_from_bytes(data: bytes) -> Configuration:
     if data[:4] != _MAGIC:
-        raise ValueError("not a rapid_tpu configuration checkpoint")
+        raise CheckpointCorruptError("not a rapid_tpu configuration checkpoint")
     r = Reader(data[4:])
-    version = r.u8()
-    if version not in (1, _VERSION):
-        raise ValueError(f"unsupported checkpoint version {version}")
-    node_ids = tuple(read_node_id(r) for _ in range(r.u32()))
-    endpoints = tuple(read_endpoint(r) for _ in range(r.u32()))
-    if version == 1:
-        topology = TOPOLOGY_NATIVE
-    else:
-        code = r.u8()
-        if code not in _TOPOLOGY_NAMES:
-            raise ValueError(f"unknown topology code {code} in checkpoint")
-        topology = _TOPOLOGY_NAMES[code]
+    try:
+        version = r.u8()
+        if version not in (1, _VERSION):
+            raise ValueError(f"unsupported checkpoint version {version}")
+        node_ids = tuple(read_node_id(r) for _ in range(r.u32()))
+        endpoints = tuple(read_endpoint(r) for _ in range(r.u32()))
+        if version == 1:
+            topology = TOPOLOGY_NATIVE
+        else:
+            code = r.u8()
+            if code not in _TOPOLOGY_NAMES:
+                raise ValueError(f"unknown topology code {code} in checkpoint")
+            topology = _TOPOLOGY_NAMES[code]
+    except CheckpointCorruptError:
+        raise
+    except (struct.error, IndexError, ValueError, EOFError) as exc:
+        # A truncated/bit-flipped blob must surface as the NAMED error, not
+        # a struct/codec traceback — the recovery tier dispatches on it.
+        raise CheckpointCorruptError(
+            f"truncated or corrupt configuration checkpoint: {exc}"
+        ) from exc
     return Configuration(node_ids, endpoints, topology=topology)
+
+
+def save_configuration(path, config: Configuration) -> None:
+    """Durable twin of :func:`configuration_to_bytes`: xxh64-sealed payload
+    published by atomic tmp+rename."""
+    _atomic_write(path, _seal(configuration_to_bytes(config)))
+
+
+def load_configuration(path) -> Configuration:
+    """Load a :func:`save_configuration` file (or a raw pre-trailer blob);
+    truncation/corruption raises :class:`CheckpointCorruptError`."""
+    return configuration_from_bytes(_unseal(Path(path).read_bytes(), path))
 
 
 def view_from_configuration(config: Configuration, k: int) -> MembershipView:
@@ -108,21 +198,92 @@ def view_from_configuration(config: Configuration, k: int) -> MembershipView:
     )
 
 
+def _cfg_entries(cfg: "EngineConfig") -> Dict[str, np.ndarray]:
+    return {
+        "__cfg__": np.asarray(list(cfg), dtype=np.int64),
+        # Field names pin value->field pairing across EngineConfig schema
+        # changes: positional loading silently misassigns values once any
+        # non-trailing field is added/removed.
+        "__cfg_fields__": np.asarray(cfg._fields, dtype=np.str_),
+    }
+
+
+def _npz_bytes(entries: Dict[str, np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **entries)
+    return buf.getvalue()
+
+
+class _LoadedNpz(dict):
+    """A fully-materialized checkpoint archive, quacking like the NpzFile
+    the loaders were written against (mapping + ``.files`` + a no-op
+    context manager — every member is already decompressed in memory)."""
+
+    @property
+    def files(self):
+        return list(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *_exc):
+        return False
+
+
+def _open_npz(path) -> _LoadedNpz:
+    """Read, integrity-check, and FULLY load a sealed .npz checkpoint;
+    every corruption class surfaces as :class:`CheckpointCorruptError`,
+    never a zipfile/zlib/numpy traceback. Members are decompressed eagerly
+    here — member corruption under an intact central directory (a
+    trailer-less legacy file, or damage confined to the trailer bytes that
+    :func:`_unseal` passes through unverified) only manifests at
+    decompression, and deferring it would leak a raw ``zlib.error``
+    through the recovery tier's named-error fallback."""
+    import zipfile
+    import zlib
+
+    payload = _unseal(Path(path).read_bytes(), path)
+    try:
+        with np.load(io.BytesIO(payload)) as data:
+            return _LoadedNpz({k: data[k] for k in data.files})
+    except (
+        zipfile.BadZipFile, zlib.error, ValueError, OSError, EOFError,
+        KeyError,
+    ) as exc:
+        raise CheckpointCorruptError(
+            f"{path}: truncated or corrupt checkpoint archive: {exc}"
+        ) from exc
+
+
+def _settle_device_owned(tree):
+    """Copy every leaf of a just-loaded pytree into an executable-OWNED
+    device buffer (one jitted identity-copy, ~ms per load).
+
+    Hard-won (root-caused via the bench ``recovery`` drill; sibling note in
+    tools/analysis/device_program.py's cache scoping): on this jaxlib's CPU
+    backend, arrays materialized from host numpy buffers — exactly what a
+    checkpoint load produces — can later be DONATED into an engine
+    executable that was DESERIALIZED from the persistent compilation
+    cache, and the donation then frees memory the backend does not own:
+    an intermittent glibc double-free/segfault (~1 in 3 at the recovery
+    drill's shape). Buffers that are executable OUTPUTS are device-owned
+    and donation-safe, so every loader below routes its pytrees through
+    this copy before handing them to a driver."""
+    import jax
+    import jax.numpy as jnp
+
+    settled = jax.jit(lambda t: jax.tree_util.tree_map(jnp.copy, t))(tree)
+    jax.block_until_ready(settled)
+    return settled
+
+
 def save_engine_state(path, cfg: "EngineConfig", state: "EngineState") -> None:
     arrays = {field: np.asarray(value) for field, value in state._asdict().items()}
     # Derived data is never persisted: ring_perm is a pure function of the
     # key lanes, and loading a stale/corrupted copy would silently diverge
     # topology from the keys. Load always recomputes it (one sort).
     arrays.pop("ring_perm", None)
-    np.savez_compressed(
-        path,
-        __cfg__=np.asarray(list(cfg), dtype=np.int64),
-        # Field names pin value->field pairing across EngineConfig schema
-        # changes: positional loading silently misassigns values once any
-        # non-trailing field is added/removed.
-        __cfg_fields__=np.asarray(cfg._fields, dtype=np.str_),
-        **arrays,
-    )
+    _atomic_write(path, _seal(_npz_bytes({**_cfg_entries(cfg), **arrays})))
 
 
 def load_engine_state(path) -> Tuple["EngineConfig", "EngineState"]:
@@ -133,7 +294,7 @@ def load_engine_state(path) -> Tuple["EngineConfig", "EngineState"]:
         lane_dtypes,
     )
 
-    with np.load(path) as data:
+    with _open_npz(path) as data:
         vals = [int(v) for v in data["__cfg__"]]
         if "__cfg_fields__" in data:
             # Name-keyed: removed fields' saved values are dropped, fields
@@ -204,5 +365,81 @@ def load_engine_state(path) -> Tuple["EngineConfig", "EngineState"]:
                 raise KeyError(
                     f"checkpoint missing field {field!r} with no known default"
                 )
-        state = EngineState(**arrays)
+        state = _settle_device_owned(EngineState(**arrays))
     return cfg, state
+
+
+# ---------------------------------------------------------------------------
+# Serving checkpoints: the whole serving target (state + faults [+ knobs]),
+# wide / compact / bit-packed / fleet-stacked alike, plus a meta cursor
+# ---------------------------------------------------------------------------
+
+def save_serving_state(
+    path,
+    cfg: "EngineConfig",
+    state: "EngineState",
+    faults,
+    knobs=None,
+    meta: Optional[Dict[str, Any]] = None,
+) -> None:
+    """One crash-consistent checkpoint of a serving target: the state AND
+    fault pytrees (and, for a fleet, the [t] knob lanes) exactly as stored —
+    shapes and dtypes round-trip verbatim, so compact (policy-narrowed),
+    bit-packed, and fleet-stacked layouts all come back bit-identical
+    (unlike :func:`save_engine_state`, ``ring_perm`` is persisted too: the
+    stacked/packed shapes cannot be re-derived by the single-cluster
+    recompute, and bit-exact resume is the whole point here). ``meta`` is a
+    small JSON-serializable dict (the supervisor's wave cursor). Sealed +
+    atomic like every writer in this module."""
+    entries = dict(_cfg_entries(cfg))
+    entries["__meta__"] = np.frombuffer(
+        json.dumps(meta or {}, sort_keys=True).encode(), dtype=np.uint8
+    )
+    for prefix, tree in (("state", state), ("faults", faults), ("knobs", knobs)):
+        if tree is None:
+            continue
+        for field, value in tree._asdict().items():
+            entries[f"{prefix}__{field}"] = np.asarray(value)
+    _atomic_write(path, _seal(_npz_bytes(entries)))
+
+
+def load_serving_state(path):
+    """Inverse of :func:`save_serving_state`: returns ``(cfg, state, faults,
+    knobs_or_None, meta)`` with every leaf at its saved shape and dtype.
+    Corruption raises :class:`CheckpointCorruptError`; a missing pytree
+    field raises KeyError naming it (a serving checkpoint is always written
+    whole by this module — absence means a foreign or damaged file)."""
+    import jax.numpy as jnp
+
+    from rapid_tpu.models.state import EngineConfig, EngineState, FaultInputs
+
+    with _open_npz(path) as data:
+        vals = [int(v) for v in data["__cfg__"]]
+        saved = dict(zip([str(f) for f in data["__cfg_fields__"]], vals))
+        cfg = EngineConfig(**{
+            f: saved[f] for f in EngineConfig._fields if f in saved
+        })
+        meta = json.loads(bytes(data["__meta__"]).decode() or "{}")
+
+        def tree(cls, prefix):
+            arrays = {}
+            for field in cls._fields:
+                key = f"{prefix}__{field}"
+                if key not in data:
+                    raise KeyError(
+                        f"serving checkpoint missing {key!r} (not written "
+                        f"by save_serving_state, or damaged)"
+                    )
+                arrays[field] = jnp.asarray(data[key])
+            return cls(**arrays)
+
+        state = tree(EngineState, "state")
+        faults = tree(FaultInputs, "faults")
+        knobs = None
+        if any(k.startswith("knobs__") for k in data.files):
+            from rapid_tpu.tenancy.fleet import TenantKnobs
+
+            knobs = tree(TenantKnobs, "knobs")
+        # None is an empty pytree: knobs settles through unchanged.
+        state, faults, knobs = _settle_device_owned((state, faults, knobs))
+    return cfg, state, faults, knobs, meta

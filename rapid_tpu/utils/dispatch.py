@@ -40,6 +40,9 @@ ENGINE_DISPATCH_PHASES = frozenset({
     "fleet_step",
     "fleet_decision",
     "fleet_wave",
+    # The per-tenant health reduction (the serving supervision tier's
+    # poisoned-tenant tripwire, rapid_tpu/serving/supervisor.py).
+    "health_scan",
     # Streaming pipeline (rapid_tpu/serving): enqueue-only dispatches and
     # the explicit fetch boundaries they synchronize at.
     "stream_enqueue",

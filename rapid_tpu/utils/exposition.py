@@ -94,6 +94,7 @@ ENGINE_KNOWN_COUNTERS = (
 TENANCY_KNOWN_COUNTERS = (
     "engine_tenant_rounds",
     "engine_tenant_cuts",
+    "engine_tenant_quarantines",
 )
 
 #: Streaming-tier counters zero-filled on snapshots whose ``engine`` section
@@ -103,6 +104,19 @@ TENANCY_KNOWN_COUNTERS = (
 STREAM_KNOWN_COUNTERS = (
     "engine_stream_waves",
     "engine_stream_cuts",
+)
+
+#: Supervision-tier counters zero-filled on snapshots whose ``engine``
+#: section carries a ``recovery`` block (a ``rapid_tpu.serving.supervisor.
+#: Supervisor`` is attached) — same stable-series rule; unsupervised
+#: scrapes never grow them.
+RECOVERY_KNOWN_COUNTERS = (
+    "engine_recovery_retries",
+    "engine_recovery_wedges",
+    "engine_recovery_checkpoints",
+    "engine_recovery_resumes",
+    "engine_recovery_quarantines",
+    "engine_recovery_quarantine_dropped_events",
 )
 
 #: ``engine.stream`` gauge keys (``StreamDriver.snapshot()``); rate/ratio
@@ -117,6 +131,21 @@ _ENGINE_STREAM_GAUGES = (
     "view_changes_per_sec",
     "overlap_efficiency",
     "p99_alert_to_commit_ms",
+)
+
+#: ``engine.recovery`` gauge keys (``Supervisor.snapshot()``); None values
+#: (no checkpoint yet, no resume yet) render NaN so the series set is
+#: stable from attach.
+_ENGINE_RECOVERY_GAUGES = (
+    "waves_submitted",
+    "checkpoint_every",
+    "checkpoints_written",
+    "last_checkpoint_wave",
+    "retries",
+    "wedges",
+    "resumes",
+    "quarantined",
+    "mttr_ms",
 )
 
 #: ``engine.compile`` counter keys -> metric suffix (all render as
@@ -259,6 +288,8 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
         counters.update({name: 0 for name in ENGINE_KNOWN_COUNTERS})
     if isinstance(engine_section, dict) and "tenancy" in engine_section:
         counters.update({name: 0 for name in TENANCY_KNOWN_COUNTERS})
+    if isinstance(engine_section, dict) and "recovery" in engine_section:
+        counters.update({name: 0 for name in RECOVERY_KNOWN_COUNTERS})
     if isinstance(engine_section, dict) and "stream" in engine_section:
         counters.update({name: 0 for name in STREAM_KNOWN_COUNTERS})
         # The alert->commit timer is lazily minted on the first wave
@@ -334,15 +365,28 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
                            node=node)
         tenancy = engine.get("tenancy")
         if isinstance(tenancy, dict):
-            # The fleet tier: tenant count and per-dispatch tenant
-            # throughput as gauges (the cumulative counters ride the
-            # ordinary metrics section, zero-filled above).
+            # The fleet tier: tenant count, per-dispatch tenant throughput,
+            # and the quarantine census as gauges (the cumulative counters
+            # ride the ordinary metrics section, zero-filled above).
             out.sample(f"{_PREFIX}_engine_tenants", "gauge",
                        tenancy.get("tenants", 0), node=node)
             out.sample(f"{_PREFIX}_engine_tenant_rounds_per_dispatch",
                        "gauge",
                        tenancy.get("tenant_rounds_per_dispatch", 0.0),
                        node=node)
+            out.sample(f"{_PREFIX}_engine_tenants_quarantined", "gauge",
+                       tenancy.get("quarantined", 0), node=node)
+        recovery = engine.get("recovery")
+        if isinstance(recovery, dict):
+            # The supervision tier (rapid_tpu/serving/supervisor.py):
+            # checkpoint cadence/progress, retry/wedge/resume tallies, the
+            # quarantine census, and the last resume's MTTR (NaN until a
+            # resume happens — the series set is stable from attach).
+            for key in _ENGINE_RECOVERY_GAUGES:
+                value = recovery.get(key)
+                out.sample(f"{_PREFIX}_engine_recovery_{key}", "gauge",
+                           float("nan") if value is None else value,
+                           node=node)
 
     recorder = snapshot.get("recorder")
     if recorder:

@@ -56,6 +56,17 @@ class LedgerEvent(Enum):
     WATCHDOG_KILL = "watchdog_kill"
     SNAPSHOT_REPLAY = "snapshot_replay"
     METRIC = "metric"
+    # Self-healing serving runtime (rapid_tpu/serving/supervisor.py +
+    # recovery.py): retry/backoff attempts, deadline wedges, checkpoint
+    # writes and corruption fallbacks, deterministic resumes, and
+    # poisoned-tenant quarantines — the events perfview renders as the
+    # recovery timeline.
+    RECOVERY_RETRY = "recovery_retry"
+    RECOVERY_WEDGED = "recovery_wedged"
+    RECOVERY_CHECKPOINT = "recovery_checkpoint"
+    RECOVERY_CHECKPOINT_CORRUPT = "recovery_checkpoint_corrupt"
+    RECOVERY_RESUME = "recovery_resume"
+    RECOVERY_QUARANTINE = "recovery_quarantine"
 
 
 #: Registered stage names (parameterize via fields — e.g. ``n=`` — never by
@@ -75,6 +86,7 @@ STAGE_NAMES = frozenset({
     "tenant_fleet",
     "stream",
     "chaos",
+    "recovery",
     "hlo_audit",
     "profile",
 })

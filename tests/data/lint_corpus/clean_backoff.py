@@ -1,0 +1,20 @@
+"""Corpus (clean): seeded retry-backoff — the schedule is a pure function
+of its seed, so a supervised fault drill replays bit-identically. The
+counterpart of unseeded_backoff.py; must produce ZERO findings.
+"""
+
+import numpy as np
+
+
+def jittered_delays(base_ms, attempts, seed):
+    # Seeded instance: the whole delay schedule derives from the seed.
+    rng = np.random.default_rng(seed)
+    return [
+        base_ms * (2.0 ** a) * (1.0 + 0.25 * float(rng.random()))
+        for a in range(attempts)
+    ]
+
+
+def injected_jitter(step_ms, rng):
+    # The rng= injection seam: the caller owns determinism.
+    return step_ms * (1.0 + float(rng.random()))

@@ -1,0 +1,31 @@
+"""Corpus: retry-backoff jitter drawn from entropy instead of a seed.
+
+A supervision tier whose backoff jitter comes from the global entropy-seeded
+generators cannot replay a fault drill bit-identically — the retry timeline
+differs every run, so a wedge repro stops reproducing. Analyzed as if it
+lived at rapid_tpu/serving/_corpus.py (the determinism discipline's tree);
+expectations are pinned finding-by-finding in tests/test_staticcheck.py.
+"""
+
+import random
+
+import numpy as np
+
+
+def jittered_delays(base_ms, attempts):
+    # An unseeded instance constructor: a different schedule every process.
+    rng = np.random.default_rng()  # expect: unseeded-random
+    return [
+        base_ms * (2.0 ** a) * (1.0 + 0.25 * float(rng.random()))
+        for a in range(attempts)
+    ]
+
+
+def sleepy_backoff(base_ms):
+    # The module-level draw shares the global entropy-seeded generator.
+    return base_ms * (1.0 + random.random())  # expect: unseeded-random
+
+
+def full_jitter(step_ms):
+    # Legacy numpy module-level draw: numpy's global generator.
+    return float(np.random.uniform(0.0, step_ms))  # expect: unseeded-random

@@ -98,6 +98,11 @@ def test_cpu_run_emits_complete_ledger(tmp_path):
             # (ramped) — warm-up + timed fuzz round over 4 mixed hostile
             # scenarios, oracle-checked clean.
             "RAPID_TPU_BENCH_CHAOS_B": "4",
+            # Tiny self-healing drill: the FULL recovery path runs
+            # (ramped) — injected transient failure, simulated kill,
+            # checkpoint resume, bit-identity check.
+            "RAPID_TPU_BENCH_RECOVERY_N": "48",
+            "RAPID_TPU_BENCH_RECOVERY_WAVES": "4",
         },
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
@@ -213,6 +218,29 @@ def test_cpu_run_emits_complete_ledger(tmp_path):
         e["event"] == "compile_stats" and e.get("stage") == "chaos"
         for e in events
     )
+    # ISSUE 15 self-healing path, same run: the recovery stage ran the
+    # whole drill — transient failure retried on seeded backoff, simulated
+    # kill between waves, checkpoint-cadence writes, deterministic resume
+    # — in its own bracketed, budgeted stage with the MTTR and the
+    # bit-identity verdict in the emitted JSON, never silently absent.
+    assert result["recovery_status"] == "ramped:4x48"
+    assert result["recovery_mttr_ms"] > 0
+    assert result["recovery_bit_identical"] is True
+    assert result["recovery_checkpoints"] >= 1
+    assert result["recovery_retries"] >= 1
+    assert result["recovery_killed_after_wave"] == 2  # waves//2
+    assert result["recovery_resumed_wave"] >= 1
+    [(recovery_begin, recovery_close)] = pairs["recovery"]
+    assert recovery_close["event"] == "stage_end"
+    assert recovery_begin["timeout_s"] > 0
+    assert recovery_begin["n"] == 48
+    # The supervisor's recovery timeline landed in the SAME ledger.
+    recovery_kinds = [
+        e["event"] for e in events if e.get("stage") == "recovery"
+    ]
+    assert "recovery_retry" in recovery_kinds
+    assert "recovery_checkpoint" in recovery_kinds
+    assert "recovery_resume" in recovery_kinds
     # ISSUE 13 memory path, same run: the hlo_audit stage (begin/end
     # bracketed above with every other stage) emits the state-compaction
     # memory axis end-to-end on CPU — bytes/member under all three
@@ -344,6 +372,35 @@ def test_chaos_plan_is_never_silently_absent(monkeypatch):
     assert bench.chaos_plan("cpu", 2000.0) == (4, "live")
     monkeypatch.setenv("RAPID_TPU_BENCH_NO_CHAOS", "1")
     assert bench.chaos_plan("tpu", 0.0) == (0, "suppressed")
+
+
+def test_recovery_plan_is_never_silently_absent(monkeypatch):
+    """ISSUE 15: every branch of the self-healing drill policy yields an
+    explicit status (the headline_plan discipline) — N=4096 x 16 waves on
+    the accelerator, ramped on CPU, skipped-budget past the
+    (shared-default) budget, suppressed on request, forced when asked."""
+    for name in ("RAPID_TPU_BENCH_NO_RECOVERY", "RAPID_TPU_BENCH_RECOVERY",
+                 "RAPID_TPU_BENCH_RECOVERY_N",
+                 "RAPID_TPU_BENCH_RECOVERY_WAVES",
+                 "RAPID_TPU_BENCH_RECOVERY_BUDGET_S",
+                 "RAPID_TPU_BENCH_XL_BUDGET_S"):
+        monkeypatch.delenv(name, raising=False)
+    assert bench.recovery_plan("tpu", 0.0) == (4096, 16, "live")
+    assert bench.recovery_plan("cpu", 0.0) == (64, 6, "ramped:6x64")
+    monkeypatch.setenv("RAPID_TPU_BENCH_RECOVERY_N", "32")
+    monkeypatch.setenv("RAPID_TPU_BENCH_RECOVERY_WAVES", "4")
+    assert bench.recovery_plan("cpu", 0.0) == (32, 4, "ramped:4x32")
+    # Past the budget the stage is skipped — but NAMED; the recovery
+    # budget defaults to the XL budget so one override governs every tail.
+    assert bench.recovery_plan("tpu", 2000.0) == (0, 0, "skipped-budget")
+    monkeypatch.setenv("RAPID_TPU_BENCH_RECOVERY_BUDGET_S", "3000")
+    assert bench.recovery_plan("tpu", 2000.0)[2] == "live"
+    # ...and forcing runs it anywhere, at the env-resolved scale.
+    monkeypatch.setenv("RAPID_TPU_BENCH_RECOVERY_BUDGET_S", "1")
+    monkeypatch.setenv("RAPID_TPU_BENCH_RECOVERY", "1")
+    assert bench.recovery_plan("cpu", 2000.0) == (32, 4, "live")
+    monkeypatch.setenv("RAPID_TPU_BENCH_NO_RECOVERY", "1")
+    assert bench.recovery_plan("tpu", 0.0) == (0, 0, "suppressed")
 
 
 def test_memory_report_status_is_never_silently_absent():

@@ -1,14 +1,25 @@
-"""Checkpoint/resume: host configuration snapshots and engine state."""
+"""Checkpoint/resume: host configuration snapshots and engine state —
+including the ISSUE-15 durability bar: atomic publishes, xxh64 integrity
+trailers, every corruption class a NAMED CheckpointCorruptError (never a
+numpy/zipfile/struct traceback), and bit-exact round trips for the
+compact, bit-packed, and fleet-stacked layouts the serving supervisor
+checkpoints."""
 
 import numpy as np
+import pytest
 
 from rapid_tpu.protocol.view import MembershipView
 from rapid_tpu.types import Endpoint, NodeId
 from rapid_tpu.utils.checkpoint import (
+    CheckpointCorruptError,
     configuration_from_bytes,
     configuration_to_bytes,
+    load_configuration,
     load_engine_state,
+    load_serving_state,
+    save_configuration,
     save_engine_state,
+    save_serving_state,
     view_from_configuration,
 )
 
@@ -162,6 +173,210 @@ def test_engine_state_loads_checkpoint_missing_new_fields(tmp_path):
     rounds, events = restored.run_until_converged(max_steps=32)
     assert events is not None
     assert restored.membership_size == 63
+
+
+def _small_cluster(compact=False, seed=0):
+    from rapid_tpu.models.virtual_cluster import VirtualCluster
+
+    vc = VirtualCluster.create(
+        24, n_slots=40, k=3, h=3, l=1, cohorts=2, fd_threshold=2,
+        seed=seed, compact=compact,
+    )
+    vc.assign_cohorts_roundrobin()
+    return vc
+
+
+def _trees_bit_identical(a, b):
+    for field in a._fields:
+        x = np.asarray(getattr(a, field))
+        y = np.asarray(getattr(b, field))
+        assert x.dtype == y.dtype and x.shape == y.shape, field
+        np.testing.assert_array_equal(x, y, err_msg=field)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15 satellite: corruption is a NAMED error, each class pinned
+# ---------------------------------------------------------------------------
+
+
+def test_configuration_file_roundtrip_and_corruption_classes(tmp_path):
+    view = MembershipView(K)
+    for i in range(8):
+        view.ring_add(Endpoint(f"10.3.0.{i}", 4000 + i), NodeId(i, i * 7))
+    path = tmp_path / "config.rtcf"
+    save_configuration(path, view.configuration)
+    assert not list(tmp_path.glob("*.tmp.*"))  # atomic publish, no debris
+    restored = load_configuration(path)
+    assert restored.configuration_id == view.configuration_id
+
+    data = path.read_bytes()
+    # Bit flip inside the payload: the xxh64 trailer catches it by name.
+    flipped = bytearray(data)
+    flipped[len(flipped) // 3] ^= 0xFF
+    (tmp_path / "flip.rtcf").write_bytes(bytes(flipped))
+    with pytest.raises(CheckpointCorruptError):
+        load_configuration(tmp_path / "flip.rtcf")
+    # Truncation (trailer gone, payload cut): named, not a struct error.
+    (tmp_path / "trunc.rtcf").write_bytes(data[: len(data) // 2])
+    with pytest.raises(CheckpointCorruptError):
+        load_configuration(tmp_path / "trunc.rtcf")
+    # Bad magic: named.
+    (tmp_path / "magic.rtcf").write_bytes(b"XXXX" + data[4:])
+    with pytest.raises(CheckpointCorruptError):
+        load_configuration(tmp_path / "magic.rtcf")
+    # Truncated raw BYTES (pre-file callers) are named too, and the named
+    # error still satisfies legacy except-ValueError callers.
+    blob = configuration_to_bytes(view.configuration)
+    with pytest.raises(CheckpointCorruptError):
+        configuration_from_bytes(blob[: len(blob) // 2])
+    assert issubclass(CheckpointCorruptError, ValueError)
+
+
+def test_engine_checkpoint_corruption_classes_are_named(tmp_path):
+    vc = _small_cluster()
+    vc.crash([3])
+    vc.step()
+    path = tmp_path / "engine.npz"
+    save_engine_state(path, vc.cfg, vc.state)
+    assert not list(tmp_path.glob("*.tmp.*"))
+    data = path.read_bytes()
+    # Truncated archive.
+    (tmp_path / "trunc.npz").write_bytes(data[: len(data) // 2])
+    with pytest.raises(CheckpointCorruptError):
+        load_engine_state(tmp_path / "trunc.npz")
+    # Flipped payload byte under an intact length: trailer mismatch.
+    flipped = bytearray(data)
+    flipped[len(flipped) // 2] ^= 0xFF
+    (tmp_path / "flip.npz").write_bytes(bytes(flipped))
+    with pytest.raises(CheckpointCorruptError):
+        load_engine_state(tmp_path / "flip.npz")
+    # Not an archive at all.
+    (tmp_path / "garbage.npz").write_bytes(b"not a checkpoint")
+    with pytest.raises(CheckpointCorruptError):
+        load_engine_state(tmp_path / "garbage.npz")
+    # Member corruption under an INTACT central directory (a trailer-less
+    # legacy file with a flipped byte mid-archive): the damage only
+    # surfaces at member decompression — still the NAMED error, never a
+    # raw zlib traceback leaking through the recovery fallback chain.
+    legacy_bad = bytearray(data[:-12])
+    legacy_bad[len(legacy_bad) // 2] ^= 0xFF
+    (tmp_path / "legacy_bad.npz").write_bytes(bytes(legacy_bad))
+    with pytest.raises(CheckpointCorruptError):
+        load_engine_state(tmp_path / "legacy_bad.npz")
+    # Legacy pre-trailer writers (a bare .npz) still load.
+    (tmp_path / "legacy.npz").write_bytes(data[:-12])  # strip the trailer
+    cfg2, _state2 = load_engine_state(tmp_path / "legacy.npz")
+    assert cfg2 == vc.cfg
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15 satellite: the layouts the supervisor checkpoints round-trip
+# bit-exactly (compact, packed, fleet-stacked), and wide checkpoints
+# migrate onto a compact config
+# ---------------------------------------------------------------------------
+
+
+def test_packed_mask_layout_roundtrips_bit_identically(tmp_path):
+    from rapid_tpu.models.state import pack_masks, unpack_masks
+
+    vc = _small_cluster()
+    vc.crash([2, 7])
+    vc.step()
+    packed_state = pack_masks(vc.state)
+    packed_faults = pack_masks(vc.faults)
+    path = tmp_path / "packed.npz"
+    save_serving_state(
+        path, vc.cfg, packed_state, packed_faults, meta={"layout": "packed"}
+    )
+    cfg2, state2, faults2, knobs2, meta = load_serving_state(path)
+    assert cfg2 == vc.cfg and knobs2 is None and meta == {"layout": "packed"}
+    _trees_bit_identical(state2, packed_state)  # packed shapes verbatim
+    _trees_bit_identical(faults2, packed_faults)
+    _trees_bit_identical(unpack_masks(state2), vc.state)  # and exact unpack
+
+
+def test_compact_serving_checkpoint_widens_bit_identically(tmp_path):
+    from rapid_tpu.models.state import widen_state
+
+    vc = _small_cluster(compact=True)
+    vc.crash([1, 4])
+    vc.run_until_converged(64)
+    path = tmp_path / "compact.npz"
+    save_serving_state(path, vc.cfg, vc.state, vc.faults)
+    cfg2, state2, _faults2, _knobs, _meta = load_serving_state(path)
+    assert cfg2.compact == 1
+    _trees_bit_identical(state2, vc.state)  # narrow dtypes verbatim
+    # ...and the widened view equals the widened original bit-for-bit (the
+    # differential seam every compact comparison goes through).
+    _trees_bit_identical(widen_state(cfg2, state2), widen_state(vc.cfg, vc.state))
+
+
+def test_fleet_stacked_checkpoint_roundtrips_and_resumes(tmp_path):
+    from rapid_tpu.models.virtual_cluster import VirtualCluster
+    from rapid_tpu.tenancy import TenantFleet
+
+    clusters = []
+    for i in range(3):
+        vc = VirtualCluster.create(
+            16, k=3, h=3, l=1, cohorts=2, fd_threshold=2, seed=30 + i
+        )
+        vc.assign_cohorts_roundrobin()
+        clusters.append(vc)
+    fleet = TenantFleet.from_clusters(clusters)
+    fleet.stream_crash([(0, 2), (2, 5)])
+    fleet.step()
+    path = tmp_path / "fleet.npz"
+    save_serving_state(
+        path, fleet.cfg, fleet.state, fleet.faults, knobs=fleet.knobs,
+        meta={"wave_index": 1},
+    )
+    cfg2, state2, faults2, knobs2, meta = load_serving_state(path)
+    assert meta["wave_index"] == 1 and knobs2 is not None
+    _trees_bit_identical(state2, fleet.state)
+    _trees_bit_identical(faults2, fleet.faults)
+    _trees_bit_identical(knobs2, fleet.knobs)
+    # The resumed fleet steps on to the same place as the original.
+    resumed = TenantFleet(cfg2, state2, faults2, knobs2)
+    resumed.step()
+    fleet.step()
+    _trees_bit_identical(resumed.state, fleet.state)
+    assert resumed.config_ids() == fleet.config_ids()
+    # A missing pytree field is a loud KeyError naming the key.
+    import io
+
+    with np.load(io.BytesIO(path.read_bytes()[:-12])) as data:
+        kept = {k: data[k] for k in data.files if k != "faults__crashed"}
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **kept)
+    (tmp_path / "missing.npz").write_bytes(buf.getvalue())
+    with pytest.raises(KeyError, match="faults__crashed"):
+        load_serving_state(tmp_path / "missing.npz")
+
+
+def test_wide_checkpoint_loads_under_a_compact_config(tmp_path):
+    """Migration path: a checkpoint written by a WIDE deployment is brought
+    up compact — validate the envelope, narrow, and the widened view is
+    bit-identical to the original (so the compact resume replays the wide
+    run's protocol exactly); the migrated cluster keeps converging."""
+    from rapid_tpu.models.state import narrow_state, validate_envelope, widen_state
+    from rapid_tpu.models.virtual_cluster import VirtualCluster
+
+    vc = _small_cluster(compact=False)
+    vc.crash([2, 9])
+    vc.step()
+    path = tmp_path / "wide.npz"
+    save_engine_state(path, vc.cfg, vc.state)
+    cfg_w, state_w = load_engine_state(path)
+    assert cfg_w.compact == 0
+    cfg_c = cfg_w._replace(compact=1)
+    validate_envelope(cfg_c, state_w)  # the loud alternative to a wrapping cast
+    narrowed = narrow_state(cfg_c, state_w)
+    _trees_bit_identical(widen_state(cfg_c, narrowed), state_w)
+    migrated = VirtualCluster(cfg_c, narrowed)
+    migrated.crash([2, 9])
+    rounds, events = migrated.run_until_converged(64)
+    assert events is not None
+    assert migrated.membership_size == 22
 
 
 def test_legacy_positional_config_drops_stale_watermark_value(tmp_path):

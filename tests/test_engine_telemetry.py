@@ -325,6 +325,11 @@ GOLDEN_FLEET_METRIC_NAMES = sorted(
         "rapid_engine_tenant_rounds_total",
         "rapid_engine_tenant_rounds_per_dispatch",
         "rapid_engine_tenants",
+        # Quarantine census (ISSUE 15): the zero-filled cumulative counter
+        # and the current-census gauge are part of every fleet scrape from
+        # the first snapshot — a quarantine must never mint a new series.
+        "rapid_engine_tenant_quarantines_total",
+        "rapid_engine_tenants_quarantined",
     }
 )
 

@@ -154,21 +154,50 @@ def test_ledger_events_come_from_registered_vocabulary():
 
 
 def test_protocol_reads_no_wall_clock():
-    """The clock-disciplined packages (rapid_tpu/protocol/ and
-    rapid_tpu/monitoring/ — failure detectors are timing consumers too)
-    must not read wall clocks directly (time.time, time.time_ns,
-    datetime.now, ...): the clock is injected (utils/clock.py, and the
-    Metrics registry's now_ms source), which is what keeps phase timings
-    correct under simulated time. The resolution-tier check lives in
+    """The clock-disciplined packages (rapid_tpu/protocol/,
+    rapid_tpu/monitoring/ — failure detectors are timing consumers too —
+    and, since ISSUE 15, rapid_tpu/serving/ — the supervision tier's
+    deadline/backoff decisions must replay under an injected clock) must
+    not read wall clocks directly (time.time, time.time_ns, datetime.now,
+    ...): the clock is injected (utils/clock.py, the Metrics registry's
+    now_ms source, the serving drivers' clock= parameter), which is what
+    keeps phase timings correct under simulated time and fault drills
+    deterministic. The resolution-tier check lives in
     tools/analysis/clocks.py (check_clock_injection) so the CLI gate
     catches it too; this test runs it as part of the ordinary session.
     The tree is currently clean — keep it that way."""
     from staticcheck import check_clock_injection
 
     offenders = []
-    for path in _py_files(("rapid_tpu/protocol", "rapid_tpu/monitoring")):
+    for path in _py_files(
+        ("rapid_tpu/protocol", "rapid_tpu/monitoring", "rapid_tpu/serving")
+    ):
         offenders.extend(str(f) for f in check_clock_injection(path))
     assert not offenders, "\n".join(offenders)
+
+
+def test_clock_injection_covers_the_serving_tier():
+    """ISSUE 15: the serving supervision tier's timing reads are
+    clock-disciplined too — a wall-clock read in a serving module is a
+    finding (the wedge-deadline decision path must be injectable), while
+    the same source outside the disciplined prefixes stays silent."""
+    import textwrap
+
+    from staticcheck import REPO as SC_REPO, check_clock_injection
+
+    offending = textwrap.dedent(
+        """
+        import time
+
+        def deadline_exceeded(t0, budget_ms):
+            return (time.monotonic() - t0) * 1000.0 >= budget_ms
+        """
+    )
+    inside = SC_REPO / "rapid_tpu" / "serving" / "_lint_probe.py"
+    findings = check_clock_injection(inside, source=offending)
+    assert [f.check for f in findings] == ["clock-injection"]
+    outside = SC_REPO / "rapid_tpu" / "sim" / "_lint_probe.py"
+    assert check_clock_injection(outside, source=offending) == []
 
 
 def test_clock_injection_check_catches_both_spellings():

@@ -293,6 +293,60 @@ def test_trajectory_renders_chaos_column_and_flags_missing(tmp_path, capsys):
     assert "chaos-missing" not in lines["BENCH_r50"]  # pre-audit history
 
 
+def test_trajectory_renders_recovery_column_and_flags_missing(tmp_path, capsys):
+    """ISSUE 15: recovery_mttr_ms renders as the RECOVERY trajectory
+    column (with a DIVERGED callout when the resumed run failed its
+    bit-identity check) under the existing trust flags; an AUDITED round
+    that omits both the value and its explicit recovery_status marker
+    flags recovery-missing; pre-audit historical rounds are exempt."""
+    audit = {"step": {"collectives": 0, "hot_loop_collectives": 0,
+                      "temp_bytes": 10, "donation_dropped": 0}}
+    base = {"n1M_status": "ramped:256", "tenant_fleet_status": "ramped:8x64",
+            "stream_status": "ramped:12x96", "chaos_status": "ramped:12x12",
+            "mem_status": "computed:cpu"}
+    points = {
+        # Pre-audit historical round: exempt (sorts first).
+        "BENCH_r60.json": {"metric": "m", "value": 1.0, "platform": "cpu"},
+        # Audited + measured drill: the MTTR in the RECOVERY column.
+        "BENCH_r61.json": {"metric": "m", "value": 100.0, "platform": "tpu",
+                           "hlo_audit": audit, **base,
+                           "recovery_status": "live",
+                           "recovery_mttr_ms": 182.4,
+                           "recovery_bit_identical": True},
+        # A resume that DIVERGED is called out beside its MTTR.
+        "BENCH_r62.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, **base,
+                           "recovery_status": "ramped:6x64",
+                           "recovery_mttr_ms": 20.9,
+                           "recovery_bit_identical": False},
+        # Audited + explicit status marker only (skipped drill): no flag.
+        "BENCH_r63.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, **base,
+                           "recovery_status": "skipped-budget"},
+        # Audited round that silently dropped the drill: flagged.
+        "BENCH_r64.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, **base},
+    }
+    paths = []
+    for name, data in points.items():
+        p = tmp_path / name
+        p.write_text(json.dumps(data))
+        paths.append(str(p))
+    assert perfview.main(paths) == 0
+    out = capsys.readouterr().out
+    assert "RECOVERY" in out.splitlines()[1]  # the trajectory header row
+    lines = {line.split()[0]: line for line in out.splitlines()
+             if line.startswith("BENCH_r6")}
+    assert "182.4ms mttr" in lines["BENCH_r61"]
+    assert "DIVERGED" not in lines["BENCH_r61"]
+    assert "recovery-missing" not in lines["BENCH_r61"]
+    assert "20.9ms mttr DIVERGED" in lines["BENCH_r62"]
+    assert "skipped-budget" in lines["BENCH_r63"]
+    assert "recovery-missing" not in lines["BENCH_r63"]
+    assert "recovery-missing" in lines["BENCH_r64"]
+    assert "recovery-missing" not in lines["BENCH_r60"]  # pre-audit history
+
+
 def test_trajectory_renders_mem_column_and_flags_missing(tmp_path, capsys):
     """ISSUE 13: bytes_per_member renders as the MEM trajectory column
     (compact figure with the wide one beside it) under the existing trust

@@ -356,6 +356,11 @@ _CORPUS_CHECKERS = {
     "clean_taskflow.py": ("rapid_tpu/messaging/_corpus.py", "check_taskflow"),
     "unseeded_random.py": ("rapid_tpu/messaging/_corpus.py", "check_determinism"),
     "clean_determinism.py": ("rapid_tpu/messaging/_corpus.py", "check_determinism"),
+    # ISSUE 15: retry-backoff jitter in the serving supervision tier must
+    # stay seeded (a fault drill replays bit-identically) — the defect +
+    # clean pair live at the serving prefix the discipline now covers.
+    "unseeded_backoff.py": ("rapid_tpu/serving/_corpus.py", "check_determinism"),
+    "clean_backoff.py": ("rapid_tpu/serving/_corpus.py", "check_determinism"),
     "ledger_event_name.py": ("rapid_tpu/models/_corpus.py", "check_ledger"),
     "clean_ledger.py": ("rapid_tpu/models/_corpus.py", "check_ledger"),
     # device_program corpus files COMPILE their miniature programs (on the

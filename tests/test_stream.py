@@ -429,6 +429,43 @@ def test_empty_wave_has_no_schedule_spelling():
         StreamWave().fault_events()
 
 
+def test_drain_rate_math_is_well_defined_for_degenerate_streams():
+    """ISSUE 15 satellite: zero-wave and zero-elapsed drains report rate
+    0.0 and a well-defined StreamResult — no div-by-~0 inf/NaN can leak
+    into bench JSON, and 0.0 (drained, nothing to rate) stays distinct
+    from the pre-drain snapshot's None (not yet drained)."""
+    import math
+
+    # Zero waves: nothing ever submitted, wall is exactly 0.
+    vc = _cluster()
+    result = StreamDriver(vc, rounds_per_wave=2, depth=2).drain()
+    assert result.waves == 0 and result.rounds == 0 and result.cuts == 0
+    assert result.wall_ms == 0.0
+    assert result.view_changes_per_sec == 0.0
+    assert result.p99_alert_to_commit_ms is None
+    assert result.overlap_efficiency is None  # unmeasurable, not fake
+    for value in result:
+        assert not (isinstance(value, float) and (
+            math.isnan(value) or math.isinf(value)
+        ))
+    json.dumps(vc.telemetry_snapshot())
+
+    # Zero elapsed: a frozen injected clock makes wall_ms exactly 0 even
+    # WITH traffic — the rate must still be 0.0, never cuts/0 = inf.
+    vc2 = _cluster()
+    frozen = StreamDriver(vc2, rounds_per_wave=2, depth=2, clock=lambda: 5.0)
+    for wave in PoissonChurn(24, 40, rate=1.0, seed=2).waves(3):
+        frozen.submit(wave)
+    result2 = frozen.drain()
+    assert result2.wall_ms == 0.0
+    assert result2.view_changes_per_sec == 0.0
+    assert result2.overlap_efficiency is None
+    for value in result2:
+        assert not (isinstance(value, float) and (
+            math.isnan(value) or math.isinf(value)
+        ))
+
+
 def test_fleet_stream_crash_bounds_checked():
     fleet = _fleet()
     with pytest.raises(IndexError):

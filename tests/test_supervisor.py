@@ -1,0 +1,622 @@
+"""Self-healing serving runtime (rapid_tpu/serving/supervisor + recovery):
+deadline-bounded dispatch, seeded retry/backoff, crash-consistent
+checkpoint/resume, and per-tenant quarantine — every failure injected by a
+seeded ``SupervisorFaultPlan`` and every recovery verified BIT-IDENTICAL.
+
+The acceptance bars (ISSUE 15):
+
+- an injected mid-stream failure and a simulated process kill between
+  waves, followed by supervisor resume, yield cuts, config-id chains, and
+  final state pytrees bit-identical to the uninterrupted run — for BOTH
+  the ``VirtualCluster`` and ``TenantFleet`` serving shapes;
+- quarantining one poisoned tenant leaves the other B-1 tenants'
+  results bit-identical to a fleet built without it (vmap independence,
+  now load-bearing for degradation);
+- wedges are LOUD: a never-ready ticket (or a lost one) raises
+  ``DispatchWedgedError`` naming the phase and wave index at the declared
+  budget, on the INJECTED clock — no real waiting in these tests.
+
+Budget (the PR-10 convention): every compile-bearing test reuses the
+test_stream geometries (n=24/n_slots=40/k=3 cluster, b=3/n=16 fleet), so
+the engine executables are shared across the session; deadline/backoff
+mechanics run on fake clocks and never sleep; the wider drill grid rides
+the unfiltered check.sh pass behind ``slow``.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from rapid_tpu.models.virtual_cluster import VirtualCluster
+from rapid_tpu.serving import (
+    BackoffPolicy,
+    DispatchWedgedError,
+    FleetPoissonChurn,
+    PoissonChurn,
+    SimulatedProcessKill,
+    StreamWave,
+    Supervisor,
+    SupervisorBudgets,
+    SupervisorFaultPlan,
+    recovery,
+)
+from rapid_tpu.tenancy import TenantFleet
+from rapid_tpu.utils import exposition
+from rapid_tpu.utils.checkpoint import CheckpointCorruptError
+from rapid_tpu.utils.ledger import RunLedger, read_ledger
+
+
+def _cluster(seed=0):
+    vc = VirtualCluster.create(
+        24, n_slots=40, k=3, h=3, l=1, cohorts=2, fd_threshold=2, seed=seed
+    )
+    vc.assign_cohorts_roundrobin()
+    return vc
+
+
+def _fleet(seeds=(10, 11, 12)):
+    clusters = []
+    for s in seeds:
+        vc = VirtualCluster.create(
+            16, k=3, h=3, l=1, cohorts=2, fd_threshold=2, seed=s
+        )
+        vc.assign_cohorts_roundrobin()
+        clusters.append(vc)
+    return TenantFleet.from_clusters(clusters)
+
+
+def _trees_equal(a, b) -> bool:
+    return bool(jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda x, y: bool((np.asarray(x) == np.asarray(y)).all()), a, b
+    )))
+
+
+def _tenant_slices_equal(tree_a, ia, tree_b, ib) -> bool:
+    return bool(jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda x, y: bool(
+            (np.asarray(x)[ia] == np.asarray(y)[ib]).all()
+        ), tree_a, tree_b,
+    )))
+
+
+class FakeClock:
+    """Injected decision clock: advances only when the fake sleep runs, so
+    deadline tests are exact and never wait."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, seconds):
+        self.t += seconds
+
+
+# ---------------------------------------------------------------------------
+# Budgets & backoff: pure, declared, seeded
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_schedule_is_a_pure_function_of_its_seed():
+    a = BackoffPolicy(max_attempts=5, seed=3).delays_ms()
+    b = BackoffPolicy(max_attempts=5, seed=3).delays_ms()
+    assert a == b and len(a) == 4
+    # Exponential envelope with bounded seeded jitter.
+    for i, delay in enumerate(a):
+        step = 2.0 * 2.0**i
+        assert step <= delay <= step * 1.25
+    # A different seed is a different jitter sequence.
+    assert a != BackoffPolicy(max_attempts=5, seed=4).delays_ms()
+
+
+def test_budget_table_covers_declared_phases_only():
+    budgets = SupervisorBudgets(submit_ms=10.0)
+    assert budgets.for_phase("submit") == 10.0
+    assert budgets.for_phase("drain") == SupervisorBudgets().drain_ms
+    with pytest.raises(ValueError, match="no deadline budget"):
+        budgets.for_phase("made_up_phase")
+
+
+# ---------------------------------------------------------------------------
+# Deadline-bounded dispatch: wedges are loud, named, and clock-injected
+# ---------------------------------------------------------------------------
+
+
+def test_wedged_dispatch_raises_named_error_at_the_budget():
+    clock = FakeClock()
+    vc = _cluster()
+    sup = Supervisor(
+        vc, rounds_per_wave=2, depth=1,
+        budgets=SupervisorBudgets(submit_ms=50.0, drain_ms=40.0),
+        fault_plan=SupervisorFaultPlan(wedge_wave=0),
+        clock=clock, sleep=clock.sleep,
+    )
+    sup.submit(StreamWave(crash=(3,)))
+    with pytest.raises(DispatchWedgedError) as exc:
+        sup.drain()
+    # The error names the phase and wave index — "wave 0 wedged in drain",
+    # never an anonymous 240 s idle.
+    assert exc.value.phase == "drain" and exc.value.wave_index == 0
+    assert "wave 0" in str(exc.value)
+    # The deadline fired on the INJECTED clock at the declared budget.
+    assert clock.t * 1000.0 >= 40.0
+    assert vc.metrics.counters["engine_recovery_wedges"] == 1
+
+
+def test_wedge_fires_at_depth_two_despite_the_opportunistic_reaper():
+    """A plan-wedged ticket must survive the reaper at any pipeline depth:
+    without the fault-aware readiness probe, depth>1 would retire the wave
+    through the REAL is_ready probe before any bounded wait saw it, and
+    the injected fault would silently never fire."""
+    clock = FakeClock()
+    vc = _cluster()
+    sup = Supervisor(
+        vc, rounds_per_wave=2, depth=2,
+        budgets=SupervisorBudgets(drain_ms=30.0),
+        fault_plan=SupervisorFaultPlan(wedge_wave=0),
+        clock=clock, sleep=clock.sleep,
+    )
+    churn = PoissonChurn(24, 40, rate=1.0, seed=5)
+    sup.submit(churn.wave())
+    sup.submit(churn.wave())  # depth not yet exceeded: reaper runs, must skip wave 0
+    vc.sync()  # wave 0's REAL ticket is now ready — the plan still holds it
+    with pytest.raises(DispatchWedgedError) as exc:
+        sup.drain()
+    assert exc.value.phase == "drain" and exc.value.wave_index == 0
+
+
+def test_backpressure_wait_wedges_under_the_submit_budget():
+    clock = FakeClock()
+    vc = _cluster()
+    sup = Supervisor(
+        vc, rounds_per_wave=2, depth=1,
+        budgets=SupervisorBudgets(submit_ms=30.0),
+        fault_plan=SupervisorFaultPlan(lose_ticket_wave=0),
+        clock=clock, sleep=clock.sleep,
+    )
+    sup.submit(StreamWave(crash=(5,)))
+    # depth=1: the next submit must first wait on wave 0's (lost) ticket.
+    with pytest.raises(DispatchWedgedError) as exc:
+        sup.submit(StreamWave(crash=(6,)))
+    assert exc.value.phase == "submit" and exc.value.wave_index == 0
+    assert "ticket lost" in str(exc.value)
+
+
+def test_transient_failures_retry_on_the_seeded_schedule():
+    import time as _time
+
+    slept = []
+
+    def sleep(seconds):
+        slept.append(seconds)
+        _time.sleep(seconds)  # the injected sleep also serves poll waits
+
+    vc = _cluster()
+    # Backoff delays (base 50 ms) are far above the poll interval (0.5 ms),
+    # so the recorded sleeps separate cleanly into poll ticks vs retries.
+    policy = BackoffPolicy(max_attempts=4, base_ms=50.0, seed=9)
+    sup = Supervisor(
+        vc, rounds_per_wave=2, poll_ms=0.5,
+        backoff=policy,
+        fault_plan=SupervisorFaultPlan(transient_submit=((0, 2),)),
+        sleep=sleep,
+    )
+    sup.submit(StreamWave(crash=(3,)))  # two injected failures, then lands
+    assert vc.metrics.counters["engine_recovery_retries"] == 2
+    # The backoff sleeps are exactly the first two seeded schedule delays.
+    expected = [d / 1000.0 for d in policy.delays_ms()[:2]]
+    assert [s for s in slept if s >= 0.01] == expected
+    sup.drain()
+    assert sup.driver.waves_completed == 1
+
+
+def test_exhausted_retries_escalate_to_dispatch_wedged():
+    vc = _cluster()
+    sup = Supervisor(
+        vc, rounds_per_wave=2,
+        backoff=BackoffPolicy(max_attempts=3, seed=1),
+        fault_plan=SupervisorFaultPlan(transient_submit=((0, 99),)),
+        sleep=lambda s: None,
+    )
+    with pytest.raises(DispatchWedgedError) as exc:
+        sup.submit(StreamWave(crash=(3,)))
+    assert exc.value.phase == "submit" and exc.value.wave_index == 0
+    assert "retries exhausted" in str(exc.value)
+    assert vc.metrics.counters["engine_recovery_retries"] == 3
+    assert vc.metrics.counters["engine_recovery_wedges"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Kill/resume differential: the acceptance bar, both serving shapes
+# ---------------------------------------------------------------------------
+
+
+def test_cluster_kill_resume_is_bit_identical(tmp_path):
+    """A transient failure mid-schedule + a simulated process kill between
+    waves; resume from the newest checkpoint replays the seeded churn to a
+    final state, cut count, and config-id chain bit-identical to the
+    uninterrupted run — with the whole recovery timeline in the ledger."""
+    waves = PoissonChurn(24, 40, rate=1.0, seed=7).waves(6)
+
+    unbroken = _cluster()
+    sup_u = Supervisor(unbroken, rounds_per_wave=4, depth=2)
+    for wave in waves:
+        sup_u.submit(wave)
+    result_u = sup_u.drain()
+    assert result_u.cuts > 0, "schedule produced no cuts — vacuous differential"
+
+    ledger = RunLedger(str(tmp_path / "ledger.jsonl"))
+    drill = _cluster()
+    sup_d = Supervisor(
+        drill, rounds_per_wave=4, depth=2,
+        checkpoint_dir=tmp_path / "ckpt", checkpoint_every=2,
+        fault_plan=SupervisorFaultPlan(
+            transient_submit=((1, 2),), kill_after_wave=3,
+        ),
+        ledger=ledger, ledger_stage="recovery", sleep=lambda s: None,
+    )
+    churn = iter(waves)
+    with pytest.raises(SimulatedProcessKill) as kill:
+        for wave in churn:
+            sup_d.submit(wave)
+    assert kill.value.wave_index == 3
+
+    resumed_sup, next_wave = recovery.resume(
+        tmp_path / "ckpt", checkpoint_every=2,
+        ledger=ledger, ledger_stage="recovery",
+    )
+    assert next_wave == 4  # checkpoint cadence 2, killed after wave 3
+    assert resumed_sup.last_resume_ms is not None
+    for wave in waves[next_wave:]:
+        resumed_sup.submit(wave)
+    resumed_sup.drain()
+
+    resumed = resumed_sup.target
+    assert _trees_equal(resumed.state, unbroken.state)
+    assert _trees_equal(resumed.faults, unbroken.faults)
+    assert resumed.config_id == unbroken.config_id
+    assert resumed.config_epoch == unbroken.config_epoch
+    # The recovery timeline is a first-class ledger record.
+    events, skipped = read_ledger(str(tmp_path / "ledger.jsonl"))
+    kinds = [e["event"] for e in events]
+    assert skipped == 0
+    assert kinds.count("recovery_retry") == 2
+    assert "recovery_checkpoint" in kinds and "recovery_resume" in kinds
+    [resume_event] = [e for e in events if e["event"] == "recovery_resume"]
+    assert resume_event["wave"] == 4 and resume_event["mttr_ms"] > 0
+
+
+def test_corrupt_checkpoint_falls_back_to_previous_valid_one(tmp_path):
+    """The fault plan corrupts the NEWEST checkpoint after its atomic
+    publish; resume must skip it loudly (CheckpointCorruptError handled,
+    ledger event emitted) and replay from the older valid one — still
+    bit-identical."""
+    waves = PoissonChurn(24, 40, rate=1.0, seed=7).waves(6)
+    unbroken = _cluster()
+    sup_u = Supervisor(unbroken, rounds_per_wave=4, depth=2)
+    for wave in waves:
+        sup_u.submit(wave)
+    sup_u.drain()
+
+    drill = _cluster()
+    sup_d = Supervisor(
+        drill, rounds_per_wave=4, depth=2,
+        checkpoint_dir=tmp_path / "ckpt", checkpoint_every=2,
+        fault_plan=SupervisorFaultPlan(
+            kill_after_wave=3, corrupt_checkpoint_at=4,
+        ),
+        sleep=lambda s: None,
+    )
+    with pytest.raises(SimulatedProcessKill):
+        for wave in waves:
+            sup_d.submit(wave)
+    # The damaged newest file fails its integrity check by name...
+    newest, loaded, skipped = recovery.latest_valid_checkpoint(tmp_path / "ckpt")
+    assert newest is not None and "w00000002" in newest.name
+    assert loaded is not None and len(skipped) == 1
+    with pytest.raises(CheckpointCorruptError):
+        from rapid_tpu.utils.checkpoint import load_serving_state
+
+        load_serving_state(tmp_path / "ckpt" / "ckpt_w00000004.npz")
+    # ...and resume falls back to the wave-2 checkpoint and replays.
+    ledger = RunLedger(str(tmp_path / "ledger.jsonl"))
+    resumed_sup, next_wave = recovery.resume(
+        tmp_path / "ckpt", ledger=ledger, ledger_stage="recovery",
+    )
+    assert next_wave == 2
+    for wave in waves[next_wave:]:
+        resumed_sup.submit(wave)
+    resumed_sup.drain()
+    assert _trees_equal(resumed_sup.target.state, unbroken.state)
+    assert resumed_sup.target.config_id == unbroken.config_id
+    events, _ = read_ledger(str(tmp_path / "ledger.jsonl"))
+    assert any(e["event"] == "recovery_checkpoint_corrupt" for e in events)
+
+
+def test_truncated_checkpoint_and_empty_dir_are_loud(tmp_path):
+    drill = _cluster()
+    sup = Supervisor(
+        drill, rounds_per_wave=2, checkpoint_dir=tmp_path / "ckpt",
+        checkpoint_every=1, checkpoint_keep=1,
+        fault_plan=SupervisorFaultPlan(truncate_checkpoint_at=1),
+    )
+    sup.submit(StreamWave(crash=(3,)))
+    # keep=1 and the only checkpoint truncated: nothing valid to resume.
+    with pytest.raises(FileNotFoundError, match="no valid checkpoint"):
+        recovery.resume(tmp_path / "ckpt")
+    with pytest.raises(FileNotFoundError, match="no valid checkpoint"):
+        recovery.resume(tmp_path / "never-written")
+
+
+def test_checkpoints_prune_to_keep(tmp_path):
+    vc = _cluster()
+    sup = Supervisor(
+        vc, rounds_per_wave=2, checkpoint_dir=tmp_path / "ckpt",
+        checkpoint_every=1, checkpoint_keep=2,
+    )
+    for wave in PoissonChurn(24, 40, rate=0.5, seed=2).waves(5):
+        sup.submit(wave)
+    sup.drain()
+    names = sorted(p.name for p in (tmp_path / "ckpt").iterdir())
+    assert names == ["ckpt_w00000004.npz", "ckpt_w00000005.npz"]
+    assert sup.checkpoints_written == 5
+
+
+def test_fleet_kill_resume_is_bit_identical(tmp_path):
+    """The TenantFleet serving shape: per-tenant Poisson crash streams,
+    killed between waves, resumed from the stacked checkpoint (knob lanes
+    included) — per-tenant config ids, epochs, and the full stacked pytree
+    bit-identical to the uninterrupted fleet."""
+    waves = FleetPoissonChurn(3, 16, rate=0.7, seed=3).waves(5)
+
+    unbroken = _fleet()
+    sup_u = Supervisor(unbroken, rounds_per_wave=3, depth=2)
+    for wave in waves:
+        sup_u.submit(wave)
+    sup_u.drain()
+
+    drill = _fleet()
+    sup_d = Supervisor(
+        drill, rounds_per_wave=3, depth=2,
+        checkpoint_dir=tmp_path / "ckpt", checkpoint_every=2,
+        fault_plan=SupervisorFaultPlan(kill_after_wave=2),
+    )
+    with pytest.raises(SimulatedProcessKill):
+        for wave in waves:
+            sup_d.submit(wave)
+
+    resumed_sup, next_wave = recovery.resume(tmp_path / "ckpt")
+    assert next_wave == 2
+    resumed = resumed_sup.target
+    assert isinstance(resumed, TenantFleet) and resumed.b == 3
+    churn = recovery.fast_forward(
+        FleetPoissonChurn(3, 16, rate=0.7, seed=3), next_wave
+    )
+    for _ in range(next_wave, 5):
+        resumed_sup.submit(churn.wave())
+    resumed_sup.drain()
+    assert _trees_equal(resumed.state, unbroken.state)
+    assert _trees_equal(resumed.faults, unbroken.faults)
+    assert _trees_equal(resumed.knobs, unbroken.knobs)
+    assert resumed.config_ids() == unbroken.config_ids()
+    np.testing.assert_array_equal(
+        resumed.config_epochs(), unbroken.config_epochs()
+    )
+
+
+@pytest.mark.slow
+def test_kill_resume_grid(tmp_path):
+    """Wider drill grid (kill points x cadences x seeds). Rides the
+    unfiltered check.sh pass; tier-1 keeps the single-point cluster and
+    fleet differentials above as the acceptance pins."""
+    for seed, kill_after, every in [(1, 1, 1), (2, 4, 3), (3, 2, 2)]:
+        waves = PoissonChurn(24, 40, rate=1.5, seed=seed).waves(6)
+        unbroken = _cluster()
+        sup_u = Supervisor(unbroken, rounds_per_wave=3, depth=2)
+        for wave in waves:
+            sup_u.submit(wave)
+        sup_u.drain()
+        ckpt = tmp_path / f"ckpt{seed}"
+        drill = _cluster()
+        sup_d = Supervisor(
+            drill, rounds_per_wave=3, depth=2, checkpoint_dir=ckpt,
+            checkpoint_every=every,
+            fault_plan=SupervisorFaultPlan(kill_after_wave=kill_after),
+        )
+        with pytest.raises(SimulatedProcessKill):
+            for wave in waves:
+                sup_d.submit(wave)
+        resumed_sup, next_wave = recovery.resume(ckpt)
+        for wave in waves[next_wave:]:
+            resumed_sup.submit(wave)
+        resumed_sup.drain()
+        label = f"seed={seed} kill={kill_after} every={every}"
+        assert _trees_equal(resumed_sup.target.state, unbroken.state), label
+        assert resumed_sup.target.config_id == unbroken.config_id, label
+
+
+# ---------------------------------------------------------------------------
+# Quarantine: detect, freeze, export, keep the other B-1 serving
+# ---------------------------------------------------------------------------
+
+
+def _poison_tenant(fleet, t):
+    """Corrupt one tenant's membership bookkeeping (the class of damage a
+    bad host write or a partial upload leaves): n_members diverges from the
+    alive population and leaves the legal range."""
+    fleet.state = fleet.state._replace(
+        n_members=fleet.state.n_members.at[t].set(-3)
+    )
+
+
+def test_health_scan_is_clean_on_a_healthy_fleet():
+    fleet = _fleet()
+    fleet.faults = fleet.faults._replace(
+        crashed=fleet.faults.crashed.at[:, 3].set(True)
+    )
+    fleet.run_until_membership(15, max_steps=64, min_cuts=1)
+    assert not fleet.health_scan().any()
+    assert fleet.tenant_health_report(0) == []
+
+
+def test_quarantine_freezes_poisoned_tenant_and_spares_the_rest(tmp_path):
+    """The degradation bar: the poisoned tenant is detected by the device
+    health reduction, frozen in place through the SAME compiled wave
+    program (the per-tenant done lane — data, no recompile), exported as a
+    replayable repro, and the other B-1 tenants' results are bit-identical
+    to a fleet that never contained it."""
+    fleet_a = _fleet((10, 11, 12))
+    _poison_tenant(fleet_a, 1)
+    scan = fleet_a.health_scan()
+    np.testing.assert_array_equal(scan, [False, True, False])
+
+    sup = Supervisor(fleet_a, rounds_per_wave=2)
+    fresh = sup.scan_and_quarantine(repro_dir=tmp_path)
+    assert fresh == [1] and fleet_a.quarantined == (1,)
+    assert sup.scan_and_quarantine() == []  # idempotent
+    report = fleet_a.tenant_health_report(1)
+    assert any("n_members=-3" in line for line in report)
+
+    # The B-1 control: same seeds, the poisoned tenant never existed.
+    fleet_b = _fleet((10, 12))
+    for fleet in (fleet_a, fleet_b):
+        fleet.faults = fleet.faults._replace(
+            crashed=fleet.faults.crashed.at[:, 3].set(True)
+        )
+    rounds_a, cuts_a, resolved_a, _ = fleet_a.run_until_membership(
+        15, max_steps=64, min_cuts=1
+    )
+    rounds_b, cuts_b, resolved_b, _ = fleet_b.run_until_membership(
+        15, max_steps=64, min_cuts=1
+    )
+    for ia, ib in ((0, 0), (2, 1)):
+        assert _tenant_slices_equal(fleet_a.state, ia, fleet_b.state, ib)
+        assert rounds_a[ia] == rounds_b[ib] and cuts_a[ia] == cuts_b[ib]
+    # The quarantined tenant sat bit-frozen: zero rounds, zero cuts.
+    assert rounds_a[1] == 0 and cuts_a[1] == 0
+    ids = fleet_a.config_ids()
+    ids_b = fleet_b.config_ids()
+    assert [ids[0], ids[2]] == ids_b
+    # Telemetry: census gauge + counter, JSON-serializable snapshot.
+    snap = fleet_a.telemetry_snapshot()
+    assert snap["engine"]["tenancy"]["quarantined"] == 1
+    assert fleet_a.metrics.counters["engine_tenant_quarantines"] == 1
+    json.dumps(snap)
+
+    # The exported repro replays deterministically: same violations.
+    repro = tmp_path / "tenant1"
+    assert (repro / "fleet.json").exists()
+    recipe = json.loads((repro / "fleet.json").read_text())
+    assert recipe["kind"] == "quarantine" and recipe["tenant_index"] == 1
+    replayed = recovery.replay_quarantine_repro(repro)
+    recorded = [
+        line for line in (repro / "violations.txt").read_text().splitlines()
+        if line and line != "(none)"
+    ]
+    assert replayed == recorded and replayed
+
+
+def test_chaosrun_replay_recognizes_quarantine_repro(tmp_path, capsys):
+    fleet = _fleet((20, 21, 22))
+    _poison_tenant(fleet, 2)
+    sup = Supervisor(fleet, rounds_per_wave=2)
+    sup.scan_and_quarantine(repro_dir=tmp_path)
+
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    import chaosrun
+
+    # Violations reproduce -> exit 1 (a repro that stops failing is news).
+    rc = chaosrun.main(["replay", str(tmp_path / "tenant2")])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "VIOLATION" in out.out and "DIVERGED" not in out.err
+
+
+def test_supervised_waves_drop_churn_for_quarantined_tenants():
+    fleet = _fleet()
+    _poison_tenant(fleet, 0)
+    sup = Supervisor(fleet, rounds_per_wave=2)
+    sup.scan_and_quarantine()
+    from rapid_tpu.serving import FleetWave
+
+    sup.submit(FleetWave(crash=((0, 5), (1, 5))))
+    sup.drain()
+    # Tenant 0's pair was dropped (its freeze is the wave-path done lane;
+    # feeding churn to a frozen tenant would sit unresolved forever);
+    # tenant 1's landed.
+    assert not bool(np.asarray(fleet.faults.crashed)[0, 5])
+    assert bool(np.asarray(fleet.faults.crashed)[1, 5])
+    assert fleet.metrics.counters[
+        "engine_recovery_quarantine_dropped_events"
+    ] == 1
+
+
+# ---------------------------------------------------------------------------
+# Observability: the recovery section's golden names
+# ---------------------------------------------------------------------------
+
+GOLDEN_RECOVERY_METRIC_NAMES = sorted(
+    [
+        f"rapid_engine_recovery_{key}"
+        for key in (
+            "waves_submitted", "checkpoint_every", "checkpoints_written",
+            "last_checkpoint_wave", "retries", "wedges", "resumes",
+            "quarantined", "mttr_ms",
+        )
+    ]
+    + [
+        f"rapid_engine_recovery_{key}_total"
+        for key in (
+            "retries", "wedges", "checkpoints", "resumes", "quarantines",
+            "quarantine_dropped_events",
+        )
+    ]
+)
+
+
+def test_recovery_prometheus_names_are_golden_and_attach_gated():
+    vc = _cluster()
+    vc.step()
+    before = exposition.metric_names(vc.prometheus_text())
+    assert not any("recovery" in name for name in before)
+    Supervisor(vc, rounds_per_wave=2)  # attach, zero traffic
+    after = exposition.metric_names(vc.prometheus_text())
+    recovery_names = sorted(n for n in after if "recovery" in n)
+    assert recovery_names == GOLDEN_RECOVERY_METRIC_NAMES
+    # Supervision implies the stream tier (the Supervisor owns a
+    # StreamDriver); beyond those two additions the vocabulary is
+    # unchanged — supervision never renames or drops a batch series.
+    residue = sorted(
+        n for n in after
+        if "recovery" not in n and "stream" not in n
+    )
+    assert residue == sorted(before)
+    json.dumps(vc.telemetry_snapshot())
+
+
+def test_clustertop_renders_recovery_pane(tmp_path):
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    import clustertop
+
+    vc = _cluster()
+    sup = Supervisor(
+        vc, rounds_per_wave=2, checkpoint_dir=tmp_path, checkpoint_every=1
+    )
+    sup.submit(StreamWave(crash=(3,)))
+    sup.drain()
+    frame = clustertop.render_frame([vc.telemetry_snapshot()])
+    assert "RECOVERY" in frame and "CKPTS" in frame
+    # Pre-supervision snapshots render no recovery pane, never a crash.
+    plain = _cluster()
+    plain.step()
+    assert "CKPTS" not in clustertop.render_frame([plain.telemetry_snapshot()])

@@ -2,7 +2,10 @@
 
 No direct wall-clock reads in the timing-sensitive packages: every timing
 consumer in ``rapid_tpu/protocol/`` AND ``rapid_tpu/monitoring/`` (failure
-detectors are timing consumers too) must go through the injected Clock
+detectors are timing consumers too) AND ``rapid_tpu/serving/`` (the
+supervision tier's deadline/backoff decisions must replay under an injected
+clock — a wall-clock read in the wedge-detection path would make every
+fault drill nondeterministic) must go through the injected Clock
 (utils/clock.py) / Metrics ``now_ms`` source, or simulated-time tests
 silently measure wall time (and phase SLO histograms record garbage under
 ManualClock).
@@ -32,7 +35,11 @@ _BANNED_CLOCK_ATTRS = frozenset(
 )
 
 #: The trees this discipline applies to (posix-style relative prefixes).
-CLOCK_DISCIPLINE_PREFIXES = ("rapid_tpu/protocol/", "rapid_tpu/monitoring/")
+CLOCK_DISCIPLINE_PREFIXES = (
+    "rapid_tpu/protocol/",
+    "rapid_tpu/monitoring/",
+    "rapid_tpu/serving/",
+)
 
 _ALLOW_RE = re.compile(r"#\s*wall-clock-ok\b")
 

@@ -109,7 +109,7 @@ FAMILIES = (
     ("names", "undefined names and star imports (symtable scope resolution)"),
     ("signatures", "call-site conformance against the real runtime callees"),
     ("clocks", "clock-injection discipline: no wall-clock reads in "
-               "protocol/monitoring"),
+               "protocol/monitoring/serving"),
     ("deadcode", "tree-wide liveness of module-level definitions"),
     ("concurrency", "asyncio guarded-by discipline, interleaving hazards, "
                     "lock re-entrancy"),

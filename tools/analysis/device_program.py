@@ -411,8 +411,15 @@ class _scoped_disable_persistent_cache:
     process (the session cache absorbs every later consumer) and keep the
     gate's facts coming from a REAL backend compile — also true inside
     bench.py, which deliberately enables the cache process-wide for its
-    own single-device workload (single-device deserialization is fine and
-    has been exercised since the cache landed)."""
+    own single-device workload. (An earlier revision of this note claimed
+    single-device deserialization was fine; the bench ``recovery`` drill's
+    bit-identity assertion later DISPROVED that — deserialized
+    single-device executables corrupt the heap under donated executions
+    too, sometimes a glibc abort and sometimes SILENT scribbling over
+    unrelated live buffers. bench.py now scopes the cache OFF around that
+    drill exactly the way this class scopes it off around the audit, and
+    utils/checkpoint.py settles loaded pytrees into executable-owned
+    buffers before any donation.)"""
 
     def __enter__(self) -> None:
         import jax

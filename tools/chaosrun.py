@@ -23,7 +23,11 @@ Three subcommands:
             violations reproduce (they must — a repro that stops failing is
             itself news worth printing). Fleet repros (the ``fleet.json``
             marker) replay through the engine fleet path with the recorded
-            per-tenant knobs; sim repros replay through the host runner.
+            per-tenant knobs; quarantine exports (``fleet.json`` carrying
+            ``kind: "quarantine"`` — the serving supervisor's poisoned-
+            tenant artifact, rapid_tpu/serving/recovery.py) reload the
+            captured state slice and re-run the deterministic health scan;
+            sim repros replay through the host runner.
 
 Usage:
 
@@ -164,8 +168,11 @@ def cmd_replay(args: argparse.Namespace) -> int:
 
 
 def _replay_fleet(args: argparse.Namespace) -> int:
-    """Replay a single-tenant FLEET repro (the per-tenant shrinker's
-    artifact) through the engine fleet path with the recorded knobs."""
+    """Replay a single-tenant FLEET repro through the engine fleet path:
+    shrinker artifacts (the per-tenant quiescent-filler repro) re-run the
+    recorded schedule with the recorded knobs; quarantine exports (the
+    serving supervisor's ``kind: "quarantine"`` marker) reload the captured
+    poisoned state slice and re-run the deterministic health scan."""
     from rapid_tpu.tenancy import chaos as tchaos
 
     recorded_path = Path(args.repro) / "violations.txt"
@@ -175,7 +182,13 @@ def _replay_fleet(args: argparse.Namespace) -> int:
         if recorded_path.exists()
         else []
     )
-    _result, violations = tchaos.replay_fleet_repro(args.repro)
+    recipe = json.loads((Path(args.repro) / "fleet.json").read_text())
+    if recipe.get("kind") == "quarantine":
+        from rapid_tpu.serving import recovery
+
+        violations = recovery.replay_quarantine_repro(args.repro)
+    else:
+        _result, violations = tchaos.replay_fleet_repro(args.repro)
     for v in violations:
         print(f"VIOLATION {v}")
     if recorded and sorted(map(str, violations)) != sorted(recorded):

@@ -234,6 +234,41 @@ def render_stream_pane(snapshots: List[Dict[str, Any]]) -> List[str]:
     return ["", *_render_table(header, rows)]
 
 
+def render_recovery_pane(snapshots: List[Dict[str, Any]]) -> List[str]:
+    """The self-healing tier rows: one line per snapshot whose ``engine``
+    section carries a ``recovery`` block (a rapid_tpu.serving.supervisor.
+    Supervisor is attached) — checkpoint cadence/progress, retry/wedge/
+    resume tallies, the quarantine census, and the last resume's MTTR.
+    Pre-supervision snapshots (no ``recovery`` key, or None gauges)
+    contribute nothing / dashes, never a crash."""
+    supervised = [
+        s for s in snapshots
+        if isinstance(s.get("engine"), dict)
+        and isinstance(s["engine"].get("recovery"), dict)
+    ]
+    if not supervised:
+        return []
+    header = (
+        "RECOVERY", "WAVES", "CKPTS", "LASTCKPT", "RETRIES", "WEDGES",
+        "RESUMES", "QUARANTINED", "MTTRMS",
+    )
+    rows: List[Tuple[str, ...]] = []
+    for snapshot in sorted(supervised, key=lambda s: str(s.get("node", ""))):
+        recovery = snapshot["engine"]["recovery"]
+        rows.append((
+            str(snapshot.get("node", "?")),
+            _fmt_opt(recovery.get("waves_submitted"), ".0f"),
+            _fmt_opt(recovery.get("checkpoints_written"), ".0f"),
+            _fmt_opt(recovery.get("last_checkpoint_wave"), ".0f"),
+            _fmt_opt(recovery.get("retries"), ".0f"),
+            _fmt_opt(recovery.get("wedges"), ".0f"),
+            _fmt_opt(recovery.get("resumes"), ".0f"),
+            _fmt_opt(recovery.get("quarantined"), ".0f"),
+            _fmt_opt(recovery.get("mttr_ms"), ".1f"),
+        ))
+    return ["", *_render_table(header, rows)]
+
+
 def render_frame(
     snapshots: List[Dict[str, Any]], errors: Optional[List[str]] = None
 ) -> str:
@@ -299,6 +334,7 @@ def render_frame(
     lines.extend(_render_table(header, rows))
     lines.extend(render_engine_pane(snapshots))
     lines.extend(render_stream_pane(snapshots))
+    lines.extend(render_recovery_pane(snapshots))
     for error in errors or ():
         lines.append(f"! {error}")
     return "\n".join(lines) + "\n"

@@ -28,12 +28,15 @@ runs, and can I trust the numbers". Two input kinds, freely mixed:
   an audited round omitting BOTH ``stream_view_changes_per_sec`` and
   ``stream_status``), ``chaos-missing`` (same discipline for the
   adversarial-chaos point: an audited round omitting BOTH
-  ``chaos_scenarios_per_sec`` and ``chaos_status``), and ``mem-missing``
+  ``chaos_scenarios_per_sec`` and ``chaos_status``), ``mem-missing``
   (same discipline for the state-compaction memory point: an audited
-  round omitting BOTH ``bytes_per_member`` and ``mem_status``). The N1M,
-  FLEET, STREAM, CHAOS, and MEM columns render the headline / fleet /
-  sustained-stream / chaos-throughput / bytes-per-member values (or
-  their status markers) per round.
+  round omitting BOTH ``bytes_per_member`` and ``mem_status``), and
+  ``recovery-missing`` (same discipline for the self-healing drill: an
+  audited round omitting BOTH ``recovery_mttr_ms`` and
+  ``recovery_status``). The N1M, FLEET, STREAM, CHAOS, MEM, and RECOVERY
+  columns render the headline / fleet / sustained-stream /
+  chaos-throughput / bytes-per-member / resume-MTTR values (or their
+  status markers) per round.
 
 ``--chrome out.json`` additionally writes Chrome trace-event JSON (the same
 envelope tools/traceview.py emits — Perfetto/chrome://tracing load it):
@@ -79,6 +82,15 @@ _POINT_EVENTS = (
     LedgerEvent.SNAPSHOT_REPLAY.value,
     LedgerEvent.COMPILE_STATS.value,
     LedgerEvent.DEVICE_MEMORY.value,
+    # Self-healing serving runtime (ISSUE 15): the recovery timeline —
+    # retries, wedges, checkpoints (and corrupt-checkpoint fallbacks),
+    # resumes, quarantines — renders as point events on the stage line.
+    LedgerEvent.RECOVERY_RETRY.value,
+    LedgerEvent.RECOVERY_WEDGED.value,
+    LedgerEvent.RECOVERY_CHECKPOINT.value,
+    LedgerEvent.RECOVERY_CHECKPOINT_CORRUPT.value,
+    LedgerEvent.RECOVERY_RESUME.value,
+    LedgerEvent.RECOVERY_QUARANTINE.value,
 )
 
 
@@ -343,6 +355,16 @@ def point_flags(
         and not data.get("mem_status")
     ):
         flags.append("mem-missing")
+    # Recovery discipline (ISSUE 15): same rule for the self-healing drill
+    # — an audited round must carry recovery_mttr_ms or its explicit
+    # recovery_status marker; the resume-MTTR metric must never be
+    # silently absent. Pre-audit historical rounds are exempt.
+    if (
+        hlo_audit_table(data) is not None
+        and not isinstance(data.get("recovery_mttr_ms"), (int, float))
+        and not data.get("recovery_status")
+    ):
+        flags.append("recovery-missing")
     if hlo_drift(prev, hlo_audit_table(data)):
         flags.append("hlo-drift")
     if not flags:
@@ -421,6 +443,20 @@ def mem_cell(data: Dict[str, Any]) -> str:
     return str(status) if status else "-"
 
 
+def recovery_cell(data: Dict[str, Any]) -> str:
+    """The RECOVERY column: the drill's resume MTTR (with the bit-identity
+    verdict beside it — a resume that diverged is worse than no resume),
+    else the explicit recovery_status marker, else '-' (pre-supervision
+    rounds)."""
+    value = data.get("recovery_mttr_ms")
+    if isinstance(value, (int, float)):
+        identical = data.get("recovery_bit_identical")
+        suffix = "" if identical in (True, None) else " DIVERGED"
+        return f"{float(value):.1f}ms mttr{suffix}"
+    status = data.get("recovery_status")
+    return str(status) if status else "-"
+
+
 def chaos_cell(data: Dict[str, Any]) -> str:
     """The CHAOS column: adversarial scenarios resolved (and oracle-checked
     clean) per second of batched fleet dispatch, with the tenant count when
@@ -438,7 +474,7 @@ def chaos_cell(data: Dict[str, Any]) -> str:
 def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
     lines = ["== perf trajectory =="]
     header = ("ROUND", "METRIC", "VALUE", "N1M", "FLEET", "STREAM", "CHAOS",
-              "MEM", "PLATFORM", "VSBASE", "FLAGS")
+              "MEM", "RECOVERY", "PLATFORM", "VSBASE", "FLAGS")
     rows: List[Tuple[str, ...]] = []
     flag_rows: List[Tuple[str, List[str]]] = []
     prev_audit: Optional[Dict[str, Any]] = None
@@ -458,6 +494,7 @@ def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
             stream_cell(data),
             chaos_cell(data),
             mem_cell(data),
+            recovery_cell(data),
             str(data.get("platform", "-")),
             "-" if vs is None else f"{float(vs):.2f}x"
             + ("@capture" if "vs_baseline_at_capture" in data else ""),
