@@ -520,6 +520,18 @@ def recovery_plan(platform: str, elapsed_s: float) -> "tuple[int, int, str]":
     return n_r, waves, f"ramped:{waves}x{n_r}"
 
 
+def activity_status(stream_fields: dict, stream_status: str) -> str:
+    """Device telemetry plane (ISSUE 16): the never-silently-absent status
+    for the lane-derived activity numbers — "measured" when the stream
+    stage actually fetched a numeric active fraction, otherwise the stage's
+    own skip reason (ramped:WxN / skipped-budget / suppressed), so
+    perfview's activity-missing flag only ever fires on instrumentation
+    LOSS (an audited round that dropped both value and status)."""
+    if isinstance(stream_fields.get("stream_active_fraction"), (int, float)):
+        return "measured"
+    return stream_status
+
+
 def _parse_scale(spec: str) -> int:
     """'10M' -> 10_000_000, '250k' -> 250_000, bare ints pass through; 0 on
     anything unparseable (the stretch point is opt-in — a typo'd env value
@@ -906,6 +918,8 @@ def run_workload(ledger, profile_dir=None) -> None:
     fleet_cuts_total = None
     fleet_wall_ms = None
     fleet_memory = None
+    fleet_activity = None
+    fleet_conflict_rates = None
     if fleet_b == 0:
         _mark(f"tenant fleet stage not run: {fleet_status}")
     else:
@@ -925,6 +939,7 @@ def run_workload(ledger, profile_dir=None) -> None:
                     fleet_n, n_slots=fleet_n + n_extra, k=k_rings, h=h, l=l,
                     cohorts=min(8, fleet_n), fd_threshold=fd_threshold,
                     seed=seed0 + i, delivery_spread=delivery_spread,
+                    telemetry=True,
                 )
                 vc.assign_cohorts_roundrobin()
                 rng = np.random.default_rng(seed0 + 10_000 + i)
@@ -973,6 +988,14 @@ def run_workload(ledger, profile_dir=None) -> None:
             )
             fleet_cuts_total = int(cuts.sum())
             fleet_vcps = fleet_cuts_total / (fleet_wall_ms / 1000.0)
+            # Device telemetry plane (ISSUE 16): the per-tenant conflict
+            # rates from the fleet's lanes — the sync boundary below is
+            # what refreshes the host cache (timing already captured).
+            fleet.sync()
+            fleet_activity = fleet.activity
+            fleet_conflict_rates = [
+                round(a["conflict_rate"], 6) for a in fleet.tenant_activity
+            ]
             fleet_memory = engine_telemetry.device_memory_snapshot()
             _mark(
                 f"tenant_fleet: {fleet_b} tenants x {fleet_n} members, "
@@ -1018,10 +1041,14 @@ def run_workload(ledger, profile_dir=None) -> None:
         stream_slots = stream_n + 2 * stream_waves
 
         def build_stream_cluster(seed: int):
+            # telemetry=True: the stream stage is where the device telemetry
+            # plane's activity numbers come from (ISSUE 16) — the lanes ride
+            # the same donated dispatches and the digest is fetched only at
+            # the drain boundary, so the measured overlap is unchanged.
             vcs = VirtualCluster.create(
                 stream_n, n_slots=stream_slots, k=k_rings, h=9, l=4,
                 cohorts=min(8, stream_n), fd_threshold=fd_threshold,
-                seed=seed, delivery_spread=delivery_spread,
+                seed=seed, delivery_spread=delivery_spread, telemetry=True,
             )
             vcs.assign_cohorts_roundrobin()
             return vcs
@@ -1033,6 +1060,7 @@ def run_workload(ledger, profile_dir=None) -> None:
                     stream_n, k=k_rings, h=9, l=4,
                     cohorts=min(8, stream_n), fd_threshold=fd_threshold,
                     seed=seed0 + i, delivery_spread=delivery_spread,
+                    telemetry=True,
                 )
                 vcs.assign_cohorts_roundrobin()
                 clusters.append(vcs)
@@ -1150,6 +1178,52 @@ def run_workload(ledger, profile_dir=None) -> None:
                     "compile_ms", 0.0
                 ),
             }
+            # Device telemetry plane (ISSUE 16): the activity numbers from
+            # BOTH serving paths' lanes, refreshed by the drains above. The
+            # two paths run different slot-table geometries, so the mean
+            # active fraction is rounds-weighted over per-engine fractions
+            # rather than pooled over raw counters.
+            activity_summaries = [
+                a for a in (
+                    vcs.activity, *(fleet_s.tenant_activity or ())
+                ) if a is not None
+            ]
+            activity_rounds = sum(s["rounds"] for s in activity_summaries)
+            decisions_fast = sum(
+                s["decisions_fast"] for s in activity_summaries
+            )
+            decisions_total = decisions_fast + sum(
+                s["decisions_classic"] for s in activity_summaries
+            )
+            if activity_rounds:
+                stream_fields.update({
+                    "stream_active_fraction": round(
+                        sum(
+                            s["active_fraction"] * s["rounds"]
+                            for s in activity_summaries
+                        ) / activity_rounds, 6,
+                    ),
+                    "stream_peak_active_fraction": round(
+                        max(
+                            s["peak_active_fraction"]
+                            for s in activity_summaries
+                        ), 6,
+                    ),
+                    "stream_fast_path_share": round(
+                        decisions_fast / decisions_total, 4,
+                    ) if decisions_total else 0.0,
+                })
+            # Zero-churn stability soak: a quiet engine must READ zero —
+            # published explicitly (0.0 is a measurement, not an absence;
+            # perfview's activity-missing flag polices exactly this).
+            quiet = build_stream_cluster(seed=7_600)
+            for _ in range(rounds_per_wave):
+                quiet.step()
+            quiet.sync()
+            stream_fields["quiescent_active_fraction"] = float(
+                quiet.activity["active_fraction"]
+            )
+            del quiet
             stream_memory = engine_telemetry.device_memory_snapshot()
             _mark(
                 f"stream: {cuts_total} view changes in {wall_ms_total:.1f} ms "
@@ -1469,6 +1543,21 @@ def run_workload(ledger, profile_dir=None) -> None:
             if fleet_vcps is not None
             else {}
         ),
+        # Device telemetry plane, fleet half (ISSUE 16): the pooled and
+        # per-tenant conflict rates from the lanes the fleet wave carried.
+        **(
+            {
+                "tenant_conflict_rate": round(
+                    fleet_activity["conflict_rate"], 6
+                ),
+                "tenant_conflict_rates": fleet_conflict_rates,
+                "fleet_fast_path_share": round(
+                    fleet_activity["fast_path_share"], 4
+                ),
+            }
+            if fleet_activity is not None
+            else {}
+        ),
         **({"fleet_device_memory": fleet_memory} if fleet_memory is not None else {}),
         # Streaming serving point (ISSUE 11): sustained view-changes/sec,
         # p99 alert->commit, and overlap efficiency through the pipelined
@@ -1478,6 +1567,9 @@ def run_workload(ledger, profile_dir=None) -> None:
         # pipeline exercise; "skipped-budget"; "suppressed").
         "stream_status": stream_status,
         **{k: v for k, v in stream_fields.items() if v is not None},
+        # Device telemetry plane status (ISSUE 16): never silently absent —
+        # see activity_status for the policy.
+        "activity_status": activity_status(stream_fields, stream_status),
         **({"stream_device_memory": stream_memory} if stream_memory is not None else {}),
         # Adversarial-chaos point (ISSUE 12): hostile scenarios resolved
         # (and oracle-checked clean) per second of batched fleet dispatch.

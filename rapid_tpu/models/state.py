@@ -114,6 +114,15 @@ class EngineConfig(NamedTuple):
     # documented envelopes (ROUND_ENVELOPE rounds and < 2^15 - 1 classic
     # attempts / fd events per configuration).
     compact: int = 0
+    # Device-resident telemetry plane (an int knob, like ``compact``): 0 =
+    # off — the round bodies trace NO telemetry code and compile
+    # byte-identical programs (the hlo.lock.json gate freezes that); 1 = a
+    # :class:`TelemetryLanes` pytree rides beside the state through the
+    # jitted round bodies, accumulating per-round activity/tally/conflict
+    # counters on-device. Telemetry never changes engine results: the lanes
+    # are write-only inside a round (nothing reads them back into protocol
+    # state), pinned bit-identical on-vs-off by tests/test_telemetry_plane.py.
+    telemetry: int = 0
 
 
 class CompactionPolicy(NamedTuple):
@@ -456,6 +465,96 @@ class StepEvents(NamedTuple):
     # step sees post-reset zeros — observers must use these instead).
     prop_hi: jnp.ndarray  # [c] uint32
     prop_lo: jnp.ndarray  # [c] uint32
+
+
+# ---------------------------------------------------------------------------
+# Device-resident telemetry plane (EngineConfig.telemetry == 1)
+# ---------------------------------------------------------------------------
+
+#: Log2 bucket count of the rounds-undecided histogram: bucket b counts
+#: decisions that sat undecided for r rounds with floor(log2(max(r, 1)))
+#: == b (clamped into the last bucket), so bucket 0 is the one-round fast
+#: path and bucket 7 holds every >= 128-round stall.
+TELEMETRY_BUCKETS = 8
+
+#: field -> shape symbols over (n, k, c, b) — the LANE_SPECS convention with
+#: ``b`` = :data:`TELEMETRY_BUCKETS`. Every telemetry lane is int32: these
+#: are accumulators, not protocol state, and the compaction policy never
+#: narrows them (a saturating counter would silently lie). The ``telemetry``
+#: analyzer family mirrors this exact field set (tools/analysis/telemetry.py)
+#: so a new lane cannot skip the partition rules or the exposition surface.
+TELEMETRY_LANE_SPECS: Dict[str, Tuple[str, ...]] = {
+    "tl_rounds": (),
+    "tl_alerts": (),
+    "tl_active": ("c", "n"),
+    "tl_invalidated": ("c", "n"),
+    "tl_proposals": ("c",),
+    "tl_tally_sum": (),
+    "tl_fast_decisions": (),
+    "tl_classic_decisions": (),
+    "tl_conflict_rounds": (),
+    "tl_undecided_hist": ("b",),
+}
+
+
+class TelemetryLanes(NamedTuple):
+    """On-device activity/tally/conflict accumulators, carried alongside
+    :class:`EngineState` through the jitted round bodies when
+    ``EngineConfig.telemetry == 1`` and fetched ONLY at the existing
+    host-sync boundaries (``sync`` / ``stream_fetch`` / ``health_scan``).
+
+    Two grains, one discipline — zero new hot-loop collectives:
+
+    - Scalar counters reuse reductions the round body already computes
+      (``alerts_emitted``, the tally scalars, the decision flags), so
+      accumulating them adds elementwise int adds only.
+    - Per-slot lanes stay at their native [c, n] / [c] grain (sharded by
+      the same :data:`rapid_tpu.parallel.mesh.PARTITION_RULES` table);
+      cross-shard reductions over them happen in the separate
+      ``telemetry_digest`` jit dispatched at fetch boundaries, never
+      inside the convergence loop.
+
+    Under the tenancy vmap every lane grows a leading ``[t]`` axis, so
+    every metric is per-tenant for free."""
+
+    tl_rounds: jnp.ndarray  # [] int32 — rounds stepped
+    tl_alerts: jnp.ndarray  # [] int32 — edge alerts applied (sum of alerts_emitted)
+    # Rounds each (cohort, subject) slot was ACTIVE: nonzero report bits or
+    # a watermark tally in the [L, H) flux band. The quantity ROADMAP item
+    # 3's sparse O(activity) rounds will skip work by.
+    tl_active: jnp.ndarray  # [c, n] int32
+    tl_invalidated: jnp.ndarray  # [c, n] int32 — implicit-invalidation events
+    tl_proposals: jnp.ndarray  # [c] int32 — proposals released per cohort
+    tl_tally_sum: jnp.ndarray  # [] int32 — winning-tally sizes, summed at decisions
+    tl_fast_decisions: jnp.ndarray  # [] int32 — one-step fast-path decisions
+    tl_classic_decisions: jnp.ndarray  # [] int32 — classic-fallback decisions
+    # Rounds where some cohort had announced but the fast path did NOT
+    # decide — the per-tenant conflict-rate numerator ("The Performance of
+    # Paxos and Fast Paxos": the fast path's win hinges on collision rate).
+    tl_conflict_rounds: jnp.ndarray  # [] int32
+    tl_undecided_hist: jnp.ndarray  # [TELEMETRY_BUCKETS] int32 — log2(rounds-undecided) at decision
+
+
+def initial_telemetry(cfg: EngineConfig) -> TelemetryLanes:
+    """All-zero telemetry lanes for this config's geometry."""
+    dims = {"n": cfg.n, "k": cfg.k, "c": cfg.c, "b": TELEMETRY_BUCKETS}
+    return TelemetryLanes(**{
+        field: jnp.zeros(tuple(dims[s] for s in shape), dtype=jnp.int32)
+        for field, shape in TELEMETRY_LANE_SPECS.items()
+    })
+
+
+def telemetry_bytes_total(cfg: EngineConfig) -> int:
+    """At-rest bytes of one cluster's telemetry lanes (all int32) — the
+    figure the hlo.lock.json ``telemetry`` block freezes per device."""
+    dims = {"n": cfg.n, "k": cfg.k, "c": cfg.c, "b": TELEMETRY_BUCKETS}
+    total = 0
+    for shape in TELEMETRY_LANE_SPECS.values():
+        elems = 1
+        for sym in shape:
+            elems *= dims[sym]
+        total += elems * 4
+    return total
 
 
 # ---------------------------------------------------------------------------

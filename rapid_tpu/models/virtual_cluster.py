@@ -24,15 +24,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from rapid_tpu.models.state import (
+    TELEMETRY_BUCKETS,
     EngineConfig,
     EngineState,
     FaultInputs,
     StepEvents,
+    TelemetryLanes,
     compaction_policy,
     initial_state,
+    initial_telemetry,
 )
-from rapid_tpu.ops.consensus import tally_candidates
-from rapid_tpu.ops.cut_detection import cohort_watermark_pass
+from rapid_tpu.ops.consensus import tally_candidates, undecided_log2_bucket
+from rapid_tpu.ops.cut_detection import cohort_watermark_pass, telemetry_cut_masks
 from rapid_tpu.ops.hashing import masked_set_hash, mix32
 from rapid_tpu.ops.pallas_kernels import (
     _popcount32,
@@ -228,13 +231,27 @@ def _cohort_cut_detection(cfg: EngineConfig, state: EngineState, new_bits, heard
 
 
 def _compute_round(
-    cfg: EngineConfig, state: EngineState, faults: FaultInputs, edge_masks=None
+    cfg: EngineConfig, state: EngineState, faults: FaultInputs, edge_masks=None,
+    telem: Optional[TelemetryLanes] = None,
 ):
     """One protocol round WITHOUT view-change application: returns the
     round-advanced state plus (decided, winner_mask, events). Keeping the
     ring re-sort out of the round body lets the convergence loop run
     sort-free and apply the view change exactly once on exit; loops also
-    hoist the per-edge gather by passing precomputed ``edge_masks``."""
+    hoist the per-edge gather by passing precomputed ``edge_masks``.
+
+    ``telem`` (the device telemetry plane, ``cfg.telemetry == 1``): when a
+    :class:`TelemetryLanes` pytree is passed, the round accumulates into it
+    and the return grows a fifth element — the updated lanes. The branch is
+    a PYTHON-level ``if``: with ``telem=None`` (telemetry off) no telemetry
+    code is traced at all, so the compiled program is byte-identical to the
+    pre-telemetry engine (the hlo.lock.json gate freezes that). Telemetry
+    is write-only — nothing below reads a ``tl_`` lane — so engine results
+    are bit-identical on vs off by construction, and every accumulation is
+    either an already-computed round scalar or elementwise at the lane's
+    native [c, n]/[c] grain: zero new collectives in the round body (the
+    cross-shard reductions live in ``telemetry_digest_impl``, dispatched
+    only at host-sync boundaries)."""
     n, k, c = cfg.n, cfg.k, cfg.c
 
     # 1. Failure-detector tick -> fresh DOWN alerts per (subject, ring) edge.
@@ -534,7 +551,34 @@ def _compute_round(
         prop_hi=prop_hi,
         prop_lo=prop_lo,
     )
-    return round_state, decided, winner_mask, events
+    if telem is None:
+        return round_state, decided, winner_mask, events
+
+    # Device telemetry plane (write-only; see the docstring contract).
+    # Scalars reuse reductions computed above; [c, n]/[c] lanes accumulate
+    # elementwise at their native grain.
+    active_cn, invalidated_cn = telemetry_cut_masks(
+        state.report_bits, new_bits, report_bits,
+        state.alive | state.join_pending, cfg.h, cfg.l,
+    )
+    decided_i = decided.astype(jnp.int32)
+    # Decision-path split, same vocabulary as the host protocol's
+    # FastPaxos.decided_path ("classic" iff the classic fallback decided).
+    bucket = undecided_log2_bucket(rounds_undecided, TELEMETRY_BUCKETS)
+    telem = TelemetryLanes(
+        tl_rounds=telem.tl_rounds + 1,
+        tl_alerts=telem.tl_alerts + alerts_emitted,
+        tl_active=telem.tl_active + active_cn.astype(jnp.int32),
+        tl_invalidated=telem.tl_invalidated + invalidated_cn.astype(jnp.int32),
+        tl_proposals=telem.tl_proposals + proposed_now.astype(jnp.int32),
+        tl_tally_sum=telem.tl_tally_sum + jnp.where(decided, tally.max_count, 0),
+        tl_fast_decisions=telem.tl_fast_decisions + fast_decided.astype(jnp.int32),
+        tl_classic_decisions=telem.tl_classic_decisions + fb_decided.astype(jnp.int32),
+        tl_conflict_rounds=telem.tl_conflict_rounds
+        + (jnp.any(announced) & ~fast_decided).astype(jnp.int32),
+        tl_undecided_hist=telem.tl_undecided_hist.at[bucket].add(decided_i),
+    )
+    return round_state, decided, winner_mask, events, telem
 
 
 def _rotation_seed(epoch_u32, j: int):
@@ -644,6 +688,61 @@ engine_step = jax.jit(engine_step_impl, static_argnums=(0,), donate_argnums=(1,)
 engine_step_nodonate = jax.jit(engine_step_impl, static_argnums=(0,))  # donate-ok: compile-check / dry-run variant; callers keep their state buffers
 
 
+def engine_step_telem_impl(
+    cfg: EngineConfig,
+    state: EngineState,
+    telem: TelemetryLanes,
+    faults: FaultInputs,
+) -> Tuple[EngineState, TelemetryLanes, StepEvents]:
+    """:func:`engine_step_impl` with the telemetry plane riding along — a
+    SEPARATE entrypoint (not a default argument on the existing one) so the
+    telemetry=0 programs and their donation layout stay untouched, which is
+    what lets the hlo.lock.json diff stay purely additive."""
+    round_state, decided, winner_mask, events, telem = _compute_round(
+        cfg, state, faults, None, telem
+    )
+    new_state = jax.lax.cond(
+        decided,
+        lambda s: apply_view_change_impl(cfg, s, winner_mask),
+        lambda s: s,
+        round_state,
+    )
+    return new_state, telem, events
+
+
+engine_step_telem = jax.jit(
+    engine_step_telem_impl, static_argnums=(0,), donate_argnums=(1, 2)
+)
+
+
+def telemetry_digest_impl(telem: TelemetryLanes) -> jnp.ndarray:
+    """The telemetry lanes reduced to one small int32 vector — THE place the
+    plane's cross-shard reductions live, dispatched only at the existing
+    host-sync boundaries (``sync`` / ``stream_fetch`` / ``health_scan``;
+    each fetch site carries a ``# telemetry-fetch-ok:`` marker the
+    ``telemetry`` analyzer family enforces), never inside a convergence
+    loop. Layout: ``engine_telemetry.TELEMETRY_DIGEST_FIELDS`` scalars then
+    the ``TELEMETRY_BUCKETS`` rounds-undecided histogram buckets."""
+    return jnp.concatenate([
+        jnp.stack([
+            telem.tl_rounds,
+            telem.tl_alerts,
+            jnp.sum(telem.tl_active, dtype=jnp.int32),
+            jnp.max(telem.tl_active),
+            jnp.sum(telem.tl_invalidated, dtype=jnp.int32),
+            jnp.sum(telem.tl_proposals, dtype=jnp.int32),
+            telem.tl_tally_sum,
+            telem.tl_fast_decisions,
+            telem.tl_classic_decisions,
+            telem.tl_conflict_rounds,
+        ]),
+        telem.tl_undecided_hist,
+    ])
+
+
+telemetry_digest = jax.jit(telemetry_digest_impl)  # donate-ok: read-only boundary fetch; the lanes stay live
+
+
 def sync_checksum_impl(state: EngineState, faults: FaultInputs):
     """Scalar checksum depending on every state/fault array — the barrier
     ``VirtualCluster.sync`` fetches (``jax.block_until_ready`` does not
@@ -707,6 +806,47 @@ def run_to_decision_impl(cfg: EngineConfig, state: EngineState, faults: FaultInp
 
 run_to_decision = jax.jit(
     run_to_decision_impl, static_argnums=(0,), donate_argnums=(1,)
+)
+
+
+def run_to_decision_telem_impl(
+    cfg: EngineConfig,
+    state: EngineState,
+    telem: TelemetryLanes,
+    faults: FaultInputs,
+    max_steps,
+):
+    """:func:`run_to_decision_impl` with the telemetry lanes joining the
+    while-loop carry (separate entrypoint; same rationale as
+    :func:`engine_step_telem_impl`)."""
+    n = cfg.n
+
+    def cond(carry):
+        _, _, steps, decided, _ = carry
+        return (~decided) & (steps < max_steps)
+
+    edge_masks = _edge_masks(cfg, state, faults)
+
+    def body(carry):
+        state, telem, steps, _, _ = carry
+        round_state, decided, winner_mask, _, telem = _compute_round(
+            cfg, state, faults, edge_masks, telem
+        )
+        return (round_state, telem, steps + 1, decided, winner_mask)
+
+    init = (state, telem, jnp.int32(0), jnp.bool_(False), jnp.zeros((n,), dtype=bool))
+    state, telem, steps, decided, winner = jax.lax.while_loop(cond, body, init)
+    state = jax.lax.cond(
+        decided,
+        lambda s: apply_view_change_impl(cfg, s, winner),
+        lambda s: s,
+        state,
+    )
+    return (state, telem, steps, decided, winner)
+
+
+run_to_decision_telem = jax.jit(
+    run_to_decision_telem_impl, static_argnums=(0,), donate_argnums=(1, 2)
 )
 
 
@@ -811,6 +951,83 @@ run_until_membership = jax.jit(
 )
 
 
+def run_until_membership_telem_impl(
+    cfg: EngineConfig,
+    state: EngineState,
+    telem: TelemetryLanes,
+    faults: FaultInputs,
+    target,
+    max_steps,
+    max_cuts,
+    min_cuts,
+):
+    """:func:`run_until_membership_impl` with the telemetry lanes joining
+    both loop carries (separate entrypoint; same rationale as
+    :func:`engine_step_telem_impl`). Telemetry accumulates ACROSS the
+    wave's view changes — the lanes are never reset by a commit, so a
+    multi-cut wave reads as one activity story."""
+    n = cfg.n
+
+    def outer_cond(carry):
+        state, _, steps, cuts, stalled, _, _ = carry
+        resolved = (state.n_members == target) & (cuts >= min_cuts)
+        return (~resolved) & (~stalled) & (steps < max_steps) & (cuts < max_cuts)
+
+    def outer_body(carry):
+        state, telem, steps, cuts, _, sizes, edge_masks = carry
+
+        def inner_cond(carry):
+            _, _, steps, decided, _ = carry
+            return (~decided) & (steps < max_steps)
+
+        def inner_body(carry):
+            state, telem, steps, _, _ = carry
+            round_state, decided, winner_mask, _, telem = _compute_round(
+                cfg, state, faults, edge_masks, telem
+            )
+            return (round_state, telem, steps + 1, decided, winner_mask)
+
+        init = (state, telem, steps, jnp.bool_(False), jnp.zeros((n,), dtype=bool))
+        state, telem, steps, decided, winner = jax.lax.while_loop(
+            inner_cond, inner_body, init
+        )
+
+        def commit(s):
+            s2 = apply_view_change_impl(cfg, s, winner)
+            return s2, _edge_masks(cfg, s2, faults)
+
+        state, edge_masks = jax.lax.cond(
+            decided, commit, lambda s: (s, edge_masks), state
+        )
+        sizes = jnp.where(
+            decided, sizes.at[cuts].set(state.n_members), sizes
+        )
+        return (
+            state, telem, steps, cuts + decided.astype(jnp.int32), ~decided,
+            sizes, edge_masks,
+        )
+
+    init = (
+        state,
+        telem,
+        jnp.int32(0),
+        jnp.int32(0),
+        jnp.bool_(False),
+        jnp.full((max_cuts,), -1, dtype=jnp.int32),
+        _edge_masks(cfg, state, faults),
+    )
+    state, telem, steps, cuts, stalled, sizes, _ = jax.lax.while_loop(
+        outer_cond, outer_body, init
+    )
+    resolved = (state.n_members == target) & (cuts >= min_cuts)
+    return (state, telem, steps, cuts, resolved, sizes)
+
+
+run_until_membership_telem = jax.jit(
+    run_until_membership_telem_impl, static_argnums=(0, 6), donate_argnums=(1, 2)
+)
+
+
 class VirtualCluster(DispatchSeam):
     """Host driver around the device engine: owns the state, injects faults
     and join waves, and runs rounds until convergence.
@@ -842,6 +1059,16 @@ class VirtualCluster(DispatchSeam):
         # self-healing tier's checkpoint/retry/wedge stats (None = no
         # supervision, no recovery section).
         self.recovery = None
+        # Device telemetry plane (cfg.telemetry == 1): the lanes live on
+        # device beside the state; the host keeps only a digest cache,
+        # zero-minted at attach (the exposition series exist from the first
+        # scrape, never mid-run) and refreshed ONLY at host-sync boundaries.
+        self.telem = initial_telemetry(cfg) if cfg.telemetry else None
+        self._activity = (
+            engine_telemetry.zero_activity_summary(cfg.n, cfg.c)
+            if cfg.telemetry
+            else None
+        )
         engine_telemetry.install()
 
     # -- construction ---------------------------------------------------
@@ -865,13 +1092,17 @@ class VirtualCluster(DispatchSeam):
         delivery_prob_permille: int = 1000,
         pallas_lanes: int = 128,
         compact: bool = False,
+        telemetry: bool = False,
     ) -> "VirtualCluster":
         """Synthetic cluster: slot identities are random 64-bit lanes (the
         host never materializes 100K endpoint strings; interop deployments
         use from_endpoints). ``compact=True`` stores the engine state at
         the config-derived narrow dtypes (models/state.compaction_policy)
         — bit-identical protocol behavior, a fraction of the bytes/member
-        (the wide layout stays the differential oracle)."""
+        (the wide layout stays the differential oracle). ``telemetry=True``
+        carries the device telemetry plane (models/state.TelemetryLanes)
+        through every round — engine results stay bit-identical; off, the
+        compiled programs are byte-identical to a pre-telemetry engine."""
         n = n_slots if n_slots is not None else n_members
         assert n >= n_members
         _validate_delivery_prob(delivery_prob_permille)
@@ -884,6 +1115,7 @@ class VirtualCluster(DispatchSeam):
             delivery_prob_permille=delivery_prob_permille,
             pallas_lanes=pallas_lanes,
             compact=int(compact),
+            telemetry=int(telemetry),
         )
         rng = np.random.default_rng(seed)
         key_hi = rng.integers(0, 2**32, size=(k, n), dtype=np.uint32)
@@ -917,6 +1149,7 @@ class VirtualCluster(DispatchSeam):
         n_members: Optional[int] = None,
         topology: str = "native",
         compact: bool = False,
+        telemetry: bool = False,
     ) -> "VirtualCluster":
         """Build from real endpoints with the host view's exact ring keys, so
         the engine's topology matches a host MembershipView bit-for-bit.
@@ -953,6 +1186,7 @@ class VirtualCluster(DispatchSeam):
             delivery_prob_permille=delivery_prob_permille,
             pallas_lanes=pallas_lanes,
             compact=int(compact),
+            telemetry=int(telemetry),
         )
         key_hi0, key_lo0 = endpoint_ring_keys(endpoints, k, topology=topology)
         key_hi = np.zeros((k, n), dtype=np.uint32)
@@ -1186,7 +1420,12 @@ class VirtualCluster(DispatchSeam):
         self.metrics.inc("engine_steps")
         self.metrics.inc("engine_convergence_steps")
         with self._dispatch(phase):
-            self.state, events = engine_step(self.cfg, self.state, self.faults)
+            if self.telem is not None:
+                self.state, self.telem, events = engine_step_telem(
+                    self.cfg, self.state, self.telem, self.faults
+                )
+            else:
+                self.state, events = engine_step(self.cfg, self.state, self.faults)
         return events
 
     def step(self) -> StepEvents:
@@ -1209,7 +1448,30 @@ class VirtualCluster(DispatchSeam):
         with self._dispatch("sync"):
             checksum = int(sync_checksum(self.state, self.faults))
         self._account_d2h(4)
+        self._refresh_activity()
         return checksum
+
+    def _refresh_activity(self) -> None:
+        """Fetch the telemetry digest and refresh the host-side activity
+        cache. Called ONLY from host-sync boundaries (sync / stream drain /
+        fleet health scans) — the cache, not the device lanes, is what
+        ``telemetry_snapshot`` reads, so scrapes never add a device fetch."""
+        if self.telem is None:
+            return
+        # telemetry-fetch-ok: sync barrier — the driver is already paying a
+        # blocking device round trip here.
+        digest = np.asarray(telemetry_digest(self.telem))
+        self._account_d2h(digest.nbytes)
+        self._activity = engine_telemetry.activity_summary(
+            digest, self.cfg.n, self.cfg.c
+        )
+
+    @property
+    def activity(self) -> Optional[dict]:
+        """The last host-sync boundary's activity summary (a copy), or
+        None on a telemetry=0 engine — reading it never touches the
+        device."""
+        return dict(self._activity) if self._activity is not None else None
 
     def run_until_converged(self, max_steps: int = 64) -> Tuple[int, Optional[StepEvents]]:
         """Run rounds until a view change commits; returns (rounds, events)."""
@@ -1229,9 +1491,15 @@ class VirtualCluster(DispatchSeam):
         if max_steps > 255:  # not an assert: python -O must not skip this
             raise ValueError(f"max_steps packs into 8 bits, got {max_steps}")
         with self._dispatch("run_to_decision"):
-            self.state, steps, decided, winner = run_to_decision(
-                self.cfg, self.state, self.faults, jnp.int32(max_steps)
-            )
+            if self.telem is not None:
+                self.state, self.telem, steps, decided, winner = run_to_decision_telem(
+                    self.cfg, self.state, self.telem, self.faults,
+                    jnp.int32(max_steps),
+                )
+            else:
+                self.state, steps, decided, winner = run_to_decision(
+                    self.cfg, self.state, self.faults, jnp.int32(max_steps)
+                )
             if self.cfg.n < (1 << 22):
                 # Layout: bits 0-7 steps, bit 8 decided, bits 9-30 membership
                 # — one scalar fetch total.
@@ -1278,11 +1546,20 @@ class VirtualCluster(DispatchSeam):
             # Not an assert: python -O must not skip this.
             raise ValueError(f"target must be in [0, {self.cfg.n}]: {target}")
         with self._dispatch("run_until_membership"):
-            self.state, steps, cuts, resolved, sizes = run_until_membership(
-                self.cfg, self.state, self.faults,
-                jnp.int32(target), jnp.int32(max_steps), int(max_cuts),
-                jnp.int32(min_cuts),
-            )
+            if self.telem is not None:
+                self.state, self.telem, steps, cuts, resolved, sizes = (
+                    run_until_membership_telem(
+                        self.cfg, self.state, self.telem, self.faults,
+                        jnp.int32(target), jnp.int32(max_steps), int(max_cuts),
+                        jnp.int32(min_cuts),
+                    )
+                )
+            else:
+                self.state, steps, cuts, resolved, sizes = run_until_membership(
+                    self.cfg, self.state, self.faults,
+                    jnp.int32(target), jnp.int32(max_steps), int(max_cuts),
+                    jnp.int32(min_cuts),
+                )
             obs = np.asarray(
                 jnp.concatenate(
                     [jnp.stack([steps, cuts, resolved.astype(jnp.int32)]), sizes]
@@ -1380,6 +1657,14 @@ class VirtualCluster(DispatchSeam):
                 **(
                     {"recovery": self.recovery.snapshot()}
                     if self.recovery is not None
+                    else {}
+                ),
+                # Device telemetry plane (cfg.telemetry == 1): the HOST
+                # CACHE, zero-minted at attach and refreshed only at sync
+                # boundaries — a scrape never fetches from device.
+                **(
+                    {"activity": dict(self._activity)}
+                    if self._activity is not None
                     else {}
                 ),
             },

@@ -90,6 +90,22 @@ def tally_candidates(
     )
 
 
+def undecided_log2_bucket(rounds_undecided: jnp.ndarray, buckets: int) -> jnp.ndarray:
+    """Log2 histogram bucket of a decision's rounds-undecided count: bucket
+    ``floor(log2(max(r, 1)))`` clamped into ``[0, buckets)``, so bucket 0 is
+    the one-round fast path and the last bucket absorbs every long stall.
+    Elementwise int32 bit-twiddling (popcount-free: a 15-bit counter needs
+    at most 15 halvings), used by the telemetry plane's
+    ``tl_undecided_hist`` scatter — keep it reduction-free so it can never
+    add hot-loop collectives."""
+    r = jnp.maximum(rounds_undecided.astype(jnp.int32), 1)
+    bucket = jnp.zeros((), dtype=jnp.int32)
+    for _ in range(buckets - 1):
+        r = r >> 1
+        bucket = bucket + (r > 0).astype(jnp.int32)
+    return jnp.minimum(bucket, buckets - 1)
+
+
 @jax.jit
 def tally_sorted(
     vote_hi: jnp.ndarray,

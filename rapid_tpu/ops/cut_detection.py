@@ -222,6 +222,43 @@ def cohort_watermark_pass(
     )
 
 
+def telemetry_cut_masks(
+    prev_bits: jnp.ndarray,
+    new_bits: jnp.ndarray,
+    final_bits: jnp.ndarray,
+    subject_mask: jnp.ndarray,
+    h,
+    l,
+):
+    """Telemetry-plane observation of one :func:`cohort_watermark_pass`:
+    ``(active[c, n], invalidated[c, n])`` bool masks, derived purely from
+    the pass's inputs and outputs so the pass itself (including its
+    cond-gated implicit-invalidation branch) stays byte-identical whether
+    or not telemetry observes it.
+
+    ``active``     — slots with nonzero report bits or a watermark tally in
+                     the ``[l, h)`` flux band (the ISSUE's active-subject
+                     definition; the quantity sparse O(activity) rounds
+                     will skip work by).
+    ``invalidated``— slots that gained report bits the merge did NOT
+                     deliver: any bit in ``final_bits`` absent from
+                     ``prev_bits | new_bits`` can only have come from the
+                     implicit edge-invalidation pass
+                     (MultiNodeCutDetector.java:137-164).
+
+    Everything here is elementwise on ``[c, n]`` (plus the existing-grain
+    popcount), so on a ``('cohort', 'nodes')`` mesh it is shard-local —
+    zero collectives by construction."""
+    bdt = final_bits.dtype
+    delivered = (prev_bits.astype(bdt) | new_bits.astype(bdt)) & jnp.where(
+        subject_mask[None, :], ~jnp.zeros((), dtype=bdt), 0
+    )
+    tally = _popcount32(final_bits)
+    active = (final_bits != 0) | ((tally >= l) & (tally < h))
+    invalidated = (final_bits & ~delivered) != 0
+    return active, invalidated
+
+
 def alerts_to_report_matrix(n: int, k: int, dst_idx, ring_numbers) -> jnp.ndarray:
     """Scatter a list of (subject slot, ring) alerts into an [n, k] bool
     matrix. Inputs are index arrays of equal length; negative dst entries are

@@ -53,10 +53,17 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from rapid_tpu.models.state import EngineConfig, EngineState, FaultInputs
+from rapid_tpu.models.state import (
+    EngineConfig,
+    EngineState,
+    FaultInputs,
+    TelemetryLanes,
+)
 from rapid_tpu.models.virtual_cluster import (
     engine_step_impl,
+    engine_step_telem_impl,
     run_until_membership_impl,
+    run_until_membership_telem_impl,
 )
 
 NODE_AXIS = "nodes"
@@ -110,6 +117,16 @@ PARTITION_RULES: Tuple[Tuple[str, Spec], ...] = (
         r"config_epoch|config_hi|config_lo|n_members|rounds_undecided"
         r"|classic_epoch|round_idx",
         (),  # replicated-ok: per-configuration scalar lanes
+    ),
+    # Telemetry plane (models/state.TelemetryLanes): the [c, n] activity and
+    # invalidation masks shard exactly like the watermark state they
+    # observe; the [c] proposal counter rides the cohort axis.
+    (r"tl_active|tl_invalidated", (COHORT_AXIS, NODE_AXIS)),
+    (r"tl_proposals", (COHORT_AXIS,)),
+    (
+        r"tl_rounds|tl_alerts|tl_tally_sum|tl_fast_decisions"
+        r"|tl_classic_decisions|tl_conflict_rounds|tl_undecided_hist",
+        (),  # replicated-ok: per-engine scalar counters + the 8-bucket histogram
     ),
 )
 
@@ -198,6 +215,13 @@ def fault_shardings(mesh: Mesh) -> FaultInputs:
     return _shardings_for(FaultInputs, mesh)
 
 
+def telemetry_shardings(mesh: Mesh) -> TelemetryLanes:
+    """NamedShardings for the telemetry lanes — the SAME rule table (the
+    ``tl_`` rules), so the plane shards wherever the state it observes
+    shards."""
+    return _shardings_for(TelemetryLanes, mesh)
+
+
 def _fleet_shardings_for(cls, mesh: Mesh):
     """The tenant-stacked sharding table: the SAME rule table, with the
     leading ``[t]`` axis of every stacked leaf sharded on ``'tenant'`` and
@@ -224,6 +248,11 @@ def fleet_state_shardings(mesh: Mesh) -> EngineState:
 
 def fleet_fault_shardings(mesh: Mesh) -> FaultInputs:
     return _fleet_shardings_for(FaultInputs, mesh)
+
+
+def fleet_telemetry_shardings(mesh: Mesh) -> TelemetryLanes:
+    """NamedShardings for tenant-STACKED telemetry lanes ([t, ...])."""
+    return _fleet_shardings_for(TelemetryLanes, mesh)
 
 
 def shard_fleet_state(state: EngineState, mesh: Mesh) -> EngineState:
@@ -354,4 +383,46 @@ def make_sharded_wave(cfg: EngineConfig, mesh: Mesh, max_cuts: int = 8):
         in_shardings=(st_sh, ft_sh, None, None, None),
         out_shardings=None,  # XLA propagates; state stays mesh-sharded
         donate_argnums=(0,),
+    )
+
+
+def make_sharded_step_telem(cfg: EngineConfig, mesh: Mesh):
+    """:func:`make_sharded_step` with the telemetry lanes riding along —
+    the audited ``sharded_step_telem`` entrypoint: the plane's lanes shard
+    on the same mesh via :func:`telemetry_shardings`, and the HLO lock
+    pins that turning them on adds zero hot-loop collectives and zero
+    host transfers to the compiled program."""
+    st_sh = state_shardings(mesh)
+    ft_sh = fault_shardings(mesh)
+    tl_sh = telemetry_shardings(mesh)
+
+    return jax.jit(
+        lambda state, telem, faults: engine_step_telem_impl(
+            cfg, state, telem, faults
+        ),
+        in_shardings=(st_sh, tl_sh, ft_sh),
+        out_shardings=None,  # XLA propagates; state/lanes stay mesh-sharded
+        donate_argnums=(0, 1),
+    )
+
+
+def make_sharded_wave_telem(cfg: EngineConfig, mesh: Mesh, max_cuts: int = 8):
+    """:func:`make_sharded_wave` with telemetry lanes in the convergence
+    carry — the audited ``sharded_wave_telem`` entrypoint. Returns
+    ``wave(state, telem, faults, target, max_steps, min_cuts) ->
+    (state, telem, steps, cuts, resolved, sizes)``."""
+    st_sh = state_shardings(mesh)
+    ft_sh = fault_shardings(mesh)
+    tl_sh = telemetry_shardings(mesh)
+
+    return jax.jit(
+        lambda state, telem, faults, target, max_steps, min_cuts: (
+            run_until_membership_telem_impl(
+                cfg, state, telem, faults, target, max_steps, max_cuts,
+                min_cuts,
+            )
+        ),
+        in_shardings=(st_sh, tl_sh, ft_sh, None, None, None),
+        out_shardings=None,  # XLA propagates; state/lanes stay mesh-sharded
+        donate_argnums=(0, 1),
     )

@@ -420,6 +420,11 @@ class StreamDriver:
         while self._pending:
             self._complete_wave("drain")
         epoch_total = self._fetch_epoch_total()
+        # Drain is a stream_fetch boundary, so the device telemetry plane
+        # refreshes here too (the lanes' digest fetch carries its own
+        # telemetry-fetch-ok marker inside _refresh_activity) — never per
+        # submitted wave, which would put a sync on the pipelined path.
+        self.target._refresh_activity()
         cuts = epoch_total - self._epoch0
         wall_ms = (
             (self._clock() - self._t0_stream) * 1000.0

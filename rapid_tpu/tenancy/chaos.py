@@ -205,6 +205,7 @@ def compile_schedule(
     seed: int,
     knobs: Tuple[int, int, int] = DEFAULT_KNOBS,
     delivery_spread: int = 0,
+    telemetry: bool = False,
 ) -> TenantScenario:
     """Compile one schedule onto a per-tenant engine cluster — the same
     event mapping the differential oracle uses (``inject_engine_event``),
@@ -231,7 +232,7 @@ def compile_schedule(
     vc = VirtualCluster.from_endpoints(
         endpoints, n_slots=len(endpoints), n_members=schedule.n0,
         k=WATERMARK_K, h=h, l=l, fd_threshold=fd_threshold,
-        delivery_spread=delivery_spread,
+        delivery_spread=delivery_spread, telemetry=telemetry,
     )
     if schedule.profile == "hier":
         vc.assign_cohorts(_hier_cohort_of(seed, schedule.n_slots))
@@ -279,11 +280,14 @@ def compile_tenant(
     seed: int,
     knobs: Tuple[int, int, int] = DEFAULT_KNOBS,
     delivery_spread: int = 0,
+    telemetry: bool = False,
 ) -> TenantScenario:
     """Compile one named ``(family, seed)`` scenario (sim/fuzz.py) onto a
-    per-tenant engine cluster."""
+    per-tenant engine cluster. ``telemetry=True`` carries the device
+    telemetry plane — engine results are bit-identical either way."""
     return compile_schedule(
-        scenario_family(family, seed), family, seed, knobs, delivery_spread
+        scenario_family(family, seed), family, seed, knobs, delivery_spread,
+        telemetry,
     )
 
 
@@ -308,6 +312,7 @@ def compile_fleet(
     specs: Sequence[Tuple[str, int]],
     knobs: Optional[Sequence[Tuple[int, int, int]]] = None,
     delivery_spread: int = 0,
+    telemetry: bool = False,
 ) -> List[TenantScenario]:
     """One compiled scenario per ``(family, seed)`` spec — honest, hostile,
     and hier families freely mixed. All families share the fuzz geometry
@@ -319,7 +324,8 @@ def compile_fleet(
         raise ValueError(f"need {len(specs)} knob triples, got {len(knobs)}")
     return [
         compile_tenant(
-            family, seed, knobs[i] if knobs else DEFAULT_KNOBS, delivery_spread
+            family, seed, knobs[i] if knobs else DEFAULT_KNOBS,
+            delivery_spread, telemetry,
         )
         for i, (family, seed) in enumerate(specs)
     ]

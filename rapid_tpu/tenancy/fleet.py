@@ -51,13 +51,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from rapid_tpu.models.state import EngineConfig, EngineState, FaultInputs, StepEvents
+from rapid_tpu.models.state import (
+    EngineConfig,
+    EngineState,
+    FaultInputs,
+    StepEvents,
+    TelemetryLanes,
+    initial_telemetry,
+)
 from rapid_tpu.models.virtual_cluster import (
     VirtualCluster,
     _compute_round,
     apply_view_change_impl,
     engine_step_impl,
+    engine_step_telem_impl,
     run_to_decision_impl,
+    run_to_decision_telem_impl,
+    telemetry_digest_impl,
 )
 from rapid_tpu.parallel.mesh import (
     TENANT_AXIS,
@@ -247,6 +257,129 @@ def fleet_wave_impl(
     return jax.vmap(one)(state, faults, knobs, target, min_cuts)
 
 
+# ---------------------------------------------------------------------------
+# Device telemetry plane, fleet grain: the SAME TelemetryLanes pytree with a
+# leading [t] axis, threaded through vmapped twins of the entrypoints above.
+# These are separate entrypoints (never default arguments on the existing
+# ones) so a telemetry=0 fleet keeps compiling byte-identical programs —
+# the hlo.lock.json gate holds the existing fleet3d entries frozen.
+# ---------------------------------------------------------------------------
+
+
+def initial_fleet_telemetry(cfg: EngineConfig, tenants: int) -> TelemetryLanes:
+    """All-zero telemetry lanes for ``tenants`` clusters: the single-cluster
+    lanes with a leading tenant axis, matching the stacked state layout."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.zeros((tenants,) + x.shape, x.dtype),
+        initial_telemetry(cfg),
+    )
+
+
+def fleet_step_telem_impl(
+    cfg: EngineConfig,
+    state: EngineState,
+    telem: TelemetryLanes,
+    faults: FaultInputs,
+    knobs: TenantKnobs,
+) -> Tuple[EngineState, TelemetryLanes, StepEvents]:
+    """:func:`fleet_step_impl` with per-tenant telemetry lanes riding along
+    (``engine_step_telem_impl`` vmapped). Per-tenant counters are
+    bit-identical to B separate telemetry-enabled ``VirtualCluster`` steps —
+    the lanes vmap exactly like the state they observe."""
+
+    def one(state, telem, faults, kn):
+        return engine_step_telem_impl(_tenant_cfg(cfg, kn), state, telem, faults)
+
+    return jax.vmap(one)(state, telem, faults, knobs)
+
+
+def fleet_run_to_decision_telem_impl(
+    cfg: EngineConfig,
+    state: EngineState,
+    telem: TelemetryLanes,
+    faults: FaultInputs,
+    knobs: TenantKnobs,
+    max_steps,
+):
+    """:func:`fleet_run_to_decision_impl` with telemetry: the batched while
+    carries the lanes per tenant (single-device driver entrypoint, same as
+    its untelemetered twin)."""
+
+    def one(state, telem, faults, kn):
+        return run_to_decision_telem_impl(
+            _tenant_cfg(cfg, kn), state, telem, faults, max_steps
+        )
+
+    return jax.vmap(one)(state, telem, faults, knobs)
+
+
+def fleet_wave_telem_impl(
+    cfg: EngineConfig,
+    state: EngineState,
+    telem: TelemetryLanes,
+    faults: FaultInputs,
+    knobs: TenantKnobs,
+    target,
+    max_steps,
+    max_cuts: int,
+    min_cuts,
+):
+    """The lockstep fleet wave with telemetry lanes in the carry. The lanes
+    are select-gated by the SAME ``active`` mask that freezes a finished
+    tenant's state: a tenant that coasts after resolving accumulates no
+    phantom rounds, so its counters stay bit-identical to a per-cluster
+    ``run_until_membership_telem`` drive (pinned with the state parity in
+    tests/test_telemetry_plane.py). No reduction ever touches the lanes
+    here — the digest is the only cross-shard telemetry reduction, and it
+    runs at fetch boundaries, never inside this loop."""
+
+    def one(state, telem, faults, kn, tgt, mc):
+        tcfg = _tenant_cfg(cfg, kn)
+
+        def body(_i, carry):
+            state, telem, steps, cuts, sizes, done = carry
+            active = ~done & (steps < max_steps)
+            round_state, decided, winner, _, round_telem = _compute_round(
+                tcfg, state, faults, None, telem
+            )
+            committed = apply_view_change_impl(tcfg, round_state, winner)
+            commit = active & decided
+            picked = jax.tree_util.tree_map(
+                lambda old, rnd, com: jnp.where(
+                    active, jnp.where(commit, com, rnd), old
+                ),
+                state, round_state, committed,
+            )
+            telem = jax.tree_util.tree_map(
+                lambda old, new: jnp.where(active, new, old),
+                telem, round_telem,
+            )
+            steps = jnp.where(active, steps + 1, steps)
+            sizes = jnp.where(
+                commit, sizes.at[cuts].set(committed.n_members), sizes
+            )
+            cuts = cuts + commit.astype(jnp.int32)
+            resolved = (picked.n_members == tgt) & (cuts >= mc)
+            done = done | (commit & resolved) | (cuts >= max_cuts)
+            return (picked, telem, steps, cuts, sizes, done)
+
+        init = (
+            state,
+            telem,
+            jnp.int32(0),
+            jnp.int32(0),
+            jnp.full((max_cuts,), -1, dtype=jnp.int32),
+            (state.n_members == tgt) & (mc <= jnp.int32(0)),
+        )
+        state, telem, steps, cuts, sizes, _ = jax.lax.fori_loop(
+            0, max_steps, body, init
+        )
+        resolved = (state.n_members == tgt) & (cuts >= mc)
+        return (state, telem, steps, cuts, resolved, sizes)
+
+    return jax.vmap(one)(state, telem, faults, knobs, target, min_cuts)
+
+
 def tenant_health_impl(cfg: EngineConfig, state: EngineState) -> jnp.ndarray:
     """The cheap device-side health reduction: one [t] bool lane, True =
     the tenant's state satisfies the protocol invariants. This is the
@@ -295,6 +428,18 @@ fleet_run_to_decision = jax.jit(
 fleet_wave = jax.jit(
     fleet_wave_impl, static_argnums=(0, 6), donate_argnums=(1,)
 )
+
+fleet_step_telem = jax.jit(
+    fleet_step_telem_impl, static_argnums=(0,), donate_argnums=(1, 2)
+)
+fleet_run_to_decision_telem = jax.jit(
+    fleet_run_to_decision_telem_impl, static_argnums=(0,), donate_argnums=(1, 2)
+)
+fleet_wave_telem = jax.jit(
+    fleet_wave_telem_impl, static_argnums=(0, 7), donate_argnums=(1, 2)
+)
+# donate-ok: read-only boundary fetch — the per-tenant lanes stay live.
+fleet_telemetry_digest = jax.jit(jax.vmap(telemetry_digest_impl))
 
 
 def make_fleet_step(cfg: EngineConfig, mesh: Mesh):
@@ -389,6 +534,17 @@ class TenantFleet(DispatchSeam):
         # tenant -> raw frozen membership captured at quarantine time (the
         # per-tenant freeze-lane inputs; see quarantine()).
         self._quarantined: dict = {}
+        # Device telemetry plane: per-tenant lanes + the host-side activity
+        # cache, zero-minted at attach (every series exists from scrape 0)
+        # and refreshed ONLY at host-sync boundaries.
+        self.telem = (
+            initial_fleet_telemetry(cfg, b) if cfg.telemetry else None
+        )
+        self._activity = (
+            [engine_telemetry.zero_activity_summary(cfg.n, cfg.c)
+             for _ in range(b)]
+            if cfg.telemetry else None
+        )
         engine_telemetry.install()
 
     # -- construction ---------------------------------------------------
@@ -438,6 +594,11 @@ class TenantFleet(DispatchSeam):
         # (the per-cluster builders already charged their own uploads to
         # their own metrics registries, which the fleet does not inherit).
         fleet._account_h2d(*jax.tree_util.tree_leaves(fleet.state))
+        if base.telemetry:
+            # Carry each tenant's accumulated lanes into the stack (a fleet
+            # assembled mid-run keeps its tenants' activity stories).
+            fleet.telem = stack_pytrees([vc.telem for vc in clusters])
+            fleet._account_h2d(*jax.tree_util.tree_leaves(fleet.telem))
         return fleet
 
     @classmethod
@@ -500,9 +661,14 @@ class TenantFleet(DispatchSeam):
         batch path the bit-identity tests pin."""
         self.metrics.inc("engine_tenant_rounds", self.b)
         with self._dispatch(phase):
-            self.state, events = fleet_step(
-                self.cfg, self.state, self.faults, self.knobs
-            )
+            if self.telem is not None:
+                self.state, self.telem, events = fleet_step_telem(
+                    self.cfg, self.state, self.telem, self.faults, self.knobs
+                )
+            else:
+                self.state, events = fleet_step(
+                    self.cfg, self.state, self.faults, self.knobs
+                )
         return events
 
     def stream_crash(self, pairs) -> None:
@@ -532,10 +698,18 @@ class TenantFleet(DispatchSeam):
         returns ``(rounds[t], decided[t], winner[t, n] on device,
         members[t])`` with one packed observation fetch."""
         with self._dispatch("fleet_decision"):
-            self.state, steps, decided, winner = fleet_run_to_decision(
-                self.cfg, self.state, self.faults, self.knobs,
-                jnp.int32(max_steps),
-            )
+            if self.telem is not None:
+                self.state, self.telem, steps, decided, winner = (
+                    fleet_run_to_decision_telem(
+                        self.cfg, self.state, self.telem, self.faults,
+                        self.knobs, jnp.int32(max_steps),
+                    )
+                )
+            else:
+                self.state, steps, decided, winner = fleet_run_to_decision(
+                    self.cfg, self.state, self.faults, self.knobs,
+                    jnp.int32(max_steps),
+                )
             obs = np.asarray(
                 jnp.stack(
                     [steps, decided.astype(jnp.int32), self.state.n_members]
@@ -585,11 +759,21 @@ class TenantFleet(DispatchSeam):
             )
         self._account_h2d(targets, min_cuts)
         with self._dispatch("fleet_wave"):
-            self.state, steps, cuts, resolved, sizes = fleet_wave(
-                self.cfg, self.state, self.faults, self.knobs,
-                jnp.asarray(targets), jnp.int32(max_steps), int(max_cuts),
-                jnp.asarray(min_cuts),
-            )
+            if self.telem is not None:
+                self.state, self.telem, steps, cuts, resolved, sizes = (
+                    fleet_wave_telem(
+                        self.cfg, self.state, self.telem, self.faults,
+                        self.knobs, jnp.asarray(targets),
+                        jnp.int32(max_steps), int(max_cuts),
+                        jnp.asarray(min_cuts),
+                    )
+                )
+            else:
+                self.state, steps, cuts, resolved, sizes = fleet_wave(
+                    self.cfg, self.state, self.faults, self.knobs,
+                    jnp.asarray(targets), jnp.int32(max_steps), int(max_cuts),
+                    jnp.asarray(min_cuts),
+                )
             obs = np.asarray(
                 jnp.concatenate(
                     [steps, cuts, resolved.astype(jnp.int32), sizes.reshape(-1)]
@@ -607,6 +791,43 @@ class TenantFleet(DispatchSeam):
     def sync(self) -> None:
         """Complete all pending uploads/compute on the fleet state."""
         jax.block_until_ready(self.state)
+        self._refresh_activity()
+
+    def _refresh_activity(self) -> None:
+        """Refresh the per-tenant activity cache from the device lanes —
+        called ONLY at host-sync boundaries (sync / health_scan / the
+        stream driver's fetch seam), never on the dispatch hot path."""
+        if self.telem is None:
+            return
+        # telemetry-fetch-ok: host-sync boundary — the caller is already
+        # paying a blocking device round trip here.
+        digest = np.asarray(fleet_telemetry_digest(self.telem))
+        self._account_d2h(digest.nbytes)
+        self._activity = [
+            engine_telemetry.activity_summary(
+                digest[t], self.cfg.n, self.cfg.c
+            )
+            for t in range(self.b)
+        ]
+
+    @property
+    def activity(self) -> Optional[dict]:
+        """The fleet-wide activity aggregate from the last host-sync
+        boundary (counters summed, peaks maxed across tenants), or None on
+        a telemetry=0 fleet — reading it never touches the device."""
+        if self._activity is None:
+            return None
+        return engine_telemetry.aggregate_activity(
+            self._activity, self.cfg.n, self.cfg.c
+        )
+
+    @property
+    def tenant_activity(self) -> Optional[List[dict]]:
+        """Per-tenant activity summaries (copies) from the last host-sync
+        boundary, or None on a telemetry=0 fleet."""
+        if self._activity is None:
+            return None
+        return [dict(a) for a in self._activity]
 
     # -- health & quarantine (the serving supervision tier's seams) ------
 
@@ -619,6 +840,7 @@ class TenantFleet(DispatchSeam):
         with self._dispatch("health_scan"):
             ok = np.asarray(tenant_health(self.cfg, self.state))
         self._account_d2h(ok.nbytes)
+        self._refresh_activity()
         return ~ok
 
     def tenant_health_report(self, t: int) -> List[str]:
@@ -767,6 +989,23 @@ class TenantFleet(DispatchSeam):
                     ) if dispatches else 0.0,
                     "quarantined": len(self._quarantined),
                 },
+                # Device telemetry plane: present only when the fleet was
+                # built with telemetry=1 (the stable-series rule — a
+                # telemetry=0 fleet's scrape vocabulary is unchanged). The
+                # aggregate pools every tenant; the per-tenant list feeds
+                # the exposition's tenant=<idx> labelled variants.
+                **(
+                    {
+                        "activity": engine_telemetry.aggregate_activity(
+                            self._activity, self.cfg.n, self.cfg.c
+                        ),
+                        "tenant_activity": [
+                            dict(a) for a in self._activity
+                        ],
+                    }
+                    if self._activity is not None
+                    else {}
+                ),
                 # Streaming tier: present only when a StreamDriver is
                 # attached (the VirtualCluster rule — batch-only scrapes
                 # keep their series set).

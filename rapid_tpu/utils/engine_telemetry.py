@@ -191,6 +191,98 @@ def device_memory_snapshot() -> Dict[str, Any]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Device telemetry plane: digest decode (models/virtual_cluster.py's
+# telemetry_digest_impl packs the lanes into one int32 vector at host-sync
+# boundaries; this is the host-side vocabulary for unpacking it)
+# ---------------------------------------------------------------------------
+
+#: Scalar layout of the telemetry digest vector, in order; the
+#: TELEMETRY_BUCKETS rounds-undecided histogram buckets follow. Shared by
+#: ``telemetry_digest_impl`` (producer) and :func:`activity_summary`
+#: (consumer) so the two cannot skew silently.
+TELEMETRY_DIGEST_FIELDS = (
+    "rounds",
+    "alerts",
+    "active_sum",
+    "active_peak",
+    "invalidations",
+    "proposals",
+    "tally_sum",
+    "decisions_fast",
+    "decisions_classic",
+    "conflict_rounds",
+)
+
+
+def activity_summary(digest: Any, n: int, c: int) -> Dict[str, Any]:
+    """The ``engine.activity`` snapshot section from one fetched digest
+    vector: the raw counters plus the derived rates clustertop/perfview/
+    bench read — mean/peak active-subject fraction (of the [c, n] detector
+    slots, per round), the fast-path decision share, and the conflict rate
+    (rounds some cohort sat announced-but-undecided, per round). Pure host
+    arithmetic on an already-fetched vector — never fetches."""
+    from rapid_tpu.models.state import TELEMETRY_BUCKETS
+
+    vec = [int(v) for v in digest]
+    expected = len(TELEMETRY_DIGEST_FIELDS) + TELEMETRY_BUCKETS
+    if len(vec) != expected:
+        raise ValueError(
+            f"telemetry digest carries {len(vec)} values, expected {expected}"
+        )
+    out: Dict[str, Any] = dict(zip(TELEMETRY_DIGEST_FIELDS, vec))
+    out["rounds_undecided_hist"] = vec[len(TELEMETRY_DIGEST_FIELDS):]
+    rounds = out["rounds"]
+    slots = n * c
+    decisions = out["decisions_fast"] + out["decisions_classic"]
+    out["active_fraction"] = (
+        out["active_sum"] / (rounds * slots) if rounds else 0.0
+    )
+    out["peak_active_fraction"] = (
+        out["active_peak"] / rounds if rounds else 0.0
+    )
+    out["fast_path_share"] = (
+        out["decisions_fast"] / decisions if decisions else 0.0
+    )
+    out["conflict_rate"] = out["conflict_rounds"] / rounds if rounds else 0.0
+    out["winning_tally_mean"] = (
+        out["tally_sum"] / decisions if decisions else 0.0
+    )
+    return out
+
+
+def zero_activity_summary(n: int, c: int) -> Dict[str, Any]:
+    """The all-zero activity section minted at driver attach: every series
+    the plane will ever export exists from the first scrape (the exposition
+    never mints a series mid-run)."""
+    from rapid_tpu.models.state import TELEMETRY_BUCKETS
+
+    return activity_summary(
+        [0] * (len(TELEMETRY_DIGEST_FIELDS) + TELEMETRY_BUCKETS), n, c
+    )
+
+
+def aggregate_activity(summaries: Any, n: int, c: int) -> Dict[str, Any]:
+    """Fleet-level rollup of per-tenant activity summaries: the counters and
+    the histogram sum across tenants, the peak lanes take the tenant max
+    (a peak summed across independent clusters is not a peak), and the
+    derived rates are recomputed over the pooled totals."""
+    summaries = list(summaries)
+    if not summaries:
+        return zero_activity_summary(n, c)
+    hist = [
+        sum(s["rounds_undecided_hist"][b] for s in summaries)
+        for b in range(len(summaries[0]["rounds_undecided_hist"]))
+    ]
+    vec = [sum(s[f] for s in summaries) for f in TELEMETRY_DIGEST_FIELDS]
+    out = activity_summary(vec + hist, n, c)
+    out["active_peak"] = max(s["active_peak"] for s in summaries)
+    out["peak_active_fraction"] = max(
+        s["peak_active_fraction"] for s in summaries
+    )
+    return out
+
+
 def compiled_memory_analysis(compiled: Any) -> Optional[Dict[str, int]]:
     """The XLA ``memory_analysis()`` of one compiled executable as a plain
     dict (argument/output/temp/generated-code bytes) — the per-config

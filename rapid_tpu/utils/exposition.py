@@ -167,6 +167,32 @@ _ENGINE_MEMORY_GAUGES = (
     "device_peak_bytes",
 )
 
+#: Device-telemetry-plane activity counters (``engine.activity`` — present
+#: exactly when the driver was built with ``telemetry=1``; the section is
+#: zero-minted at attach, so every series below exists from the first
+#: scrape and is never minted mid-run). Rendered as
+#: ``rapid_engine_activity_<name>_total``.
+_ENGINE_ACTIVITY_COUNTERS = (
+    "rounds",
+    "alerts",
+    "active_sum",
+    "invalidations",
+    "proposals",
+    "tally_sum",
+    "conflict_rounds",
+)
+
+#: ``engine.activity`` derived gauges (``rapid_engine_activity_<name>``):
+#: the rates/peaks clustertop and perfview columns read.
+_ENGINE_ACTIVITY_GAUGES = (
+    "active_peak",
+    "active_fraction",
+    "peak_active_fraction",
+    "fast_path_share",
+    "conflict_rate",
+    "winning_tally_mean",
+)
+
 
 def _esc(value: str) -> str:
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
@@ -238,6 +264,32 @@ class _Renderer:
 
     def text(self) -> str:
         return "\n".join(self._lines) + "\n"
+
+
+def _render_activity(
+    out: "_Renderer", activity: Dict[str, Any], node: Optional[str],
+    tenant: Optional[str] = None,
+) -> None:
+    """One ``engine.activity`` section as Prometheus series: the raw
+    counters, the fast/classic decision split
+    (``rapid_engine_decision_path_total{path=...}``), the derived rate
+    gauges, and the rounds-undecided log2 histogram
+    (``{bucket="<log2 floor>"}``). ``tenant`` adds the fleet variants'
+    per-tenant label."""
+    for key in _ENGINE_ACTIVITY_COUNTERS:
+        out.sample(f"{_PREFIX}_engine_activity_{key}_total", "counter",
+                   activity.get(key, 0), node=node, tenant=tenant)
+    for path in ("fast", "classic"):
+        out.sample(f"{_PREFIX}_engine_decision_path_total", "counter",
+                   activity.get(f"decisions_{path}", 0),
+                   node=node, tenant=tenant, path=path)
+    for key in _ENGINE_ACTIVITY_GAUGES:
+        out.sample(f"{_PREFIX}_engine_activity_{key}", "gauge",
+                   activity.get(key, 0), node=node, tenant=tenant)
+    for bucket, count in enumerate(activity.get("rounds_undecided_hist", ())):
+        out.sample(f"{_PREFIX}_engine_activity_rounds_undecided_total",
+                   "counter", count, node=node, tenant=tenant,
+                   bucket=str(bucket))
 
 
 def _phase_labels(phase_key: str) -> Dict[str, str]:
@@ -376,6 +428,17 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
                        node=node)
             out.sample(f"{_PREFIX}_engine_tenants_quarantined", "gauge",
                        tenancy.get("quarantined", 0), node=node)
+        activity = engine.get("activity")
+        if isinstance(activity, dict):
+            # The device telemetry plane (models/state.TelemetryLanes):
+            # present exactly when the driver runs with telemetry=1. The
+            # aggregate renders unlabelled; a fleet's per-tenant list adds
+            # tenant=<idx> variants of the same names.
+            _render_activity(out, activity, node)
+            tenant_activity = engine.get("tenant_activity")
+            if isinstance(tenant_activity, (list, tuple)):
+                for idx, per_tenant in enumerate(tenant_activity):
+                    _render_activity(out, per_tenant, node, tenant=str(idx))
         recovery = engine.get("recovery")
         if isinstance(recovery, dict):
             # The supervision tier (rapid_tpu/serving/supervisor.py):

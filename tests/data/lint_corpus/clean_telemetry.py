@@ -1,0 +1,35 @@
+"""Corpus: disciplined telemetry-lane fetches — every host materialization
+of the lanes sits at a declared boundary and carries the marker. Host
+reads of NON-lane values prove the checker does not overreach."""
+
+import numpy as np
+
+from rapid_tpu.models.virtual_cluster import telemetry_digest
+from rapid_tpu.tenancy.fleet import fleet_telemetry_digest
+
+
+class MiniFleet:
+    def __init__(self, telem, state):
+        self.telem = telem
+        self.state = state
+        self._activity = None
+
+    def sync(self):
+        # telemetry-fetch-ok: sync barrier — the driver is already paying a
+        # blocking device round trip here.
+        digest = np.asarray(telemetry_digest(self.telem))
+        self._activity = digest
+        return digest
+
+    def health_scan(self):
+        # telemetry-fetch-ok: health sweep boundary (already blocking).
+        per_tenant = np.asarray(fleet_telemetry_digest(self.telem))
+        return per_tenant.sum(axis=0)
+
+    def snapshot(self):
+        # Reads of the HOST-side cache are free — no marker needed.
+        cached = self._activity
+        # Materializing non-lane state is the sharding family's business,
+        # not this family's: no lane reference, no finding here.
+        alive = np.asarray(self.state.alive)
+        return cached, alive.sum()

@@ -1,0 +1,44 @@
+"""Lint corpus: telemetry-lane fetches outside declared boundaries.
+
+The device telemetry plane is write-only inside the round bodies and is
+materialized on host ONLY at declared sync seams. Calling a digest jit —
+or spelling the fetch directly via numpy / device_get over the lanes —
+without a ``# telemetry-fetch-ok: <why>`` marker is a blocking round trip
+smuggled onto a hot path.
+"""
+
+import numpy as np
+
+import jax
+
+from rapid_tpu.models.virtual_cluster import telemetry_digest
+from rapid_tpu.tenancy.fleet import fleet_telemetry_digest
+
+
+class MiniFleet:
+    def __init__(self, telem):
+        self.telem = telem
+        self._activity = None
+
+    def dispatch(self, wave):
+        # Refreshing activity per dispatched wave defeats the plane's whole
+        # design — the digest belongs at the drain/sync seam only.
+        digest = np.asarray(telemetry_digest(self.telem))  # expect: telemetry-unmarked-fetch
+        return digest.sum() + wave
+
+    def scan(self):
+        per_tenant = fleet_telemetry_digest(self.telem)  # expect: telemetry-unmarked-fetch
+        return per_tenant
+
+    def peek(self):
+        # The direct spellings block just the same as the digest jits.
+        raw = np.array(self.telem.tl_active)  # expect: telemetry-unmarked-fetch
+        lanes = jax.device_get(self.telem)  # expect: telemetry-unmarked-fetch
+        return raw.sum(), lanes
+
+    def sync(self):
+        # telemetry-fetch-ok: host-sync boundary — the caller is already
+        # paying a blocking device round trip here.
+        digest = np.asarray(telemetry_digest(self.telem))
+        self._activity = digest
+        return digest

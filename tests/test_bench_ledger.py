@@ -199,6 +199,25 @@ def test_cpu_run_emits_complete_ledger(tmp_path):
         e["event"] == "compile_stats" and e.get("stage") == "stream"
         for e in events
     )
+    # ISSUE 16 device-telemetry path, same run: the serving lanes measured
+    # real activity — fractions in (0, 1] with an explicit "measured"
+    # status, the zero-churn soak published as an explicit 0.0 (a
+    # measurement, not an absence — perfview's activity-missing flag
+    # polices exactly this), and the fleet half's pooled + per-tenant
+    # conflict rates from the lanes the lockstep wave carried.
+    assert result["activity_status"] == "measured"
+    assert 0.0 < result["stream_active_fraction"] <= 1.0
+    assert (
+        result["stream_active_fraction"]
+        <= result["stream_peak_active_fraction"]
+        <= 1.0
+    )
+    assert 0.0 <= result["stream_fast_path_share"] <= 1.0
+    assert result["quiescent_active_fraction"] == 0.0
+    assert 0.0 <= result["tenant_conflict_rate"] <= 1.0
+    assert len(result["tenant_conflict_rates"]) == result["fleet_tenants"]
+    assert all(0.0 <= r <= 1.0 for r in result["tenant_conflict_rates"])
+    assert 0.0 <= result["fleet_fast_path_share"] <= 1.0
     # ISSUE 12 adversarial-chaos path, same run: the chaos stage resolved
     # B mixed hostile scenarios (Byzantine false alerts, committee crashes,
     # honest churn) through batched fleet dispatches in its own bracketed,
@@ -401,6 +420,26 @@ def test_recovery_plan_is_never_silently_absent(monkeypatch):
     assert bench.recovery_plan("cpu", 2000.0) == (32, 4, "live")
     monkeypatch.setenv("RAPID_TPU_BENCH_NO_RECOVERY", "1")
     assert bench.recovery_plan("tpu", 0.0) == (0, 0, "suppressed")
+
+
+def test_activity_status_is_never_silently_absent():
+    """ISSUE 16: every branch of the device-telemetry status policy yields
+    an explicit marker — "measured" iff the stream stage fetched a numeric
+    active fraction, the stage's own skip reason otherwise — unit-pinned so
+    the skipped/suppressed paths don't need their own bench subprocess."""
+    assert bench.activity_status(
+        {"stream_active_fraction": 0.0417}, "ramped:6x48"
+    ) == "measured"
+    # 0.0 is a measurement (the quiescent soak), never an absence.
+    assert bench.activity_status(
+        {"stream_active_fraction": 0.0}, "ramped:6x48"
+    ) == "measured"
+    assert bench.activity_status({}, "ramped:12x96") == "ramped:12x96"
+    assert bench.activity_status({}, "skipped-budget") == "skipped-budget"
+    assert bench.activity_status({}, "suppressed") == "suppressed"
+    assert bench.activity_status(
+        {"stream_active_fraction": None}, "suppressed"
+    ) == "suppressed"
 
 
 def test_memory_report_status_is_never_silently_absent():

@@ -538,3 +538,117 @@ def test_engine_counters_zero_filled_only_for_engine_snapshots():
     names = exposition.metric_names(vc.prometheus_text())
     assert "rapid_engine_dispatches_total" in names
     assert "rapid_engine_steps_total" in names
+
+
+# ---------------------------------------------------------------------------
+# Device telemetry plane (rapid_tpu/models/state.TelemetryLanes): the
+# activity section's golden names
+# ---------------------------------------------------------------------------
+
+#: The device-telemetry-plane vocabulary a ``telemetry=1`` scrape adds: the
+#: per-round activity counters, the derived rate/peak gauges, the
+#: fast/classic decision-path split, and the rounds-undecided log2
+#: histogram. Present exactly when the driver carries the lanes; a
+#: telemetry=0 scrape's name set is unchanged (the stable-series rule).
+#: Same API rule as every golden list here: renaming one breaks scrape
+#: configs.
+GOLDEN_ACTIVITY_METRIC_NAMES = [
+    "rapid_engine_activity_active_fraction",
+    "rapid_engine_activity_active_peak",
+    "rapid_engine_activity_active_sum_total",
+    "rapid_engine_activity_alerts_total",
+    "rapid_engine_activity_conflict_rate",
+    "rapid_engine_activity_conflict_rounds_total",
+    "rapid_engine_activity_fast_path_share",
+    "rapid_engine_activity_invalidations_total",
+    "rapid_engine_activity_peak_active_fraction",
+    "rapid_engine_activity_proposals_total",
+    "rapid_engine_activity_rounds_total",
+    "rapid_engine_activity_rounds_undecided_total",
+    "rapid_engine_activity_tally_sum_total",
+    "rapid_engine_activity_winning_tally_mean",
+    "rapid_engine_decision_path_total",
+]
+
+
+def _telemetry_cluster():
+    vc = VirtualCluster.create(
+        16, k=3, h=3, l=1, cohorts=2, fd_threshold=2, seed=0, telemetry=True
+    )
+    vc.assign_cohorts_roundrobin()
+    return vc
+
+
+def test_activity_names_golden_and_zero_filled_from_attach():
+    # The full activity vocabulary exists before any sync boundary (the
+    # host-side cache is zero-minted at attach), every sample at 0 — one
+    # step only mints the shared dispatch histogram, never an activity
+    # value: the scrape reads the cache, not the device lanes.
+    vc = _telemetry_cluster()
+    vc.step()
+    text = vc.prometheus_text()
+    names = exposition.metric_names(text)
+    assert names == sorted(
+        set(GOLDEN_ENGINE_METRIC_NAMES) | set(GOLDEN_ACTIVITY_METRIC_NAMES)
+    )
+    activity_samples = [
+        line for line in text.splitlines()
+        if line.startswith(("rapid_engine_activity", "rapid_engine_decision"))
+    ]
+    assert activity_samples
+    assert all(line.split()[-1] in ("0", "0.0") for line in activity_samples)
+    # And a telemetry=0 scrape is untouched — no activity names, ever
+    # (pinned against the same golden list the pre-telemetry engine used).
+    plain = _cluster()
+    plain.step()
+    assert exposition.metric_names(
+        plain.prometheus_text()
+    ) == GOLDEN_ENGINE_METRIC_NAMES
+
+
+def test_activity_series_measure_after_the_sync_boundary():
+    vc = _telemetry_cluster()
+    vc.crash([3])
+    vc.run_to_decision(max_steps=32)
+    # The scrape reads the HOST cache: still zero until a sync boundary.
+    before = vc.prometheus_text()
+    assert 'rapid_engine_decision_path_total{node="virtual-cluster/16",' \
+        'path="fast"} 0' in before
+    vc.sync()
+    text = vc.prometheus_text()
+    assert 'path="fast"} 1' in text
+    assert 'path="classic"} 0' in text
+    rounds_line = next(
+        line for line in text.splitlines()
+        if line.startswith("rapid_engine_activity_rounds_total")
+    )
+    assert int(rounds_line.split()[-1]) > 0
+
+
+def test_fleet_activity_carries_per_tenant_labels():
+    from rapid_tpu.tenancy import TenantFleet
+
+    fleet = TenantFleet.create(
+        4, 12, n_slots=16, k=3, cohorts=2, knobs=[(3, 1, 2)] * 4,
+        telemetry=True,
+    )
+    fleet.faults = fleet.faults._replace(
+        crashed=fleet.faults.crashed.at[:, 3].set(True)
+    )
+    fleet.run_to_decision(max_steps=32)
+    fleet.sync()
+    text = fleet.prometheus_text()
+    names = exposition.metric_names(text)
+    assert names == sorted(
+        set(GOLDEN_FLEET_METRIC_NAMES) | set(GOLDEN_ACTIVITY_METRIC_NAMES)
+    )
+    # The aggregate renders unlabelled; every tenant gets its own variant.
+    for t in range(4):
+        assert f'tenant="{t}"' in text
+    tenant_fast = [
+        line for line in text.splitlines()
+        if line.startswith("rapid_engine_decision_path_total")
+        and 'path="fast"' in line and "tenant=" in line
+    ]
+    assert len(tenant_fast) == 4
+    assert all(line.split()[-1] == "1" for line in tenant_fast)

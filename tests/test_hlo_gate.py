@@ -107,8 +107,9 @@ def test_registered_entrypoints_audit_clean_against_committed_lock():
         )
     assert set(facts) == {
         "step", "run_to_decision", "run_until_membership", "sync",
-        "step_compact",
-        "sharded_step", "sharded_wave", "sharded2d_wave",
+        "step_compact", "step_telem",
+        "sharded_step", "sharded_step_telem", "sharded_wave",
+        "sharded2d_wave",
         "fleet3d_step", "fleet3d_wave",
     }
     trees = [(None, rel) for rel in device_program.REGISTRY_SOURCES]
@@ -118,11 +119,11 @@ def test_registered_entrypoints_audit_clean_against_committed_lock():
 
 def test_sharded_entrypoints_have_collectives_single_device_do_not():
     facts = staticcheck.collect_facts()
-    for name in ("sharded_step", "sharded_wave", "sharded2d_wave",
-                 "fleet3d_step", "fleet3d_wave"):
+    for name in ("sharded_step", "sharded_step_telem", "sharded_wave",
+                 "sharded2d_wave", "fleet3d_step", "fleet3d_wave"):
         assert facts[name]["collectives"], name
     for name in ("step", "run_to_decision", "run_until_membership", "sync",
-                 "step_compact"):
+                 "step_compact", "step_telem"):
         assert facts[name]["collectives"] == {}, name
     # Both waves' unconditional hot loops stay reduce-class at scalar/[n]
     # payloads; [c,n]-scale traffic is cond-gated — the parallel/audit

@@ -392,6 +392,53 @@ def test_trajectory_renders_mem_column_and_flags_missing(tmp_path, capsys):
     assert "mem-missing" not in lines["BENCH_r60"]  # pre-audit history
 
 
+def test_trajectory_renders_activity_column_and_flags_missing(tmp_path, capsys):
+    """ISSUE 16: the device-telemetry activity fraction renders as the
+    ACTIVITY trajectory column (fast-path share beside it) under the
+    existing trust flags; an AUDITED round that omits both the numeric
+    ``stream_active_fraction`` and its explicit ``activity_status`` marker
+    flags activity-missing; pre-audit historical rounds are exempt."""
+    audit = {"step_telem": {"collectives": 0, "hot_loop_collectives": 0,
+                            "temp_bytes": 10, "donation_dropped": 0}}
+    base = {"n1M_status": "ramped:256", "tenant_fleet_status": "ramped:8x64",
+            "stream_status": "ramped:12x96", "chaos_status": "ramped:12x12",
+            "mem_status": "computed:cpu", "recovery_status": "skipped-budget"}
+    points = {
+        # Pre-audit historical round: exempt (sorts first).
+        "BENCH_r70.json": {"metric": "m", "value": 1.0, "platform": "cpu"},
+        # Audited + measured activity: fraction + fast share in the column.
+        "BENCH_r71.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, **base,
+                           "activity_status": "measured",
+                           "stream_active_fraction": 0.0417,
+                           "stream_fast_path_share": 0.88},
+        # Audited + explicit status marker only (stream stage skipped, so
+        # the lanes never ran): status cell, no flag.
+        "BENCH_r72.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, **base,
+                           "activity_status": "skipped-budget"},
+        # Audited round that silently dropped the activity point: flagged.
+        "BENCH_r73.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, **base},
+    }
+    paths = []
+    for name, data in points.items():
+        p = tmp_path / name
+        p.write_text(json.dumps(data))
+        paths.append(str(p))
+    assert perfview.main(paths) == 0
+    out = capsys.readouterr().out
+    assert "ACTIVITY" in out.splitlines()[1]  # the trajectory header row
+    lines = {line.split()[0]: line for line in out.splitlines()
+             if line.startswith("BENCH_r7")}
+    assert "4.2% fast=88%" in lines["BENCH_r71"]
+    assert "activity-missing" not in lines["BENCH_r71"]
+    assert "skipped-budget" in lines["BENCH_r72"]
+    assert "activity-missing" not in lines["BENCH_r72"]
+    assert "activity-missing" in lines["BENCH_r73"]
+    assert "activity-missing" not in lines["BENCH_r70"]  # pre-audit history
+
+
 def test_chrome_trace_envelope(tmp_path, capsys):
     path = _complete_ledger(tmp_path)
     chrome_path = tmp_path / "trace.json"

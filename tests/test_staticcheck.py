@@ -380,6 +380,8 @@ _CORPUS_CHECKERS = {
     "clean_sharding.py": ("rapid_tpu/parallel/_corpus.py", "check_sharding"),
     "chaos_unknown_kind.py": ("rapid_tpu/sim/_corpus.py", "check_chaosvocab"),
     "clean_chaosvocab.py": ("rapid_tpu/sim/_corpus.py", "check_chaosvocab"),
+    "telemetry_unmarked_fetch.py": ("rapid_tpu/tenancy/_corpus.py", "check_telemetry"),
+    "clean_telemetry.py": ("rapid_tpu/tenancy/_corpus.py", "check_telemetry"),
 }
 
 
@@ -816,7 +818,7 @@ def test_cli_json_select_ignore_and_exit_codes(tmp_path):
 
 
 def test_cli_families_lists_all_families():
-    assert len(staticcheck.FAMILIES) == 14
+    assert len(staticcheck.FAMILIES) == 15
     result = _run_cli("--families")
     assert result.returncode == 0
     for name, _description in staticcheck.FAMILIES:

@@ -20,6 +20,8 @@ fleet, and the ShardingShapeError/pad_to_multiple discipline for a tenant
 count that does not divide the tenant axis.
 """
 
+import random
+
 import numpy as np
 import pytest
 
@@ -27,6 +29,9 @@ import jax
 import jax.numpy as jnp
 
 from rapid_tpu.models.virtual_cluster import VirtualCluster
+from rapid_tpu.protocol.fast_paxos import FastPaxos
+from rapid_tpu.types import Endpoint
+from rapid_tpu.utils.clock import ManualClock
 from rapid_tpu.parallel.mesh import (
     COHORT_AXIS,
     NODE_AXIS,
@@ -75,11 +80,14 @@ def _drive_single(vc, max_steps):
     return cuts, ids, rounds
 
 
-def _injected_tenants():
+def _injected_tenants(telemetry=False):
     """The grid's tenants with EVERY membership phase injected up front
     (maximum overlapped churn; both sides of the parity get the identical
-    injections)."""
-    scenarios = chaos.compile_fleet(GRID_SPECS, knobs=GRID_KNOBS)
+    injections). ``telemetry=True`` carries the device telemetry plane —
+    the drive itself must stay bit-identical either way."""
+    scenarios = chaos.compile_fleet(
+        GRID_SPECS, knobs=GRID_KNOBS, telemetry=telemetry
+    )
     for scenario in scenarios:
         for group in scenario.groups:
             chaos._inject_group(scenario.vc, group)
@@ -323,3 +331,168 @@ def test_mesh_fleet_step_parity_against_single_device():
     np.testing.assert_array_equal(
         np.asarray(state2.alive), np.asarray(single2.state.alive)
     )
+
+
+# ---------------------------------------------------------------------------
+# Decision-path telemetry: the per-tenant fast/classic lane split must speak
+# the host protocol's vocabulary (FastPaxos.decided_path: "classic" iff the
+# classic fallback's Paxos learner decided) and match B independent clusters
+# counter-for-counter on the pinned differential grid.
+# ---------------------------------------------------------------------------
+
+
+def _host_committee_path(n, votes):
+    """Drive a fully connected host FastPaxos committee (the test_paxos.py
+    DirectNetwork shape: FIFO-pumped direct wiring) over the given per-node
+    proposals; if the fast round stalls, one node's fallback fires a classic
+    round. Returns the committee's unanimous ``decided_path`` label — the
+    vocabulary the engine's decision-path lanes must reproduce."""
+
+    def ep(i):
+        return Endpoint("127.0.0.1", 47000 + i)
+
+    instances = {}
+    queue, pumping = [], []
+
+    def pump(destination, request):
+        queue.append((destination, request))
+        if pumping:
+            return
+        pumping.append(True)
+        try:
+            while queue:
+                dst, req = queue.pop(0)
+                targets = (
+                    [instances[dst]] if dst is not None
+                    else list(instances.values())
+                )
+                for inst in targets:
+                    inst.handle_message(req)
+        finally:
+            pumping.clear()
+
+    decisions = {}
+    clock = ManualClock()
+    for i in range(n):
+        addr = ep(i)
+        instances[addr] = FastPaxos(
+            my_addr=addr, configuration_id=1, membership_size=n,
+            broadcast_fn=lambda req: pump(None, req),
+            send_fn=pump,
+            on_decide=lambda hosts, a=addr: decisions.setdefault(
+                a, tuple(hosts)
+            ),
+            clock=clock, rng=random.Random(i),
+        )
+    for i, proposal in enumerate(votes):
+        instances[ep(i)].propose(proposal, recovery_delay_ms=1e9)
+    if not decisions:
+        instances[ep(0)].start_classic_paxos_round()
+    assert len(decisions) == n and len(set(decisions.values())) == 1
+    paths = {inst.decided_path for inst in instances.values()}
+    assert len(paths) == 1
+    return paths.pop()
+
+
+def test_decision_path_lanes_speak_the_host_fast_paxos_vocabulary():
+    """Matched host/engine contention shapes land on the same path label.
+
+    Host side: a unanimous committee decides with ``decided_path == "fast"``;
+    a split committee (no fast quorum) decides through the fallback with
+    ``decided_path == "classic"`` (fast_paxos.py: "classic" iff the inner
+    Paxos decided). Engine side: the same two contention shapes must place
+    their decision in the matching telemetry lane — the round body's
+    ``fb_decided`` is gated on ``~fast_decided`` (fallback_due), so the lanes
+    are mutually exclusive exactly like the host label."""
+
+    def ep(i):
+        return Endpoint("127.0.0.1", 47000 + i)
+
+    # Host labels for the two shapes.
+    unanimous = [(ep(9999),)] * 10
+    assert _host_committee_path(10, unanimous) == "fast"
+    split = [(ep(9999),)] * 7 + [(ep(8888),)] * 3  # quorum(10)=8: stalls
+    assert _host_committee_path(10, split) == "classic"
+
+    # Engine, unanimous shape: one crash every cohort agrees on.
+    vc = VirtualCluster.create(16, fd_threshold=2, seed=3, telemetry=True)
+    vc.crash([5])
+    rounds, events = vc.run_until_converged(max_steps=32)
+    assert events is not None and bool(events.fast_decided)
+    vc.sync()
+    activity = vc.activity
+    assert activity["decisions_fast"] == 1
+    assert activity["decisions_classic"] == 0
+    assert activity["fast_path_share"] == 1.0
+
+    # Engine, split shape (the test_engine.py contested-round scenario with
+    # the telemetry plane on): cohort 1 never hears the second victim's
+    # observers, so its subset proposal denies the fast round its quorum and
+    # the classic fallback decides the plurality cut.
+    n = 120
+    vc = VirtualCluster.create(n, fd_threshold=2, seed=11, telemetry=True)
+    cohort_of = np.zeros(n, dtype=np.int32)
+    cohort_of[80:] = 1
+    vc.assign_cohorts(cohort_of)
+    v1, v2 = 10, 60
+    vc.crash([v1, v2])
+    rx = np.zeros((vc.cfg.c, vc.cfg.n), dtype=bool)
+    rx[1, np.asarray(vc.state.obs_idx)[:, v2]] = True
+    vc.set_rx_block(rx)
+    rounds, events = vc.run_until_converged(max_steps=64)
+    assert events is not None and not bool(events.fast_decided)
+    vc.sync()
+    activity = vc.activity
+    assert activity["decisions_classic"] == 1
+    assert activity["decisions_fast"] == 0
+    assert activity["fast_path_share"] == 0.0
+    # Every announced-but-undecided round before the fallback landed is a
+    # conflict round; the fallback timer alone guarantees several.
+    assert activity["conflict_rounds"] >= vc.cfg.fallback_rounds
+
+
+def test_grid_decision_path_split_fleet_matches_singles():
+    """Per-tenant fast/classic counters on the differential grid: the fleet's
+    ``tenant_activity`` must match (a) the host-vocabulary labels recorded
+    from each single's per-decision ``events.fast_decided`` and (b) the
+    single's own fetched lanes, digest field by digest field."""
+    singles = _injected_tenants(telemetry=True)
+    expected = []
+    for scenario in singles:
+        fast = classic = 0
+        for _ in range(24):
+            events = scenario.vc.step()
+            if bool(events.decided):
+                # The host label ("classic" iff the classic fallback
+                # decided); the engine's paths are mutually exclusive.
+                if bool(events.fast_decided):
+                    fast += 1
+                else:
+                    classic += 1
+        scenario.vc.sync()
+        activity = scenario.vc.activity
+        assert activity["decisions_fast"] == fast, scenario.name
+        assert activity["decisions_classic"] == classic, scenario.name
+        expected.append((fast, classic, activity))
+    assert sum(f + c for f, c, _ in expected), "grid produced no decisions"
+
+    fleet_side = _injected_tenants(telemetry=True)
+    fleet = TenantFleet.from_clusters([s.vc for s in fleet_side])
+    for _ in range(24):
+        fleet.step()
+    fleet.sync()
+    tenant_activity = fleet.tenant_activity
+    digest_fields = tuple(expected[0][2])
+    for t, (fast, classic, single_activity) in enumerate(expected):
+        label = fleet_side[t].name
+        got = tenant_activity[t]
+        assert got["decisions_fast"] == fast, label
+        assert got["decisions_classic"] == classic, label
+        for field in digest_fields:
+            assert got[field] == single_activity[field], (label, field)
+    # The pooled aggregate recomputes the share over the summed split.
+    pooled = fleet.activity
+    total_fast = sum(f for f, _, _ in expected)
+    total = sum(f + c for f, c, _ in expected)
+    assert pooled["decisions_fast"] == total_fast
+    assert pooled["fast_path_share"] == pytest.approx(total_fast / total)

@@ -84,6 +84,12 @@ from .sharding import (
 )
 from .signatures import check_call_signatures
 from .taskflow import TASKFLOW_PREFIXES, check_taskflow
+from .telemetry import (
+    TELEMETRY_LANE_FIELDS,
+    TELEMETRY_PREFIXES,
+    check_lane_mirror,
+    check_telemetry,
+)
 from .trace_safety import TRACE_SAFETY_PREFIXES, check_trace_safety
 from .wire_schema import (
     LOCK_REL,
@@ -108,6 +114,8 @@ __all__ = [
     "SHARDING_PREFIXES",
     "STREAM_PREFIXES",
     "TASKFLOW_PREFIXES",
+    "TELEMETRY_LANE_FIELDS",
+    "TELEMETRY_PREFIXES",
     "TRACE_SAFETY_PREFIXES",
     "WIRE_FILES",
     "check_call_signatures",
@@ -119,10 +127,12 @@ __all__ = [
     "check_device_program",
     "check_dispatch",
     "check_hlo_lock",
+    "check_lane_mirror",
     "check_ledger",
     "check_partition_specs",
     "check_sharding",
     "check_taskflow",
+    "check_telemetry",
     "check_trace_safety",
     "check_undefined_names",
     "check_wire_lock",

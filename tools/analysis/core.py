@@ -91,6 +91,10 @@ ALL_CHECK_NAMES = frozenset({
     "hlo-memory-budget",
     "hlo-unknown-dtype",
     "hlo-lock-drift",
+    "hlo-quiescent-activity",
+    # telemetry family
+    "telemetry-lane-drift",
+    "telemetry-unmarked-fetch",
     # sharding family
     "missing-partition-spec",
     "host-sync-in-hot-path",
@@ -127,6 +131,10 @@ FAMILIES = (
     ("device_program", "compiled-HLO budgets for the registered engine "
                        "entrypoints (collectives, transfers, donation, "
                        "memory) frozen in hlo.lock.json"),
+    ("telemetry", "device telemetry plane discipline: the TelemetryLanes "
+                  "field set mirrored into the analyzer, and every host "
+                  "fetch of the lanes annotated as a declared sync "
+                  "boundary (# telemetry-fetch-ok:)"),
     ("sharding", "engine sharding discipline: partition-spec coverage, "
                  "host syncs in the hot path and the streaming pipeline, "
                  "donation/static-argnames at jit seams, dtype-widening "
@@ -201,7 +209,7 @@ def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
     from . import (
         chaosvocab, clocks, concurrency, deadcode, determinism,
         device_program, dispatch, ledger, names, sharding, signatures,
-        taskflow, trace_safety, wire_schema,
+        taskflow, telemetry, trace_safety, wire_schema,
     )
 
     per_file_checks = [
@@ -214,6 +222,7 @@ def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
         taskflow.check_taskflow,
         determinism.check_determinism,
         ledger.check_ledger,
+        telemetry.check_telemetry,
         sharding.check_sharding,
         chaosvocab.check_chaosvocab,
     ]
@@ -264,6 +273,7 @@ def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
         # files, so retargeted test trees skip them (and never pay the
         # device_program family's session-cached compiles).
         findings.extend(sharding.check_partition_specs(trees))
+        findings.extend(telemetry.check_lane_mirror(trees))
         findings.extend(device_program.check_hlo_lock(trees))
     return findings
 

@@ -106,9 +106,11 @@ def _build_registry() -> "Dict[str, Dict[str, Any]]":
     import jax
     import jax.numpy as jnp
 
+    from rapid_tpu.models.state import initial_telemetry
     from rapid_tpu.models.virtual_cluster import (
         VirtualCluster,
         engine_step_impl,
+        engine_step_telem_impl,
         run_to_decision_impl,
         run_until_membership_impl,
         sync_checksum_impl,
@@ -116,9 +118,12 @@ def _build_registry() -> "Dict[str, Dict[str, Any]]":
     from rapid_tpu.parallel.mesh import (
         make_mesh,
         make_sharded_step,
+        make_sharded_step_telem,
         make_sharded_wave,
         shard_faults,
+        shard_pytree,
         shard_state,
+        telemetry_shardings,
     )
 
     vc = VirtualCluster.create(
@@ -193,6 +198,27 @@ def _build_registry() -> "Dict[str, Dict[str, Any]]":
             "donated_leaves": state_leaves,
         },
     }
+    # The telemetry-plane step (ISSUE 16): identical geometry with
+    # telemetry=1 and the TelemetryLanes pytree donated alongside the
+    # state. Registered so the lock freezes the plane's entire compiled
+    # cost — the lanes' argument bytes, ZERO new hot-loop collectives
+    # (the digest is a separate boundary dispatch, never traced here),
+    # and zero host<->device transfer ops. Only the STEP is registered
+    # (the step_compact convention): the telem wave shares the round
+    # body and every extra while-loop compile costs ~10 s of tier-1;
+    # the wave path is differentially driven against the telemetry=0
+    # oracle in tests/test_telemetry_plane.py.
+    cfg_t = cfg._replace(telemetry=1)
+    telem = initial_telemetry(cfg_t)
+    telem_leaves = len(jax.tree_util.tree_leaves(telem))
+    registry["step_telem"] = {
+        "jit": jax.jit(
+            lambda s, t, f: engine_step_telem_impl(cfg_t, s, t, f),
+            donate_argnums=(0, 1),
+        ),
+        "args": (state, telem, faults),
+        "donated_leaves": state_leaves + telem_leaves,
+    }
     if jax.device_count() >= AUDIT_DEVICES:
         mesh = make_mesh(jax.devices()[:AUDIT_DEVICES])
         sh_state = shard_state(state, mesh)
@@ -209,6 +235,15 @@ def _build_registry() -> "Dict[str, Dict[str, Any]]":
                 jnp.int32(192), jnp.int32(0),
             ),
             "donated_leaves": state_leaves,
+        }
+        # The telemetry step under GSPMD: proves the plane adds zero
+        # collectives on a real mesh too (the [c, n] lanes accumulate
+        # shard-locally), not just on one device.
+        sh_telem = shard_pytree(telem, telemetry_shardings(mesh), mesh=mesh)
+        registry["sharded_step_telem"] = {
+            "jit": make_sharded_step_telem(cfg_t, mesh),
+            "args": (sh_state, sh_telem, sh_faults),
+            "donated_leaves": state_leaves + telem_leaves,
         }
         # The 2-D ('cohort', 'nodes') variant — the 1M+ headline bench
         # configuration: same devices, reshaped so the cohort lanes and the
@@ -397,6 +432,58 @@ def _compile_program(spec: Dict[str, Any]) -> Tuple[Any, List[str]]:
 #: never satisfy the lockfile gate's full-registry requirement.
 _FACTS_CACHE: Optional[Tuple[Dict[str, Any], bool]] = None
 
+#: Rounds of the zero-churn telemetry soak behind the
+#: ``quiescent_round_activity`` lock fact.
+QUIESCENT_SOAK_ROUNDS = 16
+
+_TELEMETRY_FACTS_CACHE: Optional[Dict[str, int]] = None
+
+
+def collect_telemetry_facts(force: bool = False) -> Dict[str, int]:
+    """The telemetry plane's own lock block, measured live:
+
+    - ``lane_bytes_per_device`` — the TelemetryLanes argument bytes at the
+      audit geometry (single-device grain; on a mesh the [c, n] lanes split
+      by the axis sizes like the state they observe);
+    - ``quiescent_round_activity`` — every digest counter EXCEPT ``rounds``
+      summed after a :data:`QUIESCENT_SOAK_ROUNDS`-round zero-churn soak.
+      A healthy plane reads exactly ZERO here: no churn means no alerts, no
+      active subjects, no proposals, no decisions — a nonzero value is a
+      phantom-activity bug and can never be frozen (``update_hlo_lock``
+      refuses it, like a dropped donation).
+    """
+    global _TELEMETRY_FACTS_CACHE
+    if _TELEMETRY_FACTS_CACHE is not None and not force:
+        return _TELEMETRY_FACTS_CACHE
+    import numpy as np
+
+    from rapid_tpu.models.state import telemetry_bytes_total
+    from rapid_tpu.models.virtual_cluster import (
+        VirtualCluster,
+        telemetry_digest,
+    )
+    from rapid_tpu.utils.engine_telemetry import TELEMETRY_DIGEST_FIELDS
+
+    with _scoped_disable_persistent_cache():
+        vc = VirtualCluster.create(
+            AUDIT_N - AUDIT_DEVICES, n_slots=AUDIT_N, k=AUDIT_K, h=3, l=1,
+            fd_threshold=2, cohorts=AUDIT_C, delivery_spread=2, seed=0,
+            telemetry=True,
+        )
+        vc.assign_cohorts_roundrobin()
+        for _ in range(QUIESCENT_SOAK_ROUNDS):
+            vc.step()
+        # telemetry-fetch-ok: audit boundary — a one-off gate measurement,
+        # not an engine hot path.
+        digest = np.asarray(telemetry_digest(vc.telem))
+    rounds = int(digest[list(TELEMETRY_DIGEST_FIELDS).index("rounds")])
+    _TELEMETRY_FACTS_CACHE = {
+        "lane_bytes_per_device": int(telemetry_bytes_total(vc.cfg)),
+        "quiescent_rounds": rounds,
+        "quiescent_round_activity": int(digest.sum()) - rounds,
+    }
+    return _TELEMETRY_FACTS_CACHE
+
 
 class _scoped_disable_persistent_cache:
     """SCOPED: turn jax's persistent compilation cache OFF for the audit
@@ -496,9 +583,13 @@ def collect_facts(
 # -- lock construction + comparison -----------------------------------------
 
 
-def facts_to_lock(facts: Dict[str, Any]) -> Dict[str, Any]:
+def facts_to_lock(
+    facts: Dict[str, Any], telemetry: Optional[Dict[str, int]] = None
+) -> Dict[str, Any]:
     """The canonical freeze: per-entrypoint collectives/transfers/donation/
-    memory, minus the per-row detail (evidence grain, not budget grain)."""
+    memory, minus the per-row detail (evidence grain, not budget grain).
+    ``telemetry`` (from :func:`collect_telemetry_facts`) adds the plane's
+    own block — lane bytes and the zero-churn activity fact."""
     lock: Dict[str, Any] = {
         "audit_config": {
             "n": AUDIT_N, "c": AUDIT_C, "k": AUDIT_K,
@@ -524,7 +615,42 @@ def facts_to_lock(facts: Dict[str, Any]) -> Dict[str, Any]:
             lock["entrypoints"][name]["cross_tenant_collectives"] = entry[
                 "cross_tenant_collectives"
             ]
+    if telemetry is not None:
+        lock["telemetry"] = dict(telemetry)
     return lock
+
+
+def compare_telemetry_facts(
+    current: Dict[str, int], locked: Dict[str, Any], lock_path: str
+) -> List[Finding]:
+    """Drift report for the lock's ``telemetry`` block. A nonzero
+    quiescent activity is its own finding (a phantom-activity bug — never
+    freezable); lane-byte or soak-length drift is ordinary lock drift."""
+    findings: List[Finding] = []
+    if current["quiescent_round_activity"] != 0:
+        findings.append(Finding(
+            lock_path, 1, "hlo-quiescent-activity",
+            f"telemetry plane counted "
+            f"{current['quiescent_round_activity']} unit(s) of activity "
+            f"over a {current['quiescent_rounds']}-round ZERO-churn soak — "
+            f"phantom activity; the quiescent fact is frozen at zero and "
+            f"cannot be locked in",
+        ))
+    for key in ("lane_bytes_per_device", "quiescent_rounds"):
+        if locked.get(key) != current[key]:
+            findings.append(Finding(
+                lock_path, 1, "hlo-lock-drift",
+                f"telemetry block: {key} {locked.get(key)} in the lock, "
+                f"{current[key]} now — {_REGEN_HINT}",
+            ))
+    if locked.get("quiescent_round_activity") != 0:
+        findings.append(Finding(
+            lock_path, 1, "hlo-lock-drift",
+            f"telemetry block: quiescent_round_activity must be frozen at "
+            f"0, the lock carries "
+            f"{locked.get('quiescent_round_activity')!r} — {_REGEN_HINT}",
+        ))
+    return findings
 
 
 def _within_tolerance(locked: int, current: int) -> bool:
@@ -736,7 +862,18 @@ def check_hlo_lock(trees: Sequence[Tuple[ast.AST, str]]) -> List[Finding]:
             f"HLO lock audit_config {locked.get('audit_config')} does not "
             f"match the registry's {audit_cfg} — {_REGEN_HINT}",
         )]
-    return compare_lock(facts, locked, HLO_LOCK_REL)
+    findings = compare_lock(facts, locked, HLO_LOCK_REL)
+    if "telemetry" not in locked:
+        findings.append(Finding(
+            HLO_LOCK_REL, 1, "hlo-lock-drift",
+            f"HLO lock carries no telemetry block (lane bytes + the "
+            f"zero-churn quiescent fact) — {_REGEN_HINT}",
+        ))
+    else:
+        findings.extend(compare_telemetry_facts(
+            collect_telemetry_facts(), locked["telemetry"], HLO_LOCK_REL
+        ))
+    return findings
 
 
 def compaction_differential_ok() -> Optional[str]:
@@ -798,6 +935,16 @@ def update_hlo_lock() -> Tuple[List[Finding], Optional[Path]]:
     mismatch = compaction_differential_ok()
     if mismatch:
         blocking.append(Finding(HLO_LOCK_REL, 1, "hlo-lock-drift", mismatch))
+    telem_facts = collect_telemetry_facts()
+    if telem_facts["quiescent_round_activity"] != 0:
+        # A zero-churn soak with nonzero activity counters is a telemetry
+        # bug, not a fact to freeze.
+        blocking.append(Finding(
+            HLO_LOCK_REL, 1, "hlo-quiescent-activity",
+            f"refusing to freeze quiescent_round_activity="
+            f"{telem_facts['quiescent_round_activity']} — the zero-churn "
+            f"soak must read exactly zero activity",
+        ))
     if blocking:
         return blocking, None
     lock_path = core.REPO / HLO_LOCK_REL
@@ -811,7 +958,7 @@ def update_hlo_lock() -> Tuple[List[Finding], Optional[Path]]:
             "--update-hlo-lock`; do not edit by hand — any drift from the "
             "live compiled artifacts fails the staticcheck gate."
         ),
-        **facts_to_lock(facts),
+        **facts_to_lock(facts, telemetry=telem_facts),
     }
     lock_path.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
     return [], lock_path

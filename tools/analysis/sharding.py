@@ -101,6 +101,7 @@ _PYTREE_TABLES = {
     "EngineState": "state_shardings",
     "FaultInputs": "fault_shardings",
     "TenantKnobs": "knob_shardings",
+    "TelemetryLanes": "telemetry_shardings",
 }
 
 _LAX_LOOP_FNS = frozenset({

@@ -1,0 +1,218 @@
+"""Check family 15: device telemetry plane discipline.
+
+The telemetry plane (rapid_tpu/models/state.py ``TelemetryLanes``) lives
+on device and is fetched ONLY at declared host-sync boundaries — sync,
+the stream driver's drain seam, fleet health scans, and the HLO audit.
+An undeclared fetch is a blocking device round trip smuggled onto a hot
+path, exactly the defect the sharding family's host-sync checks exist
+for; the lanes get their own family because their fetch surface (the
+``telemetry_digest`` jits) is narrower and checkable with zero false
+positives.
+
+Two checks:
+
+- ``telemetry-unmarked-fetch`` (per file): every host materialization of
+  the lanes — a call to ``telemetry_digest`` / ``fleet_telemetry_digest``,
+  or ``np.asarray`` / ``np.array`` / ``jax.device_get`` over an
+  expression that references telemetry lanes — must carry a
+  ``# telemetry-fetch-ok: <why this is a sync boundary>`` marker on the
+  call line or within the three lines above it.
+- ``telemetry-lane-drift`` (full tree): the ``TelemetryLanes`` field set
+  is mirrored here as a literal (wire_schema-style) and pinned against
+  both the NamedTuple's declared fields and the ``TELEMETRY_LANE_SPECS``
+  geometry table — adding a lane without updating every consumer
+  (digest layout, partition rules, exposition vocabulary) fails the
+  gate instead of silently dropping the lane from the digest.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+from . import core
+from .core import Finding
+
+#: Trees the fetch discipline applies to. Tests are exempt — a test
+#: fetching the digest IS the boundary it is probing.
+TELEMETRY_PREFIXES = ("rapid_tpu/", "bench.py", "tools/", "examples/")
+
+#: The literal mirror of ``TelemetryLanes``'s fields, in declaration
+#: order. Must match rapid_tpu/models/state.py exactly — the gate pins
+#: both directions, so this tuple is the analyzer-side half of the same
+#: never-drift contract wire.lock.json plays for the codec mirrors.
+TELEMETRY_LANE_FIELDS = (
+    "tl_rounds",
+    "tl_alerts",
+    "tl_active",
+    "tl_invalidated",
+    "tl_proposals",
+    "tl_tally_sum",
+    "tl_fast_decisions",
+    "tl_classic_decisions",
+    "tl_conflict_rounds",
+    "tl_undecided_hist",
+)
+
+STATE_REL = "rapid_tpu/models/state.py"
+FETCH_MARKER = "telemetry-fetch-ok"
+#: The marker may sit on the call line or this many lines above it (the
+#: prose half of the comment typically wraps onto a second line).
+MARKER_WINDOW = 3
+
+#: The jitted digest entrypoints — calling one IS the device fetch.
+_DIGEST_FETCHERS = frozenset({"telemetry_digest", "fleet_telemetry_digest"})
+#: Host materializers that become a lane fetch when fed lane references.
+_MATERIALIZERS = frozenset({"asarray", "array", "device_get"})
+
+
+def _callee_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _mentions_lanes(node: ast.AST) -> bool:
+    """True if the expression references telemetry lanes: an attribute or
+    name spelled ``telem`` (the lanes pytree by convention) or any
+    ``tl_*`` lane field."""
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Attribute):
+            name = sub.attr
+        elif isinstance(sub, ast.Name):
+            name = sub.id
+        if name is not None and (name == "telem" or name.startswith("tl_")):
+            return True
+    return False
+
+
+def _has_marker(lines: List[str], lineno: int) -> bool:
+    lo = max(0, lineno - 1 - MARKER_WINDOW)
+    return any(FETCH_MARKER in line for line in lines[lo:lineno])
+
+
+def check_telemetry(
+    path: Path,
+    source: Optional[str] = None,
+    tree: "Optional[ast.AST]" = None,
+) -> List[Finding]:
+    rel = core.rel(path)
+    posix = rel.replace("\\", "/")
+    if not any(posix.startswith(p) for p in TELEMETRY_PREFIXES):
+        return []
+    src = source if source is not None else path.read_text()
+    if FETCH_MARKER not in src and "telem" not in src:
+        return []  # cheap bail: nothing lane-shaped in this file
+    if tree is None:
+        tree = ast.parse(src, filename=str(path))
+    lines = src.splitlines()
+    findings: List[Finding] = []
+    flagged: set = set()  # one finding per line — np.asarray(digest(...))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _callee_name(node.func)
+        if name in _DIGEST_FETCHERS:
+            fetch = True
+        elif name in _MATERIALIZERS:
+            fetch = any(_mentions_lanes(arg) for arg in node.args)
+        else:
+            fetch = False
+        if fetch and node.lineno in flagged:
+            continue
+        if fetch and not _has_marker(lines, node.lineno):
+            flagged.add(node.lineno)
+            findings.append(Finding(
+                rel, node.lineno, "telemetry-unmarked-fetch",
+                "telemetry-lane fetch outside a declared boundary — a "
+                "blocking device round trip; move it to a host-sync seam "
+                "(sync / drain / health_scan) and annotate it with "
+                "'# telemetry-fetch-ok: <why>'",
+            ))
+    return findings
+
+
+def _class_fields(tree: ast.AST, name: str) -> Optional[Tuple[List[str], int]]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            fields = [
+                stmt.target.id
+                for stmt in node.body
+                if isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            ]
+            return fields, node.lineno
+    return None
+
+
+def _spec_keys(tree: ast.AST) -> Optional[Tuple[List[str], int]]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            target = node.target
+        else:
+            continue
+        if not (isinstance(target, ast.Name)
+                and target.id == "TELEMETRY_LANE_SPECS"):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return None
+        keys = [
+            k.value for k in node.value.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)
+        ]
+        return keys, node.lineno
+    return None
+
+
+def check_lane_mirror(trees: List[Tuple[ast.AST, str]]) -> List[Finding]:
+    """Full-tree check: pin the analyzer's lane mirror against the live
+    ``TelemetryLanes`` declaration AND the ``TELEMETRY_LANE_SPECS``
+    geometry table. Presence-gated on state.py being in the sweep, so
+    retargeted test trees skip it."""
+    state_tree = next((t for t, rel in trees if rel == STATE_REL), None)
+    if state_tree is None:
+        return []
+    findings: List[Finding] = []
+    mirror = list(TELEMETRY_LANE_FIELDS)
+    got = _class_fields(state_tree, "TelemetryLanes")
+    if got is None:
+        findings.append(Finding(
+            STATE_REL, 1, "telemetry-lane-drift",
+            "TelemetryLanes class not found — the analyzer's lane mirror "
+            "(tools/analysis/telemetry.py TELEMETRY_LANE_FIELDS) has "
+            "nothing to pin against",
+        ))
+        return findings
+    fields, lineno = got
+    if fields != mirror:
+        findings.append(Finding(
+            STATE_REL, lineno, "telemetry-lane-drift",
+            f"TelemetryLanes fields {fields} do not match the analyzer "
+            f"mirror {mirror} — update tools/analysis/telemetry.py AND "
+            f"every lane consumer (digest layout, PARTITION_RULES, "
+            f"exposition vocabulary) together",
+        ))
+    spec = _spec_keys(state_tree)
+    if spec is None:
+        findings.append(Finding(
+            STATE_REL, 1, "telemetry-lane-drift",
+            "TELEMETRY_LANE_SPECS literal dict not found in state.py — "
+            "the lane geometry table must stay a plain literal so the "
+            "gate can read it",
+        ))
+    else:
+        keys, lineno = spec
+        if keys != mirror:
+            findings.append(Finding(
+                STATE_REL, lineno, "telemetry-lane-drift",
+                f"TELEMETRY_LANE_SPECS keys {keys} do not match the "
+                f"analyzer mirror {mirror} — the geometry table and the "
+                f"NamedTuple must list the same lanes in the same order",
+            ))
+    return findings

@@ -149,15 +149,19 @@ def _dispatch_histogram(snapshot: Dict[str, Any]) -> Optional[LogHistogram]:
 def render_engine_pane(snapshots: List[Dict[str, Any]]) -> List[str]:
     """The device-engine rows: one line per snapshot carrying an ``engine``
     section (VirtualCluster scrapes) — compile count, persistent-cache hit
-    rate, dispatch p99, transfer bytes, device memory. Snapshots from
-    pre-ledger code (no ``engine`` key, or partial sections) contribute
-    nothing / dashes, never a crash."""
+    rate, dispatch p99, transfer bytes, device memory, and the device
+    telemetry plane's activity columns (active-subject fraction, mean
+    winning tally, fast-path share, conflict rate). Snapshots from
+    pre-ledger code (no ``engine`` key, or partial sections) and
+    pre-telemetry scrapes (no ``activity`` block, or ``telemetry=0``)
+    contribute nothing / dashes, never a crash."""
     engines = [s for s in snapshots if isinstance(s.get("engine"), dict)]
     if not engines:
         return []
     header = (
         "ENGINE", "TENANTS", "COMPILES", "CACHEHIT", "DISP99", "DISPATCHES",
         "H2D", "D2H", "LIVEBUF", "DEVMEM",
+        "ACTIVE", "TALLY", "FAST%", "CONFLICT",
     )
     rows: List[Tuple[str, ...]] = []
     for snapshot in sorted(engines, key=lambda s: str(s.get("node", ""))):
@@ -166,6 +170,8 @@ def render_engine_pane(snapshots: List[Dict[str, Any]]) -> List[str]:
         memory = engine.get("memory") or {}
         metrics = snapshot.get("metrics") or {}
         tenancy = engine.get("tenancy")
+        activity = engine.get("activity")
+        activity = activity if isinstance(activity, dict) else {}
         hits = compile_stats.get("persistent_cache_hits")
         misses = compile_stats.get("persistent_cache_misses")
         if isinstance(hits, int) and isinstance(misses, int) and hits + misses:
@@ -186,6 +192,10 @@ def render_engine_pane(snapshots: List[Dict[str, Any]]) -> List[str]:
             _fmt_bytes(metrics.get("engine_d2h_bytes")),
             _fmt_bytes(memory.get("live_buffer_bytes")),
             _fmt_bytes(memory.get("device_bytes_in_use")),
+            _fmt_ratio(activity.get("active_fraction")),
+            _fmt_opt(activity.get("winning_tally_mean"), ".1f"),
+            _fmt_ratio(activity.get("fast_path_share")),
+            _fmt_ratio(activity.get("conflict_rate")),
         ))
     return ["", *_render_table(header, rows)]
 

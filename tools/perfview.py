@@ -33,10 +33,14 @@ runs, and can I trust the numbers". Two input kinds, freely mixed:
   round omitting BOTH ``bytes_per_member`` and ``mem_status``), and
   ``recovery-missing`` (same discipline for the self-healing drill: an
   audited round omitting BOTH ``recovery_mttr_ms`` and
-  ``recovery_status``). The N1M, FLEET, STREAM, CHAOS, MEM, and RECOVERY
-  columns render the headline / fleet / sustained-stream /
-  chaos-throughput / bytes-per-member / resume-MTTR values (or their
-  status markers) per round.
+  ``recovery_status``), and ``activity-missing`` (same discipline for the
+  device telemetry plane: an audited round omitting BOTH
+  ``stream_active_fraction`` and ``activity_status`` — a zero-churn soak
+  must publish ``activity=0`` explicitly, never silence). The N1M, FLEET,
+  STREAM, CHAOS, MEM, RECOVERY, and ACTIVITY columns render the headline /
+  fleet / sustained-stream / chaos-throughput / bytes-per-member /
+  resume-MTTR / active-fraction values (or their status markers) per
+  round.
 
 ``--chrome out.json`` additionally writes Chrome trace-event JSON (the same
 envelope tools/traceview.py emits — Perfetto/chrome://tracing load it):
@@ -365,6 +369,17 @@ def point_flags(
         and not data.get("recovery_status")
     ):
         flags.append("recovery-missing")
+    # Activity discipline (ISSUE 16): same rule for the device telemetry
+    # plane — an audited round must carry stream_active_fraction or its
+    # explicit activity_status marker. A quiet cluster reads activity=0,
+    # so absence is always instrumentation loss, never "nothing happened".
+    # Pre-audit historical rounds are exempt.
+    if (
+        hlo_audit_table(data) is not None
+        and not isinstance(data.get("stream_active_fraction"), (int, float))
+        and not data.get("activity_status")
+    ):
+        flags.append("activity-missing")
     if hlo_drift(prev, hlo_audit_table(data)):
         flags.append("hlo-drift")
     if not flags:
@@ -471,10 +486,27 @@ def chaos_cell(data: Dict[str, Any]) -> str:
     return str(status) if status else "-"
 
 
+def activity_cell(data: Dict[str, Any]) -> str:
+    """The ACTIVITY column: the stream soak's mean active-subject fraction
+    (with the fast-path share beside it when present), else the explicit
+    activity_status marker, else '-' (pre-telemetry rounds). A zero-churn
+    soak renders '0.0%', not a dash — zero is a measurement."""
+    value = data.get("stream_active_fraction")
+    if isinstance(value, (int, float)):
+        share = data.get("stream_fast_path_share")
+        suffix = (
+            f" fast={100.0 * float(share):.0f}%"
+            if isinstance(share, (int, float)) else ""
+        )
+        return f"{100.0 * float(value):.1f}%{suffix}"
+    status = data.get("activity_status")
+    return str(status) if status else "-"
+
+
 def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
     lines = ["== perf trajectory =="]
     header = ("ROUND", "METRIC", "VALUE", "N1M", "FLEET", "STREAM", "CHAOS",
-              "MEM", "RECOVERY", "PLATFORM", "VSBASE", "FLAGS")
+              "MEM", "RECOVERY", "ACTIVITY", "PLATFORM", "VSBASE", "FLAGS")
     rows: List[Tuple[str, ...]] = []
     flag_rows: List[Tuple[str, List[str]]] = []
     prev_audit: Optional[Dict[str, Any]] = None
@@ -495,6 +527,7 @@ def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
             chaos_cell(data),
             mem_cell(data),
             recovery_cell(data),
+            activity_cell(data),
             str(data.get("platform", "-")),
             "-" if vs is None else f"{float(vs):.2f}x"
             + ("@capture" if "vs_baseline_at_capture" in data else ""),

@@ -40,6 +40,8 @@ from analysis import (  # noqa: E402,F401 — re-exported API surface
     SHARDING_PREFIXES,
     STREAM_PREFIXES,
     TASKFLOW_PREFIXES,
+    TELEMETRY_LANE_FIELDS,
+    TELEMETRY_PREFIXES,
     TRACE_SAFETY_PREFIXES,
     WIRE_FILES,
     check_call_signatures,
@@ -51,10 +53,12 @@ from analysis import (  # noqa: E402,F401 — re-exported API surface
     check_device_program,
     check_dispatch,
     check_hlo_lock,
+    check_lane_mirror,
     check_ledger,
     check_partition_specs,
     check_sharding,
     check_taskflow,
+    check_telemetry,
     check_trace_safety,
     check_undefined_names,
     check_wire_lock,
@@ -87,6 +91,8 @@ __all__ = [
     "SHARDING_PREFIXES",
     "STREAM_PREFIXES",
     "TASKFLOW_PREFIXES",
+    "TELEMETRY_LANE_FIELDS",
+    "TELEMETRY_PREFIXES",
     "TRACE_SAFETY_PREFIXES",
     "WIRE_FILES",
     "check_call_signatures",
@@ -98,10 +104,12 @@ __all__ = [
     "check_device_program",
     "check_dispatch",
     "check_hlo_lock",
+    "check_lane_mirror",
     "check_ledger",
     "check_partition_specs",
     "check_sharding",
     "check_taskflow",
+    "check_telemetry",
     "check_trace_safety",
     "check_undefined_names",
     "check_wire_lock",
