@@ -532,6 +532,22 @@ def activity_status(stream_fields: dict, stream_status: str) -> str:
     return stream_status
 
 
+def trace_status(stream_fields: dict, stream_status: str) -> str:
+    """Round-trace ring (ISSUE 17): the never-silently-absent status for
+    the ring-derived trajectory digest — "measured" when the stream stage
+    drained a numeric rounds-to-decision p99 out of the decoded rings,
+    otherwise the stage's own skip reason (ramped:WxN / skipped-budget /
+    suppressed), so perfview's trace-missing flag only ever fires on
+    instrumentation LOSS (an audited round that dropped both the digest
+    and the status)."""
+    trajectory = stream_fields.get("round_trajectory") or {}
+    if isinstance(
+        trajectory.get("rounds_to_decision_p99"), (int, float)
+    ):
+        return "measured"
+    return stream_status
+
+
 def _parse_scale(spec: str) -> int:
     """'10M' -> 10_000_000, '250k' -> 250_000, bare ints pass through; 0 on
     anything unparseable (the stretch point is opt-in — a typo'd env value
@@ -1035,6 +1051,13 @@ def run_workload(ledger, profile_dir=None) -> None:
 
         stream_b = 4  # fleet-path tenants: enough to exercise the stacked pipe
         rounds_per_wave = _env_int("RAPID_TPU_BENCH_STREAM_ROUNDS", 8)
+        # Round-trace ring capacity (ISSUE 17): sized to the whole stage by
+        # default so every wave's span survives to the drain decode (the
+        # trajectory quantiles cover all waves, waves_evicted == 0); a
+        # smaller override exercises the eviction accounting instead.
+        stream_trace_r = _env_int(
+            "RAPID_TPU_BENCH_TRACE_R", stream_waves * rounds_per_wave
+        )
         # Fresh-slot headroom for the join half of the churn: the generator
         # never reuses a slot (the engine's UUID discipline), so the slot
         # table must hold every joiner the whole stream can admit.
@@ -1045,10 +1068,15 @@ def run_workload(ledger, profile_dir=None) -> None:
             # plane's activity numbers come from (ISSUE 16) — the lanes ride
             # the same donated dispatches and the digest is fetched only at
             # the drain boundary, so the measured overlap is unchanged.
+            # trace=R: the ring rides the same donated dispatches and is
+            # decoded from the drain-boundary digest fetch — the measured
+            # overlap is unchanged (trace-on/off bit-identity is pinned in
+            # tests/test_trace_ring.py).
             vcs = VirtualCluster.create(
                 stream_n, n_slots=stream_slots, k=k_rings, h=9, l=4,
                 cohorts=min(8, stream_n), fd_threshold=fd_threshold,
                 seed=seed, delivery_spread=delivery_spread, telemetry=True,
+                trace=stream_trace_r,
             )
             vcs.assign_cohorts_roundrobin()
             return vcs
@@ -1060,7 +1088,7 @@ def run_workload(ledger, profile_dir=None) -> None:
                     stream_n, k=k_rings, h=9, l=4,
                     cohorts=min(8, stream_n), fd_threshold=fd_threshold,
                     seed=seed0 + i, delivery_spread=delivery_spread,
-                    telemetry=True,
+                    telemetry=True, trace=stream_trace_r,
                 )
                 vcs.assign_cohorts_roundrobin()
                 clusters.append(vcs)
@@ -1213,6 +1241,36 @@ def run_workload(ledger, profile_dir=None) -> None:
                         decisions_fast / decisions_total, 4,
                     ) if decisions_total else 0.0,
                 })
+            # Round-trace ring digest (ISSUE 17): per-wave rounds-to-
+            # decision quantiles and the active-trajectory p99, decoded
+            # from BOTH serving paths' rings at their drain boundaries
+            # (StreamDriver.last_trajectory — pure host arithmetic over
+            # the one drain-time digest fetch). The headline numbers take
+            # the WORST path (a serving p99 is the slowest story told).
+            trajectories = {
+                "cluster": stream_driver.last_trajectory,
+                "fleet": fleet_stream_driver.last_trajectory,
+            }
+            drained = [t for t in trajectories.values() if t]
+
+            def _worst(key):
+                vals = [
+                    t[key] for t in drained
+                    if isinstance(t.get(key), (int, float))
+                ]
+                return max(vals) if vals else None
+
+            stream_fields["round_trajectory"] = {
+                "trace_capacity": stream_trace_r,
+                "rounds_to_decision_p50": _worst("rounds_to_decision_p50"),
+                "rounds_to_decision_p99": _worst("rounds_to_decision_p99"),
+                "rounds_to_decision_max": _worst("rounds_to_decision_max"),
+                "active_p99": _worst("active_p99"),
+                "waves_evicted": sum(
+                    t.get("waves_evicted") or 0 for t in drained
+                ),
+                **trajectories,
+            }
             # Zero-churn stability soak: a quiet engine must READ zero —
             # published explicitly (0.0 is a measurement, not an absence;
             # perfview's activity-missing flag polices exactly this).
@@ -1570,6 +1628,9 @@ def run_workload(ledger, profile_dir=None) -> None:
         # Device telemetry plane status (ISSUE 16): never silently absent —
         # see activity_status for the policy.
         "activity_status": activity_status(stream_fields, stream_status),
+        # Round-trace ring status (ISSUE 17): never silently absent — see
+        # trace_status for the policy.
+        "trace_status": trace_status(stream_fields, stream_status),
         **({"stream_device_memory": stream_memory} if stream_memory is not None else {}),
         # Adversarial-chaos point (ISSUE 12): hostile scenarios resolved
         # (and oracle-checked clean) per second of batched fleet dispatch.

@@ -123,6 +123,17 @@ class EngineConfig(NamedTuple):
     # are write-only inside a round (nothing reads them back into protocol
     # state), pinned bit-identical on-vs-off by tests/test_telemetry_plane.py.
     telemetry: int = 0
+    # Device round-trace ring capacity R (an int knob holding the SIZE, not
+    # a boolean): 0 = off — the round bodies trace NO ring code and compile
+    # byte-identical programs (frozen by the hlo.lock.json gate, like
+    # ``telemetry``); R > 0 = a :class:`TraceRing` of the last R per-round
+    # records rides beside the state through the jitted round bodies. The
+    # ring is a REFINEMENT of the telemetry plane (its active-subject count
+    # reuses the telemetry block's cut-mask reduction), so trace > 0
+    # requires telemetry == 1 — drivers enforce this at construction. Like
+    # every EngineConfig field this appends at the END: checkpoints persist
+    # the config positionally as an int64 vector.
+    trace: int = 0
 
 
 class CompactionPolicy(NamedTuple):
@@ -550,6 +561,92 @@ def telemetry_bytes_total(cfg: EngineConfig) -> int:
     dims = {"n": cfg.n, "k": cfg.k, "c": cfg.c, "b": TELEMETRY_BUCKETS}
     total = 0
     for shape in TELEMETRY_LANE_SPECS.values():
+        elems = 1
+        for sym in shape:
+            elems *= dims[sym]
+        total += elems * 4
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Device round-trace ring (EngineConfig.trace == R > 0)
+# ---------------------------------------------------------------------------
+
+#: field -> shape symbols over (r,) with ``r`` = ``EngineConfig.trace`` (the
+#: ring capacity R) — the LANE_SPECS convention, mirrored by the ``telemetry``
+#: analyzer family (tools/analysis/telemetry.py) exactly like
+#: :data:`TELEMETRY_LANE_SPECS`, so a new ring lane cannot skip the partition
+#: rules, the decode vocabulary, or the exposition surface. Every lane is
+#: int32 (records, not protocol state; compaction never narrows them).
+TRACE_LANE_SPECS: Dict[str, Tuple[str, ...]] = {
+    "tr_round": ("r",),
+    "tr_epoch": ("r",),
+    "tr_active": ("r",),
+    "tr_alerts": ("r",),
+    "tr_proposals": ("r",),
+    "tr_tally": ("r",),
+    "tr_path": ("r",),
+    "tr_conflict": ("r",),
+    "tr_undecided": ("r",),
+    "tr_cursor": (),
+    "tr_wraps": (),
+}
+
+
+class TraceRing(NamedTuple):
+    """A bounded device-resident flight recorder of per-round records: the
+    last ``EngineConfig.trace`` rounds, one slot per round, written inside
+    the jitted round body and fetched ONLY at the existing host-sync
+    boundaries (the telemetry plane's discipline — the ring is its
+    round-resolution refinement, so ``trace > 0`` requires ``telemetry``).
+
+    Cursor semantics (the wraparound contract the property tests pin):
+
+    - ``tr_cursor`` counts records EVER written (monotone); the slot a
+      round lands in is ``tr_cursor % R``, so the ring always holds the
+      last ``min(R, tr_cursor)`` rounds.
+    - ``tr_wraps`` increments each time the write fills slot ``R - 1`` —
+      it reconciles with the cursor as ``tr_wraps == tr_cursor // R``, and
+      with the telemetry plane as ``tr_cursor == tl_rounds``.
+    - Decode order: rotate from ``tr_cursor % R`` when wrapped; the
+      ``(tr_epoch, tr_round)`` pairs of the decoded records are strictly
+      lexicographically increasing (``round_idx`` resets at each view
+      change, ``config_epoch`` only grows) — monotone across a wrap.
+
+    Under the tenancy vmap every lane grows a leading ``[t]`` axis; frozen
+    or quarantined tenants coast with a GATED cursor (the wave's tree-level
+    ``where`` holds cursor and slots alike), so a coasting tenant's ring
+    never records phantom rounds."""
+
+    tr_round: jnp.ndarray  # [R] int32 — round stamp (round_idx within the epoch)
+    tr_epoch: jnp.ndarray  # [R] int32 — config_epoch the round executed in
+    tr_active: jnp.ndarray  # [R] int32 — active (cohort, subject) slots this round
+    tr_alerts: jnp.ndarray  # [R] int32 — edge alerts applied this round
+    tr_proposals: jnp.ndarray  # [R] int32 — proposals released this round
+    tr_tally: jnp.ndarray  # [R] int32 — winning-tally size (0 unless decided)
+    tr_path: jnp.ndarray  # [R] int32 — decision path: 0 none, 1 fast, 2 classic
+    tr_conflict: jnp.ndarray  # [R] int32 — announced-but-no-fast-decision flag
+    tr_undecided: jnp.ndarray  # [R] int32 — rounds_undecided entering the round
+    tr_cursor: jnp.ndarray  # [] int32 — records ever written (slot = cursor % R)
+    tr_wraps: jnp.ndarray  # [] int32 — times the write filled slot R - 1
+
+
+def initial_trace(cfg: EngineConfig) -> TraceRing:
+    """All-zero trace ring for this config's capacity."""
+    dims = {"r": cfg.trace}
+    return TraceRing(**{
+        field: jnp.zeros(tuple(dims[s] for s in shape), dtype=jnp.int32)
+        for field, shape in TRACE_LANE_SPECS.items()
+    })
+
+
+def trace_bytes_total(cfg: EngineConfig) -> int:
+    """At-rest bytes of one cluster's trace ring (all int32) — the frozen
+    per-device figure the hlo.lock.json ``trace`` block carries: R rounds of
+    history at a byte cost fixed by config, not by event rate."""
+    dims = {"r": cfg.trace}
+    total = 0
+    for shape in TRACE_LANE_SPECS.values():
         elems = 1
         for sym in shape:
             elems *= dims[sym]

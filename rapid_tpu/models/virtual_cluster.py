@@ -30,9 +30,11 @@ from rapid_tpu.models.state import (
     FaultInputs,
     StepEvents,
     TelemetryLanes,
+    TraceRing,
     compaction_policy,
     initial_state,
     initial_telemetry,
+    initial_trace,
 )
 from rapid_tpu.ops.consensus import tally_candidates, undecided_log2_bucket
 from rapid_tpu.ops.cut_detection import cohort_watermark_pass, telemetry_cut_masks
@@ -233,6 +235,7 @@ def _cohort_cut_detection(cfg: EngineConfig, state: EngineState, new_bits, heard
 def _compute_round(
     cfg: EngineConfig, state: EngineState, faults: FaultInputs, edge_masks=None,
     telem: Optional[TelemetryLanes] = None,
+    trace: Optional[TraceRing] = None,
 ):
     """One protocol round WITHOUT view-change application: returns the
     round-advanced state plus (decided, winner_mask, events). Keeping the
@@ -251,7 +254,17 @@ def _compute_round(
     either an already-computed round scalar or elementwise at the lane's
     native [c, n]/[c] grain: zero new collectives in the round body (the
     cross-shard reductions live in ``telemetry_digest_impl``, dispatched
-    only at host-sync boundaries)."""
+    only at host-sync boundaries).
+
+    ``trace`` (the device round-trace ring, ``cfg.trace == R > 0``): when a
+    :class:`TraceRing` is passed the round also writes ONE per-round record
+    into slot ``tr_cursor % R`` and the return grows a sixth element — the
+    updated ring. Same discipline as the telemetry plane (a Python-level
+    ``if``, write-only lanes, zero new collectives: every record field is a
+    scalar the round already computed), and the ring's active-subject count
+    reuses the telemetry block's cut-mask reduction — which is why
+    ``trace`` requires ``telem`` (trace is a refinement of the telemetry
+    plane, enforced at driver construction)."""
     n, k, c = cfg.n, cfg.k, cfg.c
 
     # 1. Failure-detector tick -> fresh DOWN alerts per (subject, ring) edge.
@@ -578,7 +591,41 @@ def _compute_round(
         + (jnp.any(announced) & ~fast_decided).astype(jnp.int32),
         tl_undecided_hist=telem.tl_undecided_hist.at[bucket].add(decided_i),
     )
-    return round_state, decided, winner_mask, events, telem
+    if trace is None:
+        return round_state, decided, winner_mask, events, telem
+
+    # Device round-trace ring (write-only; one record per round into slot
+    # cursor % R). Every field is a scalar computed above — the ring adds
+    # nine scatter-stores and two int adds, nothing else. The round/epoch
+    # stamps are the PRE-round values (round_idx increments in round_state;
+    # the epoch bumps only when the caller commits the view change), so the
+    # decoded (epoch, round) pairs are lexicographically strictly increasing
+    # — the wrap-monotonicity contract tests/test_trace_ring.py pins.
+    slot = jax.lax.rem(trace.tr_cursor, jnp.int32(cfg.trace))
+    trace = TraceRing(
+        tr_round=trace.tr_round.at[slot].set(state.round_idx),
+        tr_epoch=trace.tr_epoch.at[slot].set(state.config_epoch),
+        tr_active=trace.tr_active.at[slot].set(
+            jnp.sum(active_cn, dtype=jnp.int32)
+        ),
+        tr_alerts=trace.tr_alerts.at[slot].set(alerts_emitted),
+        tr_proposals=trace.tr_proposals.at[slot].set(
+            jnp.sum(proposed_now, dtype=jnp.int32)
+        ),
+        tr_tally=trace.tr_tally.at[slot].set(jnp.where(decided, tally.max_count, 0)),
+        tr_path=trace.tr_path.at[slot].set(
+            fast_decided.astype(jnp.int32) + 2 * fb_decided.astype(jnp.int32)
+        ),
+        tr_conflict=trace.tr_conflict.at[slot].set(
+            (jnp.any(announced) & ~fast_decided).astype(jnp.int32)
+        ),
+        tr_undecided=trace.tr_undecided.at[slot].set(
+            rounds_undecided.astype(jnp.int32)
+        ),
+        tr_cursor=trace.tr_cursor + 1,
+        tr_wraps=trace.tr_wraps + (slot == cfg.trace - 1).astype(jnp.int32),
+    )
+    return round_state, decided, winner_mask, events, telem, trace
 
 
 def _rotation_seed(epoch_u32, j: int):
@@ -715,6 +762,34 @@ engine_step_telem = jax.jit(
 )
 
 
+def engine_step_trace_impl(
+    cfg: EngineConfig,
+    state: EngineState,
+    telem: TelemetryLanes,
+    trace: TraceRing,
+    faults: FaultInputs,
+) -> Tuple[EngineState, TelemetryLanes, TraceRing, StepEvents]:
+    """:func:`engine_step_telem_impl` with the round-trace ring riding along
+    — a SEPARATE entrypoint again (the ``telemetry`` convention), so the
+    trace=0 programs and their donation layout stay untouched and the
+    hlo.lock.json diff stays purely additive."""
+    round_state, decided, winner_mask, events, telem, trace = _compute_round(
+        cfg, state, faults, None, telem, trace
+    )
+    new_state = jax.lax.cond(
+        decided,
+        lambda s: apply_view_change_impl(cfg, s, winner_mask),
+        lambda s: s,
+        round_state,
+    )
+    return new_state, telem, trace, events
+
+
+engine_step_trace = jax.jit(
+    engine_step_trace_impl, static_argnums=(0,), donate_argnums=(1, 2, 3)
+)
+
+
 def telemetry_digest_impl(telem: TelemetryLanes) -> jnp.ndarray:
     """The telemetry lanes reduced to one small int32 vector — THE place the
     plane's cross-shard reductions live, dispatched only at the existing
@@ -741,6 +816,30 @@ def telemetry_digest_impl(telem: TelemetryLanes) -> jnp.ndarray:
 
 
 telemetry_digest = jax.jit(telemetry_digest_impl)  # donate-ok: read-only boundary fetch; the lanes stay live
+
+
+def trace_digest_impl(trace: TraceRing) -> jnp.ndarray:
+    """The trace ring packed into one int32 vector for a single boundary
+    fetch: ``[tr_cursor, tr_wraps]`` then the nine ``[R]`` lanes in
+    ``engine_telemetry.TRACE_RECORD_FIELDS`` order. Dispatched only at the
+    host-sync boundaries, under the same ``# telemetry-fetch-ok:`` marker
+    discipline as :func:`telemetry_digest_impl` — never inside a
+    convergence loop."""
+    return jnp.concatenate([
+        jnp.stack([trace.tr_cursor, trace.tr_wraps]),
+        trace.tr_round,
+        trace.tr_epoch,
+        trace.tr_active,
+        trace.tr_alerts,
+        trace.tr_proposals,
+        trace.tr_tally,
+        trace.tr_path,
+        trace.tr_conflict,
+        trace.tr_undecided,
+    ])
+
+
+trace_digest = jax.jit(trace_digest_impl)  # donate-ok: read-only boundary fetch; the ring stays live
 
 
 def sync_checksum_impl(state: EngineState, faults: FaultInputs):
@@ -847,6 +946,54 @@ def run_to_decision_telem_impl(
 
 run_to_decision_telem = jax.jit(
     run_to_decision_telem_impl, static_argnums=(0,), donate_argnums=(1, 2)
+)
+
+
+def run_to_decision_trace_impl(
+    cfg: EngineConfig,
+    state: EngineState,
+    telem: TelemetryLanes,
+    trace: TraceRing,
+    faults: FaultInputs,
+    max_steps,
+):
+    """:func:`run_to_decision_telem_impl` with the trace ring joining the
+    while-loop carry — the fused convergence stops being a black box: every
+    round of the loop leaves one record, and the ring's last R survive to
+    the boundary fetch."""
+    n = cfg.n
+
+    def cond(carry):
+        _, _, _, steps, decided, _ = carry
+        return (~decided) & (steps < max_steps)
+
+    edge_masks = _edge_masks(cfg, state, faults)
+
+    def body(carry):
+        state, telem, trace, steps, _, _ = carry
+        round_state, decided, winner_mask, _, telem, trace = _compute_round(
+            cfg, state, faults, edge_masks, telem, trace
+        )
+        return (round_state, telem, trace, steps + 1, decided, winner_mask)
+
+    init = (
+        state, telem, trace, jnp.int32(0), jnp.bool_(False),
+        jnp.zeros((n,), dtype=bool),
+    )
+    state, telem, trace, steps, decided, winner = jax.lax.while_loop(
+        cond, body, init
+    )
+    state = jax.lax.cond(
+        decided,
+        lambda s: apply_view_change_impl(cfg, s, winner),
+        lambda s: s,
+        state,
+    )
+    return (state, telem, trace, steps, decided, winner)
+
+
+run_to_decision_trace = jax.jit(
+    run_to_decision_trace_impl, static_argnums=(0,), donate_argnums=(1, 2, 3)
 )
 
 
@@ -1028,6 +1175,87 @@ run_until_membership_telem = jax.jit(
 )
 
 
+def run_until_membership_trace_impl(
+    cfg: EngineConfig,
+    state: EngineState,
+    telem: TelemetryLanes,
+    trace: TraceRing,
+    faults: FaultInputs,
+    target,
+    max_steps,
+    max_cuts,
+    min_cuts,
+):
+    """:func:`run_until_membership_telem_impl` with the trace ring joining
+    both loop carries. Like the telemetry lanes the ring is never reset by a
+    commit — a multi-cut wave decodes as one round-indexed story, the epoch
+    stamp marking where each view change landed."""
+    n = cfg.n
+
+    def outer_cond(carry):
+        state, _, _, steps, cuts, stalled, _, _ = carry
+        resolved = (state.n_members == target) & (cuts >= min_cuts)
+        return (~resolved) & (~stalled) & (steps < max_steps) & (cuts < max_cuts)
+
+    def outer_body(carry):
+        state, telem, trace, steps, cuts, _, sizes, edge_masks = carry
+
+        def inner_cond(carry):
+            _, _, _, steps, decided, _ = carry
+            return (~decided) & (steps < max_steps)
+
+        def inner_body(carry):
+            state, telem, trace, steps, _, _ = carry
+            round_state, decided, winner_mask, _, telem, trace = _compute_round(
+                cfg, state, faults, edge_masks, telem, trace
+            )
+            return (round_state, telem, trace, steps + 1, decided, winner_mask)
+
+        init = (
+            state, telem, trace, steps, jnp.bool_(False),
+            jnp.zeros((n,), dtype=bool),
+        )
+        state, telem, trace, steps, decided, winner = jax.lax.while_loop(
+            inner_cond, inner_body, init
+        )
+
+        def commit(s):
+            s2 = apply_view_change_impl(cfg, s, winner)
+            return s2, _edge_masks(cfg, s2, faults)
+
+        state, edge_masks = jax.lax.cond(
+            decided, commit, lambda s: (s, edge_masks), state
+        )
+        sizes = jnp.where(
+            decided, sizes.at[cuts].set(state.n_members), sizes
+        )
+        return (
+            state, telem, trace, steps, cuts + decided.astype(jnp.int32),
+            ~decided, sizes, edge_masks,
+        )
+
+    init = (
+        state,
+        telem,
+        trace,
+        jnp.int32(0),
+        jnp.int32(0),
+        jnp.bool_(False),
+        jnp.full((max_cuts,), -1, dtype=jnp.int32),
+        _edge_masks(cfg, state, faults),
+    )
+    state, telem, trace, steps, cuts, stalled, sizes, _ = jax.lax.while_loop(
+        outer_cond, outer_body, init
+    )
+    resolved = (state.n_members == target) & (cuts >= min_cuts)
+    return (state, telem, trace, steps, cuts, resolved, sizes)
+
+
+run_until_membership_trace = jax.jit(
+    run_until_membership_trace_impl, static_argnums=(0, 7), donate_argnums=(1, 2, 3)
+)
+
+
 class VirtualCluster(DispatchSeam):
     """Host driver around the device engine: owns the state, injects faults
     and join waves, and runs rounds until convergence.
@@ -1069,6 +1297,23 @@ class VirtualCluster(DispatchSeam):
             if cfg.telemetry
             else None
         )
+        # Device round-trace ring (cfg.trace == R > 0): a refinement of the
+        # telemetry plane — its active-subject record reuses the telemetry
+        # block's reduction, so a ring without the plane has nothing to
+        # record from. Not an assert: python -O must not skip this.
+        if cfg.trace and not cfg.telemetry:
+            raise ValueError(
+                "EngineConfig.trace requires telemetry: the round-trace ring "
+                "refines the telemetry plane (pass telemetry=True)"
+            )
+        if cfg.trace < 0:
+            raise ValueError(f"trace capacity must be >= 0, got {cfg.trace}")
+        self.trace_ring = initial_trace(cfg) if cfg.trace else None
+        self._trace = (
+            engine_telemetry.zero_trace_summary(cfg.trace)
+            if cfg.trace
+            else None
+        )
         engine_telemetry.install()
 
     # -- construction ---------------------------------------------------
@@ -1093,6 +1338,7 @@ class VirtualCluster(DispatchSeam):
         pallas_lanes: int = 128,
         compact: bool = False,
         telemetry: bool = False,
+        trace: int = 0,
     ) -> "VirtualCluster":
         """Synthetic cluster: slot identities are random 64-bit lanes (the
         host never materializes 100K endpoint strings; interop deployments
@@ -1102,7 +1348,11 @@ class VirtualCluster(DispatchSeam):
         (the wide layout stays the differential oracle). ``telemetry=True``
         carries the device telemetry plane (models/state.TelemetryLanes)
         through every round — engine results stay bit-identical; off, the
-        compiled programs are byte-identical to a pre-telemetry engine."""
+        compiled programs are byte-identical to a pre-telemetry engine.
+        ``trace=R`` (requires telemetry) additionally records the last R
+        rounds into the device round-trace ring (models/state.TraceRing) —
+        same bit-identity and byte-identity contracts, pinned by
+        tests/test_trace_ring.py."""
         n = n_slots if n_slots is not None else n_members
         assert n >= n_members
         _validate_delivery_prob(delivery_prob_permille)
@@ -1116,6 +1366,7 @@ class VirtualCluster(DispatchSeam):
             pallas_lanes=pallas_lanes,
             compact=int(compact),
             telemetry=int(telemetry),
+            trace=int(trace),
         )
         rng = np.random.default_rng(seed)
         key_hi = rng.integers(0, 2**32, size=(k, n), dtype=np.uint32)
@@ -1150,6 +1401,7 @@ class VirtualCluster(DispatchSeam):
         topology: str = "native",
         compact: bool = False,
         telemetry: bool = False,
+        trace: int = 0,
     ) -> "VirtualCluster":
         """Build from real endpoints with the host view's exact ring keys, so
         the engine's topology matches a host MembershipView bit-for-bit.
@@ -1187,6 +1439,7 @@ class VirtualCluster(DispatchSeam):
             pallas_lanes=pallas_lanes,
             compact=int(compact),
             telemetry=int(telemetry),
+            trace=int(trace),
         )
         key_hi0, key_lo0 = endpoint_ring_keys(endpoints, k, topology=topology)
         key_hi = np.zeros((k, n), dtype=np.uint32)
@@ -1420,7 +1673,11 @@ class VirtualCluster(DispatchSeam):
         self.metrics.inc("engine_steps")
         self.metrics.inc("engine_convergence_steps")
         with self._dispatch(phase):
-            if self.telem is not None:
+            if self.trace_ring is not None:
+                self.state, self.telem, self.trace_ring, events = engine_step_trace(
+                    self.cfg, self.state, self.telem, self.trace_ring, self.faults
+                )
+            elif self.telem is not None:
                 self.state, self.telem, events = engine_step_telem(
                     self.cfg, self.state, self.telem, self.faults
                 )
@@ -1465,6 +1722,11 @@ class VirtualCluster(DispatchSeam):
         self._activity = engine_telemetry.activity_summary(
             digest, self.cfg.n, self.cfg.c
         )
+        if self.trace_ring is not None:
+            # telemetry-fetch-ok: sync barrier — same blocking round trip.
+            tdigest = np.asarray(trace_digest(self.trace_ring))
+            self._account_d2h(tdigest.nbytes)
+            self._trace = engine_telemetry.trace_summary(tdigest, self.cfg.trace)
 
     @property
     def activity(self) -> Optional[dict]:
@@ -1472,6 +1734,17 @@ class VirtualCluster(DispatchSeam):
         None on a telemetry=0 engine — reading it never touches the
         device."""
         return dict(self._activity) if self._activity is not None else None
+
+    @property
+    def trace(self) -> Optional[dict]:
+        """The last host-sync boundary's decoded trace-ring summary (a
+        copy; ``records`` oldest -> newest with global round ordinals), or
+        None on a trace=0 engine — reading it never touches the device."""
+        if self._trace is None:
+            return None
+        out = dict(self._trace)
+        out["records"] = [dict(r) for r in self._trace["records"]]
+        return out
 
     def run_until_converged(self, max_steps: int = 64) -> Tuple[int, Optional[StepEvents]]:
         """Run rounds until a view change commits; returns (rounds, events)."""
@@ -1491,7 +1764,15 @@ class VirtualCluster(DispatchSeam):
         if max_steps > 255:  # not an assert: python -O must not skip this
             raise ValueError(f"max_steps packs into 8 bits, got {max_steps}")
         with self._dispatch("run_to_decision"):
-            if self.telem is not None:
+            if self.trace_ring is not None:
+                (
+                    self.state, self.telem, self.trace_ring, steps, decided,
+                    winner,
+                ) = run_to_decision_trace(
+                    self.cfg, self.state, self.telem, self.trace_ring,
+                    self.faults, jnp.int32(max_steps),
+                )
+            elif self.telem is not None:
                 self.state, self.telem, steps, decided, winner = run_to_decision_telem(
                     self.cfg, self.state, self.telem, self.faults,
                     jnp.int32(max_steps),
@@ -1546,7 +1827,16 @@ class VirtualCluster(DispatchSeam):
             # Not an assert: python -O must not skip this.
             raise ValueError(f"target must be in [0, {self.cfg.n}]: {target}")
         with self._dispatch("run_until_membership"):
-            if self.telem is not None:
+            if self.trace_ring is not None:
+                (
+                    self.state, self.telem, self.trace_ring, steps, cuts,
+                    resolved, sizes,
+                ) = run_until_membership_trace(
+                    self.cfg, self.state, self.telem, self.trace_ring,
+                    self.faults, jnp.int32(target), jnp.int32(max_steps),
+                    int(max_cuts), jnp.int32(min_cuts),
+                )
+            elif self.telem is not None:
                 self.state, self.telem, steps, cuts, resolved, sizes = (
                     run_until_membership_telem(
                         self.cfg, self.state, self.telem, self.faults,
@@ -1665,6 +1955,14 @@ class VirtualCluster(DispatchSeam):
                 **(
                     {"activity": dict(self._activity)}
                     if self._activity is not None
+                    else {}
+                ),
+                # Device round-trace ring (cfg.trace == R > 0): the same
+                # host-cache discipline — decoded at sync boundaries,
+                # zero-minted at attach, never fetched by a scrape.
+                **(
+                    {"trace": self.trace}
+                    if self._trace is not None
                     else {}
                 ),
             },

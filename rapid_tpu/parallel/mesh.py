@@ -58,10 +58,12 @@ from rapid_tpu.models.state import (
     EngineState,
     FaultInputs,
     TelemetryLanes,
+    TraceRing,
 )
 from rapid_tpu.models.virtual_cluster import (
     engine_step_impl,
     engine_step_telem_impl,
+    engine_step_trace_impl,
     run_until_membership_impl,
     run_until_membership_telem_impl,
 )
@@ -127,6 +129,14 @@ PARTITION_RULES: Tuple[Tuple[str, Spec], ...] = (
         r"tl_rounds|tl_alerts|tl_tally_sum|tl_fast_decisions"
         r"|tl_classic_decisions|tl_conflict_rounds|tl_undecided_hist",
         (),  # replicated-ok: per-engine scalar counters + the 8-bucket histogram
+    ),
+    # Round-trace ring (models/state.TraceRing): every lane is a per-round
+    # scalar record stretched over the [R] ring axis (no n/c dimension to
+    # shard) plus the cursor/wrap scalars.
+    (
+        r"tr_round|tr_epoch|tr_active|tr_alerts|tr_proposals|tr_tally"
+        r"|tr_path|tr_conflict|tr_undecided|tr_cursor|tr_wraps",
+        (),  # replicated-ok: [R]-ring per-round scalar records + cursor/wrap counters
     ),
 )
 
@@ -222,6 +232,13 @@ def telemetry_shardings(mesh: Mesh) -> TelemetryLanes:
     return _shardings_for(TelemetryLanes, mesh)
 
 
+def trace_shardings(mesh: Mesh) -> TraceRing:
+    """NamedShardings for the round-trace ring — the SAME rule table (the
+    ``tr_`` rules): ring lanes replicate (per-round scalars, no meshed
+    dimension), so the ring never adds cross-shard traffic to a round."""
+    return _shardings_for(TraceRing, mesh)
+
+
 def _fleet_shardings_for(cls, mesh: Mesh):
     """The tenant-stacked sharding table: the SAME rule table, with the
     leading ``[t]`` axis of every stacked leaf sharded on ``'tenant'`` and
@@ -253,6 +270,12 @@ def fleet_fault_shardings(mesh: Mesh) -> FaultInputs:
 def fleet_telemetry_shardings(mesh: Mesh) -> TelemetryLanes:
     """NamedShardings for tenant-STACKED telemetry lanes ([t, ...])."""
     return _fleet_shardings_for(TelemetryLanes, mesh)
+
+
+def fleet_trace_shardings(mesh: Mesh) -> TraceRing:
+    """NamedShardings for tenant-STACKED trace rings ([t, ...]): the tenant
+    axis shards, the ring lanes replicate within a tenant block."""
+    return _fleet_shardings_for(TraceRing, mesh)
 
 
 def shard_fleet_state(state: EngineState, mesh: Mesh) -> EngineState:
@@ -403,6 +426,27 @@ def make_sharded_step_telem(cfg: EngineConfig, mesh: Mesh):
         in_shardings=(st_sh, tl_sh, ft_sh),
         out_shardings=None,  # XLA propagates; state/lanes stay mesh-sharded
         donate_argnums=(0, 1),
+    )
+
+
+def make_sharded_step_trace(cfg: EngineConfig, mesh: Mesh):
+    """:func:`make_sharded_step_telem` with the round-trace ring riding
+    along — the audited ``step_trace`` program's mesh twin: the ring's
+    lanes replicate via :func:`trace_shardings` (per-round scalars carry no
+    meshed axis), so trace=R adds zero hot-loop collectives and zero host
+    transfers on any mesh."""
+    st_sh = state_shardings(mesh)
+    ft_sh = fault_shardings(mesh)
+    tl_sh = telemetry_shardings(mesh)
+    tr_sh = trace_shardings(mesh)
+
+    return jax.jit(
+        lambda state, telem, trace, faults: engine_step_trace_impl(
+            cfg, state, telem, trace, faults
+        ),
+        in_shardings=(st_sh, tl_sh, tr_sh, ft_sh),
+        out_shardings=None,  # XLA propagates; state/lanes/ring stay mesh-sharded
+        donate_argnums=(0, 1, 2),
     )
 
 

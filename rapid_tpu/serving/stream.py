@@ -377,6 +377,23 @@ class StreamDriver:
         self._epoch0 = self._fetch_epoch_total()
         self._fetch_ms0 = _stream_fetch_ms(target.metrics)
         self._h2d0 = int(target.metrics.counters.get("engine_h2d_bytes", 0))
+        # Round-trace attribution (trace>0 targets): every wave enqueues
+        # exactly rounds_per_wave rounds through stream_step, so wave i
+        # spans ring sequence [base + i*rpw, base + (i+1)*rpw) per lane —
+        # pure host arithmetic from a submit-time cursor snapshot, ZERO
+        # added fetches on the pipelined path. The base cursor comes from
+        # the decoded cache refreshed here (construction is already a
+        # fetch boundary — the epoch/admissibility fetches above).
+        self._has_trace = getattr(target, "trace_ring", None) is not None
+        self._wave_queue_depth: List[int] = []
+        #: Drain-time queue-wait vs rounds-to-decision decomposition
+        #: (:meth:`_round_trajectory`), or None before the first drain.
+        self.last_trajectory: Optional[dict] = None
+        if self._has_trace:
+            target._refresh_activity()
+            self._trace_base = [
+                s["rounds_recorded"] for s in self._trace_summaries()
+            ]
         # Surface the stream stats through the target's telemetry snapshot
         # (engine.stream section; golden gauge names pinned in
         # tests/test_engine_telemetry.py).
@@ -394,6 +411,11 @@ class StreamDriver:
         while len(self._pending) >= self.depth:
             self._complete_wave("submit")
         self._reap_ready()
+        if self._has_trace:
+            # Submit-time cursor snapshot, spelled as queue depth: the
+            # waves still in flight ahead of this one each own rpw ring
+            # records this wave must wait behind.
+            self._wave_queue_depth.append(len(self._pending))
         t_submit = self._clock()
         self._apply(wave)
         events = None
@@ -425,6 +447,8 @@ class StreamDriver:
         # telemetry-fetch-ok marker inside _refresh_activity) — never per
         # submitted wave, which would put a sync on the pipelined path.
         self.target._refresh_activity()
+        if self._has_trace:
+            self.last_trajectory = self._round_trajectory()
         cuts = epoch_total - self._epoch0
         wall_ms = (
             (self._clock() - self._t0_stream) * 1000.0
@@ -531,6 +555,76 @@ class StreamDriver:
         self.target.metrics.record_ms("engine_stream_alert_to_commit", latency_ms)
         self.waves_completed += 1
 
+    def _trace_summaries(self) -> List[dict]:
+        """The target's cached decoded ring summaries, one per lane (the
+        single cluster is one lane; a fleet is one per tenant). Reads the
+        host cache only — never the device."""
+        if self._is_fleet:
+            return self.target._trace or []
+        return [self.target._trace] if self.target._trace is not None else []
+
+    def _round_trajectory(self) -> dict:
+        """Decompose the streamed latency story into queue-wait vs
+        rounds-to-decision, from the decoded rings at a drain boundary.
+
+        Wave ``i`` owns ring sequence ``[base + i*rpw, base + (i+1)*rpw)``
+        in every lane (each submit enqueues exactly ``rounds_per_wave``
+        rounds; the cursor is write-per-round). A wave's rounds-to-decision
+        is the 1-based offset of the first decided record in its span,
+        maxed across lanes (a fleet wave completes when its slowest tenant
+        decides); a wave whose span slid out of the bounded ring is counted
+        EVICTED, never silently attributed — the ring holds the last R
+        rounds only. Queue-wait rides the submit-time snapshot: each wave
+        in flight ahead at submit owns ``rpw`` records this wave queued
+        behind."""
+        rpw = self.rounds_per_wave
+        summaries = self._trace_summaries()
+        decisions: List[int] = []
+        undecided = evicted = 0
+        for w in range(self.waves_submitted):
+            lane_hits: List[int] = []
+            known = True
+            for lane, s in enumerate(summaries):
+                lo = self._trace_base[lane] + w * rpw
+                oldest = s["rounds_recorded"] - s["rounds_held"]
+                if lo < oldest:
+                    known = False
+                    break
+                # Records are oldest-first with contiguous seq, so the
+                # span is a direct slice.
+                span = s["records"][lo - oldest : lo - oldest + rpw]
+                hit = next(
+                    (r["seq"] - lo + 1 for r in span if r["path"]), None
+                )
+                if hit is not None:
+                    lane_hits.append(hit)
+            if not known:
+                evicted += 1
+            elif lane_hits:
+                decisions.append(max(lane_hits))
+            else:
+                undecided += 1
+        queue_waits = [d * rpw for d in self._wave_queue_depth]
+        actives = [
+            r["active"] for s in summaries for r in s["records"]
+        ]
+
+        def q(vals, p):
+            return float(np.percentile(vals, p)) if vals else None
+
+        return {
+            "rounds_per_wave": rpw,
+            "waves_attributed": len(decisions) + undecided,
+            "waves_evicted": evicted,
+            "decided_waves": len(decisions),
+            "undecided_waves": undecided,
+            "rounds_to_decision_p50": q(decisions, 50),
+            "rounds_to_decision_p99": q(decisions, 99),
+            "rounds_to_decision_max": max(decisions) if decisions else None,
+            "queue_wait_rounds_p99": q(queue_waits, 99),
+            "active_p99": q(actives, 99),
+        }
+
     def _fetch_epoch_total(self) -> int:
         """Total committed view changes across the SERVING tenants (sum of
         config_epoch — scalar for a cluster, [t] lanes for a fleet), one
@@ -565,6 +659,7 @@ class StreamDriver:
         carry None for the drain-derived rates — the exposition renders
         them NaN so the series set is stable from the first scrape."""
         last = self._last_result
+        tj = self.last_trajectory or {}
         return {
             "waves_submitted": self.waves_submitted,
             "waves_completed": self.waves_completed,
@@ -587,6 +682,19 @@ class StreamDriver:
                 round(float(self._latency.quantile(0.99)), 3)
                 if self._latency.count
                 else None
+            ),
+            # Ring-derived decomposition, present only on trace>0 targets
+            # (the stable-series rule: a trace=0 stream's scrape vocabulary
+            # is unchanged). None before the first drain — the exposition
+            # renders NaN, never a missing series.
+            **(
+                {
+                    "rounds_to_decision_p99": tj.get("rounds_to_decision_p99"),
+                    "queue_wait_rounds_p99": tj.get("queue_wait_rounds_p99"),
+                    "waves_evicted": tj.get("waves_evicted"),
+                }
+                if self._has_trace
+                else {}
             ),
         }
 

@@ -126,6 +126,12 @@ DEFAULT_KNOBS = (WATERMARK_H, WATERMARK_L, 1)
 #: if the detector leaked, small enough to stay negligible per dispatch.
 STABILITY_SOAK_ROUNDS = 12
 
+#: Ring capacity of the repro verify run: large enough to hold a shrunk
+#: schedule's full round history (shrunk repros resolve in a handful of
+#: short phase groups), so the ``trace.json`` artifact usually carries
+#: every round the repro executed, not just a tail window.
+REPRO_TRACE_R = 64
+
 
 @dataclass
 class TenantScenario:
@@ -206,10 +212,13 @@ def compile_schedule(
     knobs: Tuple[int, int, int] = DEFAULT_KNOBS,
     delivery_spread: int = 0,
     telemetry: bool = False,
+    trace: int = 0,
 ) -> TenantScenario:
     """Compile one schedule onto a per-tenant engine cluster — the same
     event mapping the differential oracle uses (``inject_engine_event``),
-    with the tenant's ``(h, l, fd_threshold)`` knobs on top.
+    with the tenant's ``(h, l, fd_threshold)`` knobs on top. ``trace``
+    additionally carries the round-trace ring (implies telemetry) — engine
+    results are bit-identical with or without either plane.
 
     Sub-H false-report loads (the stable band) are applied HERE, as
     persistent per-(subject, ring) probe failures: they are environment-
@@ -232,7 +241,8 @@ def compile_schedule(
     vc = VirtualCluster.from_endpoints(
         endpoints, n_slots=len(endpoints), n_members=schedule.n0,
         k=WATERMARK_K, h=h, l=l, fd_threshold=fd_threshold,
-        delivery_spread=delivery_spread, telemetry=telemetry,
+        delivery_spread=delivery_spread,
+        telemetry=telemetry or bool(trace), trace=trace,
     )
     if schedule.profile == "hier":
         vc.assign_cohorts(_hier_cohort_of(seed, schedule.n_slots))
@@ -331,6 +341,23 @@ def compile_fleet(
     ]
 
 
+def _restore_trace_rings(
+    fleet: TenantFleet, scenarios: Sequence[TenantScenario]
+) -> None:
+    """Hand each tenant's slice of the fleet's trace ring back to its
+    cluster, so the ring stays continuous across the per-group
+    ``from_clusters`` restacks (the same continuity ``vc.state`` gets
+    above). No-op for untraced fleets — device-side slicing, no fetch."""
+    if fleet.trace_ring is None:
+        return
+    import jax
+
+    for i, scenario in enumerate(scenarios):
+        scenario.vc.trace_ring = jax.tree_util.tree_map(
+            lambda leaf, t=i: leaf[t], fleet.trace_ring
+        )
+
+
 def _inject_group(vc: VirtualCluster, group: List[FaultEvent]) -> int:
     """Apply one membership phase group's events to a tenant's cluster via
     the shared host-event -> engine-seam mapping. Returns the membership
@@ -402,6 +429,7 @@ def run_fleet(
                 config_epoch=int(epochs[i]),
                 members=int(members[i]),
             ))
+        _restore_trace_rings(fleet, scenarios)
         alive = np.asarray(fleet.state.alive)
 
     if soak_rounds is None:
@@ -430,6 +458,7 @@ def run_fleet(
         result.total_cuts += int(result.soak_cuts.sum())
         for i, scenario in enumerate(scenarios):
             scenario.vc.state = fleet.tenant_state(i)
+        _restore_trace_rings(fleet, scenarios)
         alive = np.asarray(fleet.state.alive)
 
     if alive is None:
@@ -602,16 +631,34 @@ def write_fleet_repro(
     """Collapse a shrunk violating tenant to a single-tenant repro dir in
     the sim schedule format: ``schedule.json`` (the repro itself),
     ``fleet.json`` (the engine-side compile recipe — knobs, family, the
-    original tenant index and fleet size for provenance), and
-    ``violations.txt`` re-verified by ONE fresh single-tenant fleet run
-    (tenant index 0 — what a replay will see). ``chaosrun replay``
+    original tenant index and fleet size for provenance), ``violations.txt``
+    re-verified by ONE fresh single-tenant fleet run (tenant index 0 — what
+    a replay will see), and ``trace.json`` — the verify run's decoded
+    round-trace ring (capacity :data:`REPRO_TRACE_R`), the write-time round
+    history ``replay_trace_divergence`` diffs a replay against to name the
+    first divergent round. The verify run carries the ring on top of the
+    engine (bit-identical either way — the trace differential the HLO gate
+    pins), so the artifact costs no extra run. ``chaosrun replay``
     recognizes the marker and replays through the engine fleet path."""
     import json
 
+    from rapid_tpu.models.virtual_cluster import trace_digest
+    from rapid_tpu.utils import engine_telemetry
+
     directory = Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
-    single = compile_schedule(schedule, family, seed, knobs, delivery_spread)
+    single = compile_schedule(
+        schedule, family, seed, knobs, delivery_spread,
+        telemetry=True, trace=REPRO_TRACE_R,
+    )
     verified = check_fleet(run_fleet([single]))
+    # telemetry-fetch-ok: repro-write boundary — the verify run is over;
+    # one digest fetch freezes the decoded ring into the artifact.
+    digest = np.asarray(trace_digest(single.vc.trace_ring))
+    summary = engine_telemetry.trace_summary(digest, REPRO_TRACE_R)
+    (directory / "trace.json").write_text(
+        json.dumps(summary, indent=1, sort_keys=True) + "\n"
+    )
     (directory / "schedule.json").write_text(schedule.to_json())
     (directory / "fleet.json").write_text(json.dumps({
         "version": 1,
@@ -647,6 +694,52 @@ def replay_fleet_repro(directory) -> Tuple[FleetRunResult, List[Violation]]:
     )
     result = run_fleet([scenario])
     return result, check_fleet(result)
+
+
+def replay_trace_divergence(directory) -> Optional[dict]:
+    """Diff a repro dir's written ``trace.json`` (the decoded round-trace
+    ring frozen at write time) against a fresh trace-enabled replay of the
+    same schedule. Returns None for pre-trace repro dirs (no artifact —
+    older repros stay replayable); otherwise a dict carrying both runs'
+    recorded-round counts and ``first_divergent_round`` — the global round
+    ordinal where the two histories fork, or None when the rings agree
+    record for record (the deterministic-repro invariant). This is the
+    round-granular instrument behind ``chaosrun replay``: when verdicts
+    diverge, it names WHERE, not just that they did."""
+    import json
+
+    from rapid_tpu.models.virtual_cluster import trace_digest
+    from rapid_tpu.utils import engine_telemetry
+
+    directory = Path(directory)
+    path = directory / "trace.json"
+    if not path.exists():
+        return None
+    written = json.loads(path.read_text())
+    capacity = int(written.get("capacity", REPRO_TRACE_R))
+    recipe = json.loads((directory / "fleet.json").read_text())
+    schedule = FaultSchedule.from_json((directory / "schedule.json").read_text())
+    scenario = compile_schedule(
+        schedule,
+        str(recipe.get("family", "repro")),
+        int(recipe.get("seed", schedule.seed)),
+        tuple(recipe.get("knobs", DEFAULT_KNOBS)),
+        int(recipe.get("delivery_spread", 0)),
+        telemetry=True, trace=capacity,
+    )
+    run_fleet([scenario])
+    # telemetry-fetch-ok: replay boundary — the run is over; one digest
+    # fetch decodes the replayed ring for the divergence diff.
+    digest = np.asarray(trace_digest(scenario.vc.trace_ring))
+    replayed = engine_telemetry.trace_summary(digest, capacity)
+    return {
+        "capacity": capacity,
+        "written_rounds": int(written["rounds_recorded"]),
+        "replayed_rounds": replayed["rounds_recorded"],
+        "first_divergent_round": engine_telemetry.first_divergent_round(
+            written, replayed
+        ),
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -57,7 +57,9 @@ from rapid_tpu.models.state import (
     FaultInputs,
     StepEvents,
     TelemetryLanes,
+    TraceRing,
     initial_telemetry,
+    initial_trace,
 )
 from rapid_tpu.models.virtual_cluster import (
     VirtualCluster,
@@ -65,9 +67,12 @@ from rapid_tpu.models.virtual_cluster import (
     apply_view_change_impl,
     engine_step_impl,
     engine_step_telem_impl,
+    engine_step_trace_impl,
     run_to_decision_impl,
     run_to_decision_telem_impl,
+    run_to_decision_trace_impl,
     telemetry_digest_impl,
+    trace_digest_impl,
 )
 from rapid_tpu.parallel.mesh import (
     TENANT_AXIS,
@@ -380,6 +385,135 @@ def fleet_wave_telem_impl(
     return jax.vmap(one)(state, telem, faults, knobs, target, min_cuts)
 
 
+# ---------------------------------------------------------------------------
+# Round-trace ring, fleet grain: the SAME TraceRing pytree with a leading
+# [t] axis, threaded through vmapped twins of the telemetry entrypoints.
+# Separate entrypoints again (never default arguments) so trace=0 fleets —
+# telemetry-on or off — keep compiling byte-identical programs.
+# ---------------------------------------------------------------------------
+
+
+def initial_fleet_trace(cfg: EngineConfig, tenants: int) -> TraceRing:
+    """All-zero trace rings for ``tenants`` clusters: the single-cluster
+    ring with a leading tenant axis, matching the stacked lane layout."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.zeros((tenants,) + x.shape, x.dtype),
+        initial_trace(cfg),
+    )
+
+
+def fleet_step_trace_impl(
+    cfg: EngineConfig,
+    state: EngineState,
+    telem: TelemetryLanes,
+    trace: TraceRing,
+    faults: FaultInputs,
+    knobs: TenantKnobs,
+) -> Tuple[EngineState, TelemetryLanes, TraceRing, StepEvents]:
+    """:func:`fleet_step_telem_impl` with per-tenant trace rings riding
+    along (``engine_step_trace_impl`` vmapped). Each tenant's ring records
+    ITS OWN rounds — cursor, wraps, and records are bit-identical to B
+    separate trace-enabled ``VirtualCluster`` steps."""
+
+    def one(state, telem, trace, faults, kn):
+        return engine_step_trace_impl(
+            _tenant_cfg(cfg, kn), state, telem, trace, faults
+        )
+
+    return jax.vmap(one)(state, telem, trace, faults, knobs)
+
+
+def fleet_run_to_decision_trace_impl(
+    cfg: EngineConfig,
+    state: EngineState,
+    telem: TelemetryLanes,
+    trace: TraceRing,
+    faults: FaultInputs,
+    knobs: TenantKnobs,
+    max_steps,
+):
+    """:func:`fleet_run_to_decision_telem_impl` with the ring in the batched
+    while carry (single-device driver entrypoint, same as its twins)."""
+
+    def one(state, telem, trace, faults, kn):
+        return run_to_decision_trace_impl(
+            _tenant_cfg(cfg, kn), state, telem, trace, faults, max_steps
+        )
+
+    return jax.vmap(one)(state, telem, trace, faults, knobs)
+
+
+def fleet_wave_trace_impl(
+    cfg: EngineConfig,
+    state: EngineState,
+    telem: TelemetryLanes,
+    trace: TraceRing,
+    faults: FaultInputs,
+    knobs: TenantKnobs,
+    target,
+    max_steps,
+    max_cuts: int,
+    min_cuts,
+):
+    """The lockstep fleet wave with trace rings in the carry. The ring is
+    select-gated by the SAME ``active`` mask that freezes a finished
+    tenant's state and telemetry: a coasting tenant's cursor holds still
+    and its slots are never overwritten, so the decoded ring stays
+    bit-identical to a per-cluster ``run_until_membership_trace`` drive
+    (quarantined tenants — done from iteration 0 — record nothing)."""
+
+    def one(state, telem, trace, faults, kn, tgt, mc):
+        tcfg = _tenant_cfg(cfg, kn)
+
+        def body(_i, carry):
+            state, telem, trace, steps, cuts, sizes, done = carry
+            active = ~done & (steps < max_steps)
+            round_state, decided, winner, _, round_telem, round_trace = (
+                _compute_round(tcfg, state, faults, None, telem, trace)
+            )
+            committed = apply_view_change_impl(tcfg, round_state, winner)
+            commit = active & decided
+            picked = jax.tree_util.tree_map(
+                lambda old, rnd, com: jnp.where(
+                    active, jnp.where(commit, com, rnd), old
+                ),
+                state, round_state, committed,
+            )
+            telem = jax.tree_util.tree_map(
+                lambda old, new: jnp.where(active, new, old),
+                telem, round_telem,
+            )
+            trace = jax.tree_util.tree_map(
+                lambda old, new: jnp.where(active, new, old),
+                trace, round_trace,
+            )
+            steps = jnp.where(active, steps + 1, steps)
+            sizes = jnp.where(
+                commit, sizes.at[cuts].set(committed.n_members), sizes
+            )
+            cuts = cuts + commit.astype(jnp.int32)
+            resolved = (picked.n_members == tgt) & (cuts >= mc)
+            done = done | (commit & resolved) | (cuts >= max_cuts)
+            return (picked, telem, trace, steps, cuts, sizes, done)
+
+        init = (
+            state,
+            telem,
+            trace,
+            jnp.int32(0),
+            jnp.int32(0),
+            jnp.full((max_cuts,), -1, dtype=jnp.int32),
+            (state.n_members == tgt) & (mc <= jnp.int32(0)),
+        )
+        state, telem, trace, steps, cuts, sizes, _ = jax.lax.fori_loop(
+            0, max_steps, body, init
+        )
+        resolved = (state.n_members == tgt) & (cuts >= mc)
+        return (state, telem, trace, steps, cuts, resolved, sizes)
+
+    return jax.vmap(one)(state, telem, trace, faults, knobs, target, min_cuts)
+
+
 def tenant_health_impl(cfg: EngineConfig, state: EngineState) -> jnp.ndarray:
     """The cheap device-side health reduction: one [t] bool lane, True =
     the tenant's state satisfies the protocol invariants. This is the
@@ -440,6 +574,20 @@ fleet_wave_telem = jax.jit(
 )
 # donate-ok: read-only boundary fetch — the per-tenant lanes stay live.
 fleet_telemetry_digest = jax.jit(jax.vmap(telemetry_digest_impl))
+
+fleet_step_trace = jax.jit(
+    fleet_step_trace_impl, static_argnums=(0,), donate_argnums=(1, 2, 3)
+)
+fleet_run_to_decision_trace = jax.jit(
+    fleet_run_to_decision_trace_impl,
+    static_argnums=(0,),
+    donate_argnums=(1, 2, 3),
+)
+fleet_wave_trace = jax.jit(
+    fleet_wave_trace_impl, static_argnums=(0, 8), donate_argnums=(1, 2, 3)
+)
+# donate-ok: read-only boundary fetch — the per-tenant rings stay live.
+fleet_trace_digest = jax.jit(jax.vmap(trace_digest_impl))
 
 
 def make_fleet_step(cfg: EngineConfig, mesh: Mesh):
@@ -545,6 +693,18 @@ class TenantFleet(DispatchSeam):
              for _ in range(b)]
             if cfg.telemetry else None
         )
+        # Round-trace ring, per tenant (trace=R refines the telemetry plane;
+        # VirtualCluster.__init__ already rejects trace without telemetry,
+        # and EngineConfig validation runs there for every construction
+        # path, so a fleet config reaching here is consistent).
+        self.trace_ring = (
+            initial_fleet_trace(cfg, b) if cfg.trace else None
+        )
+        self._trace = (
+            [engine_telemetry.zero_trace_summary(cfg.trace)
+             for _ in range(b)]
+            if cfg.trace else None
+        )
         engine_telemetry.install()
 
     # -- construction ---------------------------------------------------
@@ -599,6 +759,13 @@ class TenantFleet(DispatchSeam):
             # assembled mid-run keeps its tenants' activity stories).
             fleet.telem = stack_pytrees([vc.telem for vc in clusters])
             fleet._account_h2d(*jax.tree_util.tree_leaves(fleet.telem))
+        if base.trace:
+            # Same carry for the rings: a mid-run stack keeps each tenant's
+            # last-R rounds (cursor and wraps included).
+            fleet.trace_ring = stack_pytrees(
+                [vc.trace_ring for vc in clusters]
+            )
+            fleet._account_h2d(*jax.tree_util.tree_leaves(fleet.trace_ring))
         return fleet
 
     @classmethod
@@ -661,7 +828,14 @@ class TenantFleet(DispatchSeam):
         batch path the bit-identity tests pin."""
         self.metrics.inc("engine_tenant_rounds", self.b)
         with self._dispatch(phase):
-            if self.telem is not None:
+            if self.trace_ring is not None:
+                self.state, self.telem, self.trace_ring, events = (
+                    fleet_step_trace(
+                        self.cfg, self.state, self.telem, self.trace_ring,
+                        self.faults, self.knobs,
+                    )
+                )
+            elif self.telem is not None:
                 self.state, self.telem, events = fleet_step_telem(
                     self.cfg, self.state, self.telem, self.faults, self.knobs
                 )
@@ -698,7 +872,14 @@ class TenantFleet(DispatchSeam):
         returns ``(rounds[t], decided[t], winner[t, n] on device,
         members[t])`` with one packed observation fetch."""
         with self._dispatch("fleet_decision"):
-            if self.telem is not None:
+            if self.trace_ring is not None:
+                self.state, self.telem, self.trace_ring, steps, decided, winner = (
+                    fleet_run_to_decision_trace(
+                        self.cfg, self.state, self.telem, self.trace_ring,
+                        self.faults, self.knobs, jnp.int32(max_steps),
+                    )
+                )
+            elif self.telem is not None:
                 self.state, self.telem, steps, decided, winner = (
                     fleet_run_to_decision_telem(
                         self.cfg, self.state, self.telem, self.faults,
@@ -759,7 +940,17 @@ class TenantFleet(DispatchSeam):
             )
         self._account_h2d(targets, min_cuts)
         with self._dispatch("fleet_wave"):
-            if self.telem is not None:
+            if self.trace_ring is not None:
+                (
+                    self.state, self.telem, self.trace_ring,
+                    steps, cuts, resolved, sizes,
+                ) = fleet_wave_trace(
+                    self.cfg, self.state, self.telem, self.trace_ring,
+                    self.faults, self.knobs, jnp.asarray(targets),
+                    jnp.int32(max_steps), int(max_cuts),
+                    jnp.asarray(min_cuts),
+                )
+            elif self.telem is not None:
                 self.state, self.telem, steps, cuts, resolved, sizes = (
                     fleet_wave_telem(
                         self.cfg, self.state, self.telem, self.faults,
@@ -809,6 +1000,15 @@ class TenantFleet(DispatchSeam):
             )
             for t in range(self.b)
         ]
+        if self.trace_ring is not None:
+            # telemetry-fetch-ok: same host-sync boundary — one stacked
+            # [t, 2 + 9R] digest fetch decodes every tenant's ring.
+            tdigest = np.asarray(fleet_trace_digest(self.trace_ring))
+            self._account_d2h(tdigest.nbytes)
+            self._trace = [
+                engine_telemetry.trace_summary(tdigest[t], self.cfg.trace)
+                for t in range(self.b)
+            ]
 
     @property
     def activity(self) -> Optional[dict]:
@@ -828,6 +1028,20 @@ class TenantFleet(DispatchSeam):
         if self._activity is None:
             return None
         return [dict(a) for a in self._activity]
+
+    @property
+    def tenant_trace(self) -> Optional[List[dict]]:
+        """Per-tenant decoded ring digests (deep copies — records included)
+        from the last host-sync boundary, or None on a trace=0 fleet.
+        Reading it never touches the device."""
+        if self._trace is None:
+            return None
+        out = []
+        for tr in self._trace:
+            d = dict(tr)
+            d["records"] = [dict(r) for r in tr["records"]]
+            out.append(d)
+        return out
 
     # -- health & quarantine (the serving supervision tier's seams) ------
 
@@ -1004,6 +1218,13 @@ class TenantFleet(DispatchSeam):
                         ],
                     }
                     if self._activity is not None
+                    else {}
+                ),
+                # Round-trace ring: per-tenant decoded digests, present only
+                # on trace>0 fleets (the same stable-series rule).
+                **(
+                    {"tenant_trace": self.tenant_trace}
+                    if self._trace is not None
                     else {}
                 ),
                 # Streaming tier: present only when a StreamDriver is

@@ -283,6 +283,178 @@ def aggregate_activity(summaries: Any, n: int, c: int) -> Dict[str, Any]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Device round-trace ring: digest decode (models/virtual_cluster.py's
+# trace_digest_impl packs the ring into one int32 vector at host-sync
+# boundaries; this is the host-side vocabulary for unpacking it)
+# ---------------------------------------------------------------------------
+
+#: Per-round record fields, in the lane order ``trace_digest_impl`` packs
+#: (after the two leading ``[cursor, wraps]`` scalars, one ``[R]`` lane per
+#: field). Shared by producer and consumer so the two cannot skew silently —
+#: the same contract :data:`TELEMETRY_DIGEST_FIELDS` carries for the plane.
+TRACE_RECORD_FIELDS = (
+    "round",
+    "epoch",
+    "active",
+    "alerts",
+    "proposals",
+    "tally",
+    "path",
+    "conflict",
+    "undecided",
+)
+
+#: Decision-path code vocabulary (the ``path`` record field): the engine's
+#: analog of the host protocol's decided_path label.
+TRACE_PATH_NAMES = {0: "none", 1: "fast", 2: "classic"}
+
+
+def trace_summary(digest: Any, capacity: int) -> Dict[str, Any]:
+    """The ``engine.trace`` snapshot section from one fetched trace digest:
+    the decoded ring — ``records`` oldest -> newest, each a dict of
+    :data:`TRACE_RECORD_FIELDS` plus the global round ordinal ``seq`` (the
+    i-th round ever recorded) — and the derived scalars the exposition /
+    clustertop / perfview surfaces read. Pure host arithmetic on an
+    already-fetched vector — never fetches.
+
+    Decode contract (tests/test_trace_ring.py pins it): the ring holds
+    exactly the last ``min(capacity, cursor)`` rounds; when wrapped, the
+    oldest record sits at slot ``cursor % capacity``; the decoded
+    ``(epoch, round)`` stamps are strictly lexicographically increasing."""
+    vec = [int(v) for v in digest]
+    expected = 2 + len(TRACE_RECORD_FIELDS) * capacity
+    if len(vec) != expected:
+        raise ValueError(
+            f"trace digest carries {len(vec)} values, expected {expected}"
+        )
+    cursor, wraps = vec[0], vec[1]
+    lanes = {
+        field: vec[2 + i * capacity : 2 + (i + 1) * capacity]
+        for i, field in enumerate(TRACE_RECORD_FIELDS)
+    }
+    held = min(cursor, capacity)
+    start = cursor % capacity if cursor >= capacity else 0
+    records = []
+    for i in range(held):
+        slot = (start + i) % capacity
+        rec = {field: lanes[field][slot] for field in TRACE_RECORD_FIELDS}
+        rec["seq"] = cursor - held + i
+        records.append(rec)
+    last = records[-1] if records else dict.fromkeys(TRACE_RECORD_FIELDS, 0)
+    return {
+        "capacity": capacity,
+        "rounds_recorded": cursor,
+        "wraps": wraps,
+        "rounds_held": held,
+        "decisions_held": sum(1 for r in records if r["path"]),
+        "conflicts_held": sum(r["conflict"] for r in records),
+        "last_round": last["round"],
+        "last_epoch": last["epoch"],
+        "last_active": last["active"],
+        "last_path": last["path"],
+        "last_undecided": last["undecided"],
+        "records": records,
+    }
+
+
+def zero_trace_summary(capacity: int) -> Dict[str, Any]:
+    """The all-zero trace section minted at driver attach (empty ring, no
+    records) — same never-mint-a-series-mid-run rule as
+    :func:`zero_activity_summary`."""
+    return trace_summary(
+        [0] * (2 + len(TRACE_RECORD_FIELDS) * capacity), capacity
+    )
+
+
+def trace_recorder_snapshot(
+    summary: Dict[str, Any],
+    node: str = "(engine)",
+    t0_ms: float = 0.0,
+    ms_per_round: float = 1.0,
+    config_id: Optional[int] = None,
+) -> Dict[str, Any]:
+    """A decoded ring rendered as a flight-recorder snapshot dict — the
+    per-node artifact shape ``tools/traceview.py`` merges — so device rounds
+    join the host and ``(chaos)`` lanes of one causally-ordered timeline.
+
+    Device rounds carry no wall clock, so timestamps are synthesized on an
+    injected :class:`~rapid_tpu.utils.clock.ManualClock`: record ``seq``
+    lands at ``t0_ms + seq * ms_per_round`` (callers aligning against a host
+    recording pick the scenario's round cadence). Every round emits one
+    registered ``ENGINE_ROUND`` event; conflict rounds add
+    ``ENGINE_CONFLICT`` and deciding rounds ``ENGINE_DECISION`` — ranked so
+    they interleave correctly with host consensus events at equal stamps."""
+    from rapid_tpu.utils.clock import ManualClock
+    from rapid_tpu.utils.flight_recorder import EventName, FlightRecorder
+
+    clock = ManualClock()
+    records = summary["records"]
+    recorder = FlightRecorder(
+        node, clock, capacity=max(1, summary["capacity"] * 3)
+    )
+    for rec in records:
+        target = t0_ms + rec["seq"] * ms_per_round
+        clock.advance_ms(target - clock.now_ms())
+        recorder.record(
+            EventName.ENGINE_ROUND,
+            config_id=config_id,
+            seq=rec["seq"],
+            round=rec["round"],
+            epoch=rec["epoch"],
+            active=rec["active"],
+            alerts=rec["alerts"],
+            proposals=rec["proposals"],
+            undecided=rec["undecided"],
+        )
+        if rec["conflict"]:
+            recorder.record(
+                EventName.ENGINE_CONFLICT,
+                config_id=config_id,
+                seq=rec["seq"],
+                epoch=rec["epoch"],
+                undecided=rec["undecided"],
+            )
+        if rec["path"]:
+            recorder.record(
+                EventName.ENGINE_DECISION,
+                config_id=config_id,
+                seq=rec["seq"],
+                epoch=rec["epoch"],
+                path=TRACE_PATH_NAMES.get(rec["path"], str(rec["path"])),
+                tally=rec["tally"],
+            )
+    snap = recorder.snapshot()
+    # The ring already dropped rounds before the decode window; surface the
+    # TRUE totals so "dropped" reads as rounds lost to wraparound, not as
+    # recorder-local arithmetic over the survivors.
+    snap["recorded_total"] = summary["rounds_recorded"]
+    snap["dropped"] = summary["rounds_recorded"] - summary["rounds_held"]
+    return snap
+
+
+def first_divergent_round(
+    a: Dict[str, Any], b: Dict[str, Any]
+) -> Optional[int]:
+    """The global round ordinal (``seq``) of the first record where two
+    decoded rings disagree, or None when their overlapping windows agree
+    record-for-record. Compares the overlap of the two held windows plus
+    the cursor frontier — the chaos repro artifact's divergence instrument
+    (a write-time ring vs a replay-time ring of the same schedule)."""
+    by_seq_a = {r["seq"]: r for r in a["records"]}
+    by_seq_b = {r["seq"]: r for r in b["records"]}
+    shared = sorted(set(by_seq_a) & set(by_seq_b))
+    for seq in shared:
+        ra, rb = by_seq_a[seq], by_seq_b[seq]
+        if any(ra[f] != rb[f] for f in TRACE_RECORD_FIELDS):
+            return seq
+    if a["rounds_recorded"] != b["rounds_recorded"]:
+        # One run recorded more rounds than the other: the first round the
+        # shorter run never executed is where the histories fork.
+        return min(a["rounds_recorded"], b["rounds_recorded"])
+    return None
+
+
 def compiled_memory_analysis(compiled: Any) -> Optional[Dict[str, int]]:
     """The XLA ``memory_analysis()`` of one compiled executable as a plain
     dict (argument/output/temp/generated-code bytes) — the per-config

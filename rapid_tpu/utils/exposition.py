@@ -193,6 +193,39 @@ _ENGINE_ACTIVITY_GAUGES = (
     "winning_tally_mean",
 )
 
+#: Round-trace ring counters (``engine.trace`` / per-tenant
+#: ``engine.tenant_trace`` — present exactly when the driver was built with
+#: ``trace=R``; zero-minted at attach, so every series exists from the
+#: first scrape). Rendered as ``rapid_engine_trace_<name>_total``.
+_ENGINE_TRACE_COUNTERS = (
+    "rounds_recorded",
+    "wraps",
+)
+
+#: Round-trace ring gauges (``rapid_engine_trace_<name>``): ring geometry,
+#: held-window census, and the newest record's stamps — the clustertop
+#: ROUNDS pane's inputs.
+_ENGINE_TRACE_GAUGES = (
+    "capacity",
+    "rounds_held",
+    "decisions_held",
+    "conflicts_held",
+    "last_round",
+    "last_epoch",
+    "last_active",
+    "last_path",
+    "last_undecided",
+)
+
+#: ``engine.stream`` gauge keys that exist only on trace>0 targets
+#: (StreamDriver.snapshot adds them exactly then): rendered when present,
+#: so a trace=0 stream's scrape vocabulary is unchanged.
+_ENGINE_STREAM_TRACE_GAUGES = (
+    "rounds_to_decision_p99",
+    "queue_wait_rounds_p99",
+    "waves_evicted",
+)
+
 
 def _esc(value: str) -> str:
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
@@ -290,6 +323,22 @@ def _render_activity(
         out.sample(f"{_PREFIX}_engine_activity_rounds_undecided_total",
                    "counter", count, node=node, tenant=tenant,
                    bucket=str(bucket))
+
+
+def _render_trace(
+    out: "_Renderer", trace: Dict[str, Any], node: Optional[str],
+    tenant: Optional[str] = None,
+) -> None:
+    """One decoded ring digest (``engine.trace`` / a ``tenant_trace``
+    entry) as Prometheus series: the monotone cursor/wrap counters plus the
+    held-window and last-record gauges. The per-record lanes themselves are
+    a timeline, not a gauge surface — traceview renders those."""
+    for key in _ENGINE_TRACE_COUNTERS:
+        out.sample(f"{_PREFIX}_engine_trace_{key}_total", "counter",
+                   trace.get(key, 0), node=node, tenant=tenant)
+    for key in _ENGINE_TRACE_GAUGES:
+        out.sample(f"{_PREFIX}_engine_trace_{key}", "gauge",
+                   trace.get(key, 0), node=node, tenant=tenant)
 
 
 def _phase_labels(phase_key: str) -> Dict[str, str]:
@@ -415,6 +464,14 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
                 out.sample(f"{_PREFIX}_engine_stream_{key}", "gauge",
                            float("nan") if value is None else value,
                            node=node)
+            # Ring-derived decomposition gauges: present in the snapshot
+            # exactly when the stream's target runs trace>0 (NaN pre-drain).
+            for key in _ENGINE_STREAM_TRACE_GAUGES:
+                if key in stream:
+                    value = stream.get(key)
+                    out.sample(f"{_PREFIX}_engine_stream_{key}", "gauge",
+                               float("nan") if value is None else value,
+                               node=node)
         tenancy = engine.get("tenancy")
         if isinstance(tenancy, dict):
             # The fleet tier: tenant count, per-dispatch tenant throughput,
@@ -439,6 +496,16 @@ def prometheus_text(snapshot: Dict[str, Any]) -> str:
             if isinstance(tenant_activity, (list, tuple)):
                 for idx, per_tenant in enumerate(tenant_activity):
                     _render_activity(out, per_tenant, node, tenant=str(idx))
+        trace = engine.get("trace")
+        if isinstance(trace, dict):
+            # The round-trace ring (models/state.TraceRing): present
+            # exactly when the driver runs with trace=R (zero-minted at
+            # attach — the series set is stable from the first scrape).
+            _render_trace(out, trace, node)
+        tenant_trace = engine.get("tenant_trace")
+        if isinstance(tenant_trace, (list, tuple)):
+            for idx, per_tenant in enumerate(tenant_trace):
+                _render_trace(out, per_tenant, node, tenant=str(idx))
         recovery = engine.get("recovery")
         if isinstance(recovery, dict):
             # The supervision tier (rapid_tpu/serving/supervisor.py):

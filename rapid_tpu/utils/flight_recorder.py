@@ -78,6 +78,13 @@ class EventName(enum.Enum):
     CATCH_UP_RESULT = "catch_up_result"
     CONFIG_BEACON_TX = "config_beacon_tx"
     UNKNOWN_JOINER_WEDGE = "unknown_joiner_wedge"
+    # Device engine round-trace ring (models/state.TraceRing): one decoded
+    # ring record per fused-engine round, synthesized at fetch boundaries by
+    # utils/engine_telemetry.trace_recorder_snapshot so traceview merges
+    # device rounds into the same timeline as host and chaos lanes.
+    ENGINE_ROUND = "engine_round"
+    ENGINE_CONFLICT = "engine_conflict"
+    ENGINE_DECISION = "engine_decision"
 
     # Causal phase rank within one membership change: used by traceview to
     # order events that share a timestamp (simulated clocks tick coarsely).
@@ -111,6 +118,13 @@ _PHASE_RANK: Dict[EventName, int] = {
     EventName.UNKNOWN_JOINER_WEDGE: 12,
     EventName.VIEW_CHANGE: 13,
     EventName.KICKED: 13,
+    # Device rounds: the round record opens its timestamp's pipeline; the
+    # conflict flag aligns with the classic-fallback window and the decision
+    # with CONSENSUS_DECIDED, so a host recording and a decoded ring of the
+    # same scenario interleave in causal order at equal timestamps.
+    EventName.ENGINE_ROUND: 0,
+    EventName.ENGINE_CONFLICT: 9,
+    EventName.ENGINE_DECISION: 10,
 }
 
 

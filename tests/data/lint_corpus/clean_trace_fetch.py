@@ -1,0 +1,35 @@
+"""Corpus: disciplined round-trace ring fetches — every host decode of
+the ring sits at a declared boundary and carries the marker. Host reads
+of the DECODED summaries (plain dicts, named ``trace`` by convention)
+prove the checker does not overreach onto the host-side cache."""
+
+import numpy as np
+
+from rapid_tpu.models.virtual_cluster import trace_digest
+from rapid_tpu.tenancy.fleet import fleet_trace_digest
+
+
+class MiniRecorder:
+    def __init__(self, trace_ring):
+        self.trace_ring = trace_ring
+        self.trace = None
+
+    def sync(self):
+        # telemetry-fetch-ok: sync barrier — the driver is already paying
+        # a blocking device round trip here; one [2 + 9R] digest rides it.
+        digest = np.asarray(trace_digest(self.trace_ring))
+        self.trace = digest
+        return digest
+
+    def health_scan(self):
+        # telemetry-fetch-ok: health sweep boundary (already blocking);
+        # one stacked fetch decodes every tenant's ring.
+        per_tenant = np.asarray(fleet_trace_digest(self.trace_ring))
+        return per_tenant[:, 0]
+
+    def snapshot(self):
+        # Reads of the decoded HOST-side summary are free — ``trace`` is
+        # a plain dict here, not the device ring; no marker needed.
+        cached = self.trace
+        wraps = np.asarray(cached[1]) if cached is not None else None
+        return cached, wraps

@@ -1,0 +1,45 @@
+"""Lint corpus: round-trace ring fetches outside declared boundaries.
+
+The trace ring is the telemetry plane's flight recorder: write-only
+inside the round bodies, decoded on host ONLY at the same sync seams the
+lane digests use. Calling a trace digest jit — or spelling the fetch
+directly via numpy / device_get over the ring — without a
+``# telemetry-fetch-ok: <why>`` marker is a blocking round trip smuggled
+onto a hot path, exactly like an unmarked lane fetch.
+"""
+
+import numpy as np
+
+import jax
+
+from rapid_tpu.models.virtual_cluster import trace_digest
+from rapid_tpu.tenancy.fleet import fleet_trace_digest
+
+
+class MiniRecorder:
+    def __init__(self, trace_ring):
+        self.trace_ring = trace_ring
+        self._summary = None
+
+    def dispatch(self, wave):
+        # Decoding the ring per dispatched wave defeats the recorder's
+        # whole design — the digest belongs at the drain/sync seam only.
+        digest = np.asarray(trace_digest(self.trace_ring))  # expect: telemetry-unmarked-fetch
+        return digest[0] + wave
+
+    def scan(self):
+        per_tenant = fleet_trace_digest(self.trace_ring)  # expect: telemetry-unmarked-fetch
+        return per_tenant
+
+    def peek(self):
+        # The direct spellings block just the same as the digest jits.
+        cursor = np.array(self.trace_ring.tr_cursor)  # expect: telemetry-unmarked-fetch
+        ring = jax.device_get(self.trace_ring)  # expect: telemetry-unmarked-fetch
+        return cursor, ring
+
+    def sync(self):
+        # telemetry-fetch-ok: host-sync boundary — the caller is already
+        # paying a blocking device round trip here.
+        digest = np.asarray(trace_digest(self.trace_ring))
+        self._summary = digest
+        return digest

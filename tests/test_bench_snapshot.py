@@ -392,7 +392,7 @@ def test_hlo_audit_summary_embeds_per_entrypoint_budget_table():
     table = bench.hlo_audit_summary()
     assert "error" not in table, table
     assert {"step", "run_to_decision", "run_until_membership", "sync",
-            "step_compact", "step_telem",
+            "step_compact", "step_telem", "step_trace",
             "sharded_step", "sharded_step_telem", "sharded_wave",
             "sharded2d_wave",
             "fleet3d_step", "fleet3d_wave"} == set(table)

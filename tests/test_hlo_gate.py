@@ -107,7 +107,7 @@ def test_registered_entrypoints_audit_clean_against_committed_lock():
         )
     assert set(facts) == {
         "step", "run_to_decision", "run_until_membership", "sync",
-        "step_compact", "step_telem",
+        "step_compact", "step_telem", "step_trace",
         "sharded_step", "sharded_step_telem", "sharded_wave",
         "sharded2d_wave",
         "fleet3d_step", "fleet3d_wave",
@@ -123,7 +123,7 @@ def test_sharded_entrypoints_have_collectives_single_device_do_not():
                  "sharded2d_wave", "fleet3d_step", "fleet3d_wave"):
         assert facts[name]["collectives"], name
     for name in ("step", "run_to_decision", "run_until_membership", "sync",
-                 "step_compact", "step_telem"):
+                 "step_compact", "step_telem", "step_trace"):
         assert facts[name]["collectives"] == {}, name
     # Both waves' unconditional hot loops stay reduce-class at scalar/[n]
     # payloads; [c,n]-scale traffic is cond-gated — the parallel/audit

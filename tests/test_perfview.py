@@ -439,6 +439,57 @@ def test_trajectory_renders_activity_column_and_flags_missing(tmp_path, capsys):
     assert "activity-missing" not in lines["BENCH_r70"]  # pre-audit history
 
 
+def test_trajectory_renders_trace_column_and_flags_missing(tmp_path, capsys):
+    """ISSUE 17: the round-trace ring's stream decomposition renders as the
+    TRACE trajectory column (rounds-to-decision p99, worst wave beside it)
+    under the same trust discipline as ACTIVITY: an AUDITED round that
+    omits both the numeric ``round_trajectory.rounds_to_decision_p99`` and
+    its explicit ``trace_status`` marker flags trace-missing; pre-audit
+    historical rounds are exempt."""
+    audit = {"step_trace": {"collectives": 0, "hot_loop_collectives": 0,
+                            "temp_bytes": 10, "donation_dropped": 0}}
+    base = {"n1M_status": "ramped:256", "tenant_fleet_status": "ramped:8x64",
+            "stream_status": "ramped:12x96", "chaos_status": "ramped:12x12",
+            "mem_status": "computed:cpu", "recovery_status": "skipped-budget",
+            "activity_status": "skipped-budget"}
+    points = {
+        # Pre-audit historical round: exempt (sorts first).
+        "BENCH_r80.json": {"metric": "m", "value": 1.0, "platform": "cpu"},
+        # Audited + a measured trajectory: p99 + worst wave in the column.
+        "BENCH_r81.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, **base,
+                           "trace_status": "measured",
+                           "round_trajectory": {
+                               "rounds_to_decision_p99": 3.0,
+                               "rounds_to_decision_max": 4,
+                           }},
+        # Audited + explicit status marker only (trace=0 bench): status
+        # cell, no flag.
+        "BENCH_r82.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, **base,
+                           "trace_status": "skipped-budget"},
+        # Audited round that silently dropped the trajectory: flagged.
+        "BENCH_r83.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, **base},
+    }
+    paths = []
+    for name, data in points.items():
+        p = tmp_path / name
+        p.write_text(json.dumps(data))
+        paths.append(str(p))
+    assert perfview.main(paths) == 0
+    out = capsys.readouterr().out
+    assert "TRACE" in out.splitlines()[1]  # the trajectory header row
+    lines = {line.split()[0]: line for line in out.splitlines()
+             if line.startswith("BENCH_r8")}
+    assert "p99=3.0r max=4" in lines["BENCH_r81"]
+    assert "trace-missing" not in lines["BENCH_r81"]
+    assert "skipped-budget" in lines["BENCH_r82"]
+    assert "trace-missing" not in lines["BENCH_r82"]
+    assert "trace-missing" in lines["BENCH_r83"]
+    assert "trace-missing" not in lines["BENCH_r80"]  # pre-audit history
+
+
 def test_chrome_trace_envelope(tmp_path, capsys):
     path = _complete_ledger(tmp_path)
     chrome_path = tmp_path / "trace.json"

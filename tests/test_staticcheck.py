@@ -382,6 +382,12 @@ _CORPUS_CHECKERS = {
     "clean_chaosvocab.py": ("rapid_tpu/sim/_corpus.py", "check_chaosvocab"),
     "telemetry_unmarked_fetch.py": ("rapid_tpu/tenancy/_corpus.py", "check_telemetry"),
     "clean_telemetry.py": ("rapid_tpu/tenancy/_corpus.py", "check_telemetry"),
+    # ISSUE 17: the round-trace ring rides the telemetry fetch discipline —
+    # unmarked ring decodes (digest jits or direct spellings over
+    # ``trace_ring`` / ``tr_*``) block like unmarked lane fetches, while
+    # the decoded host-side summaries stay free.
+    "trace_unmarked_fetch.py": ("rapid_tpu/serving/_corpus.py", "check_telemetry"),
+    "clean_trace_fetch.py": ("rapid_tpu/serving/_corpus.py", "check_telemetry"),
 }
 
 

@@ -7,6 +7,7 @@ chain can never taint its neighbors.
 """
 
 import dataclasses
+import json
 
 import pytest
 
@@ -234,6 +235,23 @@ def test_tampered_tenant_shrinks_to_minimal_single_tenant_repro(tmp_path):
     assert recorded  # the repro still fails after collapsing to one tenant
     _result, replayed = chaos.replay_fleet_repro(repro)
     assert sorted(map(str, replayed)) == sorted(recorded)
+
+    # The write-time verify run froze its decoded round-trace ring next to
+    # the verdicts, and a faithful replay never forks round histories (the
+    # chaosrun replay trace instrument, ISSUE 17).
+    written = json.loads((repro / "trace.json").read_text())
+    assert written["rounds_recorded"] > 0
+    diff = chaos.replay_trace_divergence(repro)
+    assert diff is not None
+    assert diff["first_divergent_round"] is None
+    assert diff["written_rounds"] == written["rounds_recorded"]
+    assert diff["replayed_rounds"] == written["rounds_recorded"]
+    # Pre-trace repro dirs (no artifact) skip the instrument silently and
+    # stay replayable on verdicts alone.
+    (repro / "trace.json").unlink()
+    assert chaos.replay_trace_divergence(repro) is None
+    _result2, replayed2 = chaos.replay_fleet_repro(repro)
+    assert sorted(map(str, replayed2)) == sorted(recorded)
 
 
 @pytest.mark.slow

@@ -77,6 +77,11 @@ AUDIT_COHORT_DEVICES = 2
 AUDIT_TENANTS = 4
 AUDIT_FLEET_MESH = (2, 2, 2)
 AUDIT_TENANT_BLOCK = AUDIT_DEVICES // AUDIT_FLEET_MESH[0]
+#: Ring capacity for the round-trace audit entrypoint: small enough that
+#: the ring's argument bytes stay a rounding error next to the state, big
+#: enough that the soak below (QUIESCENT_SOAK_ROUNDS rounds) wraps it —
+#: the cursor fact is measured across a wrap, not just a partial fill.
+AUDIT_TRACE_R = 8
 
 #: Relative tolerance + absolute slack for the temp/codegen memory
 #: comparison: XLA's buffer assignment may legitimately wobble a little
@@ -106,11 +111,12 @@ def _build_registry() -> "Dict[str, Dict[str, Any]]":
     import jax
     import jax.numpy as jnp
 
-    from rapid_tpu.models.state import initial_telemetry
+    from rapid_tpu.models.state import initial_telemetry, initial_trace
     from rapid_tpu.models.virtual_cluster import (
         VirtualCluster,
         engine_step_impl,
         engine_step_telem_impl,
+        engine_step_trace_impl,
         run_to_decision_impl,
         run_until_membership_impl,
         sync_checksum_impl,
@@ -218,6 +224,27 @@ def _build_registry() -> "Dict[str, Dict[str, Any]]":
         ),
         "args": (state, telem, faults),
         "donated_leaves": state_leaves + telem_leaves,
+    }
+    # The round-trace ring step (ISSUE 17): the telemetry geometry with an
+    # AUDIT_TRACE_R-slot TraceRing donated alongside the state and lanes.
+    # Registered so the lock freezes the ring's entire compiled footprint —
+    # its argument bytes, ZERO new hot-loop collectives (ring writes are
+    # slot-local dynamic-update-slices; the digest is a boundary dispatch,
+    # never traced here) and zero host<->device transfer ops. Only the STEP
+    # is registered (the step_telem convention): the fused and fleet trace
+    # variants share the round body, and each extra while-loop compile
+    # costs ~10 s of tier-1 — those paths are differentially driven
+    # against the trace=0 oracle in tests/test_trace_ring.py.
+    cfg_tr = cfg_t._replace(trace=AUDIT_TRACE_R)
+    trace_ring = initial_trace(cfg_tr)
+    trace_leaves = len(jax.tree_util.tree_leaves(trace_ring))
+    registry["step_trace"] = {
+        "jit": jax.jit(
+            lambda s, t, r, f: engine_step_trace_impl(cfg_tr, s, t, r, f),
+            donate_argnums=(0, 1, 2),
+        ),
+        "args": (state, telem, trace_ring, faults),
+        "donated_leaves": state_leaves + telem_leaves + trace_leaves,
     }
     if jax.device_count() >= AUDIT_DEVICES:
         mesh = make_mesh(jax.devices()[:AUDIT_DEVICES])
@@ -485,6 +512,48 @@ def collect_telemetry_facts(force: bool = False) -> Dict[str, int]:
     return _TELEMETRY_FACTS_CACHE
 
 
+_TRACE_FACTS_CACHE: Optional[Dict[str, int]] = None
+
+
+def collect_trace_facts(force: bool = False) -> Dict[str, int]:
+    """The round-trace ring's own lock block, measured live:
+
+    - ``ring_bytes_per_device`` — the TraceRing argument bytes at the audit
+      geometry with ``capacity`` = :data:`AUDIT_TRACE_R` slots;
+    - ``soak_cursor_delta`` — ring cursor minus the telemetry plane's round
+      counter after a :data:`QUIESCENT_SOAK_ROUNDS`-round zero-churn soak
+      (which wraps the AUDIT_TRACE_R-slot ring, so the cursor fact covers
+      rotation too). A healthy recorder reads exactly ZERO here: every
+      round writes exactly one record, wrap or no wrap — a nonzero delta
+      is a miscounting recorder and can never be frozen (``update_hlo_lock``
+      refuses it, like phantom telemetry activity).
+    """
+    global _TRACE_FACTS_CACHE
+    if _TRACE_FACTS_CACHE is not None and not force:
+        return _TRACE_FACTS_CACHE
+
+    from rapid_tpu.models.state import trace_bytes_total
+    from rapid_tpu.models.virtual_cluster import VirtualCluster
+
+    with _scoped_disable_persistent_cache():
+        vc = VirtualCluster.create(
+            AUDIT_N - AUDIT_DEVICES, n_slots=AUDIT_N, k=AUDIT_K, h=3, l=1,
+            fd_threshold=2, cohorts=AUDIT_C, delivery_spread=2, seed=0,
+            telemetry=True, trace=AUDIT_TRACE_R,
+        )
+        vc.assign_cohorts_roundrobin()
+        for _ in range(QUIESCENT_SOAK_ROUNDS):
+            vc.step()
+        rounds = int(vc.activity["rounds"])
+        cursor = int(vc.trace["rounds_recorded"])
+    _TRACE_FACTS_CACHE = {
+        "ring_bytes_per_device": int(trace_bytes_total(vc.cfg)),
+        "capacity": AUDIT_TRACE_R,
+        "soak_cursor_delta": cursor - rounds,
+    }
+    return _TRACE_FACTS_CACHE
+
+
 class _scoped_disable_persistent_cache:
     """SCOPED: turn jax's persistent compilation cache OFF for the audit
     compiles, restoring the previous config after.
@@ -584,12 +653,16 @@ def collect_facts(
 
 
 def facts_to_lock(
-    facts: Dict[str, Any], telemetry: Optional[Dict[str, int]] = None
+    facts: Dict[str, Any],
+    telemetry: Optional[Dict[str, int]] = None,
+    trace: Optional[Dict[str, int]] = None,
 ) -> Dict[str, Any]:
     """The canonical freeze: per-entrypoint collectives/transfers/donation/
     memory, minus the per-row detail (evidence grain, not budget grain).
     ``telemetry`` (from :func:`collect_telemetry_facts`) adds the plane's
-    own block — lane bytes and the zero-churn activity fact."""
+    own block — lane bytes and the zero-churn activity fact; ``trace``
+    (from :func:`collect_trace_facts`) adds the ring block — ring bytes,
+    audit capacity, and the zero cursor-vs-rounds delta."""
     lock: Dict[str, Any] = {
         "audit_config": {
             "n": AUDIT_N, "c": AUDIT_C, "k": AUDIT_K,
@@ -617,6 +690,8 @@ def facts_to_lock(
             ]
     if telemetry is not None:
         lock["telemetry"] = dict(telemetry)
+    if trace is not None:
+        lock["trace"] = dict(trace)
     return lock
 
 
@@ -649,6 +724,37 @@ def compare_telemetry_facts(
             f"telemetry block: quiescent_round_activity must be frozen at "
             f"0, the lock carries "
             f"{locked.get('quiescent_round_activity')!r} — {_REGEN_HINT}",
+        ))
+    return findings
+
+
+def compare_trace_facts(
+    current: Dict[str, int], locked: Dict[str, Any], lock_path: str
+) -> List[Finding]:
+    """Drift report for the lock's ``trace`` block. A nonzero cursor delta
+    after the soak is its own finding (a miscounting recorder — never
+    freezable); ring-byte or capacity drift is ordinary lock drift."""
+    findings: List[Finding] = []
+    if current["soak_cursor_delta"] != 0:
+        findings.append(Finding(
+            lock_path, 1, "hlo-trace-cursor",
+            f"trace ring cursor drifted {current['soak_cursor_delta']} "
+            f"record(s) from the telemetry round counter over the "
+            f"zero-churn soak — every round must write exactly one record; "
+            f"the cursor fact is frozen at zero and cannot be locked in",
+        ))
+    for key in ("ring_bytes_per_device", "capacity"):
+        if locked.get(key) != current[key]:
+            findings.append(Finding(
+                lock_path, 1, "hlo-lock-drift",
+                f"trace block: {key} {locked.get(key)} in the lock, "
+                f"{current[key]} now — {_REGEN_HINT}",
+            ))
+    if locked.get("soak_cursor_delta") != 0:
+        findings.append(Finding(
+            lock_path, 1, "hlo-lock-drift",
+            f"trace block: soak_cursor_delta must be frozen at 0, the lock "
+            f"carries {locked.get('soak_cursor_delta')!r} — {_REGEN_HINT}",
         ))
     return findings
 
@@ -873,6 +979,16 @@ def check_hlo_lock(trees: Sequence[Tuple[ast.AST, str]]) -> List[Finding]:
         findings.extend(compare_telemetry_facts(
             collect_telemetry_facts(), locked["telemetry"], HLO_LOCK_REL
         ))
+    if "trace" not in locked:
+        findings.append(Finding(
+            HLO_LOCK_REL, 1, "hlo-lock-drift",
+            f"HLO lock carries no trace block (ring bytes + the zero "
+            f"cursor-vs-rounds soak fact) — {_REGEN_HINT}",
+        ))
+    else:
+        findings.extend(compare_trace_facts(
+            collect_trace_facts(), locked["trace"], HLO_LOCK_REL
+        ))
     return findings
 
 
@@ -915,12 +1031,60 @@ def compaction_differential_ok() -> Optional[str]:
     return None
 
 
+def trace_differential_ok() -> Optional[str]:
+    """Run the compaction differential's crash+join scenario through the
+    telemetry engine with the trace ring OFF and ON (same geometry/seed)
+    and compare state AND telemetry leaf-for-leaf. Returns None on
+    bit-identity, else a message naming the first divergent lane.
+    ``update_hlo_lock`` refuses while this disagrees: the ring is
+    write-only by construction, so a trace knob that perturbs the engine
+    or its telemetry is a recorder bug that must be fixed, not locked
+    in."""
+    import numpy as np
+
+    from rapid_tpu.models.virtual_cluster import VirtualCluster
+
+    def drive(trace: int) -> VirtualCluster:
+        vc = VirtualCluster.create(
+            56, n_slots=64, k=3, h=3, l=1, cohorts=4, fd_threshold=2,
+            delivery_spread=1, seed=17, telemetry=True, trace=trace,
+        )
+        vc.assign_cohorts_roundrobin()
+        vc.crash([1, 9, 20])
+        vc.inject_join_wave([60, 61])
+        vc.run_until_membership(55, min_cuts=2)
+        return vc
+
+    off, on = drive(0), drive(AUDIT_TRACE_R)
+    for label, a_tree, b_tree in (
+        ("state", off.state, on.state),
+        ("telemetry", off.telem, on.telem),
+    ):
+        for field in a_tree._fields:
+            a = np.asarray(getattr(a_tree, field))
+            b = np.asarray(getattr(b_tree, field))
+            if a.dtype != b.dtype or not (a == b).all():
+                return (
+                    f"trace-on<->trace-off differential disagrees on "
+                    f"{label} lane {field!r} (crash+join scenario at n=64) "
+                    f"— the ring must be write-only; fix the trace layer "
+                    f"before regenerating the lock"
+                )
+    if off.config_id != on.config_id:
+        return (
+            "trace-on<->trace-off differential disagrees on the "
+            "configuration id"
+        )
+    return None
+
+
 def update_hlo_lock() -> Tuple[List[Finding], Optional[Path]]:
     """Regenerate the lockfile from freshly-collected facts. Refuses while
-    an unknown dtype, an unwaived dropped donation, or a wide<->compact
-    state differential disagreement is present — a budget the gate would
-    immediately fail (or a compact layout that no longer matches its
-    oracle) must be fixed, not frozen."""
+    an unknown dtype, an unwaived dropped donation, a wide<->compact state
+    differential disagreement, or a trace-on<->trace-off differential
+    disagreement is present — a budget the gate would immediately fail (or
+    a compact layout / trace ring that no longer matches its oracle) must
+    be fixed, not frozen."""
     try:
         facts = collect_facts()
     except RuntimeError as exc:
@@ -935,6 +1099,9 @@ def update_hlo_lock() -> Tuple[List[Finding], Optional[Path]]:
     mismatch = compaction_differential_ok()
     if mismatch:
         blocking.append(Finding(HLO_LOCK_REL, 1, "hlo-lock-drift", mismatch))
+    mismatch_tr = trace_differential_ok()
+    if mismatch_tr:
+        blocking.append(Finding(HLO_LOCK_REL, 1, "hlo-lock-drift", mismatch_tr))
     telem_facts = collect_telemetry_facts()
     if telem_facts["quiescent_round_activity"] != 0:
         # A zero-churn soak with nonzero activity counters is a telemetry
@@ -944,6 +1111,16 @@ def update_hlo_lock() -> Tuple[List[Finding], Optional[Path]]:
             f"refusing to freeze quiescent_round_activity="
             f"{telem_facts['quiescent_round_activity']} — the zero-churn "
             f"soak must read exactly zero activity",
+        ))
+    trace_facts = collect_trace_facts()
+    if trace_facts["soak_cursor_delta"] != 0:
+        # A ring whose cursor disagrees with the round counter is a
+        # recorder bug, not a fact to freeze.
+        blocking.append(Finding(
+            HLO_LOCK_REL, 1, "hlo-trace-cursor",
+            f"refusing to freeze soak_cursor_delta="
+            f"{trace_facts['soak_cursor_delta']} — every soak round must "
+            f"write exactly one trace record",
         ))
     if blocking:
         return blocking, None
@@ -958,7 +1135,7 @@ def update_hlo_lock() -> Tuple[List[Finding], Optional[Path]]:
             "--update-hlo-lock`; do not edit by hand — any drift from the "
             "live compiled artifacts fails the staticcheck gate."
         ),
-        **facts_to_lock(facts, telemetry=telem_facts),
+        **facts_to_lock(facts, telemetry=telem_facts, trace=trace_facts),
     }
     lock_path.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
     return [], lock_path

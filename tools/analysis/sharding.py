@@ -102,6 +102,7 @@ _PYTREE_TABLES = {
     "FaultInputs": "fault_shardings",
     "TenantKnobs": "knob_shardings",
     "TelemetryLanes": "telemetry_shardings",
+    "TraceRing": "trace_shardings",
 }
 
 _LAX_LOOP_FNS = frozenset({

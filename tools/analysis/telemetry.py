@@ -23,6 +23,14 @@ Two checks:
   geometry table — adding a lane without updating every consumer
   (digest layout, partition rules, exposition vocabulary) fails the
   gate instead of silently dropping the lane from the digest.
+
+The round-trace ring (ISSUE 17, ``TraceRing`` / ``TRACE_LANE_SPECS``)
+rides the same family: its digest fetchers (``trace_digest`` /
+``fleet_trace_digest``) and ``tr_*`` lane references fall under the same
+``telemetry-unmarked-fetch`` marker discipline, and the ring's field set
+gets its own analyzer mirror (``TRACE_LANE_FIELDS``) pinned by the same
+``telemetry-lane-drift`` check — the ring is a refinement of the
+telemetry plane, not a new observability channel with new rules.
 """
 
 from __future__ import annotations
@@ -55,6 +63,24 @@ TELEMETRY_LANE_FIELDS = (
     "tl_undecided_hist",
 )
 
+#: The literal mirror of ``TraceRing``'s fields, in declaration order —
+#: the nine per-round lanes, then the cursor pair. Pinned against both
+#: the NamedTuple and ``TRACE_LANE_SPECS`` exactly like the telemetry
+#: mirror above.
+TRACE_LANE_FIELDS = (
+    "tr_round",
+    "tr_epoch",
+    "tr_active",
+    "tr_alerts",
+    "tr_proposals",
+    "tr_tally",
+    "tr_path",
+    "tr_conflict",
+    "tr_undecided",
+    "tr_cursor",
+    "tr_wraps",
+)
+
 STATE_REL = "rapid_tpu/models/state.py"
 FETCH_MARKER = "telemetry-fetch-ok"
 #: The marker may sit on the call line or this many lines above it (the
@@ -62,7 +88,10 @@ FETCH_MARKER = "telemetry-fetch-ok"
 MARKER_WINDOW = 3
 
 #: The jitted digest entrypoints — calling one IS the device fetch.
-_DIGEST_FETCHERS = frozenset({"telemetry_digest", "fleet_telemetry_digest"})
+_DIGEST_FETCHERS = frozenset({
+    "telemetry_digest", "fleet_telemetry_digest",
+    "trace_digest", "fleet_trace_digest",
+})
 #: Host materializers that become a lane fetch when fed lane references.
 _MATERIALIZERS = frozenset({"asarray", "array", "device_get"})
 
@@ -76,16 +105,22 @@ def _callee_name(func: ast.AST) -> Optional[str]:
 
 
 def _mentions_lanes(node: ast.AST) -> bool:
-    """True if the expression references telemetry lanes: an attribute or
-    name spelled ``telem`` (the lanes pytree by convention) or any
-    ``tl_*`` lane field."""
+    """True if the expression references device telemetry lanes: an
+    attribute or name spelled ``telem`` (the lanes pytree by convention)
+    or ``trace_ring`` (the device ring by convention — bare ``trace`` is
+    deliberately NOT matched: it names decoded host-side summaries), or
+    any ``tl_*`` / ``tr_*`` lane field."""
     for sub in ast.walk(node):
         name = None
         if isinstance(sub, ast.Attribute):
             name = sub.attr
         elif isinstance(sub, ast.Name):
             name = sub.id
-        if name is not None and (name == "telem" or name.startswith("tl_")):
+        if name is not None and (
+            name in ("telem", "trace_ring")
+            or name.startswith("tl_")
+            or name.startswith("tr_")
+        ):
             return True
     return False
 
@@ -105,7 +140,7 @@ def check_telemetry(
     if not any(posix.startswith(p) for p in TELEMETRY_PREFIXES):
         return []
     src = source if source is not None else path.read_text()
-    if FETCH_MARKER not in src and "telem" not in src:
+    if FETCH_MARKER not in src and "telem" not in src and "trace" not in src:
         return []  # cheap bail: nothing lane-shaped in this file
     if tree is None:
         tree = ast.parse(src, filename=str(path))
@@ -149,7 +184,9 @@ def _class_fields(tree: ast.AST, name: str) -> Optional[Tuple[List[str], int]]:
     return None
 
 
-def _spec_keys(tree: ast.AST) -> Optional[Tuple[List[str], int]]:
+def _spec_keys(
+    tree: ast.AST, var_name: str = "TELEMETRY_LANE_SPECS"
+) -> Optional[Tuple[List[str], int]]:
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign) and len(node.targets) == 1:
             target = node.targets[0]
@@ -157,8 +194,7 @@ def _spec_keys(tree: ast.AST) -> Optional[Tuple[List[str], int]]:
             target = node.target
         else:
             continue
-        if not (isinstance(target, ast.Name)
-                and target.id == "TELEMETRY_LANE_SPECS"):
+        if not (isinstance(target, ast.Name) and target.id == var_name):
             continue
         if not isinstance(node.value, ast.Dict):
             return None
@@ -170,49 +206,58 @@ def _spec_keys(tree: ast.AST) -> Optional[Tuple[List[str], int]]:
     return None
 
 
+#: (NamedTuple name, geometry-table name, analyzer mirror) — one row per
+#: device observability plane pinned by ``check_lane_mirror``.
+_LANE_MIRRORS = (
+    ("TelemetryLanes", "TELEMETRY_LANE_SPECS", TELEMETRY_LANE_FIELDS),
+    ("TraceRing", "TRACE_LANE_SPECS", TRACE_LANE_FIELDS),
+)
+
+
 def check_lane_mirror(trees: List[Tuple[ast.AST, str]]) -> List[Finding]:
-    """Full-tree check: pin the analyzer's lane mirror against the live
-    ``TelemetryLanes`` declaration AND the ``TELEMETRY_LANE_SPECS``
-    geometry table. Presence-gated on state.py being in the sweep, so
-    retargeted test trees skip it."""
+    """Full-tree check: pin the analyzer's lane mirrors against the live
+    ``TelemetryLanes`` / ``TraceRing`` declarations AND their
+    ``*_LANE_SPECS`` geometry tables. Presence-gated on state.py being in
+    the sweep, so retargeted test trees skip it."""
     state_tree = next((t for t, rel in trees if rel == STATE_REL), None)
     if state_tree is None:
         return []
     findings: List[Finding] = []
-    mirror = list(TELEMETRY_LANE_FIELDS)
-    got = _class_fields(state_tree, "TelemetryLanes")
-    if got is None:
-        findings.append(Finding(
-            STATE_REL, 1, "telemetry-lane-drift",
-            "TelemetryLanes class not found — the analyzer's lane mirror "
-            "(tools/analysis/telemetry.py TELEMETRY_LANE_FIELDS) has "
-            "nothing to pin against",
-        ))
-        return findings
-    fields, lineno = got
-    if fields != mirror:
-        findings.append(Finding(
-            STATE_REL, lineno, "telemetry-lane-drift",
-            f"TelemetryLanes fields {fields} do not match the analyzer "
-            f"mirror {mirror} — update tools/analysis/telemetry.py AND "
-            f"every lane consumer (digest layout, PARTITION_RULES, "
-            f"exposition vocabulary) together",
-        ))
-    spec = _spec_keys(state_tree)
-    if spec is None:
-        findings.append(Finding(
-            STATE_REL, 1, "telemetry-lane-drift",
-            "TELEMETRY_LANE_SPECS literal dict not found in state.py — "
-            "the lane geometry table must stay a plain literal so the "
-            "gate can read it",
-        ))
-    else:
-        keys, lineno = spec
-        if keys != mirror:
+    for cls_name, spec_name, mirror_fields in _LANE_MIRRORS:
+        mirror = list(mirror_fields)
+        got = _class_fields(state_tree, cls_name)
+        if got is None:
+            findings.append(Finding(
+                STATE_REL, 1, "telemetry-lane-drift",
+                f"{cls_name} class not found — the analyzer's lane mirror "
+                f"(tools/analysis/telemetry.py) has nothing to pin against",
+            ))
+            continue
+        fields, lineno = got
+        if fields != mirror:
             findings.append(Finding(
                 STATE_REL, lineno, "telemetry-lane-drift",
-                f"TELEMETRY_LANE_SPECS keys {keys} do not match the "
-                f"analyzer mirror {mirror} — the geometry table and the "
-                f"NamedTuple must list the same lanes in the same order",
+                f"{cls_name} fields {fields} do not match the analyzer "
+                f"mirror {mirror} — update tools/analysis/telemetry.py AND "
+                f"every lane consumer (digest layout, partition rules, "
+                f"exposition vocabulary) together",
             ))
+        spec = _spec_keys(state_tree, spec_name)
+        if spec is None:
+            findings.append(Finding(
+                STATE_REL, 1, "telemetry-lane-drift",
+                f"{spec_name} literal dict not found in state.py — the "
+                f"lane geometry table must stay a plain literal so the "
+                f"gate can read it",
+            ))
+        else:
+            keys, lineno = spec
+            if keys != mirror:
+                findings.append(Finding(
+                    STATE_REL, lineno, "telemetry-lane-drift",
+                    f"{spec_name} keys {keys} do not match the analyzer "
+                    f"mirror {mirror} — the geometry table and the "
+                    f"NamedTuple must list the same lanes in the same "
+                    f"order",
+                ))
     return findings

@@ -27,7 +27,11 @@ Three subcommands:
             ``kind: "quarantine"`` — the serving supervisor's poisoned-
             tenant artifact, rapid_tpu/serving/recovery.py) reload the
             captured state slice and re-run the deterministic health scan;
-            sim repros replay through the host runner.
+            sim repros replay through the host runner. Fleet repros written
+            with a ``trace.json`` artifact (the verify run's decoded
+            round-trace ring) additionally get a round-granular diff: a
+            divergent replay names the FIRST round where the two engine
+            histories fork, not just that the verdicts changed.
 
 Usage:
 
@@ -191,7 +195,29 @@ def _replay_fleet(args: argparse.Namespace) -> int:
         _result, violations = tchaos.replay_fleet_repro(args.repro)
     for v in violations:
         print(f"VIOLATION {v}")
-    if recorded and sorted(map(str, violations)) != sorted(recorded):
+    diverged = recorded and sorted(map(str, violations)) != sorted(recorded)
+    if recipe.get("kind") != "quarantine":
+        # Round-granular divergence instrument: diff the replayed engine's
+        # decoded trace ring against the write-time trace.json. Pre-trace
+        # repro dirs (no artifact) skip this silently — they stay
+        # replayable on verdicts alone.
+        trace_diff = tchaos.replay_trace_divergence(args.repro)
+        if trace_diff is not None:
+            fork = trace_diff["first_divergent_round"]
+            if fork is None:
+                print(
+                    f"trace: rings agree record-for-record "
+                    f"({trace_diff['replayed_rounds']} round(s) recorded)"
+                )
+            else:
+                print(
+                    f"trace: round histories FORK at round {fork} "
+                    f"(written {trace_diff['written_rounds']} round(s), "
+                    f"replayed {trace_diff['replayed_rounds']})",
+                    file=sys.stderr,
+                )
+                diverged = True
+    if diverged:
         print("chaosrun replay: violations DIVERGED from the recorded repro:",
               file=sys.stderr)
         for line in recorded:

@@ -279,6 +279,89 @@ def render_recovery_pane(snapshots: List[Dict[str, Any]]) -> List[str]:
     return ["", *_render_table(header, rows)]
 
 
+#: Height-coded glyphs for the ROUNDS sparkline, lowest to highest.
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: List[Any], width: int = 16) -> str:
+    """The last ``width`` numeric values as a unicode sparkline, scaled to
+    the window's max (floor 1 so an all-zero window renders flat, not
+    blank). Non-numeric entries (torn snapshots) are dropped; an empty
+    window dashes."""
+    vals = [float(v) for v in values if isinstance(v, (int, float))]
+    if not vals:
+        return "-"
+    vals = vals[-width:]
+    top = max(max(vals), 1.0)
+    hi = len(_SPARK_CHARS) - 1
+    return "".join(
+        _SPARK_CHARS[min(int(v * hi / top + 0.5), hi)] for v in vals
+    )
+
+
+def _trace_rows(snapshot: Dict[str, Any]) -> List[Tuple[str, Dict[str, Any]]]:
+    """(row label, decoded trace summary) pairs of one snapshot: the
+    single-cluster ``engine.trace`` section under the node's own label,
+    fleet ``engine.tenant_trace`` entries as ``node/t<i>`` lanes. Sections
+    of the wrong shape (torn mid-rewrite) contribute nothing."""
+    engine = snapshot.get("engine")
+    if not isinstance(engine, dict):
+        return []
+    node = str(snapshot.get("node", "?"))
+    out: List[Tuple[str, Dict[str, Any]]] = []
+    trace = engine.get("trace")
+    if isinstance(trace, dict):
+        out.append((node, trace))
+    tenant_trace = engine.get("tenant_trace")
+    if isinstance(tenant_trace, list):
+        out.extend(
+            (f"{node}/t{i}", t)
+            for i, t in enumerate(tenant_trace)
+            if isinstance(t, dict)
+        )
+    return out
+
+
+def render_rounds_pane(snapshots: List[Dict[str, Any]]) -> List[str]:
+    """The device round-trace rows: one line per decoded ring (a
+    ``VirtualCluster`` with ``trace=R``, or one per tenant of a traced
+    fleet) — recorded/held round counts, wrap and decision tallies, the
+    last recorded round's stamp and decision path, and a sparkline of the
+    ``rounds_undecided`` trajectory across the held window (the climb to
+    each decision, as the compiled engine recorded it). Pre-trace
+    snapshots (no ``trace`` / ``tenant_trace`` section) and torn records
+    contribute nothing / dashes, never a crash."""
+    from rapid_tpu.utils.engine_telemetry import TRACE_PATH_NAMES
+
+    pairs = [pair for s in snapshots for pair in _trace_rows(s)]
+    if not pairs:
+        return []
+    header = (
+        "ROUNDS", "RECORDED", "HELD", "WRAPS", "DECIDED", "CONFLICT",
+        "LASTROUND", "LASTPATH", "UNDECIDED",
+    )
+    rows: List[Tuple[str, ...]] = []
+    for label, trace in sorted(pairs, key=lambda p: p[0]):
+        records = trace.get("records")
+        records = records if isinstance(records, list) else []
+        undecided = [
+            r.get("undecided") for r in records if isinstance(r, dict)
+        ]
+        path = trace.get("last_path")
+        rows.append((
+            label,
+            _fmt_opt(trace.get("rounds_recorded"), ".0f"),
+            _fmt_opt(trace.get("rounds_held"), ".0f"),
+            _fmt_opt(trace.get("wraps"), ".0f"),
+            _fmt_opt(trace.get("decisions_held"), ".0f"),
+            _fmt_opt(trace.get("conflicts_held"), ".0f"),
+            _fmt_opt(trace.get("last_round"), ".0f"),
+            TRACE_PATH_NAMES.get(path, "-") if isinstance(path, int) else "-",
+            _sparkline(undecided),
+        ))
+    return ["", *_render_table(header, rows)]
+
+
 def render_frame(
     snapshots: List[Dict[str, Any]], errors: Optional[List[str]] = None
 ) -> str:
@@ -343,6 +426,7 @@ def render_frame(
         ))
     lines.extend(_render_table(header, rows))
     lines.extend(render_engine_pane(snapshots))
+    lines.extend(render_rounds_pane(snapshots))
     lines.extend(render_stream_pane(snapshots))
     lines.extend(render_recovery_pane(snapshots))
     for error in errors or ():

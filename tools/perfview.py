@@ -380,6 +380,20 @@ def point_flags(
         and not data.get("activity_status")
     ):
         flags.append("activity-missing")
+    # Trace discipline (ISSUE 17): same rule for the round-trace ring — an
+    # audited round must carry the round_trajectory digest's
+    # rounds-to-decision p99 or its explicit trace_status marker. The ring
+    # is zero-minted at attach, so absence is instrumentation loss, never
+    # "nothing decided". Pre-audit historical rounds are exempt.
+    trajectory = data.get("round_trajectory") or {}
+    if (
+        hlo_audit_table(data) is not None
+        and not isinstance(
+            trajectory.get("rounds_to_decision_p99"), (int, float)
+        )
+        and not data.get("trace_status")
+    ):
+        flags.append("trace-missing")
     if hlo_drift(prev, hlo_audit_table(data)):
         flags.append("hlo-drift")
     if not flags:
@@ -503,10 +517,27 @@ def activity_cell(data: Dict[str, Any]) -> str:
     return str(status) if status else "-"
 
 
+def trace_cell(data: Dict[str, Any]) -> str:
+    """The TRACE column: the round-trajectory digest's rounds-to-decision
+    p99 (with the worst wave beside it when present), else the explicit
+    trace_status marker, else '-' (pre-trace rounds)."""
+    trajectory = data.get("round_trajectory") or {}
+    value = trajectory.get("rounds_to_decision_p99")
+    if isinstance(value, (int, float)):
+        worst = trajectory.get("rounds_to_decision_max")
+        suffix = (
+            f" max={int(worst)}" if isinstance(worst, (int, float)) else ""
+        )
+        return f"p99={float(value):.1f}r{suffix}"
+    status = data.get("trace_status")
+    return str(status) if status else "-"
+
+
 def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
     lines = ["== perf trajectory =="]
     header = ("ROUND", "METRIC", "VALUE", "N1M", "FLEET", "STREAM", "CHAOS",
-              "MEM", "RECOVERY", "ACTIVITY", "PLATFORM", "VSBASE", "FLAGS")
+              "MEM", "RECOVERY", "ACTIVITY", "TRACE", "PLATFORM", "VSBASE",
+              "FLAGS")
     rows: List[Tuple[str, ...]] = []
     flag_rows: List[Tuple[str, List[str]]] = []
     prev_audit: Optional[Dict[str, Any]] = None
@@ -528,6 +559,7 @@ def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
             mem_cell(data),
             recovery_cell(data),
             activity_cell(data),
+            trace_cell(data),
             str(data.get("platform", "-")),
             "-" if vs is None else f"{float(vs):.2f}x"
             + ("@capture" if "vs_baseline_at_capture" in data else ""),

@@ -23,7 +23,11 @@ A chaos-scenario artifact directory (what ``tools/chaosrun.py`` and
 merged as usual and the fault-injection events (partition start/heal,
 crash, restart, clock faults) are woven into the timeline as a synthetic
 ``(chaos)`` lane, so a repro reads end-to-end — injection, detection,
-agreement, delivery.
+agreement, delivery. A directory carrying a ``trace.json`` (the decoded
+device round-trace ring that ``tenancy/chaos.write_fleet_repro`` freezes)
+additionally gets a synthetic ``(engine)`` lane: every recorded engine
+round, its conflicts and its decisions, merged into the same timeline —
+the compiled engine's own flight recording next to the host's.
 
 Usage:
 
@@ -138,6 +142,38 @@ def fault_snapshot(faultlog_path) -> Optional[Dict[str, Any]]:
     return {"node": FAULT_LANE, "events": events}
 
 
+#: Synthetic node name for the device round-trace ring: the compiled
+#: engine's lane in the merged timeline, next to hosts and ``(chaos)``.
+ENGINE_LANE = "(engine)"
+
+
+def engine_trace_snapshot(trace_path) -> Optional[Dict[str, Any]]:
+    """The decoded device round-trace ring of a repro directory
+    (``trace.json``, frozen by ``tenancy/chaos.write_fleet_repro``) as a
+    recorder-style snapshot for the synthetic :data:`ENGINE_LANE` node —
+    ``engine_telemetry.trace_recorder_snapshot`` turns each held round into
+    registered ``engine_round`` / ``engine_conflict`` / ``engine_decision``
+    events, so :func:`merge_events` weaves device rounds into the timeline
+    like any other recording. A missing file returns None — pre-trace
+    repro directories merge exactly as before."""
+    path = Path(trace_path)
+    if not path.exists():
+        return None
+    try:
+        summary = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError) as exc:
+        raise SnapshotLoadError(
+            f"{path}: cannot read trace ring: {exc}"
+        ) from exc
+    if not isinstance(summary, dict) or "records" not in summary:
+        raise SnapshotLoadError(
+            f"{path}: not a decoded trace ring (no records section)"
+        )
+    from rapid_tpu.utils.engine_telemetry import trace_recorder_snapshot
+
+    return trace_recorder_snapshot(summary, node=ENGINE_LANE)
+
+
 def expand_scenario_dir(path: str) -> Tuple[List[str], Optional[Path]]:
     """A scenario artifact directory expands to its per-node snapshots plus
     its fault log: ``nodes/*.json`` when the ``write_repro`` layout is
@@ -148,7 +184,8 @@ def expand_scenario_dir(path: str) -> Tuple[List[str], Optional[Path]]:
     if nodes_dir.is_dir():
         snapshots = sorted(str(p) for p in nodes_dir.glob("*.json"))
     else:
-        skip = {"schedule.json", "result.json", "faultlog.json"}
+        skip = {"schedule.json", "result.json", "faultlog.json",
+                "fleet.json", "trace.json"}
         snapshots = sorted(
             str(p) for p in root.glob("*.json") if p.name not in skip
         )
@@ -167,6 +204,9 @@ def scenario_snapshots(path) -> List[Dict[str, Any]]:
         lane = fault_snapshot(faultlog)
         if lane is not None:
             snapshots.append(lane)
+    engine_lane = engine_trace_snapshot(Path(path) / "trace.json")
+    if engine_lane is not None:
+        snapshots.append(engine_lane)
     return snapshots
 
 
