@@ -334,6 +334,62 @@ def hlo_audit_summary() -> dict:
     return summary
 
 
+def cost_report() -> dict:
+    """Scaling-law cost axis of the trajectory (ISSUE 18), never silently
+    absent: the zero-churn round's ``quiescent_round_cost`` (rides the
+    session's ``collect_facts`` compiles the hlo_audit stage already paid;
+    ROADMAP item 3's sparse restructure must shrink it round over round)
+    and the fitted per-entrypoint scaling classes from the geometry
+    ladder. The ladder costs real compile seconds, so
+    ``RAPID_TPU_BENCH_COST_LADDER=0`` suppresses it EXPLICITLY for smoke
+    runs — every suppressed or unavailable branch yields a named status,
+    exactly like the headline/fleet plans."""
+    tools_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    if tools_dir not in sys.path:
+        sys.path.append(tools_dir)
+    try:
+        from analysis import cost_model
+    except Exception as exc:  # noqa: BLE001 — strictly observational
+        reason = {"status": f"unavailable: {exc}"}
+        return {"quiescent_round_cost": reason, "cost_fit": dict(reason)}
+    try:
+        quiescent = cost_model.collect_quiescent_cost(require_mesh=False)
+    except Exception as exc:  # noqa: BLE001 — strictly observational
+        quiescent = None
+        quiescent_status = f"unavailable: {exc}"
+    else:
+        quiescent_status = (
+            "unavailable: no sharded step in this collection "
+            "(needs the 8-device mesh)"
+        )
+    out = {
+        "quiescent_round_cost": (
+            quiescent if quiescent is not None
+            else {"status": quiescent_status}
+        ),
+    }
+    if not _env_int("RAPID_TPU_BENCH_COST_LADDER", 1):
+        out["cost_fit"] = {
+            "status": "suppressed:RAPID_TPU_BENCH_COST_LADDER=0"
+        }
+        return out
+    try:
+        table = cost_model.collect_ladder(require_mesh=False)
+        fits, refusals = cost_model.fit_ladder(table)
+    except Exception as exc:  # noqa: BLE001 — strictly observational
+        out["cost_fit"] = {"status": f"unavailable: {exc}"}
+        return out
+    out["cost_fit"] = {
+        name: {fact: fit["class"] for fact, fit in sorted(per.items())}
+        for name, per in sorted(fits.items())
+    }
+    if refusals:
+        out["cost_fit_refused"] = [
+            f"{name}/{fact}: {why}" for name, fact, why in refusals
+        ]
+    return out
+
+
 # ---------------------------------------------------------------------------
 # The workload (runs inside the watchdogged child, or inline on CPU).
 # ---------------------------------------------------------------------------
@@ -1522,6 +1578,17 @@ def run_workload(ledger, profile_dir=None) -> None:
             f"({mem_fields['mem_status']}); 100M sizing "
             f"{mem_fields['mem_sizing']['100M']['compact_gb']:.0f} GB"
         )
+        # Scaling-law cost axis (ISSUE 18): quiescent round cost +
+        # fitted classes, riding the same stage (and its compiles).
+        with _heartbeat("cost ladder compile"):
+            cost_fields = cost_report()
+        fit = cost_fields["cost_fit"]
+        _mark(
+            "cost fit: " + (
+                fit["status"] if "status" in fit
+                else f"{len(fit)} entrypoints classified"
+            )
+        )
 
     # Opt-in jax.profiler capture (--profile DIR): one extra resolved churn
     # under utils/profiling.trace, as its own budgeted stage — TensorBoard/
@@ -1673,6 +1740,11 @@ def run_workload(ledger, profile_dir=None) -> None:
         # 100k->100M deployment sizing, and the never-silently-absent
         # mem_status — perfview renders the MEM column from these.
         **mem_fields,
+        # Scaling-law cost axis (ISSUE 18): the zero-churn round's frozen
+        # per-round cost + fitted per-entrypoint scaling classes (or the
+        # named suppressed/unavailable status) — perfview renders the
+        # COSTFIT column from these.
+        **cost_fields,
         # Engine-tier provenance for the trajectory: how much compile time
         # this run paid and whether the persistent cache carried it.
         "compiles": engine_compiles["compiles"],

@@ -145,10 +145,12 @@ class CompactionPolicy(NamedTuple):
     Lane kinds:
 
     - ``idx``     — ring/topology index tables and cp rank indices, values
-                    in [-1, n-1]: int8 below 129 slots, int16 below 32769.
-    - ``cohort``  — receiver-cohort indices, values in [-1, c-1]: int8
-                    below 128 cohorts (c is capped at 1024 -> never wider
-                    than int16).
+                    in [-1, n-1] plus the count n itself (jax index
+                    normalization): int8 below 128 slots, int16 below
+                    32768.
+    - ``cohort``  — receiver-cohort indices, values in [-1, c-1] plus c:
+                    int8 below 128 cohorts (c is capped at 1024 -> never
+                    wider than int16).
     - ``counter`` — fd_count / classic-Paxos rank rounds / classic_epoch /
                     rounds_undecided: int16 (envelope: < 2^15 - 1 events
                     per configuration; every view change resets them).
@@ -191,10 +193,14 @@ NARROWABLE_LANES = frozenset({
 
 
 def min_index_dtype(n: int) -> str:
-    """Smallest signed dtype holding indices in [-1, n-1]."""
-    if n <= 1 << 7:
+    """Smallest signed dtype holding indices in [-1, n-1] AND the count
+    ``n`` itself: jax's advanced indexing materializes the axis size in
+    the index dtype when normalizing negative indices, so a dtype whose
+    max is exactly ``n - 1`` overflows at trace time (n=128 under int8
+    was the scaling-ladder-found boundary bug)."""
+    if n < 1 << 7:
         return "int8"
-    if n <= 1 << 15:
+    if n < 1 << 15:
         return "int16"
     return "int32"
 

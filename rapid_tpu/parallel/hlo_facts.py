@@ -84,6 +84,61 @@ def shape_bytes(shape_str: str, unknown: Optional[List[str]] = None) -> int:
     return (total_bits + 7) // 8
 
 
+def shape_operand_bytes(
+    shape_str: str, unknown: Optional[List[str]] = None
+) -> List[int]:
+    """Per-operand payload bytes of a (possibly tuple) shape string.
+
+    A variadic all-reduce carries a tuple shape — ``(u32[64]{0},
+    f32[64]{0})`` — and :func:`shape_bytes` prices the whole tuple as one
+    sum. This returns one entry per array leaf instead, so callers can
+    account BOTH the total payload (sum) and the largest single operand:
+    the scaling-class fit must see totals (multi-operand fusion cannot
+    hide payload growth inside a tuple) while per-operand sizes keep the
+    largest-single-payload classing honest. Unknown dtypes follow the
+    :func:`shape_bytes` contract: appended to ``unknown`` when a list is
+    passed (the operand is skipped), else ``ValueError``."""
+    out: List[int] = []
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        bits = DTYPE_BITS.get(dtype)
+        if bits is None:
+            if unknown is None:
+                raise ValueError(f"unknown HLO dtype {dtype!r} in {shape_str!r}")
+            unknown.append(dtype)
+            continue
+        elems = 1
+        for d in dims.split(","):
+            if d:
+                elems *= int(d)
+        out.append((elems * bits + 7) // 8)
+    return out
+
+
+def compiled_cost_analysis(compiled) -> Optional[Dict[str, float]]:
+    """Normalized ``compiled.cost_analysis()``: ``{"flops", "bytes_accessed"}``
+    floats, or None when the backend exposes neither (never guessed).
+
+    jax versions disagree on the return shape (a dict, or a one-element
+    list of dicts per partition) and backends disagree on which keys they
+    populate; this folds both to one optional dict keyed by our fact
+    names. Duck-typed on the compiled object — no jax import, keeping this
+    module stdlib-only."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — backends without a cost model raise backend-specific types; absent pricing is the documented None contract, not a wedge
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out: Dict[str, float] = {}
+    for key, fact in (("flops", "flops"), ("bytes accessed", "bytes_accessed")):
+        value = ca.get(key)
+        if isinstance(value, (int, float)) and value == value and value >= 0:
+            out[fact] = float(value)
+    return out or None
+
+
 def entry_parameter_bytes(
     compiled_text: str, unknown: Optional[List[str]] = None
 ) -> Dict[str, int]:
@@ -203,11 +258,18 @@ def audit_collectives(compiled_text: str, n: int, c: int) -> List[Dict]:
         op_name_m = re.search(r'op_name="([^"]*)"', line)
         op_name = op_name_m.group(1) if op_name_m else ""
         unknown: List[str] = []
-        payload = shape_bytes(shape, unknown=unknown)
+        operand_bytes = shape_operand_bytes(shape, unknown=unknown)
+        payload = sum(operand_bytes)
         rows.append({
             "kind": kind,
             "shape": shape.split("{")[0],
+            # "bytes" is the TOTAL payload (sum over tuple operands) —
+            # the fact the ladder fit consumes; "largest_operand_bytes"
+            # prices the biggest single array so a variadic fusion can
+            # neither hide growth in the sum nor in one operand.
             "bytes": payload,
+            "operand_bytes": operand_bytes,
+            "largest_operand_bytes": max(operand_bytes, default=0),
             "location": classify_location(op_name),
             "source": source_of(op_name),
             "cn_scale": payload >= c * n,

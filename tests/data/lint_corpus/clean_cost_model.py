@@ -1,0 +1,56 @@
+"""Lint corpus (clean): compiled cost that matches its frozen classes.
+
+The linear twin of ``cost_scaling_regression.py``: every operand is a
+per-slot [n] lane, so argument bytes and FLOPs both fit O(N) with zero
+residual, exactly what the inline ``COST_LOCK`` claims — the
+``cost_model`` family must stay silent. ``scalar_probe`` pins the O(1)
+floor: a geometry-independent scalar program whose every audited fact is
+constant across the ladder.
+"""
+
+import jax
+import jax.numpy as jnp
+
+COST_LADDER = (8, 16, 32, 64)
+AUDIT_C = 1
+
+
+def _linear_probe(n):
+    return {
+        "jit": jax.jit(lambda x, y: x * 2.0 + y),
+        "args": (
+            jnp.ones((n,), jnp.float32),
+            jnp.ones((n,), jnp.float32),
+        ),
+        "donated_leaves": 0,
+    }
+
+
+def _scalar_probe(n):
+    del n  # geometry-independent by construction
+    return {
+        "jit": jax.jit(lambda x: x * 3.0),
+        "args": (jnp.float32(1.0),),
+        "donated_leaves": 0,
+    }
+
+
+COST_AUDIT_PROGRAMS = {
+    "linear_probe": _linear_probe,
+    "scalar_probe": _scalar_probe,
+}
+
+COST_LOCK = {
+    "linear_probe": {
+        "facts": {
+            "argument_bytes": {"class": "O(N)"},
+            "flops": {"class": "O(N)"},
+        },
+    },
+    "scalar_probe": {
+        "facts": {
+            "argument_bytes": {"class": "O(1)"},
+            "flops": {"class": "O(1)"},
+        },
+    },
+}

@@ -1,0 +1,76 @@
+"""Lint corpus: compiled cost that grew past its frozen scaling class.
+
+Three miniature programs the ``cost_model`` family must fail, each a
+distinct drift mode. ``quadratic_probe`` feeds an [n, n] operand to a
+matvec so its argument bytes fit O(N^2) exactly while the inline
+``COST_LOCK`` claims O(N) — a scaling REGRESSION by name (its ceiling is
+raised to O(N^2) so only the regression fires). ``runaway_probe`` locks
+the honest O(N^2) class but keeps the default O(N*K) ceiling, so the fit
+agrees with the lock and the CEILING still refuses it. ``stepped_probe``
+widens its dtype halfway up the ladder — a policy step function, not a
+scaling law — and the fitter must refuse to classify it rather than
+guess.
+"""
+
+import jax
+import jax.numpy as jnp
+
+COST_LADDER = (8, 16, 32, 64)
+AUDIT_C = 1
+
+
+def _quadratic_probe(n):
+    # THE defect: the per-round operand is a full [n, n] matrix, so the
+    # compiled signature grows quadratically with cluster size.
+    return {
+        "jit": jax.jit(lambda m, v: m @ v),
+        "args": (
+            jnp.ones((n, n), jnp.float32),
+            jnp.ones((n,), jnp.float32),
+        ),
+        "donated_leaves": 0,
+    }
+
+
+def _runaway_probe(n):
+    return {
+        "jit": jax.jit(lambda m: m.sum(axis=1)),
+        "args": (jnp.ones((n, n), jnp.float32),),
+        "donated_leaves": 0,
+    }
+
+
+def _stepped_probe(n):
+    # Bytes-per-element is a step function of n (the dtype widens at 32),
+    # so no scaling class explains the series — the fit must REFUSE.
+    dtype = jnp.int8 if n < 32 else jnp.int16
+    return {
+        "jit": jax.jit(lambda x: x + jnp.ones((), x.dtype)),
+        "args": (jnp.zeros((n,), dtype),),
+        "donated_leaves": 0,
+    }
+
+
+COST_AUDIT_PROGRAMS = {
+    "quadratic_probe": _quadratic_probe,  # expect: cost-scaling-regression
+    "runaway_probe": _runaway_probe,  # expect: cost-superlinear
+    "stepped_probe": _stepped_probe,  # expect: cost-unexplained
+}
+
+#: What these programs CLAIM. ``quadratic_probe`` claims linear argument
+#: growth under a quadratic ceiling; ``runaway_probe`` admits the
+#: quadratic class but inherits the default O(N*K) ceiling; the stepped
+#: probe's claimed class is irrelevant — the refusal fires first.
+COST_LOCK = {
+    "quadratic_probe": {
+        "ceiling": "O(N^2)",
+        "facts": {"argument_bytes": {"class": "O(N)"}},
+    },
+    "runaway_probe": {
+        "facts": {"argument_bytes": {"class": "O(N^2)"}},
+    },
+    "stepped_probe": {
+        "ceiling": "O(N^2)",
+        "facts": {"argument_bytes": {"class": "O(N)"}},
+    },
+}

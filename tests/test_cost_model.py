@@ -1,0 +1,316 @@
+"""Unit gate for the scaling-law cost model (tools/analysis/cost_model).
+
+The fitter must put synthetic series in the right class, REFUSE noisy or
+under-determined ladders rather than guess, and the lock machinery must
+round-trip byte-identically, block superlinear freezes by name, and keep
+the committed ``cost.lock.json`` consistent with the live registry. The
+expensive real-ladder compiles are exercised by the whole-tree sweep in
+``test_lint.py`` — everything here runs on synthetic tables so the unit
+tier stays cheap.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+import pytest  # noqa: E402
+
+import staticcheck  # noqa: E402
+from analysis import cost_model, device_program  # noqa: E402
+
+
+def _n_points(values, k=4):
+    """[(n, value), ...] -> the ((n, k), value) shape fit_scaling takes."""
+    return [((n, k), v) for n, v in values]
+
+
+# ---------------------------------------------------------------------------
+# The fitter: synthetic series land in the right class
+# ---------------------------------------------------------------------------
+
+
+def test_fit_constant_series_is_o1():
+    fit = cost_model.fit_scaling(
+        _n_points([(64, 10.0), (128, 10.0), (256, 10.0), (512, 10.0)]), 0.02
+    )
+    assert fit["class"] == "O(1)" and fit["coeff"] == pytest.approx(10.0)
+
+
+def test_fit_all_zero_series_is_o1_with_zero_coeff():
+    fit = cost_model.fit_scaling(
+        _n_points([(64, 0.0), (128, 0.0), (256, 0.0), (512, 0.0)]), 0.02
+    )
+    assert fit["class"] == "O(1)" and fit["coeff"] == 0.0
+    assert fit["residual"] == 0.0
+
+
+def test_fit_logarithmic_series_is_olog():
+    fit = cost_model.fit_scaling(
+        _n_points([(64, 12.0), (128, 14.0), (256, 16.0), (512, 18.0)]), 0.02
+    )
+    assert fit["class"] == "O(log N)"
+    assert fit["coeff"] == pytest.approx(2.0)
+
+
+def test_fit_affine_series_is_on_not_olog():
+    fit = cost_model.fit_scaling(
+        _n_points([(64, 300.0), (128, 492.0), (256, 876.0), (512, 1644.0)]),
+        0.02,
+    )
+    assert fit["class"] == "O(N)" and fit["coeff"] == pytest.approx(3.0)
+
+
+def test_fit_nk_mixture_needs_the_k_axis():
+    # The real step signature: 108 + 253*N + 38*N*K. With K varying the
+    # mixture is identified exactly; collapsed to one K it must fall back
+    # to O(N) (classifying O(N*K) off an N-only ladder would be a guess).
+    mix = lambda n, k: 108.0 + 253.0 * n + 38.0 * n * k  # noqa: E731
+    varied = [((n, 4), mix(n, 4)) for n in (64, 128, 256, 512)]
+    varied += [((256, k), mix(256, k)) for k in (2, 8)]
+    fit = cost_model.fit_scaling(varied, 0.02)
+    assert fit["class"] == "O(N*K)" and fit["coeff"] == pytest.approx(38.0)
+
+    fixed_k = cost_model.fit_scaling(
+        [((n, 4), mix(n, 4)) for n in (64, 128, 256, 512)], 0.02
+    )
+    assert fixed_k["class"] == "O(N)"
+
+
+def test_fit_quadratic_series_is_on2():
+    fit = cost_model.fit_scaling(
+        _n_points([(8, 32.0), (16, 128.0), (32, 512.0), (64, 2048.0)]), 0.02
+    )
+    assert fit["class"] == "O(N^2)"
+    assert fit["coeff"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# Refusals: never guess
+# ---------------------------------------------------------------------------
+
+
+def test_fit_refuses_short_ladder():
+    fit = cost_model.fit_scaling(_n_points([(64, 1.0), (512, 8.0)]), 0.02)
+    assert "error" in fit and "ladder" in fit["error"]
+
+
+def test_fit_refuses_noisy_series_instead_of_guessing():
+    fit = cost_model.fit_scaling(
+        _n_points([(64, 100.0), (128, 900.0), (256, 150.0), (512, 4000.0)]),
+        0.02,
+    )
+    assert "error" in fit
+    assert "residual" in fit["error"]
+
+
+def test_fit_refuses_dtype_step_series():
+    # Bytes-per-element doubling halfway up the ladder is a policy step
+    # function, not a scaling law — exactly the compact-layout lesson the
+    # real ladder hit (min_index_dtype widens at n=128).
+    fit = cost_model.fit_scaling(
+        _n_points([(8, 8.0), (16, 16.0), (32, 64.0), (64, 128.0)]), 0.02
+    )
+    assert "error" in fit
+
+
+def test_fit_refuses_exactly_determined_quadratic():
+    # 3 points cannot justify the 3-basis O(N^2) model (points must
+    # strictly exceed bases) — an exactly-determined system fits anything.
+    fit = cost_model.fit_scaling(
+        _n_points([(8, 70.0), (16, 270.0), (32, 1060.0)]), 0.02
+    )
+    assert "error" in fit
+
+
+# ---------------------------------------------------------------------------
+# Lock construction, refusal gates, byte-identical regen
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_table(arg_values):
+    """A one-entrypoint collect_ladder() table with the given
+    argument_bytes series (and a constant transfer_ops fact)."""
+    return {
+        "step": [
+            {
+                "key": f"n{n}_k4",
+                "n_eff": n,
+                "k": 4,
+                "facts": {"argument_bytes": v, "transfer_ops": 0.0},
+            }
+            for n, v in arg_values
+        ]
+    }
+
+
+_LINEAR = [(64, 16300.0), (128, 32492.0), (256, 64876.0), (512, 129644.0)]
+_QUADRATIC = [(64, 16384.0), (128, 65536.0), (256, 262144.0),
+              (512, 1048576.0)]
+
+
+def _patch_collectors(monkeypatch, table, tmp_path):
+    monkeypatch.setattr(cost_model, "collect_ladder",
+                        lambda *a, **kw: table)
+    monkeypatch.setattr(
+        cost_model, "collect_quiescent_cost",
+        lambda *a, **kw: {
+            "entrypoint": "sharded_step",
+            "collective_payload_bytes": 53218,
+            "hot_loop_payload_bytes": 0,
+            "flops": 161789.0,
+        },
+    )
+    monkeypatch.setattr(device_program, "compaction_differential_ok",
+                        lambda: None)
+    monkeypatch.setattr(device_program, "trace_differential_ok",
+                        lambda: None)
+    target = tmp_path / "cost.lock.json"
+    monkeypatch.setattr(cost_model, "COST_LOCK_REL", str(target))
+    return target
+
+
+def test_update_cost_lock_round_trips_byte_identical(monkeypatch, tmp_path):
+    target = _patch_collectors(
+        monkeypatch, _synthetic_table(_LINEAR), tmp_path
+    )
+    findings, path = cost_model.update_cost_lock()
+    assert findings == [] and path == target
+    first = target.read_bytes()
+    locked = json.loads(first)
+    assert locked["entrypoints"]["step"]["facts"]["argument_bytes"][
+        "class"] == "O(N)"
+    assert locked["quiescent_round_cost"]["collective_payload_bytes"] == 53218
+
+    findings, _path = cost_model.update_cost_lock()
+    assert findings == []
+    assert target.read_bytes() == first
+
+    # ... and the gate sweeps clean against what the generator just wrote.
+    fits, refusals = cost_model.fit_ladder(_synthetic_table(_LINEAR))
+    assert refusals == []
+    drift = cost_model.compare_cost_lock(
+        fits, cost_model.collect_quiescent_cost(), locked, str(target)
+    )
+    assert drift == [], drift
+
+
+def test_update_cost_lock_refuses_superlinear_by_name(monkeypatch, tmp_path):
+    target = _patch_collectors(
+        monkeypatch, _synthetic_table(_QUADRATIC), tmp_path
+    )
+    findings, path = cost_model.update_cost_lock()
+    assert path is None and not target.exists()
+    checks = [f.check for f in findings]
+    assert checks == ["cost-superlinear"]
+    message = findings[0].message
+    assert "step" in message and "argument_bytes" in message
+    assert "O(N^2)" in message and "O(N*K)" in message
+
+
+def test_update_cost_lock_refuses_unexplained(monkeypatch, tmp_path):
+    stepped = [(8, 8.0), (16, 16.0), (32, 64.0), (64, 128.0)]
+    target = _patch_collectors(
+        monkeypatch, _synthetic_table(stepped), tmp_path
+    )
+    findings, path = cost_model.update_cost_lock()
+    assert path is None and not target.exists()
+    assert [f.check for f in findings] == ["cost-unexplained"]
+    assert "step" in findings[0].message
+    assert "argument_bytes" in findings[0].message
+
+
+def test_injected_regression_fails_gate_with_old_and_new_class(
+    monkeypatch, tmp_path
+):
+    # Freeze the linear world, then swap in a quadratic artifact under a
+    # raised ceiling: the drift report must name the entrypoint, the fact,
+    # and both classes.
+    target = _patch_collectors(
+        monkeypatch, _synthetic_table(_LINEAR), tmp_path
+    )
+    _findings, _path = cost_model.update_cost_lock()
+    locked = json.loads(target.read_text())
+
+    monkeypatch.setitem(cost_model.COST_CEILINGS, "step", "O(N^2)")
+    fits, refusals = cost_model.fit_ladder(_synthetic_table(_QUADRATIC))
+    assert refusals == []
+    findings = cost_model.compare_cost_lock(
+        fits, cost_model.collect_quiescent_cost(), locked, str(target)
+    )
+    regressions = [f for f in findings
+                   if f.check == "cost-scaling-regression"]
+    assert len(regressions) == 1
+    message = regressions[0].message
+    assert "step" in message and "argument_bytes" in message
+    assert "O(N)" in message and "O(N^2)" in message and "WORSENED" in message
+
+
+def test_quiescent_drift_is_named(monkeypatch, tmp_path):
+    target = _patch_collectors(
+        monkeypatch, _synthetic_table(_LINEAR), tmp_path
+    )
+    _findings, _path = cost_model.update_cost_lock()
+    locked = json.loads(target.read_text())
+
+    findings = cost_model.compare_quiescent(
+        dict(locked["quiescent_round_cost"], collective_payload_bytes=99999),
+        locked["quiescent_round_cost"], str(target),
+    )
+    assert [f.check for f in findings] == ["cost-quiescent"]
+    assert "collective_payload_bytes" in findings[0].message
+
+    # FLOPs wobble within 10% is tolerated; beyond it is drift.
+    near = dict(locked["quiescent_round_cost"],
+                flops=locked["quiescent_round_cost"]["flops"] * 1.05)
+    assert cost_model.compare_quiescent(
+        near, locked["quiescent_round_cost"], str(target)) == []
+    far = dict(locked["quiescent_round_cost"],
+               flops=locked["quiescent_round_cost"]["flops"] * 1.5)
+    drifted = cost_model.compare_quiescent(
+        far, locked["quiescent_round_cost"], str(target))
+    assert [f.check for f in drifted] == ["cost-quiescent"]
+
+
+# ---------------------------------------------------------------------------
+# The committed lock: acceptance-criteria pins (no compiles — pure reads)
+# ---------------------------------------------------------------------------
+
+
+def test_committed_lock_covers_every_registered_entrypoint():
+    locked = json.loads(
+        (staticcheck.core.REPO / cost_model.COST_LOCK_REL).read_text()
+    )
+    assert set(locked["entrypoints"]) == set(cost_model.COST_REGISTRY)
+    for name, entry in locked["entrypoints"].items():
+        facts = entry["facts"]
+        for fact in ("collective_payload_bytes", "argument_bytes",
+                     "temp_bytes"):
+            assert fact in facts, (name, fact)
+        ceiling = entry["ceiling"]
+        for fact, fit in facts.items():
+            assert (
+                cost_model.CLASS_RANK[fit["class"]]
+                <= cost_model.CLASS_RANK[ceiling]
+            ), (name, fact, fit["class"])
+
+
+def test_committed_lock_freezes_the_quiescent_round_cost():
+    locked = json.loads(
+        (staticcheck.core.REPO / cost_model.COST_LOCK_REL).read_text()
+    )
+    quiescent = locked["quiescent_round_cost"]
+    assert quiescent["entrypoint"] == "sharded_step"
+    assert quiescent["collective_payload_bytes"] > 0
+    assert quiescent["hot_loop_payload_bytes"] == 0
+    assert locked["ladder_config"] == cost_model._ladder_config()
+
+
+def test_cost_checks_are_registered_and_selectable():
+    new = {"cost-unexplained", "cost-scaling-regression", "cost-superlinear",
+           "cost-quiescent", "cost-lock-drift"}
+    assert new <= set(staticcheck.ALL_CHECK_NAMES)
+    assert any(name == "cost_model" for name, _ in staticcheck.FAMILIES)

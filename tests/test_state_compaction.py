@@ -81,11 +81,14 @@ def test_policy_is_wide_by_default():
 
 def test_policy_picks_minimal_legal_dtypes():
     base = dict(k=10, h=9, l=4, compact=1)
-    # Index width follows N (values live in [-1, n-1]).
-    assert compaction_policy(EngineConfig(n=128, **base)).idx == "int8"
-    assert compaction_policy(EngineConfig(n=129, **base)).idx == "int16"
-    assert compaction_policy(EngineConfig(n=1 << 15, **base)).idx == "int16"
-    assert compaction_policy(EngineConfig(n=(1 << 15) + 1, **base)).idx == "int32"
+    # Index width follows N — and must hold N itself, not just n-1: jax
+    # index normalization materializes the axis size in the index dtype,
+    # so n=128 under int8 overflows at trace time (the cost-model ladder
+    # found exactly that boundary).
+    assert compaction_policy(EngineConfig(n=127, **base)).idx == "int8"
+    assert compaction_policy(EngineConfig(n=128, **base)).idx == "int16"
+    assert compaction_policy(EngineConfig(n=(1 << 15) - 1, **base)).idx == "int16"
+    assert compaction_policy(EngineConfig(n=1 << 15, **base)).idx == "int32"
     # Cohort width follows C.
     assert compaction_policy(EngineConfig(n=256, c=8, **base)).cohort == "int8"
     assert compaction_policy(EngineConfig(n=256, c=512, **base)).cohort == "int16"

@@ -284,22 +284,24 @@ def test_narrowed_roots_skip_liveness(tmp_path, monkeypatch):
 
 def test_whole_tree_is_finding_free():
     # The gate itself: resolution-tier findings fail the build exactly the
-    # way error-prone fails the reference's. All fourteen check families
-    # run — including the compiled-program gate (device_program), whose
-    # entrypoint compiles are collected ONCE per process; pre-warm that
-    # session cache here so this budget pins the ANALYSIS cost, not the
-    # compile cost (tests/test_lint.py budgets the compile-inclusive sweep
+    # way error-prone fails the reference's. All sixteen check families
+    # run — including the compiled-program gate (device_program) and the
+    # ISSUE-18 cost-model ladder (cost_model), whose entrypoint compiles
+    # are collected ONCE per process; pre-warm both session caches here so
+    # this budget pins the ANALYSIS cost, not the compile cost
+    # (tests/test_lint.py budgets the compile-inclusive sweep
     # separately). Process CPU time, not wall-clock: a loaded CI machine
     # must not fail the gate — only an analyzer going superlinear.
     import time
 
     staticcheck.collect_facts()  # session-shared; test_hlo_gate.py pins it
+    staticcheck.collect_ladder()  # session-shared; test_lint.py pins it
     started = time.process_time()
     findings = staticcheck.run()
     elapsed = time.process_time() - started
     assert not findings, "\n".join(str(f) for f in findings)
     assert elapsed < 15.0, (
-        f"fourteen-family tree sweep used {elapsed:.1f}s CPU (budget 15s)"
+        f"sixteen-family tree sweep used {elapsed:.1f}s CPU (budget 15s)"
     )
 
 
@@ -388,6 +390,12 @@ _CORPUS_CHECKERS = {
     # the decoded host-side summaries stay free.
     "trace_unmarked_fetch.py": ("rapid_tpu/serving/_corpus.py", "check_telemetry"),
     "clean_trace_fetch.py": ("rapid_tpu/serving/_corpus.py", "check_telemetry"),
+    # ISSUE 18: the cost-model corpus COMPILES its miniature programs
+    # across the module's inline COST_LADDER and fits each audited fact to
+    # a scaling class — the O(N^2) defect trio (regression past the lock,
+    # ceiling breach, dtype-step refusal) against the linear clean twin.
+    "cost_scaling_regression.py": ("rapid_tpu/models/_corpus.py", "check_cost_model"),
+    "clean_cost_model.py": ("rapid_tpu/models/_corpus.py", "check_cost_model"),
 }
 
 
@@ -824,7 +832,7 @@ def test_cli_json_select_ignore_and_exit_codes(tmp_path):
 
 
 def test_cli_families_lists_all_families():
-    assert len(staticcheck.FAMILIES) == 15
+    assert len(staticcheck.FAMILIES) == 16
     result = _run_cli("--families")
     assert result.returncode == 0
     for name, _description in staticcheck.FAMILIES:

@@ -55,6 +55,14 @@ from . import core
 from .chaosvocab import check_chaosvocab
 from .clocks import CLOCK_DISCIPLINE_PREFIXES, check_clock_injection
 from .concurrency import CONCURRENCY_PREFIXES, check_concurrency
+from .cost_model import (
+    COST_LOCK_REL,
+    check_cost_lock,
+    check_cost_model,
+    collect_ladder,
+    fit_scaling,
+    update_cost_lock,
+)
 from .core import (
     ALL_CHECK_NAMES,
     DEFAULT_ROOTS,
@@ -103,6 +111,7 @@ __all__ = [
     "ALL_CHECK_NAMES",
     "CLOCK_DISCIPLINE_PREFIXES",
     "CONCURRENCY_PREFIXES",
+    "COST_LOCK_REL",
     "DEFAULT_ROOTS",
     "DETERMINISM_PREFIXES",
     "DISPATCH_PREFIXES",
@@ -122,6 +131,8 @@ __all__ = [
     "check_chaosvocab",
     "check_clock_injection",
     "check_concurrency",
+    "check_cost_lock",
+    "check_cost_model",
     "check_dead_definitions",
     "check_determinism",
     "check_device_program",
@@ -138,10 +149,13 @@ __all__ = [
     "check_wire_lock",
     "check_wire_schema",
     "collect_facts",
+    "collect_ladder",
     "core",
+    "fit_scaling",
     "iter_files",
     "main",
     "run",
+    "update_cost_lock",
     "update_hlo_lock",
     "update_wire_lock",
 ]

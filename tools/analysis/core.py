@@ -105,6 +105,12 @@ ALL_CHECK_NAMES = frozenset({
     # chaosvocab family
     "chaos-unknown-kind",
     "chaos-family-drift",
+    # cost_model family (fitted scaling classes vs cost.lock.json)
+    "cost-unexplained",
+    "cost-scaling-regression",
+    "cost-superlinear",
+    "cost-quiescent",
+    "cost-lock-drift",
 })
 
 #: The check families, in documentation order — one (name, description)
@@ -142,6 +148,11 @@ FAMILIES = (
     ("chaosvocab", "chaos vocabulary discipline: FaultEvent kinds, scenario "
                    "FAMILIES, fleet mix tables, and the chaosrun CLI cannot "
                    "drift from the registered registries"),
+    ("cost_model", "scaling-law cost model: every registered entrypoint's "
+                   "compiled facts fitted across N/K/tenant geometry "
+                   "ladders to O(1)/O(log N)/O(N)/O(N*K)/O(N^2) classes "
+                   "and frozen in cost.lock.json (nothing in the round "
+                   "body may exceed O(N*K))"),
 )
 
 
@@ -207,7 +218,7 @@ def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
     # The per-file check imports live here (not module top level) so the
     # CLI shim can import this module before sys.path is fully arranged.
     from . import (
-        chaosvocab, clocks, concurrency, deadcode, determinism,
+        chaosvocab, clocks, concurrency, cost_model, deadcode, determinism,
         device_program, dispatch, ledger, names, sharding, signatures,
         taskflow, telemetry, trace_safety, wire_schema,
     )
@@ -275,6 +286,10 @@ def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
         findings.extend(sharding.check_partition_specs(trees))
         findings.extend(telemetry.check_lane_mirror(trees))
         findings.extend(device_program.check_hlo_lock(trees))
+        # The cost-model ladder runs right after the HLO gate so its base
+        # point rides the collect_facts session cache the gate just paid
+        # for; it presence-gates on the same engine sources.
+        findings.extend(cost_model.check_cost_lock(trees))
     return findings
 
 
@@ -314,6 +329,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "and regenerate tools/analysis/hlo.lock.json "
                              "(refuses while an unknown dtype or an "
                              "unwaived dropped donation is present)")
+    parser.add_argument("--update-cost-lock", action="store_true",
+                        dest="update_cost_lock",
+                        help="refit the geometry ladders and regenerate "
+                             "tools/analysis/cost.lock.json (refuses while "
+                             "any fit is unexplained, any fact exceeds its "
+                             "ceiling, or the hlo.lock differentials "
+                             "disagree)")
     args = parser.parse_args(argv)
     if args.families:
         for name, description in FAMILIES:
@@ -341,6 +363,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             print("staticcheck: refusing to lock a compiled-program surface "
                   "the gate would immediately fail — fix the findings above "
                   "first")
+            return 1
+        print(f"wrote {lock_path}")
+        return 0
+    if args.update_cost_lock:
+        from . import cost_model
+
+        findings, lock_path = cost_model.update_cost_lock()
+        if findings:
+            for f in findings:
+                print(f)
+            print("staticcheck: refusing to lock a scaling surface the gate "
+                  "would immediately fail — fix the findings above first")
             return 1
         print(f"wrote {lock_path}")
         return 0

@@ -360,6 +360,156 @@ def _build_registry() -> "Dict[str, Dict[str, Any]]":
     return registry
 
 
+#: Entrypoints :func:`build_ladder_spec` can rebuild at arbitrary geometry
+#: — the single-device dispatch surface plus the meshless vmapped fleet
+#: step. The cost-model family (tools/analysis/cost_model.py) sweeps these
+#: across its N/K/tenant ladders; the mesh-gated GSPMD entrypoints are
+#: deliberately absent (a ladder of sharded compiles would cost minutes of
+#: every tier-1 session — their base-shape facts still feed the quiescent
+#: cost block via :func:`collect_facts`).
+LADDER_ENTRYPOINTS = (
+    "step",
+    "run_to_decision",
+    "run_until_membership",
+    "sync",
+    "step_compact",
+    "step_telem",
+    "step_trace",
+    "fleet_step",
+)
+
+
+def build_ladder_spec(
+    name: str,
+    n: int,
+    k: int,
+    c: int = AUDIT_C,
+    tenants: Optional[int] = None,
+) -> Dict[str, Any]:
+    """One registry-shaped spec (``{"jit", "args", "donated_leaves"}``) for
+    a single entrypoint at an arbitrary ``(n, k, c)`` geometry — the
+    cost-model ladder plumbing. At the audit geometry this builds exactly
+    what :func:`_build_registry` builds for the same name (the cost ladder
+    reuses the session's :func:`collect_facts` entry for that point instead
+    of recompiling); at every other point the caller compiles fresh via
+    :func:`_compile_program`. ``fleet_step`` is the MESHLESS vmapped
+    :func:`rapid_tpu.tenancy.fleet.fleet_step_impl` over ``tenants``
+    per-tenant clusters of ``n`` slots each — usable without the 8-device
+    mesh, which is what keeps the tenant ladder inside the tier-1 budget."""
+    import jax
+    import jax.numpy as jnp
+
+    if name not in LADDER_ENTRYPOINTS:
+        raise ValueError(f"unknown ladder entrypoint {name!r}")
+
+    from rapid_tpu.models.state import initial_telemetry, initial_trace
+    from rapid_tpu.models.virtual_cluster import (
+        VirtualCluster,
+        engine_step_impl,
+        engine_step_telem_impl,
+        engine_step_trace_impl,
+        run_to_decision_impl,
+        run_until_membership_impl,
+        sync_checksum_impl,
+    )
+
+    if name == "fleet_step":
+        from rapid_tpu.tenancy.fleet import TenantFleet, fleet_step_impl
+
+        clusters = []
+        for i in range(int(tenants or 1)):
+            h, l = ((3, 1), (4, 2))[i % 2]
+            tvc = VirtualCluster.create(
+                n - AUDIT_DEVICES, n_slots=n, k=k, h=h, l=l, fd_threshold=2,
+                cohorts=c, delivery_spread=2, seed=i,
+            )
+            tvc.assign_cohorts_roundrobin()
+            clusters.append(tvc)
+        fleet = TenantFleet.from_clusters(clusters)
+        fcfg = fleet.cfg
+        return {
+            "jit": jax.jit(
+                lambda s, f, kb: fleet_step_impl(fcfg, s, f, kb),
+                donate_argnums=(0,),
+            ),
+            "args": (fleet.state, fleet.faults, fleet.knobs),
+            "donated_leaves": len(jax.tree_util.tree_leaves(fleet.state)),
+        }
+
+    vc = VirtualCluster.create(
+        n - AUDIT_DEVICES, n_slots=n, k=k, h=3, l=1, fd_threshold=2,
+        cohorts=c, delivery_spread=2, seed=0, compact=(name == "step_compact"),
+    )
+    vc.assign_cohorts_roundrobin()
+    cfg, state, faults = vc.cfg, vc.state, vc.faults
+    state_leaves = len(jax.tree_util.tree_leaves(state))
+    if name in ("step", "step_compact"):
+        return {
+            "jit": jax.jit(
+                lambda s, f: engine_step_impl(cfg, s, f), donate_argnums=(0,)
+            ),
+            "args": (state, faults),
+            "donated_leaves": state_leaves,
+        }
+    if name == "run_to_decision":
+        return {
+            "jit": jax.jit(
+                lambda s, f: run_to_decision_impl(cfg, s, f, jnp.int32(96)),
+                donate_argnums=(0,),
+            ),
+            "args": (state, faults),
+            "donated_leaves": state_leaves,
+        }
+    if name == "run_until_membership":
+        return {
+            "jit": jax.jit(
+                lambda s, f: run_until_membership_impl(
+                    cfg, s, f, jnp.int32(n - AUDIT_DEVICES),
+                    jnp.int32(192), 8, jnp.int32(0),
+                ),
+                donate_argnums=(0,),
+            ),
+            "args": (state, faults),
+            "donated_leaves": state_leaves,
+        }
+    if name == "sync":
+        return {
+            "jit": jax.jit(sync_checksum_impl),
+            "args": (state, faults),
+            "donated_leaves": 0,
+        }
+    if name == "step_telem":
+        cfg_t = cfg._replace(telemetry=1)
+        telem = initial_telemetry(cfg_t)
+        return {
+            "jit": jax.jit(
+                lambda s, t, f: engine_step_telem_impl(cfg_t, s, t, f),
+                donate_argnums=(0, 1),
+            ),
+            "args": (state, telem, faults),
+            "donated_leaves": (
+                state_leaves + len(jax.tree_util.tree_leaves(telem))
+            ),
+        }
+    if name == "step_trace":
+        cfg_tr = cfg._replace(telemetry=1, trace=AUDIT_TRACE_R)
+        telem = initial_telemetry(cfg_tr)
+        ring = initial_trace(cfg_tr)
+        return {
+            "jit": jax.jit(
+                lambda s, t, r, f: engine_step_trace_impl(cfg_tr, s, t, r, f),
+                donate_argnums=(0, 1, 2),
+            ),
+            "args": (state, telem, ring, faults),
+            "donated_leaves": (
+                state_leaves
+                + len(jax.tree_util.tree_leaves(telem))
+                + len(jax.tree_util.tree_leaves(ring))
+            ),
+        }
+    raise ValueError(f"unknown ladder entrypoint {name!r}")
+
+
 # -- fact extraction --------------------------------------------------------
 
 
@@ -428,6 +578,12 @@ def extract_facts(
             "reasons": sorted(set(donation_reasons or [])),
         },
         "memory": memory,
+        # Normalized ``compiled.cost_analysis()`` (flops / bytes_accessed
+        # where the backend exposes them, None otherwise — never guessed).
+        # Informational to the HLO lock (facts_to_lock keeps its explicit
+        # key list, so this cannot perturb hlo.lock.json); budget grain for
+        # the cost-model ladder fit (tools/analysis/cost_model.py).
+        "cost": hlo_facts.compiled_cost_analysis(compiled),
         "unknown_dtypes": sorted(set(unknown)),
         "rows": rows,
     }

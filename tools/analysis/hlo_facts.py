@@ -28,12 +28,14 @@ from rapid_tpu.parallel.hlo_facts import (  # noqa: E402,F401 — re-exported
     classify_location,
     collective_groups,
     collective_violations,
+    compiled_cost_analysis,
     count_transfer_ops,
     entry_parameter_bytes,
     groups_cross_blocks,
     input_output_aliases,
     payload_class,
     shape_bytes,
+    shape_operand_bytes,
     source_of,
 )
 
@@ -46,11 +48,13 @@ __all__ = [
     "classify_location",
     "collective_violations",
     "collective_groups",
+    "compiled_cost_analysis",
     "count_transfer_ops",
     "entry_parameter_bytes",
     "groups_cross_blocks",
     "input_output_aliases",
     "payload_class",
     "shape_bytes",
+    "shape_operand_bytes",
     "source_of",
 ]

@@ -36,11 +36,13 @@ runs, and can I trust the numbers". Two input kinds, freely mixed:
   ``recovery_status``), and ``activity-missing`` (same discipline for the
   device telemetry plane: an audited round omitting BOTH
   ``stream_active_fraction`` and ``activity_status`` — a zero-churn soak
-  must publish ``activity=0`` explicitly, never silence). The N1M, FLEET,
-  STREAM, CHAOS, MEM, RECOVERY, and ACTIVITY columns render the headline /
-  fleet / sustained-stream / chaos-throughput / bytes-per-member /
-  resume-MTTR / active-fraction values (or their status markers) per
-  round.
+  must publish ``activity=0`` explicitly, never silence), and
+  ``cost-missing`` (same discipline for the scaling-law cost model: an
+  audited round omitting the ``cost_fit`` table AND its status marker).
+  The N1M, FLEET, STREAM, CHAOS, MEM, RECOVERY, ACTIVITY, and COSTFIT
+  columns render the headline / fleet / sustained-stream /
+  chaos-throughput / bytes-per-member / resume-MTTR / active-fraction /
+  worst-fitted-scaling-class values (or their status markers) per round.
 
 ``--chrome out.json`` additionally writes Chrome trace-event JSON (the same
 envelope tools/traceview.py emits — Perfetto/chrome://tracing load it):
@@ -394,6 +396,13 @@ def point_flags(
         and not data.get("trace_status")
     ):
         flags.append("trace-missing")
+    # Cost-model discipline (ISSUE 18): same rule for the scaling-law
+    # axis — an audited round must carry the cost_fit table (fitted
+    # per-entrypoint scaling classes) or its explicit status marker
+    # (suppressed ladder / unavailable backend). Pre-audit historical
+    # rounds are exempt.
+    if hlo_audit_table(data) is not None and not data.get("cost_fit"):
+        flags.append("cost-missing")
     if hlo_drift(prev, hlo_audit_table(data)):
         flags.append("hlo-drift")
     if not flags:
@@ -533,11 +542,50 @@ def trace_cell(data: Dict[str, Any]) -> str:
     return str(status) if status else "-"
 
 
+#: Scaling-class vocabulary, weakest to strongest — mirrors
+#: tools/analysis/cost_model.CLASSES (perfview stays import-light; the
+#: spelling is part of the bench artifact contract). Classes this tool
+#: does not know sort WORST — a future stronger class must never render
+#: as better than the ones it replaced.
+_COST_CLASS_ORDER = ("O(1)", "O(log N)", "O(N)", "O(N*K)", "O(N^2)")
+
+
+def cost_cell(data: Dict[str, Any]) -> str:
+    """The COSTFIT column: the WORST fitted scaling class across the
+    round's audited entrypoints (with the quiescent round's collective
+    payload beside it when measured), else the explicit cost_fit status
+    marker, else '-' (pre-cost rounds)."""
+    fit = data.get("cost_fit")
+    if isinstance(fit, dict) and "status" in fit:
+        return str(fit["status"])
+    if isinstance(fit, dict) and fit:
+        classes = [
+            cls for per in fit.values() if isinstance(per, dict)
+            for cls in per.values()
+        ]
+        if classes:
+            worst = max(
+                classes,
+                key=lambda cls: (
+                    _COST_CLASS_ORDER.index(cls)
+                    if cls in _COST_CLASS_ORDER else len(_COST_CLASS_ORDER)
+                ),
+            )
+            quiescent = data.get("quiescent_round_cost") or {}
+            payload = quiescent.get("collective_payload_bytes")
+            suffix = (
+                f" q={int(payload)}B" if isinstance(payload, (int, float))
+                else ""
+            )
+            return f"worst={worst}{suffix}"
+    return "-"
+
+
 def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
     lines = ["== perf trajectory =="]
     header = ("ROUND", "METRIC", "VALUE", "N1M", "FLEET", "STREAM", "CHAOS",
-              "MEM", "RECOVERY", "ACTIVITY", "TRACE", "PLATFORM", "VSBASE",
-              "FLAGS")
+              "MEM", "RECOVERY", "ACTIVITY", "TRACE", "COSTFIT", "PLATFORM",
+              "VSBASE", "FLAGS")
     rows: List[Tuple[str, ...]] = []
     flag_rows: List[Tuple[str, List[str]]] = []
     prev_audit: Optional[Dict[str, Any]] = None
@@ -560,6 +608,7 @@ def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
             recovery_cell(data),
             activity_cell(data),
             trace_cell(data),
+            cost_cell(data),
             str(data.get("platform", "-")),
             "-" if vs is None else f"{float(vs):.2f}x"
             + ("@capture" if "vs_baseline_at_capture" in data else ""),

@@ -29,6 +29,7 @@ from analysis import (  # noqa: E402,F401 — re-exported API surface
     ALL_CHECK_NAMES,
     CLOCK_DISCIPLINE_PREFIXES,
     CONCURRENCY_PREFIXES,
+    COST_LOCK_REL,
     DEFAULT_ROOTS,
     DETERMINISM_PREFIXES,
     DISPATCH_PREFIXES,
@@ -48,6 +49,8 @@ from analysis import (  # noqa: E402,F401 — re-exported API surface
     check_chaosvocab,
     check_clock_injection,
     check_concurrency,
+    check_cost_lock,
+    check_cost_model,
     check_dead_definitions,
     check_determinism,
     check_device_program,
@@ -64,9 +67,12 @@ from analysis import (  # noqa: E402,F401 — re-exported API surface
     check_wire_lock,
     check_wire_schema,
     collect_facts,
+    collect_ladder,
+    fit_scaling,
     iter_files,
     main,
     run,
+    update_cost_lock,
     update_hlo_lock,
     update_wire_lock,
 )
@@ -79,6 +85,7 @@ __all__ = [
     "ALL_CHECK_NAMES",
     "CLOCK_DISCIPLINE_PREFIXES",
     "CONCURRENCY_PREFIXES",
+    "COST_LOCK_REL",
     "DEFAULT_ROOTS",
     "DETERMINISM_PREFIXES",
     "DISPATCH_PREFIXES",
@@ -99,6 +106,8 @@ __all__ = [
     "check_chaosvocab",
     "check_clock_injection",
     "check_concurrency",
+    "check_cost_lock",
+    "check_cost_model",
     "check_dead_definitions",
     "check_determinism",
     "check_device_program",
@@ -115,10 +124,13 @@ __all__ = [
     "check_wire_lock",
     "check_wire_schema",
     "collect_facts",
+    "collect_ladder",
     "core",
+    "fit_scaling",
     "iter_files",
     "main",
     "run",
+    "update_cost_lock",
     "update_hlo_lock",
     "update_wire_lock",
 ]
