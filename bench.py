@@ -390,6 +390,51 @@ def cost_report() -> dict:
     return out
 
 
+def dataflow_summary() -> dict:
+    """Jaxpr provenance axis of the trajectory (ISSUE 19), never silently
+    absent: the observer-silence / tenant-isolation verdicts and the
+    sparse-opportunity coverage from the registry trace (compile-free;
+    the byte-pricing join rides the session's ``collect_facts`` compiles
+    the hlo_audit stage already paid). The trace still costs a few
+    seconds, so ``RAPID_TPU_BENCH_DATAFLOW=0`` suppresses it EXPLICITLY
+    for smoke runs — every suppressed or unavailable branch yields a
+    named status, exactly like the cost ladder."""
+    if not _env_int("RAPID_TPU_BENCH_DATAFLOW", 1):
+        return {
+            "dataflow": {"status": "suppressed:RAPID_TPU_BENCH_DATAFLOW=0"}
+        }
+    tools_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    if tools_dir not in sys.path:
+        sys.path.append(tools_dir)
+    try:
+        from analysis import dataflow
+
+        payload, findings = dataflow.collect_dataflow(require_mesh=False)
+    except Exception as exc:  # noqa: BLE001 — strictly observational
+        return {"dataflow": {"status": f"unavailable: {exc}"}}
+    opp = payload["opportunity_map"]
+    tenant = payload["tenant_isolation"]
+    return {
+        "dataflow": {
+            "status": "ok" if not findings else f"findings:{len(findings)}",
+            "observer_silent": all(
+                e["observer_silent"] for e in payload["entrypoints"].values()
+            ),
+            "tenant_isolated": (
+                all(t["proven"] for t in tenant.values()) if tenant else None
+            ),
+            "opportunity_coverage_pct": opp.get("coverage_pct"),
+            "opportunity_claimed_bytes": opp.get("claimed_bytes"),
+            "opportunity_total_bytes": opp.get(
+                "total_collective_payload_bytes"
+            ),
+            **({"opportunity_status": opp["status"]} if "status" in opp else {}),
+            "carry_only_lanes": payload["carry_only_lanes"],
+            **({"findings": [str(f) for f in findings]} if findings else {}),
+        }
+    }
+
+
 # ---------------------------------------------------------------------------
 # The workload (runs inside the watchdogged child, or inline on CPU).
 # ---------------------------------------------------------------------------
@@ -1589,6 +1634,19 @@ def run_workload(ledger, profile_dir=None) -> None:
                 else f"{len(fit)} entrypoints classified"
             )
         )
+        # Jaxpr provenance axis (ISSUE 19): observer-silence and
+        # tenant-isolation verdicts plus the sparse-opportunity coverage,
+        # riding the same stage (the byte join reuses its compiles).
+        with _heartbeat("dataflow trace"):
+            dataflow_fields = dataflow_summary()
+        df = dataflow_fields["dataflow"]
+        _mark(
+            "dataflow: " + (
+                df["status"] if df["status"] != "ok"
+                else f"proofs ok, opportunity map covers "
+                     f"{df['opportunity_coverage_pct']}% of quiescent bytes"
+            )
+        )
 
     # Opt-in jax.profiler capture (--profile DIR): one extra resolved churn
     # under utils/profiling.trace, as its own budgeted stage — TensorBoard/
@@ -1745,6 +1803,11 @@ def run_workload(ledger, profile_dir=None) -> None:
         # named suppressed/unavailable status) — perfview renders the
         # COSTFIT column from these.
         **cost_fields,
+        # Jaxpr dataflow provenance axis (ISSUE 19): proof verdicts + the
+        # sparse-opportunity coverage (or the named suppressed/unavailable
+        # status) — perfview renders the OPPTY column and the
+        # dataflow-missing trust flag from these.
+        **dataflow_fields,
         # Engine-tier provenance for the trajectory: how much compile time
         # this run paid and whether the persistent cache carried it.
         "compiles": engine_compiles["compiles"],

@@ -213,6 +213,16 @@ def source_of(op_name: str) -> str:
         ("gather", "cross-slot gather"),
         ("sort", "sort"),
         ("reduce", "reduction"),
+        # Lowering-artifact spellings: GSPMD re-shards around these ops and
+        # the resulting collectives inherit their op_name leaf. Naming them
+        # keeps the dataflow gate's cost join total — an unnamed source
+        # would land in "other" and the sparse-opportunity map could not
+        # attribute its payload bytes (dataflow.py joins on these labels).
+        ("scatter", "scatter update"),
+        ("concatenate", "concatenate"),
+        ("dynamic_slice", "dynamic slice"),
+        ("squeeze", "reshape"),
+        ("slice", "slice"),
     )
     for needle, label in markers:
         if needle in op_name:

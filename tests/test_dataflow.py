@@ -1,0 +1,235 @@
+"""Proof + unit gate for the jaxpr dataflow provenance family.
+
+The expensive half traces the REAL registry once per session (compile
+free — ``jitted.trace``) and pins the ISSUE-19 acceptance surface: the
+observer-silence and tenant-isolation proofs hold over every registered
+entrypoint, the sparse-opportunity map explains >= 90% of the frozen
+quiescent payload bytes, and the committed ``dataflow.lock.json``
+round-trips byte-identically. The cheap half runs synthetic jaxprs
+through the taint interpreter — most importantly the scan-carry /
+donated-buffer aliasing cases where a union-carry interpreter would
+fabricate influence edges the per-slot fixpoint must not.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import staticcheck  # noqa: E402
+from analysis import dataflow, device_program  # noqa: E402
+from analysis.core import Finding  # noqa: E402
+
+
+def _registry_trees():
+    """The minimal (tree, rel) set that opens the presence gate."""
+    return [(ast.parse(""), src) for src in device_program.REGISTRY_SOURCES]
+
+
+# ---------------------------------------------------------------------------
+# The proofs over the real registry (session-cached trace)
+# ---------------------------------------------------------------------------
+
+
+def test_head_proofs_hold_over_every_registered_entrypoint():
+    payload, findings = staticcheck.collect_dataflow()
+    assert not findings, "\n".join(str(f) for f in findings)
+    registry = set(device_program._build_registry())
+    assert set(payload["entrypoints"]) == registry | {"fleet_step"}
+    for name, entry in payload["entrypoints"].items():
+        assert entry["observer_silent"] is True, name
+    for name, proof in payload["tenant_isolation"].items():
+        assert proof["proven"] is True, name
+        assert proof["mixed_outputs"] == [], name
+        assert proof["axis_rule_fallbacks"] == [], name
+
+
+def test_opportunity_map_explains_the_frozen_quiescent_bytes():
+    payload, _ = staticcheck.collect_dataflow()
+    opp = payload["opportunity_map"]
+    frozen = json.loads(
+        (staticcheck.core.REPO / staticcheck.COST_LOCK_REL).read_text()
+    )
+    assert opp["total_collective_payload_bytes"] == (
+        frozen["quiescent_round_cost"]["collective_payload_bytes"]
+    )
+    assert opp["coverage_pct"] >= 90.0
+    # Every claimed bucket names the mask lane(s) gating its dense ops —
+    # that attribution is what makes the map a work-list, not a listing.
+    for bucket in opp["dense_gated"]:
+        for op in bucket["dense_ops"]:
+            assert op["gated_by"], (bucket, op)
+
+
+def test_carry_only_lanes_reconcile_with_the_deadcode_collector():
+    # The two liveness families must never disagree: every lane the jaxpr
+    # says is carry-only is host-fetched by name (attribute reads,
+    # getattr strings, f-string fields — the deadcode family's collector),
+    # which is exactly why no dataflow-dead-lane finding fires on HEAD.
+    payload, findings = staticcheck.collect_dataflow()
+    referenced = dataflow._tree_reference_names()
+    for lane in payload["carry_only_lanes"]:
+        assert dataflow._field_of(lane) in referenced, lane
+    assert not [f for f in findings if f.check == "dataflow-dead-lane"]
+
+
+def test_committed_lock_matches_the_live_trace():
+    assert staticcheck.check_dataflow_lock(_registry_trees()) == []
+
+
+# ---------------------------------------------------------------------------
+# Lock machinery
+# ---------------------------------------------------------------------------
+
+
+def test_update_dataflow_lock_is_a_deterministic_round_trip(
+    tmp_path, monkeypatch, capsys
+):
+    # Regenerating over an unchanged tree produces the byte-identical
+    # lock, into a REDIRECTED path so the committed file is never
+    # silently overwritten (same discipline as the wire-lock round trip).
+    committed = (
+        staticcheck.core.REPO / staticcheck.DATAFLOW_LOCK_REL
+    ).read_text()
+    target = tmp_path / "dataflow.lock.json"
+    monkeypatch.setattr(dataflow, "DATAFLOW_LOCK_REL", str(target))
+    rc = staticcheck.main(["--update-dataflow-lock"])
+    assert rc == 0
+    assert "wrote" in capsys.readouterr().out
+    assert target.read_text() == committed
+
+
+def test_update_refuses_while_any_proof_fails(tmp_path, monkeypatch):
+    leak = Finding(
+        "tools/analysis/dataflow.lock.json", 1, "dataflow-observer-effect",
+        "observer lane telem.tl_enq influences subject lane state.cuts",
+    )
+    monkeypatch.setattr(
+        dataflow, "collect_dataflow", lambda force=False: ({}, [leak])
+    )
+    target = tmp_path / "dataflow.lock.json"
+    monkeypatch.setattr(dataflow, "DATAFLOW_LOCK_REL", str(target))
+    findings, lock_path = dataflow.update_dataflow_lock()
+    assert lock_path is None and not target.exists()
+    assert [f.check for f in findings] == ["dataflow-observer-effect"]
+    assert findings[0].message.startswith("refusing to freeze: ")
+
+
+def test_lock_drift_is_reported_per_block(tmp_path, monkeypatch):
+    tampered = json.loads(
+        (staticcheck.core.REPO / staticcheck.DATAFLOW_LOCK_REL).read_text()
+    )
+    tampered["carry_only_lanes"] = ["state.no_such_lane"]
+    target = tmp_path / "dataflow.lock.json"
+    target.write_text(json.dumps(tampered, indent=2, sort_keys=True) + "\n")
+    monkeypatch.setattr(dataflow, "DATAFLOW_LOCK_REL", str(target))
+    findings = staticcheck.check_dataflow_lock(_registry_trees())
+    assert [f.check for f in findings] == ["dataflow-lock-drift"]
+    assert "carry_only_lanes" in findings[0].message
+
+
+def test_presence_gate_skips_retargeted_trees():
+    # A tree without the engine sources (a tmp_path unit-test tree) must
+    # never pay a registry trace or compare against the lock.
+    trees = [(ast.parse(""), "some/other/module.py")]
+    assert staticcheck.check_dataflow_lock(trees) == []
+
+
+def test_coverage_floor_and_two_lock_total_are_enforced():
+    opp = {
+        "total_collective_payload_bytes": 100,
+        "coverage_pct": 50.0,
+        "unclaimed": [
+            {"location": "cond", "source": "reduction", "bytes": 50},
+        ],
+    }
+    findings = dataflow._coverage_findings(opp, ("probe", 1))
+    messages = [f.message for f in findings]
+    assert any("does not match the cost lock" in m for m in messages)
+    assert any("floor 90%" in m for m in messages)
+    assert all(f.check == "dataflow-dense-op" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# Taint interpreter: carry aliasing must not fabricate influence edges
+# ---------------------------------------------------------------------------
+
+
+def _out_taints(jitted, args):
+    entry = dataflow._trace_entry("probe", {"jit": jitted, "args": args})
+    n = len(entry["in_labels"])
+    return dataflow._taint_closed(
+        entry["closed"], [frozenset([i]) for i in range(n)]
+    )
+
+
+def test_scan_carry_slots_stay_separate():
+    # carry = (a, b); the body never mixes them. A union-carry
+    # interpreter (one taint set for the whole carry) would report a's
+    # lineage in b_final and vice versa — the per-slot fixpoint must not.
+    def step(carry, x):
+        a, b = carry
+        return (a + 1.0, b * 2.0), b + x
+
+    jitted = jax.jit(lambda a, b, xs: jax.lax.scan(step, (a, b), xs))
+    args = (
+        jnp.float32(0.0),
+        jnp.float32(1.0),
+        jnp.zeros((4,), jnp.float32),
+    )
+    a_final, b_final, ys = _out_taints(jitted, args)
+    assert a_final == frozenset([0])
+    assert b_final == frozenset([1])
+    assert ys == frozenset([1, 2])
+
+
+def test_donated_while_carry_reuse_keeps_slots_apart():
+    # Donated buffers mean the compiled program reuses the carry slots in
+    # place — at the jaxpr level the slots are still distinct variables,
+    # and the fixpoint must keep them apart. The loop counter drives the
+    # predicate, so BOTH data slots legitimately inherit its taint
+    # (iteration count is influence); the data slots must not inherit
+    # each other's.
+    def loop(state):
+        def cond(s):
+            return s[0] < 3
+
+        def body(s):
+            return (s[0] + 1, s[1] + 1.0, s[2] * 2.0)
+
+        return jax.lax.while_loop(cond, body, state)
+
+    jitted = jax.jit(loop, donate_argnums=(0,))
+    args = ((jnp.int32(0), jnp.float32(0.0), jnp.float32(1.0)),)
+    counter, a_final, b_final = _out_taints(jitted, args)
+    assert counter == frozenset([0])
+    assert a_final == frozenset([0, 1])
+    assert b_final == frozenset([0, 2])
+
+
+# ---------------------------------------------------------------------------
+# Corpus mode plumbing (the probes themselves live in the lint corpus)
+# ---------------------------------------------------------------------------
+
+
+def test_corpus_mode_skips_files_without_the_marker(tmp_path):
+    probe = tmp_path / "plain.py"
+    probe.write_text("X = 1\n")
+    assert staticcheck.check_dataflow(probe) == []
+
+
+def test_corpus_mode_reports_a_broken_probe_as_a_finding(tmp_path):
+    probe = tmp_path / "broken_probe.py"
+    probe.write_text(
+        "DATAFLOW_AUDIT_PROGRAMS = {}\nraise RuntimeError('boom')\n"
+    )
+    findings = staticcheck.check_dataflow(probe)
+    assert [f.check for f in findings] == ["dataflow-lock-drift"]
+    assert "failed to execute" in findings[0].message

@@ -552,6 +552,74 @@ def test_trajectory_renders_costfit_column_and_flags_missing(
     assert "cost-missing" not in lines["BENCH_r90"]  # pre-audit history
 
 
+def test_trajectory_renders_oppty_column_and_flags_missing(
+    tmp_path, capsys
+):
+    """ISSUE 19: the jaxpr dataflow provenance axis renders as the OPPTY
+    trajectory column (opportunity-map coverage of the quiescent payload
+    bytes + the proof verdicts) under the same trust discipline as the
+    other axes: an AUDITED round that omits the ``dataflow`` block flags
+    dataflow-missing; pre-provenance historical rounds are exempt."""
+    audit = {"step": {"collectives": 0, "hot_loop_collectives": 0,
+                      "temp_bytes": 10, "donation_dropped": 0}}
+    base = {"n1M_status": "ramped:256", "tenant_fleet_status": "ramped:8x64",
+            "stream_status": "ramped:12x96", "chaos_status": "ramped:12x12",
+            "mem_status": "computed:cpu", "recovery_status": "skipped-budget",
+            "activity_status": "skipped-budget",
+            "trace_status": "skipped-budget",
+            "cost_fit": {"status": "suppressed:RAPID_TPU_BENCH_"
+                                   "COST_LADDER=0"}}
+    points = {
+        # Pre-provenance historical round: exempt (sorts first).
+        "BENCH_r95.json": {"metric": "m", "value": 1.0, "platform": "cpu"},
+        # Audited + proofs + coverage: both render in the column.
+        "BENCH_r96.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, **base,
+                           "dataflow": {
+                               "status": "ok",
+                               "observer_silent": True,
+                               "tenant_isolated": True,
+                               "opportunity_coverage_pct": 99.69,
+                           }},
+        # Audited + explicit suppressed marker (smoke run): status cell,
+        # no flag.
+        "BENCH_r97.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, **base,
+                           "dataflow": {"status":
+                                        "suppressed:RAPID_TPU_BENCH_"
+                                        "DATAFLOW=0"}},
+        # Audited round that silently dropped the provenance axis: flagged.
+        "BENCH_r98.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, **base},
+        # A failed proof must be visible at a glance, never "ok".
+        "BENCH_r99.json": {"metric": "m", "value": 1.0, "platform": "cpu",
+                           "hlo_audit": audit, **base,
+                           "dataflow": {
+                               "status": "findings:1",
+                               "observer_silent": False,
+                               "tenant_isolated": True,
+                               "opportunity_coverage_pct": 95.0,
+                           }},
+    }
+    paths = []
+    for name, data in points.items():
+        p = tmp_path / name
+        p.write_text(json.dumps(data))
+        paths.append(str(p))
+    assert perfview.main(paths) == 0
+    out = capsys.readouterr().out
+    assert "OPPTY" in out.splitlines()[1]  # the trajectory header row
+    lines = {line.split()[0]: line for line in out.splitlines()
+             if line.startswith("BENCH_r9")}
+    assert "100%/ok" in lines["BENCH_r96"]
+    assert "dataflow-missing" not in lines["BENCH_r96"]
+    assert "suppressed:RAPID_TPU_BENCH_DATAFLOW=0" in lines["BENCH_r97"]
+    assert "dataflow-missing" not in lines["BENCH_r97"]
+    assert "dataflow-missing" in lines["BENCH_r98"]
+    assert "dataflow-missing" not in lines["BENCH_r95"]  # pre-provenance
+    assert "95%/LEAK" in lines["BENCH_r99"]
+
+
 def test_chrome_trace_envelope(tmp_path, capsys):
     path = _complete_ledger(tmp_path)
     chrome_path = tmp_path / "trace.json"

@@ -272,6 +272,27 @@ def test_dead_definition_liveness_channels(tmp_path):
     assert _dead_defs(tmp_path) == []
 
 
+def test_dead_definition_sees_getattr_and_fstring_references(tmp_path):
+    # ISSUE 19 regression: a definition consumed only via
+    # getattr(obj, "name") or named inside an f-string fragment is live —
+    # the dataflow family's dead-lane check proves such lanes reachable,
+    # and the two families must never disagree on liveness.
+    (tmp_path / "mod.py").write_text(textwrap.dedent(
+        '''
+        def fd_hist_decode(): return 1
+        def config_digest(): return 2
+        def truly_dead(): return 3
+        def probe(state, name):
+            handler = getattr(state, "fd_hist_decode")
+            return f"lane config_digest={handler(name)}"
+        print(probe)
+        '''
+    ))
+    assert sorted(f.message for f in _dead_defs(tmp_path)) == [
+        "module-level 'truly_dead' is referenced nowhere in the tree",
+    ]
+
+
 def test_narrowed_roots_skip_liveness(tmp_path, monkeypatch):
     # A per-file/per-dir CLI run must not report cross-root consumers'
     # definitions as dead: liveness only runs on full-tree invocations.
@@ -284,10 +305,11 @@ def test_narrowed_roots_skip_liveness(tmp_path, monkeypatch):
 
 def test_whole_tree_is_finding_free():
     # The gate itself: resolution-tier findings fail the build exactly the
-    # way error-prone fails the reference's. All sixteen check families
-    # run — including the compiled-program gate (device_program) and the
-    # ISSUE-18 cost-model ladder (cost_model), whose entrypoint compiles
-    # are collected ONCE per process; pre-warm both session caches here so
+    # way error-prone fails the reference's. All seventeen check families
+    # run — including the compiled-program gate (device_program), the
+    # ISSUE-18 cost-model ladder (cost_model), and the ISSUE-19 jaxpr
+    # provenance gate (dataflow), whose entrypoint compiles/traces are
+    # collected ONCE per process; pre-warm the session caches here so
     # this budget pins the ANALYSIS cost, not the compile cost
     # (tests/test_lint.py budgets the compile-inclusive sweep
     # separately). Process CPU time, not wall-clock: a loaded CI machine
@@ -296,12 +318,13 @@ def test_whole_tree_is_finding_free():
 
     staticcheck.collect_facts()  # session-shared; test_hlo_gate.py pins it
     staticcheck.collect_ladder()  # session-shared; test_lint.py pins it
+    staticcheck.collect_dataflow()  # session-shared; test_dataflow.py pins it
     started = time.process_time()
     findings = staticcheck.run()
     elapsed = time.process_time() - started
     assert not findings, "\n".join(str(f) for f in findings)
     assert elapsed < 15.0, (
-        f"sixteen-family tree sweep used {elapsed:.1f}s CPU (budget 15s)"
+        f"seventeen-family tree sweep used {elapsed:.1f}s CPU (budget 15s)"
     )
 
 
@@ -396,6 +419,12 @@ _CORPUS_CHECKERS = {
     # ceiling breach, dtype-step refusal) against the linear clean twin.
     "cost_scaling_regression.py": ("rapid_tpu/models/_corpus.py", "check_cost_model"),
     "clean_cost_model.py": ("rapid_tpu/models/_corpus.py", "check_cost_model"),
+    # ISSUE 19: the dataflow corpus TRACES its miniature programs (no
+    # compile) and runs the jaxpr provenance proofs over each — observer
+    # feedback, a cross-tenant gather, and a mask-gated dense round body
+    # against the silent clean twin.
+    "dataflow_observer_leak.py": ("rapid_tpu/models/_corpus.py", "check_dataflow"),
+    "clean_dataflow.py": ("rapid_tpu/models/_corpus.py", "check_dataflow"),
 }
 
 
@@ -832,7 +861,7 @@ def test_cli_json_select_ignore_and_exit_codes(tmp_path):
 
 
 def test_cli_families_lists_all_families():
-    assert len(staticcheck.FAMILIES) == 16
+    assert len(staticcheck.FAMILIES) == 17
     result = _run_cli("--families")
     assert result.returncode == 0
     for name, _description in staticcheck.FAMILIES:

@@ -72,6 +72,13 @@ from .core import (
     main,
     run,
 )
+from .dataflow import (
+    DATAFLOW_LOCK_REL,
+    check_dataflow,
+    check_dataflow_lock,
+    collect_dataflow,
+    update_dataflow_lock,
+)
 from .deadcode import check_dead_definitions
 from .determinism import DETERMINISM_PREFIXES, check_determinism
 from .device_program import (
@@ -112,6 +119,7 @@ __all__ = [
     "CLOCK_DISCIPLINE_PREFIXES",
     "CONCURRENCY_PREFIXES",
     "COST_LOCK_REL",
+    "DATAFLOW_LOCK_REL",
     "DEFAULT_ROOTS",
     "DETERMINISM_PREFIXES",
     "DISPATCH_PREFIXES",
@@ -133,6 +141,8 @@ __all__ = [
     "check_concurrency",
     "check_cost_lock",
     "check_cost_model",
+    "check_dataflow",
+    "check_dataflow_lock",
     "check_dead_definitions",
     "check_determinism",
     "check_device_program",
@@ -148,6 +158,7 @@ __all__ = [
     "check_undefined_names",
     "check_wire_lock",
     "check_wire_schema",
+    "collect_dataflow",
     "collect_facts",
     "collect_ladder",
     "core",
@@ -156,6 +167,7 @@ __all__ = [
     "main",
     "run",
     "update_cost_lock",
+    "update_dataflow_lock",
     "update_hlo_lock",
     "update_wire_lock",
 ]

@@ -111,6 +111,12 @@ ALL_CHECK_NAMES = frozenset({
     "cost-superlinear",
     "cost-quiescent",
     "cost-lock-drift",
+    # dataflow family (jaxpr lane provenance vs dataflow.lock.json)
+    "dataflow-observer-effect",
+    "dataflow-cross-tenant",
+    "dataflow-dense-op",
+    "dataflow-dead-lane",
+    "dataflow-lock-drift",
 })
 
 #: The check families, in documentation order — one (name, description)
@@ -153,6 +159,13 @@ FAMILIES = (
                    "ladders to O(1)/O(log N)/O(N)/O(N*K)/O(N^2) classes "
                    "and frozen in cost.lock.json (nothing in the round "
                    "body may exceed O(N*K))"),
+    ("dataflow", "jaxpr dataflow provenance: per-lane taint over every "
+                 "registered entrypoint's closed jaxpr, proving observer "
+                 "silence (telemetry/trace lanes never influence engine "
+                 "lanes) and fleet tenant isolation, plus the "
+                 "sparse-opportunity map of mask-gated dense round-body "
+                 "ops priced against the quiescent payload bytes — all "
+                 "frozen in dataflow.lock.json"),
 )
 
 
@@ -218,9 +231,9 @@ def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
     # The per-file check imports live here (not module top level) so the
     # CLI shim can import this module before sys.path is fully arranged.
     from . import (
-        chaosvocab, clocks, concurrency, cost_model, deadcode, determinism,
-        device_program, dispatch, ledger, names, sharding, signatures,
-        taskflow, telemetry, trace_safety, wire_schema,
+        chaosvocab, clocks, concurrency, cost_model, dataflow, deadcode,
+        determinism, device_program, dispatch, ledger, names, sharding,
+        signatures, taskflow, telemetry, trace_safety, wire_schema,
     )
 
     per_file_checks = [
@@ -290,6 +303,10 @@ def run(roots: Sequence[str] = DEFAULT_ROOTS) -> List[Finding]:
         # point rides the collect_facts session cache the gate just paid
         # for; it presence-gates on the same engine sources.
         findings.extend(cost_model.check_cost_lock(trees))
+        # The dataflow provenance gate traces (no compile) the same
+        # registry and prices its opportunity map off the facts the two
+        # gates above already cached; same presence gate, same session.
+        findings.extend(dataflow.check_dataflow_lock(trees))
     return findings
 
 
@@ -336,6 +353,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "any fit is unexplained, any fact exceeds its "
                              "ceiling, or the hlo.lock differentials "
                              "disagree)")
+    parser.add_argument("--update-dataflow-lock", action="store_true",
+                        dest="update_dataflow_lock",
+                        help="retrace the registered entrypoints and "
+                             "regenerate tools/analysis/dataflow.lock.json "
+                             "(refuses while any provenance proof fails: "
+                             "an observer leak, a cross-tenant edge, a "
+                             "dead lane, or an opportunity map under the "
+                             "90% coverage floor)")
     args = parser.parse_args(argv)
     if args.families:
         for name, description in FAMILIES:
@@ -375,6 +400,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f)
             print("staticcheck: refusing to lock a scaling surface the gate "
                   "would immediately fail — fix the findings above first")
+            return 1
+        print(f"wrote {lock_path}")
+        return 0
+    if args.update_dataflow_lock:
+        from . import dataflow as dataflow_mod
+
+        findings, lock_path = dataflow_mod.update_dataflow_lock()
+        if findings:
+            for f in findings:
+                print(f)
+            print("staticcheck: refusing to lock a provenance surface the "
+                  "gate would immediately fail — fix the findings above "
+                  "first")
             return 1
         print(f"wrote {lock_path}")
         return 0

@@ -31,10 +31,15 @@ def _collect_definitions(tree: ast.AST, rel: str):
 def _collect_references(tree: ast.AST) -> set:
     """Every way a module-level definition can be consumed: name loads,
     attribute accesses, function parameter names (pytest fixtures are used
-    by naming them as parameters), and identifiers inside CODE-LOOKING
-    string constants (multi-line or call-shaped — subprocess job scripts,
-    ``python -c`` payloads). Single-word strings deliberately do NOT count:
-    an ``__all__`` entry must not keep an otherwise-unreferenced export
+    by naming them as parameters), ``getattr``/``setattr``/``hasattr``/
+    ``delattr`` with a literal field name (dynamic lane access is still
+    access — the dataflow family's dead-lane check and this one must
+    never disagree on liveness), identifiers inside f-string fragments
+    (a lane named in a debug label is consumed by whoever reads the
+    label), and identifiers inside CODE-LOOKING string constants
+    (multi-line or call-shaped — subprocess job scripts, ``python -c``
+    payloads). Other single-word strings deliberately do NOT count: an
+    ``__all__`` entry must not keep an otherwise-unreferenced export
     alive — re-export padding is exactly what this check exists to catch.
 
     A module-level definition's OWN subtree never contributes its own name:
@@ -54,6 +59,22 @@ def _collect_references(tree: ast.AST) -> set:
             a = node.args
             for arg in [*a.posonlyargs, *a.args, *a.kwonlyargs]:
                 refs.add(arg.arg)
+        elif isinstance(node, ast.Call):
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("getattr", "setattr", "hasattr", "delattr")
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+                and node.args[1].value != self_name
+            ):
+                refs.add(node.args[1].value)
+        elif isinstance(node, ast.JoinedStr):
+            for frag in node.values:
+                if isinstance(frag, ast.Constant) and isinstance(frag.value, str):
+                    refs.update(
+                        w for w in _IDENT.findall(frag.value) if w != self_name
+                    )
         elif isinstance(node, ast.Constant) and isinstance(node.value, str):
             if "\n" in node.value or "(" in node.value:
                 refs.update(w for w in _IDENT.findall(node.value) if w != self_name)

@@ -403,6 +403,13 @@ def point_flags(
     # rounds are exempt.
     if hlo_audit_table(data) is not None and not data.get("cost_fit"):
         flags.append("cost-missing")
+    # Dataflow-provenance discipline (ISSUE 19): same rule for the jaxpr
+    # proof axis — an audited round must carry the dataflow block (proof
+    # verdicts + opportunity coverage, or its explicit suppressed/
+    # unavailable status inside it). Pre-provenance historical rounds are
+    # exempt.
+    if hlo_audit_table(data) is not None and not data.get("dataflow"):
+        flags.append("dataflow-missing")
     if hlo_drift(prev, hlo_audit_table(data)):
         flags.append("hlo-drift")
     if not flags:
@@ -581,11 +588,31 @@ def cost_cell(data: Dict[str, Any]) -> str:
     return "-"
 
 
+def oppty_cell(data: Dict[str, Any]) -> str:
+    """The OPPTY column: the sparse-opportunity map's coverage of the
+    quiescent payload bytes with the proof verdicts beside it (ok = both
+    observer-silence and tenant-isolation proven), else the explicit
+    dataflow status marker, else '-' (pre-provenance rounds)."""
+    df = data.get("dataflow")
+    if not isinstance(df, dict):
+        return "-"
+    coverage = df.get("opportunity_coverage_pct")
+    if isinstance(coverage, (int, float)):
+        proofs = (
+            "ok" if df.get("observer_silent")
+            and df.get("tenant_isolated") is not False
+            else "LEAK"
+        )
+        return f"{float(coverage):.0f}%/{proofs}"
+    status = df.get("opportunity_status") or df.get("status")
+    return str(status) if status else "-"
+
+
 def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
     lines = ["== perf trajectory =="]
     header = ("ROUND", "METRIC", "VALUE", "N1M", "FLEET", "STREAM", "CHAOS",
-              "MEM", "RECOVERY", "ACTIVITY", "TRACE", "COSTFIT", "PLATFORM",
-              "VSBASE", "FLAGS")
+              "MEM", "RECOVERY", "ACTIVITY", "TRACE", "COSTFIT", "OPPTY",
+              "PLATFORM", "VSBASE", "FLAGS")
     rows: List[Tuple[str, ...]] = []
     flag_rows: List[Tuple[str, List[str]]] = []
     prev_audit: Optional[Dict[str, Any]] = None
@@ -609,6 +636,7 @@ def render_trajectory(points: List[Tuple[str, Dict[str, Any]]]) -> str:
             activity_cell(data),
             trace_cell(data),
             cost_cell(data),
+            oppty_cell(data),
             str(data.get("platform", "-")),
             "-" if vs is None else f"{float(vs):.2f}x"
             + ("@capture" if "vs_baseline_at_capture" in data else ""),

@@ -30,6 +30,7 @@ from analysis import (  # noqa: E402,F401 — re-exported API surface
     CLOCK_DISCIPLINE_PREFIXES,
     CONCURRENCY_PREFIXES,
     COST_LOCK_REL,
+    DATAFLOW_LOCK_REL,
     DEFAULT_ROOTS,
     DETERMINISM_PREFIXES,
     DISPATCH_PREFIXES,
@@ -51,6 +52,8 @@ from analysis import (  # noqa: E402,F401 — re-exported API surface
     check_concurrency,
     check_cost_lock,
     check_cost_model,
+    check_dataflow,
+    check_dataflow_lock,
     check_dead_definitions,
     check_determinism,
     check_device_program,
@@ -66,6 +69,7 @@ from analysis import (  # noqa: E402,F401 — re-exported API surface
     check_undefined_names,
     check_wire_lock,
     check_wire_schema,
+    collect_dataflow,
     collect_facts,
     collect_ladder,
     fit_scaling,
@@ -73,6 +77,7 @@ from analysis import (  # noqa: E402,F401 — re-exported API surface
     main,
     run,
     update_cost_lock,
+    update_dataflow_lock,
     update_hlo_lock,
     update_wire_lock,
 )
@@ -86,6 +91,7 @@ __all__ = [
     "CLOCK_DISCIPLINE_PREFIXES",
     "CONCURRENCY_PREFIXES",
     "COST_LOCK_REL",
+    "DATAFLOW_LOCK_REL",
     "DEFAULT_ROOTS",
     "DETERMINISM_PREFIXES",
     "DISPATCH_PREFIXES",
@@ -108,6 +114,8 @@ __all__ = [
     "check_concurrency",
     "check_cost_lock",
     "check_cost_model",
+    "check_dataflow",
+    "check_dataflow_lock",
     "check_dead_definitions",
     "check_determinism",
     "check_device_program",
@@ -123,6 +131,7 @@ __all__ = [
     "check_undefined_names",
     "check_wire_lock",
     "check_wire_schema",
+    "collect_dataflow",
     "collect_facts",
     "collect_ladder",
     "core",
@@ -131,6 +140,7 @@ __all__ = [
     "main",
     "run",
     "update_cost_lock",
+    "update_dataflow_lock",
     "update_hlo_lock",
     "update_wire_lock",
 ]
